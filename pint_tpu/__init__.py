@@ -1,0 +1,31 @@
+"""pint_tpu — a TPU-native pulsar-timing framework.
+
+A from-scratch reimplementation of the capabilities of PINT
+(reference: ktzhao/PINT, a fork of nanograv/PINT; see SURVEY.md) designed
+for JAX/XLA on TPU rather than ported from the numpy/astropy original:
+
+* PINT's ``numpy.longdouble`` time arithmetic -> double-double (hi/lo
+  float64 pairs, :mod:`pint_tpu.ops.dd`) evaluated on IEEE-exact CPU
+  backends, with the heavy linear algebra (design matrices, GLS solves)
+  linearized into plain float64 on the TPU's MXU.
+* PINT's hand-coded analytic parameter derivatives
+  (``TimingModel.d_phase_d_param``; reference src/pint/models/timing_model.py)
+  -> ``jax.jacfwd`` over pure phase functions.
+* PINT's single-core per-component Python loops -> pure functions composed
+  once, ``vmap``-ed over the TOA axis, and ``pjit``-ed with the TOA axis
+  sharded over a device mesh (:mod:`pint_tpu.parallel`).
+
+Numerical precision contract: every time-like quantity that must hold
+nanosecond precision over multi-decade baselines (~1e-18 relative) is a
+double-double; everything else (delays < ~1e4 s, design-matrix entries,
+covariances) is float64.
+"""
+
+import jax as _jax
+
+# The whole framework assumes 64-bit floats; enable before anything traces.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from pint_tpu.ops import dd  # noqa: E402,F401

@@ -1,0 +1,6 @@
+"""Bundled runtime data tables (leap seconds, TDB series, observatories).
+
+Mirrors the role of the reference's ``src/pint/data/runtime/`` directory
+(observatories.json, ecliptic.dat, ...) but shipped as Python modules so
+they are importable with zero file IO and fully offline.
+"""

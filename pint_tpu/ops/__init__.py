@@ -1,0 +1,6 @@
+"""Numeric primitives: double-double arithmetic, phase containers, time scales."""
+
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+
+__all__ = ["dd", "DD"]

@@ -1,0 +1,439 @@
+"""Double-double (hi/lo float64 pair) arithmetic for JAX.
+
+This module replaces ``numpy.longdouble`` in the reference design
+(PINT keeps all TOA MJDs and pulse phases in 80-bit extended precision;
+reference src/pint/pulsar_mjd.py and src/pint/phase.py). TPUs have no
+long double, and x86 extended precision does not exist on any accelerator,
+so the framework represents every precision-critical scalar as an
+unevaluated sum ``hi + lo`` of two float64 with ``|lo| <= ulp(hi)/2``.
+That gives ~106 bits of significand (~1e-32 relative), comfortably beyond
+the ~1e-18 needed for 1 ns over 30 years.
+
+Correctness rests on *error-free transforms* (Knuth TwoSum, Dekker split /
+TwoProd), which require IEEE-754 correctly-rounded float64 add/sub/mul.
+
+.. warning::
+   Empirically (checked at framework bring-up; see ``self_check``):
+
+   * XLA **CPU** is bit-identical to numpy IEEE float64 — error-free
+     transforms hold under ``jit``.
+   * XLA **TPU** float64 emulation is *not* correctly rounded (1-2 ulp
+     errors on plain add), so TwoSum/TwoProd error terms are garbage there.
+
+   Therefore all DD computation must be placed on CPU devices (see
+   :func:`pint_tpu.parallel.mesh.cpu_device`); the TPU consumes only
+   collapsed float64 values whose errors are multiplied by small parameter
+   deltas (design matrices, GLS linear algebra). ``self_check()`` verifies
+   the invariants on whichever backend it runs.
+
+All functions are shape-polymorphic, jit-safe, and vmap-safe; ``DD`` is a
+NamedTuple and hence a pytree.
+"""
+
+from __future__ import annotations
+
+import operator
+from decimal import Decimal, getcontext
+from fractions import Fraction
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+# Dekker splitter for binary64: 2^27 + 1.
+_SPLITTER = 134217729.0
+
+
+class DD(NamedTuple):
+    """Unevaluated sum hi + lo of two float64; |lo| <= ulp(hi)/2 when normalized."""
+
+    hi: Array
+    lo: Array
+
+    # -- convenience operator sugar (pure functions below do the work) --
+    def __add__(self, other):
+        return add(self, other)
+
+    def __radd__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return sub(self, other)
+
+    def __rsub__(self, other):
+        return sub(_coerce(other), self)
+
+    def __mul__(self, other):
+        return mul(self, other)
+
+    def __rmul__(self, other):
+        return mul(self, other)
+
+    def __truediv__(self, other):
+        return div(self, other)
+
+    def __rtruediv__(self, other):
+        return div(_coerce(other), self)
+
+    def __neg__(self):
+        return DD(-self.hi, -self.lo)
+
+    @property
+    def shape(self):
+        return jnp.shape(self.hi)
+
+    @property
+    def dtype(self):
+        return jnp.asarray(self.hi).dtype
+
+    def __getitem__(self, idx):
+        return DD(self.hi[idx], self.lo[idx])
+
+    def astype_f64(self) -> Array:
+        """Collapse to a single float64 (loses the low word)."""
+        return self.hi + self.lo
+
+
+DDLike = Union[DD, Array, float, int, np.ndarray]
+
+
+def _coerce(x: DDLike) -> DD:
+    if isinstance(x, DD):
+        return x
+    x = jnp.asarray(x, dtype=jnp.float64)
+    return DD(x, jnp.zeros_like(x))
+
+
+# ---------------------------------------------------------------------------
+# Error-free transforms
+# ---------------------------------------------------------------------------
+
+
+def two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Knuth TwoSum: s + err == a + b exactly (6 flops, branch-free)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def quick_two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Fast TwoSum requiring |a| >= |b| (or a == 0)."""
+    s = a + b
+    err = b - (s - a)
+    return s, err
+
+
+def split(a: Array) -> tuple[Array, Array]:
+    """Dekker split: a == hi + lo with hi, lo having <= 26/27-bit significands."""
+    t = _SPLITTER * a
+    hi = t - (t - a)
+    lo = a - hi
+    return hi, lo
+
+
+def two_prod(a: Array, b: Array) -> tuple[Array, Array]:
+    """Dekker TwoProd: p + err == a * b exactly (IEEE multiply required)."""
+    p = a * b
+    ahi, alo = split(a)
+    bhi, blo = split(b)
+    err = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
+    return p, err
+
+
+# ---------------------------------------------------------------------------
+# Construction / conversion
+# ---------------------------------------------------------------------------
+
+
+def from_f64(x) -> DD:
+    """Lift float64 array (exact) into DD."""
+    x = jnp.asarray(x, dtype=jnp.float64)
+    return DD(x, jnp.zeros_like(x))
+
+
+def from_sum(a, b) -> DD:
+    """DD representing a + b exactly, for float64 a, b."""
+    a = jnp.asarray(a, dtype=jnp.float64)
+    b = jnp.asarray(b, dtype=jnp.float64)
+    return DD(*two_sum(a, b))
+
+
+def normalize(x: DD) -> DD:
+    """Renormalize so |lo| <= ulp(hi)/2."""
+    return DD(*quick_two_sum(*two_sum(x.hi, x.lo)))
+
+
+def from_string(s: str) -> DD:
+    """Parse a decimal string into DD *exactly* (host-side, not jittable).
+
+    This is how par/tim files feed the framework: PINT reads MJDs and F0
+    with up to ~20 significant digits into longdouble (reference
+    src/pint/pulsar_mjd.py :: str2longdouble); we split the exact decimal
+    value into hi = round(x), lo = round(x - hi) via Fraction arithmetic.
+    """
+    s = s.strip().replace("D", "e").replace("d", "e")
+    try:
+        frac = Fraction(Decimal(s))
+    except Exception as exc:
+        raise ValueError(f"not a decimal number: {s!r}") from exc
+    hi = float(frac)
+    lo = float(frac - Fraction(hi))
+    return DD(jnp.asarray(hi, jnp.float64), jnp.asarray(lo, jnp.float64))
+
+
+def from_strings(strings) -> DD:
+    """Vector version of :func:`from_string` -> DD of shape (n,)."""
+    his = np.empty(len(strings), dtype=np.float64)
+    los = np.empty(len(strings), dtype=np.float64)
+    for i, s in enumerate(strings):
+        s = str(s).strip().replace("D", "e").replace("d", "e")
+        try:
+            frac = Fraction(Decimal(s))
+        except Exception as exc:
+            raise ValueError(f"not a decimal number: {s!r}") from exc
+        hi = float(frac)
+        his[i] = hi
+        los[i] = float(frac - Fraction(hi))
+    return DD(jnp.asarray(his), jnp.asarray(los))
+
+
+def to_string(x: DD, ndigits: int = 25) -> str:
+    """Render a scalar DD to a decimal string with `ndigits` significant digits."""
+    getcontext().prec = max(ndigits, 40)
+    val = Decimal(float(np.asarray(x.hi))) + Decimal(float(np.asarray(x.lo)))
+    getcontext().prec = ndigits
+    return str(+val)
+
+
+def to_longdouble(x: DD) -> np.ndarray:
+    """Host-side conversion to numpy longdouble (for tests/interop)."""
+    return np.asarray(jax.device_get(x.hi), np.longdouble) + np.asarray(
+        jax.device_get(x.lo), np.longdouble
+    )
+
+
+def from_longdouble(x) -> DD:
+    """Host-side conversion from numpy longdouble (exact for 80-bit x86)."""
+    x = np.asarray(x, np.longdouble)
+    hi = np.asarray(x, np.float64)
+    lo = np.asarray(x - np.asarray(hi, np.longdouble), np.float64)
+    return DD(jnp.asarray(hi), jnp.asarray(lo))
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic
+# ---------------------------------------------------------------------------
+
+
+def add(x: DDLike, y: DDLike) -> DD:
+    """Full-precision DD addition (IEEE TwoSum cascade)."""
+    x, y = _coerce(x), _coerce(y)
+    s, e = two_sum(x.hi, y.hi)
+    t, f = two_sum(x.lo, y.lo)
+    e = e + t
+    s, e = quick_two_sum(s, e)
+    e = e + f
+    return DD(*quick_two_sum(s, e))
+
+
+def sub(x: DDLike, y: DDLike) -> DD:
+    y = _coerce(y)
+    return add(x, DD(-y.hi, -y.lo))
+
+
+def mul(x: DDLike, y: DDLike) -> DD:
+    x, y = _coerce(x), _coerce(y)
+    p, e = two_prod(x.hi, y.hi)
+    e = e + (x.hi * y.lo + x.lo * y.hi)
+    return DD(*quick_two_sum(p, e))
+
+
+def div(x: DDLike, y: DDLike) -> DD:
+    x, y = _coerce(x), _coerce(y)
+    q1 = x.hi / y.hi
+    r = sub(x, mul(y, q1))
+    q2 = r.hi / y.hi
+    r = sub(r, mul(y, q2))
+    q3 = r.hi / y.hi
+    q, e = quick_two_sum(q1, q2)
+    return DD(*quick_two_sum(q, e + q3))
+
+
+def scale_pow2(x: DD, k: float) -> DD:
+    """Multiply by an exact power of two (error-free)."""
+    return DD(x.hi * k, x.lo * k)
+
+
+def neg(x: DD) -> DD:
+    return DD(-x.hi, -x.lo)
+
+
+def abs_(x: DD) -> DD:
+    sgn = jnp.where(x.hi < 0, -1.0, 1.0)
+    return DD(x.hi * sgn, x.lo * sgn)
+
+
+def sqr(x: DD) -> DD:
+    return mul(x, x)
+
+
+# ---------------------------------------------------------------------------
+# Rounding / modular ops (the phase-wrapping workhorses)
+# ---------------------------------------------------------------------------
+
+
+def floor(x: DD) -> DD:
+    """floor(hi+lo) as DD (exact)."""
+    fh = jnp.floor(x.hi)
+    # if hi is integral the low word decides whether we've already passed floor
+    fl = jnp.where(fh == x.hi, jnp.floor(x.lo), 0.0)
+    return DD(*quick_two_sum(fh, fl))
+
+
+def round_half_even_int(x: DD) -> Array:
+    """Round to nearest integer (ties arbitrary at DD precision), as float64.
+
+    Only valid when |x| < 2^52 so the result fits a float64 exactly.
+    """
+    r = jnp.round(x.hi)
+    d = (x.hi - r) + x.lo  # exact when |x.hi - r| <= 0.5
+    r = r + jnp.round(d)
+    # one correction pass for |d| straddling 0.5
+    rem = (x.hi - r) + x.lo
+    r = r + jnp.where(rem > 0.5, 1.0, 0.0) - jnp.where(rem < -0.5, 1.0, 0.0)
+    return r
+
+
+def split_int_frac(x: DD) -> tuple[Array, DD]:
+    """Split into (nearest integer as float64, fractional DD in [-0.5, 0.5])."""
+    n = round_half_even_int(x)
+    f = add(DD(x.hi - n, jnp.zeros_like(x.hi)), DD(x.lo, jnp.zeros_like(x.lo)))
+    # x.hi - n is exact (both near each other), so f = (x.hi-n) + x.lo exactly
+    return n, f
+
+
+def sum_(x: DD) -> DD:
+    """Compensated sum of a DD array -> scalar DD (Kahan-style over pairs)."""
+
+    def body(carry, xi):
+        return add(carry, DD(xi[0], xi[1])), None
+
+    stacked = jnp.stack([x.hi.ravel(), x.lo.ravel()], axis=-1)
+    init = DD(jnp.asarray(0.0, x.hi.dtype), jnp.asarray(0.0, x.hi.dtype))
+    out, _ = jax.lax.scan(body, init, stacked)
+    return out
+
+
+def dot_f64(a: Array, x: DD) -> DD:
+    """Precise dot product of float64 vector with DD vector."""
+    prods = mul(from_f64(a), x)
+    return sum_(prods)
+
+
+# comparisons (on normalized inputs)
+def _cmp(x: DDLike, y: DDLike, op) -> Array:
+    x, y = _coerce(x), _coerce(y)
+    d = sub(x, y)
+    z = d.hi + d.lo
+    return op(z, 0.0) if op is not operator.eq else (d.hi == 0.0) & (d.lo == 0.0)
+
+
+def lt(x, y):
+    return _cmp(x, y, operator.lt)
+
+
+def le(x, y):
+    return _cmp(x, y, operator.le)
+
+
+def gt(x, y):
+    return _cmp(x, y, operator.gt)
+
+
+def ge(x, y):
+    return _cmp(x, y, operator.ge)
+
+
+def eq(x, y):
+    return _cmp(x, y, operator.eq)
+
+
+# ---------------------------------------------------------------------------
+# Elementary functions (DD-accurate where the framework needs them)
+# ---------------------------------------------------------------------------
+
+
+def polyval(coeffs: list[DD], x: DD) -> DD:
+    """Horner evaluation with DD coefficients and DD argument."""
+    acc = coeffs[0]
+    for c in coeffs[1:]:
+        acc = add(mul(acc, x), c)
+    return acc
+
+
+_TWO_PI = from_string("6.283185307179586476925286766559005768")
+_PI = from_string("3.1415926535897932384626433832795028842")
+
+
+def sin2pi(x: DD) -> Array:
+    """sin(2*pi*x) with argument reduction done in DD (result float64).
+
+    For oscillatory terms (WAVE components, binary phases) the *argument*
+    is the precision-critical part: x may be ~1e4 revolutions, and float64
+    reduction would lose ~1e-12 of a turn. We reduce mod 1 in DD then
+    evaluate in float64 (result precision ~1e-16 is ample for delays).
+    """
+    _, frac = split_int_frac(x)
+    ang = frac.hi * (2.0 * np.pi) + frac.lo * (2.0 * np.pi)
+    return jnp.sin(ang)
+
+
+def cos2pi(x: DD) -> Array:
+    _, frac = split_int_frac(x)
+    ang = frac.hi * (2.0 * np.pi) + frac.lo * (2.0 * np.pi)
+    return jnp.cos(ang)
+
+
+# ---------------------------------------------------------------------------
+# Backend validation
+# ---------------------------------------------------------------------------
+
+
+def self_check(device=None) -> bool:
+    """Verify error-free-transform invariants hold on `device`.
+
+    Returns True iff TwoSum and TwoProd are exact under jit on the target
+    backend. CPU passes; TPU (f64 emulation, non-IEEE rounding) fails —
+    which is why the DD pipeline pins itself to CPU devices.
+    """
+    rng = np.random.default_rng(1234)
+    a = rng.uniform(-1e9, 1e9, 4096)
+    b = rng.uniform(-1e-6, 1e-6, 4096)
+
+    def probe(a, b):
+        s, e = two_sum(a, b)
+        p, f = two_prod(a, b * 1e6)
+        return s, e, p, f
+
+    if device is not None:
+        a_d = jax.device_put(a, device)
+        b_d = jax.device_put(b, device)
+    else:
+        a_d, b_d = a, b
+    s, e, p, f = jax.jit(probe)(a_d, b_d)
+    s, e, p, f = map(np.asarray, (s, e, p, f))
+
+    # reference with numpy (IEEE): same transforms must match bit-for-bit
+    s0 = a + b
+    bb = s0 - a
+    e0 = (a - (s0 - bb)) + (b - bb)
+    ok_sum = np.array_equal(s, s0) and np.array_equal(e, e0)
+
+    ld = np.longdouble
+    exact = ld(a) * ld(b * 1e6) - ld(p)
+    ok_prod = bool(np.max(np.abs(ld(f) - exact)) < 1e-18 * np.max(np.abs(p)))
+    return bool(ok_sum and ok_prod)

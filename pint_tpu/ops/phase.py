@@ -1,0 +1,70 @@
+"""Pulse-phase container with exact integer part.
+
+Reference equivalent: ``pint.phase.Phase`` (src/pint/phase.py), a
+(longdouble int, longdouble frac) 2-tuple. Here the integer part is a
+float64 holding an exact integer (|n| < 2^53 covers any realistic pulse
+count; a 30-yr, 700 Hz pulsar accumulates ~7e11 turns) and the fractional
+part is a double-double in [-0.5, 0.5].
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class Phase(NamedTuple):
+    """Pulse phase = int_part + frac, with frac a DD in [-0.5, 0.5]."""
+
+    int_part: Array  # exact integers stored as float64
+    frac: DD
+
+    def __add__(self, other: "Phase") -> "Phase":
+        return add(self, other)
+
+    def __sub__(self, other: "Phase") -> "Phase":
+        return add(self, neg(other))
+
+    def __neg__(self) -> "Phase":
+        return neg(self)
+
+    def total(self) -> DD:
+        """Full phase as DD turns (int + frac)."""
+        return dd.add(dd.from_f64(self.int_part), self.frac)
+
+    def total_f64(self) -> Array:
+        return self.int_part + self.frac.hi + self.frac.lo
+
+
+def from_dd(x: DD) -> Phase:
+    """Wrap a DD turn count into (int, frac in [-0.5, 0.5])."""
+    n, f = dd.split_int_frac(x)
+    return Phase(n, f)
+
+
+def from_f64(x: Array) -> Phase:
+    return from_dd(dd.from_f64(x))
+
+
+def zero_like(x: Array) -> Phase:
+    z = jnp.zeros_like(jnp.asarray(x, jnp.float64))
+    return Phase(z, DD(z, z))
+
+
+def add(a: Phase, b: Phase) -> Phase:
+    """Exact phase addition with re-wrapping of the fractional part."""
+    n = a.int_part + b.int_part
+    f = dd.add(a.frac, b.frac)  # |f| <= 1
+    k, f = dd.split_int_frac(f)
+    return Phase(n + k, f)
+
+
+def neg(a: Phase) -> Phase:
+    return Phase(-a.int_part, dd.neg(a.frac))

@@ -1,0 +1,124 @@
+"""Time-scale conversions: UTC -> TAI -> TT -> TDB, in double-double MJD.
+
+Replaces the reference's reliance on ``astropy.time`` + the ERFA C library
+(reference: src/pint/toa.py :: TOAs.compute_TDBs, src/pint/pulsar_mjd.py).
+Neither astropy nor erfa exists on this machine (SURVEY.md §2.4), so the
+chain is built from first principles:
+
+* **Leap seconds** (TAI-UTC): step table shipped in
+  :data:`pint_tpu.data.leapseconds.LEAP_MJD` / ``LEAP_TAI_MINUS_UTC``,
+  current through 2017-01-01 (TAI-UTC = 37 s; no leap second has been
+  scheduled since, as of 2026). Pluggable for updates.
+* **TT = TAI + 32.184 s** (exact by definition).
+* **TDB - TT**: truncated Fairhead & Bretagnon (1990) harmonic series
+  (the same family ERFA's ``dtdb.c`` implements with 787 terms). We ship
+  the principal terms in :mod:`pint_tpu.data.fb1990`; truncation +
+  offline-recalled coefficients bound the absolute accuracy at the
+  ~0.1-1 us level. This is documented, acceptable for self-consistent
+  simulate->fit workflows, and the table is data (swap in the full ERFA
+  table for exact parity when available).
+* **Topocentric Einstein term** ``v_earth . r_obs / c^2`` (diurnal,
+  ~2 us amplitude) is applied by the data layer when observatory position
+  vectors are available.
+
+Conventions
+-----------
+All epochs are double-double MJD *days* in a named scale. A day is always
+86400 s of its scale ("pulsar MJD" convention for UTC: the day fraction is
+interpreted against 86400 even across leap seconds, matching PINT's
+``pulsar_mjd`` format; reference src/pint/pulsar_mjd.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.data.fb1990 import FB1990_T0, FB1990_T1, FB1990_T2
+from pint_tpu.data.leapseconds import LEAP_MJD, LEAP_TAI_MINUS_UTC
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+
+SECS_PER_DAY = 86400.0
+TT_MINUS_TAI_S = 32.184
+MJD_J2000 = 51544.5  # TT
+JULIAN_MILLENNIUM_DAYS = 365250.0
+C_M_S = 299792458.0
+
+_LEAP_MJD = jnp.asarray(LEAP_MJD, jnp.float64)
+_LEAP_OFF = jnp.asarray(LEAP_TAI_MINUS_UTC, jnp.float64)
+
+
+def tai_minus_utc(mjd_utc_day: jax.Array) -> jax.Array:
+    """TAI-UTC in seconds at the given UTC MJD (float64 day is ample)."""
+    idx = jnp.clip(jnp.searchsorted(_LEAP_MJD, mjd_utc_day, side="right") - 1, 0, None)
+    return _LEAP_OFF[idx]
+
+
+def utc_to_tai(mjd_utc: DD) -> DD:
+    off_days = tai_minus_utc(mjd_utc.hi) / SECS_PER_DAY
+    return dd.add(mjd_utc, off_days)
+
+
+def tai_to_tt(mjd_tai: DD) -> DD:
+    return dd.add(mjd_tai, TT_MINUS_TAI_S / SECS_PER_DAY)
+
+
+def utc_to_tt(mjd_utc: DD) -> DD:
+    return tai_to_tt(utc_to_tai(mjd_utc))
+
+
+def _fb_eval(t_millennia: jax.Array) -> jax.Array:
+    """Fairhead-Bretagnon harmonic series: TDB-TT in seconds (float64).
+
+    Sum over groups g of T^g * sum_i A_i sin(w_i T + phi_i), amplitudes in
+    microseconds. Evaluated in float64: the result is ~1.7e-3 s with
+    required absolute accuracy ~1e-9 s, i.e. ~1e-6 relative — far above
+    float64 noise, so no DD needed *inside* the series.
+    """
+    T = t_millennia
+    total = jnp.zeros_like(T)
+    for power, table in enumerate((FB1990_T0, FB1990_T1, FB1990_T2)):
+        amp, freq, phase = (jnp.asarray(col, jnp.float64) for col in table)
+        terms = amp[:, None] * jnp.sin(freq[:, None] * T[None, :] + phase[:, None])
+        total = total + (T**power) * jnp.sum(terms, axis=0)
+    return total * 1e-6
+
+
+def tdb_minus_tt(mjd_tt: DD) -> jax.Array:
+    """TDB-TT in seconds at geocenter (float64)."""
+    t = (mjd_tt.hi - MJD_J2000 + mjd_tt.lo) / JULIAN_MILLENNIUM_DAYS
+    return _fb_eval(jnp.atleast_1d(t))
+
+
+def tt_to_tdb(mjd_tt: DD, topo_correction_s: jax.Array | None = None) -> DD:
+    """TT -> TDB. `topo_correction_s` adds the observatory Einstein term."""
+    corr = tdb_minus_tt(mjd_tt)
+    corr = corr.reshape(jnp.shape(mjd_tt.hi)) if jnp.ndim(mjd_tt.hi) else corr[0]
+    if topo_correction_s is not None:
+        corr = corr + topo_correction_s
+    return dd.add(mjd_tt, corr / SECS_PER_DAY)
+
+
+def utc_to_tdb(mjd_utc: DD, topo_correction_s: jax.Array | None = None) -> DD:
+    return tt_to_tdb(utc_to_tt(mjd_utc), topo_correction_s)
+
+
+def dt_seconds(t: DD, epoch: DD) -> DD:
+    """(t - epoch) in seconds, both DD MJD days — the fundamental Δt."""
+    return dd.mul(dd.sub(t, epoch), SECS_PER_DAY)
+
+
+def mjd_string_to_dd(s: str) -> DD:
+    """Exact decimal MJD string -> DD days (host-side)."""
+    return dd.from_string(s)
+
+
+def topocentric_einstein_s(v_earth_m_s: jax.Array, r_obs_m: jax.Array) -> jax.Array:
+    """v_E . r_obs / c^2 — diurnal topocentric piece of TDB-TT (seconds).
+
+    v_earth: (..., 3) SSB velocity of geocenter [m/s]; r_obs: (..., 3)
+    geocentric observatory position in the same frame [m].
+    """
+    return jnp.sum(v_earth_m_s * r_obs_m, axis=-1) / (C_M_S * C_M_S)

@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Per SURVEY.md §4, multi-device behavior is tested on a virtual CPU mesh
+(the TPU sandbox exposes a single chip). DD arithmetic additionally
+*requires* IEEE float64, which only the CPU backend guarantees
+(see pint_tpu.ops.dd docstring), so tests pin the default device to CPU.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Honored in plain environments; the axon TPU-tunnel plugin ignores it, so we
+# also pin the default device below.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+_cpus = jax.devices("cpu")
+jax.config.update("jax_default_device", _cpus[0])
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    return _cpus

@@ -1,0 +1,172 @@
+"""Property tests for double-double arithmetic.
+
+The reference's equivalent precision layer is numpy.longdouble (80-bit
+x86 extended, 64-bit significand). DD (106-bit significand) is strictly
+more precise, so longdouble works as an independent *approximate* oracle
+at the 1e-19 relative level, and Fraction gives an exact oracle.
+"""
+
+from fractions import Fraction
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.ops import dd
+
+
+def dd_to_fraction(x):
+    hi = float(np.asarray(x.hi))
+    lo = float(np.asarray(x.lo))
+    return Fraction(hi) + Fraction(lo)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_backend_is_ieee():
+    assert dd.self_check(jax.devices("cpu")[0])
+
+
+def test_two_sum_exact(rng):
+    a = rng.uniform(-1e9, 1e9, 1000)
+    b = rng.uniform(-1e-9, 1e-9, 1000)
+    s, e = jax.jit(dd.two_sum)(a, b)
+    for i in range(0, 1000, 97):
+        assert Fraction(float(s[i])) + Fraction(float(e[i])) == Fraction(a[i]) + Fraction(b[i])
+
+
+def test_two_prod_exact(rng):
+    a = rng.uniform(-1e5, 1e5, 1000)
+    b = rng.uniform(-1e5, 1e5, 1000)
+    p, e = jax.jit(dd.two_prod)(a, b)
+    for i in range(0, 1000, 97):
+        assert Fraction(float(p[i])) + Fraction(float(e[i])) == Fraction(a[i]) * Fraction(b[i])
+
+
+def test_add_precision(rng):
+    # worst case for plain f64: big + small over 30 years of seconds
+    big = rng.uniform(1e8, 1e9, 500)
+    small = rng.uniform(-1e-7, 1e-7, 500)
+    x = dd.from_f64(big)
+    y = dd.from_f64(small)
+    z = jax.jit(dd.add)(x, y)
+    for i in range(0, 500, 53):
+        exact = Fraction(big[i]) + Fraction(small[i])
+        got = dd_to_fraction(z[i])
+        assert abs(got - exact) < Fraction(1, 10**25)
+
+
+def test_mul_precision(rng):
+    f0 = rng.uniform(100, 700, 200)  # pulsar spin freqs
+    dt = rng.uniform(1e8, 1e9, 200)  # seconds over decades
+    z = jax.jit(dd.mul)(dd.from_f64(f0), dd.from_f64(dt))
+    for i in range(0, 200, 23):
+        exact = Fraction(f0[i]) * Fraction(dt[i])
+        got = dd_to_fraction(z[i])
+        # phase ~1e11 turns; need frac part to ~1e-10 turn => abs err << 1e-10
+        assert abs(got - exact) < Fraction(1, 10**16)
+
+
+def test_div_precision(rng):
+    a = rng.uniform(1, 1e6, 100)
+    b = rng.uniform(1, 1e3, 100)
+    z = jax.jit(dd.div)(dd.from_f64(a), dd.from_f64(b))
+    for i in range(0, 100, 13):
+        exact = Fraction(a[i]) / Fraction(b[i])
+        got = dd_to_fraction(z[i])
+        assert abs((got - exact) / exact) < Fraction(1, 10**30)
+
+
+def test_string_roundtrip():
+    s = "58526.21889327341602516"  # 20 significant digits, typical TOA MJD
+    x = dd.from_string(s)
+    from decimal import Decimal
+
+    exact = Fraction(Decimal(s))
+    # correctly-rounded DD: error < 2^-106 relative (~1e-27 abs at MJD scale)
+    assert abs(dd_to_fraction(x) - exact) < Fraction(1, 10**26)
+    out = dd.to_string(x, ndigits=23)
+    assert abs(Fraction(Decimal(out)) - exact) < Fraction(1, 10**16)
+
+
+def test_from_strings_vector():
+    strs = ["53478.2858714192189005", "100.1234567890123456789", "-0.5"]
+    x = dd.from_strings(strs)
+    assert x.hi.shape == (3,)
+    from decimal import Decimal
+
+    for i, s in enumerate(strs):
+        exact = Fraction(Decimal(s))
+        assert abs(dd_to_fraction(x[i]) - exact) <= abs(exact) / Fraction(2) ** 104
+
+
+def test_split_int_frac():
+    # phase = huge integer + tiny fraction must survive exactly
+    n_true = 123456789012.0
+    f_true = 3.72e-11
+    x = dd.add(dd.from_f64(n_true), dd.from_f64(f_true))
+    n, f = jax.jit(dd.split_int_frac)(x)
+    assert float(n) == n_true
+    assert abs(float(f.hi) + float(f.lo) - f_true) < 1e-25
+
+
+def test_split_int_frac_half_boundary():
+    for v, nexp in [(2.49999999, 2.0), (2.5000001, 3.0), (-2.4999999, -2.0), (-2.50001, -3.0)]:
+        n, f = dd.split_int_frac(dd.from_f64(v))
+        assert float(n) == nexp
+        total = float(n) + float(f.hi) + float(f.lo)
+        assert abs(total - v) < 1e-20
+
+
+def test_floor():
+    cases = [3.7, -3.7, 2.0, -2.0, 0.0]
+    for v in cases:
+        f = dd.floor(dd.from_f64(v))
+        assert float(f.hi) == np.floor(v)
+    # integral hi with negative lo: floor must step down
+    x = dd.DD(jnp.asarray(5.0), jnp.asarray(-1e-20))
+    assert float(dd.floor(x).hi) == 4.0
+
+
+def test_sum_compensated(rng):
+    vals = rng.uniform(-1, 1, 10000) * 1e9
+    x = dd.from_f64(vals)
+    s = dd.sum_(x)
+    exact = sum(Fraction(v) for v in vals)
+    assert abs(dd_to_fraction(s) - exact) < Fraction(1, 10**10)
+
+
+def test_sin2pi_argument_reduction():
+    # x = k + 0.25 for huge k: plain f64 would destroy the fraction
+    x = dd.add(dd.from_f64(1e12), dd.from_f64(0.25))
+    v = float(jax.jit(dd.sin2pi)(x))
+    assert abs(v - 1.0) < 1e-12
+
+
+def test_comparisons():
+    a = dd.from_string("100.00000000000000000001")
+    b = dd.from_string("100.00000000000000000002")
+    assert bool(dd.lt(a, b))
+    assert not bool(dd.lt(b, a))
+    assert bool(dd.eq(a, a))
+
+
+def test_longdouble_interop(rng):
+    vals = np.asarray(rng.uniform(5e4, 6e4, 50), np.longdouble) + np.longdouble(1e-13)
+    x = dd.from_longdouble(vals)
+    back = dd.to_longdouble(x)
+    assert np.max(np.abs(back - vals)) == 0.0
+
+
+def test_operator_sugar():
+    a = dd.from_f64(2.0)
+    b = dd.from_f64(3.0)
+    assert float((a + b).hi) == 5.0
+    assert float((a - b).hi) == -1.0
+    assert float((a * b).hi) == 6.0
+    assert float((a / b * b).hi) == 2.0
+    assert float((2.0 + a).hi) == 4.0

@@ -1,0 +1,94 @@
+"""Tests for the UTC->TAI->TT->TDB chain and Phase container."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.ops import dd, phase, timescales as ts
+
+
+def test_leap_lookup():
+    # scalar and vector
+    assert float(ts.tai_minus_utc(jnp.asarray(58000.0))) == 37.0
+    vals = ts.tai_minus_utc(jnp.asarray([41316.0, 41317.0, 50082.9, 50083.0, 60000.0]))
+    assert list(np.asarray(vals)) == [10.0, 10.0, 29.0, 30.0, 37.0]
+
+
+def test_utc_to_tt_offset():
+    t = dd.from_string("58000.0")
+    tt = ts.utc_to_tt(t)
+    # TT-UTC = 37 + 32.184 = 69.184 s
+    delta_s = float(dd.mul(dd.sub(tt, t), ts.SECS_PER_DAY).hi)
+    assert abs(delta_s - 69.184) < 1e-9
+
+
+def test_tdb_minus_tt_amplitude_and_period():
+    # annual sinusoid, amplitude ~1.657 ms, zero-mean-ish
+    mjds = 51544.5 + np.linspace(0, 365.25, 1000)
+    corr = np.asarray(ts.tdb_minus_tt(dd.from_f64(mjds)))
+    assert 1.5e-3 < np.max(np.abs(corr)) < 1.8e-3
+    assert abs(np.mean(corr)) < 2e-4
+    # one year apart should nearly repeat (annual dominant term)
+    c0 = float(ts.tdb_minus_tt(dd.from_f64(55000.0))[0])
+    c1 = float(ts.tdb_minus_tt(dd.from_f64(55000.0 + 365.25))[0])
+    assert abs(c0 - c1) < 1.5e-4
+
+
+def test_dt_seconds_precision():
+    t = dd.from_string("58526.21889327341602516")
+    ep = dd.from_string("53750.000000")
+    dt = ts.dt_seconds(t, ep)
+    # independent longdouble computation
+    ld = dd.to_longdouble(t) - dd.to_longdouble(ep)
+    assert abs(float(dd.to_longdouble(dt) - ld * np.longdouble(86400.0))) < 1e-9
+
+
+def test_phase_wrap_and_add():
+    f0 = 339.31568728824463  # NGC6440E-like spin frequency
+    dt = ts.dt_seconds(dd.from_string("58526.2188932734160"), dd.from_string("53750.0"))
+    ph = phase.from_dd(dd.mul(dd.from_f64(f0), dt))
+    # int part is a clean integer and frac in [-0.5, 0.5]
+    assert float(ph.int_part) == np.round(float(ph.int_part))
+    assert abs(float(ph.frac.hi)) <= 0.5
+    # adding and subtracting the same phase cancels exactly
+    z = ph - ph
+    assert float(z.int_part) == 0.0 and float(z.frac.hi) == 0.0
+
+    # addition wraps: 0.4 + 0.3 -> int 1, frac -0.3
+    a = phase.from_dd(dd.from_f64(0.4))
+    b = phase.from_dd(dd.from_f64(0.3))
+    c = a + b
+    assert float(c.int_part) == 1.0
+    assert abs(float(c.frac.hi) + 0.3) < 1e-15
+
+
+def test_phase_precision_over_30yr():
+    """1 ns over 30 years: the defining requirement (SURVEY.md §7)."""
+    f0 = 641.928222  # fast MSP
+    t1 = dd.from_string("47892.0")
+    t2 = dd.from_string("58857.123456789012345678")  # ~30 yr later
+    dt = ts.dt_seconds(t2, t1)
+    ph = phase.from_dd(dd.mul(dd.from_f64(f0), dt))
+    # perturb t2 by exactly 1 ns and check the phase moves by f0 * 1e-9
+    t2b = dd.add(t2, 1e-9 / 86400.0)
+    ph2 = phase.from_dd(dd.mul(dd.from_f64(f0), ts.dt_seconds(t2b, t1)))
+    dphi = (ph2 - ph).frac
+    expected = f0 * 1e-9
+    assert abs((float(dphi.hi) + float(dphi.lo)) - expected) < 1e-12 * expected + 1e-16
+
+
+def test_utc_tdb_roundtrip_consistency():
+    # TDB-UTC at MJD 57000 (Dec 2014, TAI-UTC=35): ~67.184 s +- 2 ms, smooth
+    t = dd.from_f64(np.linspace(57000.0, 57010.0, 100))
+    tdb = ts.utc_to_tdb(t)
+    delta = np.asarray(dd.mul(dd.sub(tdb, t), 86400.0).hi)
+    assert np.all(np.abs(delta - 67.184) < 5e-3)
+    assert np.max(np.abs(np.diff(delta))) < 1e-4
+
+
+def test_topocentric_einstein_magnitude():
+    v = jnp.asarray([[30000.0, 0.0, 0.0]])  # Earth orbital speed
+    r = jnp.asarray([[6.4e6, 0.0, 0.0]])  # observatory at equator, aligned
+    corr = ts.topocentric_einstein_s(v, r)
+    assert abs(float(corr[0]) - 30000.0 * 6.4e6 / 299792458.0**2) < 1e-15
+    assert 1e-6 < float(corr[0]) < 3e-6  # ~2 us
