@@ -1,0 +1,6 @@
+"""Host-side file IO: par files, tim files (no JAX; exact-string numerics)."""
+
+from pint_tpu.io.parfile import ParFile, parse_parfile
+from pint_tpu.io.timfile import TimFile, parse_timfile
+
+__all__ = ["ParFile", "parse_parfile", "TimFile", "parse_timfile"]

@@ -33,7 +33,7 @@ NamedTuple and hence a pytree.
 from __future__ import annotations
 
 import operator
-from decimal import Decimal, getcontext
+from decimal import Decimal
 from fractions import Fraction
 from typing import NamedTuple, Union
 
@@ -175,14 +175,19 @@ def from_string(s: str) -> DD:
     src/pint/pulsar_mjd.py :: str2longdouble); we split the exact decimal
     value into hi = round(x), lo = round(x - hi) via Fraction arithmetic.
     """
-    s = s.strip().replace("D", "e").replace("d", "e")
+    hi, lo = _split_decimal(s)
+    return DD(jnp.asarray(hi, jnp.float64), jnp.asarray(lo, jnp.float64))
+
+
+def _split_decimal(s: str) -> tuple[float, float]:
+    s = str(s).strip().replace("D", "e").replace("d", "e")
     try:
         frac = Fraction(Decimal(s))
-    except Exception as exc:
-        raise ValueError(f"not a decimal number: {s!r}") from exc
-    hi = float(frac)
-    lo = float(frac - Fraction(hi))
-    return DD(jnp.asarray(hi, jnp.float64), jnp.asarray(lo, jnp.float64))
+        hi = float(frac)
+        lo = float(frac - Fraction(hi))
+    except Exception as exc:  # ConversionSyntax, OverflowError, ...
+        raise ValueError(f"not a float64-representable decimal: {s!r}") from exc
+    return hi, lo
 
 
 def from_strings(strings) -> DD:
@@ -190,23 +195,19 @@ def from_strings(strings) -> DD:
     his = np.empty(len(strings), dtype=np.float64)
     los = np.empty(len(strings), dtype=np.float64)
     for i, s in enumerate(strings):
-        s = str(s).strip().replace("D", "e").replace("d", "e")
-        try:
-            frac = Fraction(Decimal(s))
-        except Exception as exc:
-            raise ValueError(f"not a decimal number: {s!r}") from exc
-        hi = float(frac)
-        his[i] = hi
-        los[i] = float(frac - Fraction(hi))
+        his[i], los[i] = _split_decimal(s)
     return DD(jnp.asarray(his), jnp.asarray(los))
 
 
 def to_string(x: DD, ndigits: int = 25) -> str:
     """Render a scalar DD to a decimal string with `ndigits` significant digits."""
-    getcontext().prec = max(ndigits, 40)
-    val = Decimal(float(np.asarray(x.hi))) + Decimal(float(np.asarray(x.lo)))
-    getcontext().prec = ndigits
-    return str(+val)
+    from decimal import localcontext
+
+    with localcontext() as ctx:
+        ctx.prec = max(ndigits, 40)
+        val = Decimal(float(np.asarray(x.hi))) + Decimal(float(np.asarray(x.lo)))
+        ctx.prec = ndigits
+        return str(+val)
 
 
 def to_longdouble(x: DD) -> np.ndarray:
@@ -373,10 +374,6 @@ def polyval(coeffs: list[DD], x: DD) -> DD:
     for c in coeffs[1:]:
         acc = add(mul(acc, x), c)
     return acc
-
-
-_TWO_PI = from_string("6.283185307179586476925286766559005768")
-_PI = from_string("3.1415926535897932384626433832795028842")
 
 
 def sin2pi(x: DD) -> Array:

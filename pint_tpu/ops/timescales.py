@@ -75,14 +75,14 @@ def _fb_eval(t_millennia: jax.Array) -> jax.Array:
     Sum over groups g of T^g * sum_i A_i sin(w_i T + phi_i), amplitudes in
     microseconds. Evaluated in float64: the result is ~1.7e-3 s with
     required absolute accuracy ~1e-9 s, i.e. ~1e-6 relative — far above
-    float64 noise, so no DD needed *inside* the series.
+    float64 noise, so no DD needed *inside* the series. Shape-polymorphic.
     """
-    T = t_millennia
-    total = jnp.zeros_like(T)
+    T = t_millennia[..., None]  # broadcast against the term axis
+    total = jnp.zeros(jnp.shape(t_millennia))
     for power, table in enumerate((FB1990_T0, FB1990_T1, FB1990_T2)):
         amp, freq, phase = (jnp.asarray(col, jnp.float64) for col in table)
-        terms = amp[:, None] * jnp.sin(freq[:, None] * T[None, :] + phase[:, None])
-        total = total + (T**power) * jnp.sum(terms, axis=0)
+        terms = amp * jnp.sin(freq * T + phase)
+        total = total + (t_millennia**power) * jnp.sum(terms, axis=-1)
     return total * 1e-6
 
 

@@ -1,0 +1,329 @@
+"""TOA table: parsed arrival times + precomputed astrometric context.
+
+Reference equivalent: ``pint.toa.TOAs`` / ``get_TOAs()`` (src/pint/toa.py),
+which stores an astropy Table and computes clock corrections, TDB times and
+observatory solar-system positions. Here the table is a *pytree of device
+arrays* (registered dataclass) so the whole object flows through jit /
+vmap / shard_map, with host-only metadata (flags, site names) held as
+static aux data.
+
+Load pipeline (host, once per dataset — mirrors reference call stack
+SURVEY.md §3.1):
+
+1. parse `.tim` (strings; exact-precision MJDs)
+2. site clock chain -> UTC        (observatory.clock_corrections_s)
+3. UTC -> TT -> TDB in DD         (ops.timescales; topocentric Einstein term)
+4. observatory GCRS offset        (earth.itrf_to_gcrs_posvel)
+5. Earth/Sun/planet posvels       (ephemeris provider)
+
+Everything downstream (delays, phases, fits) consumes only this object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import earth, observatory as obs_mod
+from pint_tpu.ephemeris import AnalyticEphemeris, Ephemeris, get_ephemeris
+from pint_tpu.io.timfile import RawTOA, TimFile, parse_timfile
+from pint_tpu.ops import dd, timescales as ts
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+C_M_S = 299792458.0
+PLANET_NAMES = ("sun", "venus", "jupiter", "saturn", "uranus", "neptune")
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TOAs:
+    """Pytree TOA table. Shapes: (n,) unless noted; positions (n, 3) lt-s."""
+
+    # --- data fields (traced leaves) ---
+    tdb: DD  # TDB MJD at the observatory
+    utc: DD  # site-clock-corrected UTC MJD (for rotation/evaluation)
+    freq_mhz: Array  # topocentric observing frequency
+    error_us: Array  # TOA uncertainty
+    obs_pos_ls: Array  # observatory wrt SSB [lt-s], (n, 3)
+    obs_vel_c: Array  # observatory velocity / c, (n, 3)
+    phase_offset: Array  # accumulated tim-file PHASE commands
+    planet_pos_ls: dict  # name -> (n,3) body position wrt *observatory* [lt-s]
+    pulse_number: Array  # tracked pulse numbers (nan = absent)
+
+    # --- metadata (static aux) ---
+    obs_index: np.ndarray = field(metadata=dict(static=True))  # site per TOA
+    obs_names: tuple = field(metadata=dict(static=True))  # index -> site name
+    flags: tuple = field(metadata=dict(static=True))  # per-TOA flag dicts
+    jump_group: np.ndarray = field(metadata=dict(static=True))
+    ephem_name: str = field(default="builtin_analytic", metadata=dict(static=True))
+    clock_applied: bool = field(default=True, metadata=dict(static=True))
+
+    def __len__(self) -> int:
+        return int(np.shape(self.tdb.hi)[0])
+
+    @property
+    def ntoas(self) -> int:
+        return len(self)
+
+    def get_mjds(self) -> np.ndarray:
+        """TDB MJDs as float64 (display/selection precision)."""
+        return np.asarray(self.tdb.hi + self.tdb.lo)
+
+    def get_errors_s(self) -> Array:
+        return self.error_us * 1e-6
+
+    def get_freqs_hz(self) -> Array:
+        return self.freq_mhz * 1e6
+
+    def get_flag_value(self, flag: str, default: str = "") -> list[str]:
+        return [f.get(flag, default) for f in self.flags]
+
+    def select(self, mask) -> "TOAs":
+        """Boolean-mask subset (host-side; returns a new TOAs)."""
+        mask = np.asarray(mask)
+        idx = np.nonzero(mask)[0]
+        take = lambda a: jnp.asarray(np.asarray(a)[idx])
+        return TOAs(
+            tdb=DD(take(self.tdb.hi), take(self.tdb.lo)),
+            utc=DD(take(self.utc.hi), take(self.utc.lo)),
+            freq_mhz=take(self.freq_mhz),
+            error_us=take(self.error_us),
+            obs_pos_ls=take(self.obs_pos_ls),
+            obs_vel_c=take(self.obs_vel_c),
+            phase_offset=take(self.phase_offset),
+            planet_pos_ls={k: take(v) for k, v in self.planet_pos_ls.items()},
+            pulse_number=take(self.pulse_number),
+            obs_index=self.obs_index[idx],
+            obs_names=self.obs_names,
+            flags=tuple(self.flags[i] for i in idx),
+            jump_group=self.jump_group[idx],
+            ephem_name=self.ephem_name,
+            clock_applied=self.clock_applied,
+        )
+
+    def first_mjd(self) -> float:
+        return float(np.min(self.get_mjds()))
+
+    def last_mjd(self) -> float:
+        return float(np.max(self.get_mjds()))
+
+
+def merge_TOAs(toas_list: list[TOAs]) -> TOAs:
+    """Concatenate TOA tables (reference: pint.toa.merge_TOAs)."""
+    cat = lambda getter: jnp.concatenate([np.asarray(getter(t)) for t in toas_list])
+    planets = {}
+    for name in toas_list[0].planet_pos_ls:
+        planets[name] = jnp.concatenate([t.planet_pos_ls[name] for t in toas_list])
+    # site indices need remapping onto the merged name table
+    names: list[str] = []
+    for t in toas_list:
+        for n in t.obs_names:
+            if n not in names:
+                names.append(n)
+    obs_index = np.concatenate(
+        [np.asarray([names.index(t.obs_names[i]) for i in t.obs_index]) for t in toas_list]
+    )
+    return TOAs(
+        tdb=DD(cat(lambda t: t.tdb.hi), cat(lambda t: t.tdb.lo)),
+        utc=DD(cat(lambda t: t.utc.hi), cat(lambda t: t.utc.lo)),
+        freq_mhz=cat(lambda t: t.freq_mhz),
+        error_us=cat(lambda t: t.error_us),
+        obs_pos_ls=cat(lambda t: t.obs_pos_ls),
+        obs_vel_c=cat(lambda t: t.obs_vel_c),
+        phase_offset=cat(lambda t: t.phase_offset),
+        planet_pos_ls=planets,
+        pulse_number=cat(lambda t: t.pulse_number),
+        obs_index=obs_index,
+        obs_names=tuple(names),
+        flags=tuple(f for t in toas_list for f in t.flags),
+        jump_group=np.concatenate([t.jump_group for t in toas_list]),
+        ephem_name=toas_list[0].ephem_name,
+        clock_applied=all(t.clock_applied for t in toas_list),
+    )
+
+
+def get_TOAs(
+    timfile: str | TimFile,
+    *,
+    ephem: str | Ephemeris = "builtin_analytic",
+    planets: bool = True,
+    include_clock: bool = True,
+    clock_limits: str = "warn",
+) -> TOAs:
+    """Load a `.tim` file into a fully-corrected TOAs table.
+
+    Mirrors reference ``pint.toa.get_TOAs(timfile, ...)`` including the
+    clock chain and posvel computation (src/pint/toa.py).
+    """
+    tf = parse_timfile(timfile) if isinstance(timfile, str) else timfile
+    if not tf.toas:
+        raise ValueError("tim file contains no TOAs")
+    eph = get_ephemeris(ephem) if isinstance(ephem, str) else ephem
+    return build_TOAs_from_raw(tf, eph, planets=planets,
+                               include_clock=include_clock, clock_limits=clock_limits)
+
+
+def build_TOAs_from_raw(
+    tf: TimFile,
+    eph: Ephemeris,
+    *,
+    planets: bool = True,
+    include_clock: bool = True,
+    clock_limits: str = "warn",
+) -> TOAs:
+    raw = tf.toas
+    n = len(raw)
+
+    # exact-precision MJD parse (site-local time scale, usually UTC)
+    mjd_local = dd.from_strings([t.mjd_str for t in raw])
+    # TIME command offsets (seconds) — applied before clock corrections
+    time_off = np.asarray([t.time_offset_s for t in raw])
+    if np.any(time_off):
+        mjd_local = dd.add(mjd_local, jnp.asarray(time_off) / ts.SECS_PER_DAY)
+
+    site_names: list[str] = []
+    obs_index = np.empty(n, dtype=np.int32)
+    for i, t in enumerate(raw):
+        name = obs_mod.get_observatory(t.obs).name
+        if name not in site_names:
+            site_names.append(name)
+        obs_index[i] = site_names.index(name)
+
+    # clock chain to UTC (host-side numpy; per-site vectorized)
+    clock_s = np.zeros(n)
+    if include_clock:
+        mjd_f64 = np.asarray(mjd_local.hi + mjd_local.lo)
+        for si, sname in enumerate(site_names):
+            sel = obs_index == si
+            if not np.any(sel):
+                continue
+            ob = obs_mod.get_observatory(sname)
+            if ob.is_special:
+                continue
+            clock_s[sel] = obs_mod.clock_corrections_s(sname, mjd_f64[sel], limits=clock_limits)
+    utc = dd.add(mjd_local, jnp.asarray(clock_s) / ts.SECS_PER_DAY)
+
+    # special-site handling
+    is_bary = np.asarray(
+        [obs_mod.get_observatory(s).is_barycenter for s in site_names]
+    )[obs_index]
+    is_geo = np.asarray(
+        [obs_mod.get_observatory(s).is_geocenter for s in site_names]
+    )[obs_index]
+
+    # observatory ITRF -> GCRS (zeros for special sites)
+    itrf = np.zeros((n, 3))
+    for si, sname in enumerate(site_names):
+        ob = obs_mod.get_observatory(sname)
+        if ob.itrf_xyz_m is not None:
+            itrf[obs_index == si] = np.asarray(ob.itrf_xyz_m)
+
+    tt = ts.utc_to_tt(utc)
+    tt_f64 = np.asarray(tt.hi + tt.lo)
+    obs_gcrs_pos, obs_gcrs_vel = earth.itrf_to_gcrs_posvel(jnp.asarray(itrf), np.asarray(utc.hi + utc.lo))
+
+    # Earth posvel for the Einstein topocentric term (evaluated at TT ~ TDB)
+    earth_pos, earth_vel = eph.earth_posvel_ssb(jnp.asarray(tt_f64))
+    topo_corr = ts.topocentric_einstein_s(earth_vel * C_M_S, obs_gcrs_pos)
+    topo_corr = jnp.where(jnp.asarray(is_bary | is_geo), 0.0, topo_corr)
+    tdb = ts.tt_to_tdb(tt, topo_corr)
+    # Barycentric TOAs are already TDB at the SSB: undo the TT->TDB shift
+    if np.any(is_bary):
+        tdb = DD(
+            jnp.where(jnp.asarray(is_bary), utc.hi, tdb.hi),
+            jnp.where(jnp.asarray(is_bary), utc.lo, tdb.lo),
+        )
+
+    tdb_f64 = jnp.asarray(tdb.hi + tdb.lo)
+    earth_pos, earth_vel = eph.earth_posvel_ssb(tdb_f64)
+
+    obs_pos = earth_pos + obs_gcrs_pos / (C_M_S)  # GCRS meters -> light-seconds
+    obs_vel = earth_vel + obs_gcrs_vel / C_M_S
+    zero3 = jnp.zeros_like(obs_pos)
+    bary_mask = jnp.asarray(is_bary)[:, None]
+    geo_mask = jnp.asarray(is_geo)[:, None]
+    obs_pos = jnp.where(bary_mask, zero3, jnp.where(geo_mask, earth_pos, obs_pos))
+    obs_vel = jnp.where(bary_mask, zero3, jnp.where(geo_mask, earth_vel, obs_vel))
+
+    planet_pos = {}
+    if planets:
+        for name in PLANET_NAMES:
+            p, _ = eph.planet_posvel_ssb(name, tdb_f64)
+            planet_pos[name] = p - obs_pos
+    else:
+        p, _ = eph.sun_posvel_ssb(tdb_f64)
+        planet_pos["sun"] = p - obs_pos
+
+    flags = tuple(dict(t.flags) for t in raw)
+    pulse_number = jnp.asarray(
+        [float(f.get("pn", "nan")) for f in flags], jnp.float64
+    )
+
+    return TOAs(
+        tdb=tdb,
+        utc=utc,
+        freq_mhz=jnp.asarray([t.freq_mhz for t in raw]),
+        error_us=jnp.asarray([t.error_us for t in raw]),
+        obs_pos_ls=obs_pos,
+        obs_vel_c=obs_vel,
+        phase_offset=jnp.asarray([t.phase_offset for t in raw]),
+        planet_pos_ls=planet_pos,
+        pulse_number=pulse_number,
+        obs_index=obs_index,
+        obs_names=tuple(site_names),
+        flags=flags,
+        jump_group=np.asarray([t.jump_group for t in raw]),
+        ephem_name=getattr(eph, "name", "custom"),
+        clock_applied=include_clock,
+    )
+
+
+def save_pickle(toas: TOAs, path: str) -> None:
+    """Cache a TOAs table (reference: get_TOAs(..., usepickle=True))."""
+    np.savez_compressed(
+        path,
+        tdb_hi=np.asarray(toas.tdb.hi), tdb_lo=np.asarray(toas.tdb.lo),
+        utc_hi=np.asarray(toas.utc.hi), utc_lo=np.asarray(toas.utc.lo),
+        freq_mhz=np.asarray(toas.freq_mhz), error_us=np.asarray(toas.error_us),
+        obs_pos=np.asarray(toas.obs_pos_ls), obs_vel=np.asarray(toas.obs_vel_c),
+        phase_offset=np.asarray(toas.phase_offset),
+        pulse_number=np.asarray(toas.pulse_number),
+        obs_index=toas.obs_index,
+        obs_names=np.asarray(toas.obs_names, dtype=object),
+        flags=np.asarray([repr(f) for f in toas.flags], dtype=object),
+        jump_group=toas.jump_group,
+        planet_names=np.asarray(list(toas.planet_pos_ls), dtype=object),
+        **{f"planet_{k}": np.asarray(v) for k, v in toas.planet_pos_ls.items()},
+        ephem_name=np.asarray(toas.ephem_name, dtype=object),
+        clock_applied=np.asarray(toas.clock_applied),
+    )
+
+
+def load_pickle(path: str) -> TOAs:
+    import ast
+
+    z = np.load(path, allow_pickle=True)
+    return TOAs(
+        tdb=DD(jnp.asarray(z["tdb_hi"]), jnp.asarray(z["tdb_lo"])),
+        utc=DD(jnp.asarray(z["utc_hi"]), jnp.asarray(z["utc_lo"])),
+        freq_mhz=jnp.asarray(z["freq_mhz"]),
+        error_us=jnp.asarray(z["error_us"]),
+        obs_pos_ls=jnp.asarray(z["obs_pos"]),
+        obs_vel_c=jnp.asarray(z["obs_vel"]),
+        phase_offset=jnp.asarray(z["phase_offset"]),
+        planet_pos_ls={str(k): jnp.asarray(z[f"planet_{k}"]) for k in z["planet_names"]},
+        pulse_number=jnp.asarray(z["pulse_number"]),
+        obs_index=z["obs_index"],
+        obs_names=tuple(str(s) for s in z["obs_names"]),
+        flags=tuple(ast.literal_eval(str(f)) for f in z["flags"]),
+        jump_group=z["jump_group"],
+        ephem_name=str(z["ephem_name"]),
+        clock_applied=bool(z["clock_applied"]),
+    )

@@ -1,0 +1,239 @@
+"""Data-layer tests: par/tim parsing, ephemeris, earth rotation, TOAs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu import earth, observatory as obs_mod
+from pint_tpu.clock import ClockFile
+from pint_tpu.ephemeris import AnalyticEphemeris, TabulatedEphemeris
+from pint_tpu.io.parfile import parse_parfile, write_parfile
+from pint_tpu.io.timfile import parse_timfile, write_timfile
+from pint_tpu.toas import get_TOAs, load_pickle, merge_TOAs, save_pickle
+
+AU_LS = 499.00478383615643
+
+PAR = """
+PSR              J1744-1134
+RAJ      17:44:29.4059063      1     0.00000094
+DECJ    -11:34:54.68126        1     0.00007
+F0      245.4261196898081      1     2.5e-12
+F1      -5.38156E-16           1     2.7e-20
+PEPOCH        53742.000000
+DM               3.1380        1     0.0001
+PLANET_SHAPIRO Y
+EPHEM            DE421
+CLK              TT(BIPM)
+UNITS            TDB
+JUMP -fe L-wide 0.000307       1     0.000021
+EFAC -f 430_PUPPI 1.07
+"""
+
+TIM = """FORMAT 1
+f1 1400.0 53478.2858714192189005 1.50 gbt -fe Rcvr1_2 -pn 12345
+f2 1410.0 53679.8671192734817305 1.20 gbt -fe Rcvr1_2
+f3 430.0  53800.1234567890123456 2.10 ao -fe 430
+"""
+
+
+def test_parse_parfile_basic():
+    pf = parse_parfile(PAR)
+    assert pf.get_value("PSR") == "J1744-1134"
+    f0 = pf.get("F0")
+    assert f0.value == "245.4261196898081"
+    assert f0.fit is True
+    assert f0.uncertainty == "2.5e-12"
+    assert pf.get("F1").value_float == pytest.approx(-5.38156e-16)
+    assert pf.get("PLANET_SHAPIRO").value == "Y"
+
+
+def test_parse_parfile_mask_params():
+    pf = parse_parfile(PAR)
+    jump = pf.get("JUMP")
+    assert jump.rest == ("-fe", "L-wide")
+    assert jump.value == "0.000307"
+    assert jump.fit is True
+    efac = pf.get("EFAC")
+    assert efac.rest == ("-f", "430_PUPPI")
+    assert efac.value == "1.07"
+
+
+def test_parfile_roundtrip():
+    pf = parse_parfile(PAR)
+    text = write_parfile(pf)
+    pf2 = parse_parfile(text)
+    assert pf2.get("F0").value == pf.get("F0").value
+    assert pf2.get("JUMP").rest[:2] == ("-fe", "L-wide")
+
+
+def test_parse_timfile(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(TIM)
+    tf = parse_timfile(str(p))
+    assert len(tf.toas) == 3
+    assert tf.toas[0].mjd_str == "53478.2858714192189005"
+    assert tf.toas[0].flags["fe"] == "Rcvr1_2"
+    assert tf.toas[0].flags["pn"] == "12345"
+    assert tf.toas[2].obs == "ao"
+    assert tf.toas[2].freq_mhz == 430.0
+
+
+def test_timfile_commands(tmp_path):
+    body = (
+        "FORMAT 1\n"
+        "a 1400 53000.5 1.0 gbt\n"
+        "JUMP\n"
+        "b 1400 53001.5 1.0 gbt\n"
+        "JUMP\n"
+        "TIME 0.5\n"
+        "cc3 1400 53002.5 1.0 gbt\n"
+        "SKIP\n"
+        "bad 1400 53003.5 1.0 gbt\n"
+        "NOSKIP\n"
+        "END\n"
+        "never 1400 53004.5 1.0 gbt\n"
+    )
+    p = tmp_path / "c.tim"
+    p.write_text(body)
+    tf = parse_timfile(str(p))
+    assert [t.flags["name"] for t in tf.toas] == ["a", "b", "cc3"]
+    assert tf.toas[0].jump_group == 0
+    assert tf.toas[1].jump_group == 1
+    assert tf.toas[2].jump_group == 0
+    assert tf.toas[2].time_offset_s == 0.5
+
+
+def test_timfile_include(tmp_path):
+    inner = tmp_path / "inner.tim"
+    inner.write_text("FORMAT 1\nx 1400 53010.5 1.0 gbt\n")
+    outer = tmp_path / "outer.tim"
+    outer.write_text(f"FORMAT 1\nINCLUDE inner.tim\ny 1400 53011.5 1.0 gbt\n")
+    tf = parse_timfile(str(outer))
+    assert [t.flags["name"] for t in tf.toas] == ["x", "y"]
+
+
+def test_ephemeris_earth_orbit():
+    eph = AnalyticEphemeris()
+    t = np.linspace(50000.0, 50000.0 + 365.25, 200)
+    pos, vel = eph.earth_posvel_ssb(t)
+    r = np.linalg.norm(np.asarray(pos), axis=1) / AU_LS
+    # heliocentric-ish distance within [0.97, 1.03] au incl. SSB offset
+    assert np.all((r > 0.97) & (r < 1.03))
+    # speed ~ 29.8 km/s -> v/c ~ 9.9e-5
+    v = np.linalg.norm(np.asarray(vel), axis=1)
+    assert np.all((v > 9.2e-5) & (v < 1.05e-4))
+    # velocity consistent with dp/dt (finite difference)
+    dt_days = t[1] - t[0]
+    fd = (np.asarray(pos)[2:] - np.asarray(pos)[:-2]) / (2 * dt_days * 86400.0)
+    assert np.max(np.abs(fd - np.asarray(vel)[1:-1])) < 2e-7  # lt-s/s
+
+
+def test_ephemeris_annual_period():
+    eph = AnalyticEphemeris()
+    p0, _ = eph.earth_posvel_ssb(np.asarray([53000.0]))
+    p1, _ = eph.earth_posvel_ssb(np.asarray([53000.0 + 365.2564]))  # sidereal year
+    # same orbital phase to within ~1.5% of the orbit
+    sep = np.linalg.norm(np.asarray(p0 - p1))
+    assert sep < 0.1 * AU_LS
+
+
+def test_tabulated_ephemeris_matches_source():
+    eph = AnalyticEphemeris()
+    grid = np.arange(53000.0, 53030.0, 0.25)
+    pos, vel = eph.earth_posvel_ssb(grid)
+    tab = TabulatedEphemeris(
+        t0=53000.0, dt_days=0.25,
+        tables={"earth": (np.asarray(pos), np.asarray(vel)),
+                "sun": (np.asarray(pos) * 0, np.asarray(vel) * 0)},
+    )
+    t_test = np.asarray([53010.1234, 53015.9876])
+    p_interp, v_interp = tab.earth_posvel_ssb(t_test)
+    p_true, v_true = eph.earth_posvel_ssb(t_test)
+    # Hermite on 0.25-day grid: sub-1e-9 lt-s (sub-ns) interpolation error
+    assert np.max(np.abs(np.asarray(p_interp) - np.asarray(p_true))) < 1e-9
+    assert np.max(np.abs(np.asarray(v_interp) - np.asarray(v_true))) < 1e-13
+
+
+def test_observatory_registry():
+    gbt = obs_mod.get_observatory("GBT")
+    assert gbt.name == "gbt"
+    assert obs_mod.get_observatory("1").name == "gbt"  # tempo code
+    assert obs_mod.get_observatory("@").is_barycenter
+    assert obs_mod.get_observatory("coe").is_geocenter
+    with pytest.raises(KeyError):
+        obs_mod.get_observatory("atlantis")
+
+
+def test_earth_rotation_diurnal():
+    gbt = obs_mod.get_observatory("gbt")
+    t = 55000.0 + np.linspace(0, 0.9972696, 97)  # one sidereal day
+    pos, vel = earth.itrf_to_gcrs_posvel(np.asarray(gbt.itrf_xyz_m), t)
+    r = np.linalg.norm(np.asarray(pos), axis=1)
+    # radius preserved by rotations
+    assert np.allclose(r, np.linalg.norm(gbt.itrf_xyz_m), rtol=1e-9)
+    # returns to start after one sidereal day up to one day of precession
+    # (~0.14 arcsec/day -> ~3 m at Earth radius)
+    assert np.linalg.norm(np.asarray(pos)[0] - np.asarray(pos)[-1]) < 5.0
+    # surface speed ~ 350 m/s at GBT latitude
+    v = np.linalg.norm(np.asarray(vel), axis=1)
+    assert np.all((v > 250) & (v < 500))
+
+
+def test_clock_file(tmp_path):
+    p = tmp_path / "test.clk"
+    p.write_text("# UTC(gbt) UTC\n50000.0 1.5e-6\n50010.0 2.5e-6\n")
+    cf = ClockFile.read_tempo2(str(p))
+    assert cf.evaluate(np.asarray([50005.0]))[0] == pytest.approx(2.0e-6)
+    # extrapolation warns but clamps
+    assert cf.evaluate(np.asarray([49999.0]))[0] == pytest.approx(1.5e-6)
+    with pytest.raises(ValueError):
+        cf.evaluate(np.asarray([49000.0]), limits="error")
+
+
+def test_clock_chain_applied(tmp_path):
+    cf = ClockFile(np.asarray([50000.0, 60000.0]), np.asarray([1e-4, 1e-4]), "const")
+    obs_mod.register_clock("gbt", [cf])
+    try:
+        tim = "FORMAT 1\nx 1400 53478.2858714192189005 1.0 gbt\n"
+        p = tmp_path / "ck.tim"
+        p.write_text(tim)
+        t_with = get_TOAs(str(p))
+        t_wo = get_TOAs(str(p), include_clock=False)
+        dt = (float(t_with.utc.hi[0]) - float(t_wo.utc.hi[0])) * 86400.0 + (
+            float(t_with.utc.lo[0]) - float(t_wo.utc.lo[0])
+        ) * 86400.0
+        assert dt == pytest.approx(1e-4, rel=1e-6)
+    finally:
+        obs_mod._CLOCKS.pop("gbt", None)
+
+
+def test_toas_roundtrip_pickle(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(TIM)
+    t = get_TOAs(str(p))
+    cache = tmp_path / "cache.npz"
+    save_pickle(t, str(cache))
+    t2 = load_pickle(str(cache))
+    assert len(t2) == len(t)
+    assert np.array_equal(np.asarray(t2.tdb.hi), np.asarray(t.tdb.hi))
+    assert np.array_equal(np.asarray(t2.tdb.lo), np.asarray(t.tdb.lo))
+    assert t2.flags[0]["fe"] == "Rcvr1_2"
+    assert t2.obs_names == t.obs_names
+
+
+def test_merge_toas(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(TIM)
+    t = get_TOAs(str(p))
+    m = merge_TOAs([t, t.select(np.asarray([True, False, False]))])
+    assert len(m) == 4
+    assert m.obs_names == t.obs_names
+
+
+def test_pulse_number_flag(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(TIM)
+    t = get_TOAs(str(p))
+    assert float(t.pulse_number[0]) == 12345.0
+    assert np.isnan(float(t.pulse_number[1]))
