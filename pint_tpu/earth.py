@@ -29,7 +29,7 @@ import numpy as np
 
 Array = jax.Array
 
-MJD_J2000 = 51544.5
+from pint_tpu.constants import MJD_J2000  # noqa: E402
 ARCSEC = np.pi / (180.0 * 3600.0)
 
 

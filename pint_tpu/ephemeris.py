@@ -37,12 +37,9 @@ import numpy as np
 
 Array = jax.Array
 
-AU_LIGHT_S = 499.00478383615643  # 1 au in light-seconds (IAU 2012 au / c)
-DAY_S = 86400.0
-MJD_J2000 = 51544.5
+from pint_tpu.constants import AU_LIGHT_S, MJD_J2000, OBLIQUITY_RAD as EPS0_RAD
+from pint_tpu.constants import SECS_PER_DAY as DAY_S
 
-# Obliquity of the ecliptic at J2000 (IAU 2006), arcsec -> rad
-EPS0_RAD = np.deg2rad(84381.406 / 3600.0)
 
 
 def _rot_ecl_to_eq(xyz_ecl: Array) -> Array:

@@ -1,0 +1,6 @@
+"""Fitting layer: weighted/generalized least squares on device.
+
+Reference equivalent: ``pint.fitter`` (src/pint/fitter.py).
+"""
+
+from pint_tpu.fitting.fitter import Fitter, WLSFitter  # noqa: F401

@@ -99,10 +99,15 @@ def parse_parfile(path_or_text: str) -> ParFile:
             pf.lines.append(ParLine(name, ""))
             continue
 
-        if _is_mask_param(name) and rest and rest[0].startswith("-"):
-            # e.g. JUMP -fe L-wide 0.034 1 0.001
-            selector = tuple(rest[:2])
-            vals = rest[2:]
+        if _is_mask_param(name) and rest and (
+            rest[0].startswith("-") or rest[0].upper() in ("MJD", "FREQ")
+        ):
+            # flag form:  JUMP -fe L-wide 0.034 1 0.001
+            # range form: JUMP MJD 55000 56000 0.034 1  (also -mjd/-freq)
+            key = rest[0].lstrip("-").lower()
+            nsel = 3 if key in ("mjd", "freq") else 2
+            selector = ("-" + key,) + tuple(rest[1:nsel])
+            vals = rest[nsel:]
             value = vals[0] if vals else "0"
             fit = len(vals) > 1 and vals[1] == "1"
             unc = vals[2] if len(vals) > 2 else ""
@@ -128,7 +133,7 @@ def write_parfile(pf: ParFile) -> str:
     out = []
     for l in pf.lines:
         parts = [l.name]
-        parts.extend(l.rest[:2] if l.rest and l.rest[0].startswith("-") else ())
+        parts.extend(l.rest if l.rest and l.rest[0].startswith("-") else ())
         parts.append(l.value)
         if l.fit or l.uncertainty:
             parts.append("1" if l.fit else "0")

@@ -113,6 +113,14 @@ def _parse_into(path: str, tf: TimFile, depth: int) -> None:
                 continue
             upper = stripped.split()[0].upper()
 
+            # A SKIP..NOSKIP region suppresses EVERYTHING inside it —
+            # TOAs *and* commands (INCLUDE/TIME/PHASE/JUMP/FORMAT), per
+            # tempo semantics; only NOSKIP ends the region.
+            if skipping:
+                if upper == "NOSKIP":
+                    skipping = False
+                continue
+
             if upper == "FORMAT":
                 fmt = "tempo2" if "1" in stripped.split()[1:] else "princeton"
                 tf.format = fmt
@@ -141,12 +149,9 @@ def _parse_into(path: str, tf: TimFile, depth: int) -> None:
                 skipping = True
                 continue
             if upper == "NOSKIP":
-                skipping = False
-                continue
+                continue  # NOSKIP outside a SKIP region is a no-op
             if upper == "END":
                 break
-            if skipping:
-                continue
 
             if fmt == "tempo2":
                 toa = _parse_tempo2(stripped.split()) or _parse_princeton(line)

@@ -1,0 +1,12 @@
+"""Timing-model layer: parameters, components, TimingModel, builder.
+
+Reference equivalent: ``pint.models`` (src/pint/models/). The key design
+departure (SURVEY.md §7 "design spine"): components are *pure functions*
+of a resolved parameter dict, the model's phase is one composed pure
+function, and analytic ``d_phase_d_param`` chains are replaced by
+``jax.jacfwd`` of that function.
+"""
+
+from pint_tpu.models.builder import get_model, get_model_and_toas  # noqa: F401
+from pint_tpu.models.timing_model import TimingModel  # noqa: F401
+from pint_tpu.models.parameter import Param  # noqa: F401

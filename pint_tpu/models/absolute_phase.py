@@ -1,0 +1,58 @@
+"""Absolute phase anchor: the TZR (zero-phase reference) TOA.
+
+Reference equivalent: ``pint.models.absolute_phase.AbsPhase``
+(src/pint/models/absolute_phase.py). TZRMJD/TZRSITE/TZRFRQ define a
+fiducial TOA at which the model phase is zero; ``TimingModel.phase`` with
+``abs_phase=True`` subtracts the phase evaluated at that TOA, pinning the
+integer pulse numbering.
+
+The TZR TOA is materialized host-side through the same data pipeline as
+ordinary TOAs (clock chain, TDB, posvels) and cached per ephemeris.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from pint_tpu.models.component import Component
+from pint_tpu.models.parameter import float_param, mjd_param, str_param
+from pint_tpu.ops import dd
+
+
+class AbsPhase(Component):
+    category = "absolute_phase"
+    is_phase = False  # handled specially by TimingModel (needs a second TOA set)
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(mjd_param("TZRMJD", desc="Epoch of zero phase (site time)"))
+        self.add_param(str_param("TZRSITE", default="ssb", desc="TZR observatory"))
+        self.add_param(float_param("TZRFRQ", units="MHz", default=np.inf,
+                                   desc="TZR observing frequency"))
+        self._tzr_cache: dict[str, object] = {}
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return pf.get("TZRMJD") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "AbsPhase":
+        self = cls()
+        self.setup_from_parfile(pf)
+        return self
+
+    def get_tzr_toas(self, ephem: str = "builtin_analytic", planets: bool = True):
+        """One-row TOAs table at the TZR epoch (cached)."""
+        key = f"{ephem}:{planets}"
+        if key not in self._tzr_cache:
+            from pint_tpu.io.timfile import RawTOA, TimFile
+            from pint_tpu.toas import get_TOAs
+
+            mjd_str = dd.to_string(self.param("TZRMJD").as_dd(), ndigits=25)
+            freq = self.param("TZRFRQ").value_f64
+            if not np.isfinite(freq) or freq == 0.0:
+                freq = 1e12  # effectively infinite frequency: no dispersion
+            tf = TimFile(toas=[RawTOA(mjd_str, 0.0, freq, str(self.param("TZRSITE").value))])
+            self._tzr_cache[key] = get_TOAs(tf, ephem=ephem, planets=planets)
+        return self._tzr_cache[key]

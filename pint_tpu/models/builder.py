@@ -1,0 +1,110 @@
+"""Model builder: par file -> TimingModel with the right components.
+
+Reference equivalent: ``pint.models.model_builder.ModelBuilder`` /
+``get_model`` / ``get_model_and_toas`` (src/pint/models/model_builder.py).
+Component classes advertise ``applicable(parfile)``; the builder
+instantiates every applicable component (category conflicts resolved by
+class priority within a category), hands each the parsed par file, and
+validates the assembled model.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from pint_tpu.io.parfile import ParFile, parse_parfile
+from pint_tpu.models.absolute_phase import AbsPhase
+from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
+from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
+from pint_tpu.models.jump import PhaseJump
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+from pint_tpu.models.spindown import Spindown
+from pint_tpu.models.timing_model import TimingModel
+
+log = logging.getLogger(__name__)
+
+# Build-priority list. Within a category, the first applicable class wins
+# (e.g. ecliptic astrometry shadows equatorial when ELONG present).
+COMPONENT_BUILD_ORDER: list[type] = [
+    Spindown,
+    AstrometryEcliptic,
+    AstrometryEquatorial,
+    SolarSystemShapiro,
+    DispersionDM,
+    DispersionDMX,
+    PhaseJump,
+    AbsPhase,
+]
+
+_HEADER_KEYS = ["PSR", "PSRJ", "PSRB", "EPHEM", "CLK", "CLOCK", "UNITS",
+                "TIMEEPH", "T2CMETHOD", "DILATEFREQ", "DMDATA", "NTOA",
+                "TRES", "CHI2", "MODE", "INFO", "SOLARN0", "START", "FINISH",
+                "EPHVER"]
+
+
+def register_component(cls: type, priority: int | None = None) -> None:
+    """Extension hook: add a component class to the builder's search list."""
+    if priority is None:
+        COMPONENT_BUILD_ORDER.append(cls)
+    else:
+        COMPONENT_BUILD_ORDER.insert(priority, cls)
+
+
+def get_model(parfile: str | ParFile) -> TimingModel:
+    """Build a TimingModel from a par file path, text block, or ParFile."""
+    pf = parse_parfile(parfile) if isinstance(parfile, str) else parfile
+
+    taken_categories: set[str] = set()
+    components = []
+    for cls in COMPONENT_BUILD_ORDER:
+        if cls.category in taken_categories:
+            continue
+        if not cls.applicable(pf):
+            continue
+        comp = cls.from_parfile(pf)
+        components.append(comp)
+        taken_categories.add(cls.category)
+
+    if not components:
+        raise ValueError("par file selects no timing-model components")
+
+    header = {}
+    for key in _HEADER_KEYS:
+        line = pf.get(key)
+        if line is not None and line.value:
+            header[key] = line.value
+    name = header.get("PSR") or header.get("PSRJ") or header.get("PSRB") or ""
+
+    units = header.get("UNITS", "TDB").upper()
+    if units not in ("TDB", ""):
+        # TCB par files need rescaling (reference: pint.models.tcb_conversion);
+        # not yet implemented — refuse rather than silently misfit.
+        raise NotImplementedError(
+            f"UNITS {units} not supported yet (only TDB); convert with tcb2tdb"
+        )
+
+    model = TimingModel(components, name=name, header=header)
+    model.validate()
+
+    recognized = set(_HEADER_KEYS) | set(model.params)
+    for p in model.params.values():
+        recognized.update(p.aliases)
+    for line in pf.lines:
+        nm = line.name
+        if nm in recognized or nm == "JUMP" or nm.startswith(
+            ("DMXR1_", "DMXR2_", "DMX_", "JUMP")
+        ):
+            continue
+        log.warning("par parameter %s not recognized by any component; ignored", nm)
+    return model
+
+
+def get_model_and_toas(parfile: str, timfile: str, *, planets: bool = True,
+                       include_clock: bool = True, **kw):
+    """Load model + TOAs consistently (reference: get_model_and_toas)."""
+    from pint_tpu.toas import get_TOAs
+
+    model = get_model(parfile)
+    toas = get_TOAs(timfile, ephem=model.ephem, planets=planets,
+                    include_clock=include_clock, **kw)
+    return model, toas

@@ -1,0 +1,123 @@
+"""Component base class and registry.
+
+Reference equivalent: ``pint.models.timing_model.Component`` with its
+``component_types`` auto-registration (src/pint/models/timing_model.py).
+A component here owns a list of :class:`~pint_tpu.models.parameter.Param`
+descriptors (host state) and exposes *pure* traced functions:
+
+* delay components:  ``delay(p, toas, acc_delay, aux) -> (n,) seconds``
+* phase components:  ``phase(p, toas, delay, aux) -> Phase``
+
+``p`` is the resolved parameter dict ``{name: DD scalar}`` = base values
+(+) fit deltas, so ``jax.jacfwd`` of the composed model phase with respect
+to the deltas reproduces the reference's hand-coded
+``d_phase_d_param``/``d_delay_d_param`` chains automatically.
+
+``aux`` is a mutable dict threaded through the delay chain in category
+order; astrometry publishes ``aux["psr_dir"]`` ((n,3) unit vectors) that
+Shapiro/solar-wind/binary components consume — the functional analogue of
+the reference's cross-component ``ssb_to_psb_xyz`` calls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import Param
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+# Evaluation order of delay/phase categories (reference:
+# pint.models.timing_model.DEFAULT_ORDER).
+DEFAULT_ORDER = [
+    "astrometry",
+    "jump_delay",
+    "troposphere",
+    "solar_system_shapiro",
+    "solar_wind",
+    "dispersion_constant",
+    "dispersion_dmx",
+    "dispersion_jump",
+    "pulsar_system",
+    "frequency_dependent",
+    "absolute_phase",
+    "spindown",
+    "phase_jump",
+    "wave",
+    "ifunc",
+    "glitch",
+]
+
+
+class Component:
+    """Base class; subclasses auto-register into :data:`component_types`."""
+
+    category: str = ""
+    is_delay: bool = False
+    is_phase: bool = False
+    # registry of concrete component classes (name -> class)
+    component_types: dict[str, type["Component"]] = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.category:
+            Component.component_types[cls.__name__] = cls
+
+    def __init__(self):
+        self.params: list[Param] = []
+
+    # -- host-side construction ----------------------------------------
+    def add_param(self, p: Param) -> Param:
+        self.params.append(p)
+        return p
+
+    def param(self, name: str) -> Param:
+        for p in self.params:
+            if p.name == name:
+                return p
+        raise KeyError(f"{type(self).__name__} has no parameter {name}")
+
+    def has_param(self, name: str) -> bool:
+        return any(p.name == name for p in self.params)
+
+    def setup_from_parfile(self, pf) -> None:
+        """Consume this component's lines from a parsed ParFile."""
+        for p in self.params:
+            line = None
+            for cand in (p.name,) + p.aliases:
+                line = pf.get(cand)
+                if line is not None:
+                    break
+            if line is None:
+                continue
+            p.set_from_par(line.value)
+            p.frozen = not line.fit
+            if line.uncertainty:
+                p.set_uncertainty_from_par(line.uncertainty)
+
+    def validate(self) -> None:  # pragma: no cover - overridden where needed
+        pass
+
+    # -- class-level par-file matching ---------------------------------
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        """Does a parsed ParFile call for this component?"""
+        raise NotImplementedError
+
+    # -- traced compute ------------------------------------------------
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        raise NotImplementedError
+
+    def phase(self, p: dict[str, DD], toas, delay: Array, aux: dict):
+        raise NotImplementedError
+
+
+def f64(p: dict[str, DD], name: str) -> Array:
+    """Resolved parameter as float64 (collapses DD; gradient flows)."""
+    v = p[name]
+    return v.hi + v.lo

@@ -1,0 +1,118 @@
+"""Dispersion delay: cold-plasma DM delay, Taylor DM(t), DMX windows.
+
+Reference equivalent: ``pint.models.dispersion_model``
+(src/pint/models/dispersion_model.py :: DispersionDM, DispersionDMX).
+delay = K * DM(t) / freq^2 with K = 1/2.41e-4 s MHz^2 cm^3 / pc (the
+tempo-compatible dispersion constant the reference uses).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import Param, float_param, mjd_param, toa_mask
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+from pint_tpu.constants import DM_CONST
+
+
+class DispersionDM(Component):
+    category = "dispersion_constant"
+    is_delay = True
+
+    def __init__(self, num_dm_terms: int = 1):
+        super().__init__()
+        self.num_dm_terms = max(1, num_dm_terms)
+        for k in range(self.num_dm_terms):
+            name = "DM" if k == 0 else f"DM{k}"
+            units = "pc cm^-3" if k == 0 else f"pc cm^-3 / yr^{k}"
+            self.add_param(float_param(name, units=units, index=k,
+                                       desc=f"Dispersion measure derivative {k}"))
+        self.add_param(mjd_param("DMEPOCH", desc="Epoch of DM parameters"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return pf.get("DM") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "DispersionDM":
+        nd = 1
+        while pf.get(f"DM{nd}") is not None:
+            nd += 1
+        self = cls(num_dm_terms=nd)
+        self.setup_from_parfile(pf)
+        if self.param("DMEPOCH").value_f64 == 0.0:
+            pep = pf.get("PEPOCH")
+            if pep is not None:
+                self.param("DMEPOCH").set_from_par(pep.value)
+        return self
+
+    # ------------------------------------------------------------------
+    def dm_value(self, p: dict[str, DD], toas) -> Array:
+        """DM(t) [pc cm^-3] at each TOA (float64; DM precision ~1e-6 ample)."""
+        t = toas.tdb.hi + toas.tdb.lo
+        dt_yr = (t - f64(p, "DMEPOCH")) / 365.25
+        dm = jnp.zeros_like(t)
+        for k in reversed(range(self.num_dm_terms)):
+            name = "DM" if k == 0 else f"DM{k}"
+            dm = dm * dt_yr + f64(p, name) / math.factorial(k)
+        return dm
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        dm = self.dm_value(p, toas)
+        aux["dm"] = dm
+        return DM_CONST * dm / toas.freq_mhz**2
+
+
+class DispersionDMX(Component):
+    """Piecewise-constant DM offsets over MJD windows (DMX_#### / DMXR1/DMXR2).
+
+    Reference: pint.models.dispersion_model.DispersionDMX. Window masks are
+    static (built from float64 MJDs at trace time); the per-window DM offset
+    is a fitted delta like any other parameter.
+    """
+
+    category = "dispersion_dmx"
+    is_delay = True
+
+    def __init__(self, indices: list[int] | None = None):
+        super().__init__()
+        self.indices = list(indices or [])
+        self.ranges: dict[int, tuple[float, float]] = {}
+        for i in self.indices:
+            self.add_param(float_param(f"DMX_{i:04d}", units="pc cm^-3", index=i,
+                                       desc=f"DM offset in window {i}"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return bool(pf.get_all("DMX_"))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "DispersionDMX":
+        idx = sorted(int(l.name.split("_")[1]) for l in pf.get_all("DMX_"))
+        self = cls(indices=idx)
+        self.setup_from_parfile(pf)
+        for i in idx:
+            r1 = pf.get(f"DMXR1_{i:04d}")
+            r2 = pf.get(f"DMXR2_{i:04d}")
+            self.ranges[i] = (
+                float(r1.value) if r1 else 0.0,
+                float(r2.value) if r2 else 1e9,
+            )
+        return self
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        mjds = toas.get_mjds()  # host numpy, static at trace time
+        total = jnp.zeros(len(toas))
+        for i in self.indices:
+            lo, hi = self.ranges[i]
+            mask = jnp.asarray((mjds >= lo) & (mjds <= hi), jnp.float64)
+            total = total + mask * f64(p, f"DMX_{i:04d}")
+        return DM_CONST * total / toas.freq_mhz**2

@@ -1,0 +1,245 @@
+"""Parameter system: typed, unit-tagged timing-model parameters.
+
+Reference equivalent: ``pint.models.parameter`` (src/pint/models/parameter.py
+:: floatParameter, MJDParameter, AngleParameter, boolParameter, strParameter,
+prefixParameter, maskParameter). Differences, by design:
+
+* Values that must survive at ~1e-18 relative precision (spin frequencies,
+  epochs) are stored as an exact (hi, lo) float64 pair — the host-side twin
+  of :class:`pint_tpu.ops.dd.DD` — parsed losslessly from par-file decimal
+  strings.
+* Fitting never mutates these values directly on device. The fitter solves
+  for a small float64 *delta* per free parameter (linearization around the
+  base value) and the host applies ``base <- base (+) delta`` in exact DD
+  arithmetic. This is what makes float64 TPU linear algebra compatible with
+  longdouble-grade state.
+* maskParameter selection (JUMP -fe L-wide ...) is host-side metadata here;
+  boolean masks are materialized at trace time from static TOA flags.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+from pint_tpu.utils import angles
+
+# parameter kinds
+FLOAT = "float"  # plain numeric (float64-grade)
+DDFLOAT = "ddfloat"  # numeric needing double-double (F0, epochs-as-values)
+MJD = "mjd"  # epoch in MJD, DD-grade, usually not fittable
+ANGLE_RA = "angle_ra"  # sexagesimal hours -> rad
+ANGLE_DEC = "angle_dec"  # sexagesimal degrees -> rad
+BOOL = "bool"
+STR = "str"
+
+
+@dataclass
+class Param:
+    """One timing-model parameter (host-side descriptor).
+
+    ``value`` is an exact (hi, lo) float64 pair for numeric kinds, a bool
+    for BOOL, a string for STR. ``uncertainty`` is in *internal* units
+    (rad for angles); :func:`format_uncertainty` converts for par output.
+    """
+
+    name: str
+    kind: str = FLOAT
+    value: object = None
+    units: str = ""
+    description: str = ""
+    frozen: bool = True
+    uncertainty: float = 0.0
+    aliases: tuple[str, ...] = ()
+    # maskParameter selector, e.g. ("-fe", "L-wide") or ("-tel", "gbt") or
+    # ("tim_jump", "2") for tim-file JUMP blocks; empty for plain params.
+    selector: tuple[str, ...] = ()
+    # prefixParameter index (F0 -> 0, DMX_0003 -> 3); -1 for non-prefix.
+    index: int = -1
+    # scale from par-file display units to internal units (angles handled
+    # separately by kind).
+    par_scale: float = 1.0
+
+    # ------------------------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (FLOAT, DDFLOAT, MJD, ANGLE_RA, ANGLE_DEC)
+
+    @property
+    def fittable(self) -> bool:
+        # Epochs and discrete params are never fit (matches reference:
+        # PEPOCH/POSEPOCH/DMEPOCH have no derivatives in PINT either).
+        return self.is_numeric and self.kind != MJD
+
+    @property
+    def hi(self) -> float:
+        return self.value[0]
+
+    @property
+    def lo(self) -> float:
+        return self.value[1]
+
+    def as_dd(self) -> DD:
+        """Value as a scalar DD of jnp arrays (for the compute path)."""
+        return DD(jnp.asarray(self.hi, jnp.float64), jnp.asarray(self.lo, jnp.float64))
+
+    @property
+    def value_f64(self) -> float:
+        return float(self.hi + self.lo)
+
+    # ------------------------------------------------------------------
+    def set_from_par(self, text: str) -> None:
+        """Parse a par-file value string into the internal representation."""
+        if self.kind == BOOL:
+            self.value = str(text).strip().upper() in ("1", "Y", "YES", "T", "TRUE")
+        elif self.kind == STR:
+            self.value = str(text).strip()
+        elif self.kind == ANGLE_RA:
+            self.value = _split_f64(angles.hms_to_rad(text))
+        elif self.kind == ANGLE_DEC:
+            self.value = _split_f64(angles.dms_to_rad(text))
+        else:
+            v = dd.from_string(text)
+            hi, lo = float(np.asarray(v.hi)), float(np.asarray(v.lo))
+            if self.par_scale != 1.0:
+                hi, lo = hi * self.par_scale, lo * self.par_scale
+            self.value = (hi, lo)
+
+    def set_uncertainty_from_par(self, text: str) -> None:
+        try:
+            u = float(text.replace("D", "e").replace("d", "e"))
+        except ValueError:
+            return
+        if self.kind == ANGLE_RA:
+            u *= angles.RAD_PER_HOURANGLE_SEC
+        elif self.kind == ANGLE_DEC:
+            u *= angles.RAD_PER_ARCSEC
+        else:
+            u *= self.par_scale
+        self.uncertainty = u
+
+    def set_value_dd(self, hi: float, lo: float = 0.0) -> None:
+        self.value = (float(hi), float(lo))
+
+    def add_delta(self, delta: float) -> None:
+        """Apply a fitted correction exactly: value <- value (+) delta."""
+        s, e = _two_sum(self.hi, float(delta))
+        e += self.lo
+        self.value = _renorm(s, e)
+
+    # ------------------------------------------------------------------
+    def format_value(self) -> str:
+        if self.kind == BOOL:
+            return "Y" if self.value else "N"
+        if self.kind == STR:
+            return str(self.value)
+        if self.kind == ANGLE_RA:
+            return angles.rad_to_hms(self.value_f64, ndp=11)
+        if self.kind == ANGLE_DEC:
+            return angles.rad_to_dms(self.value_f64, ndp=10)
+        hi, lo = self.hi / self.par_scale, self.lo / self.par_scale
+        if lo == 0.0 and abs(hi) < 1e15:
+            # short representation when exactly a float64
+            s = repr(hi)
+            return s
+        return dd.to_string(DD(jnp.asarray(hi), jnp.asarray(lo)), ndigits=21)
+
+    def format_uncertainty(self) -> str:
+        u = self.uncertainty
+        if self.kind == ANGLE_RA:
+            u /= angles.RAD_PER_HOURANGLE_SEC
+        elif self.kind == ANGLE_DEC:
+            u /= angles.RAD_PER_ARCSEC
+        else:
+            u /= self.par_scale
+        return f"{u:.8g}"
+
+    def as_parfile_line(self) -> str:
+        parts = [f"{self.name:<15}"]
+        if self.selector and self.selector[0].startswith("-"):
+            base = self.name.rstrip("0123456789")
+            parts = [f"{base:<8}", *self.selector]
+        parts.append(self.format_value())
+        if self.is_numeric and self.fittable:
+            parts.append("1" if not self.frozen else "0")
+            if self.uncertainty:
+                parts.append(self.format_uncertainty())
+        return " ".join(str(p) for p in parts)
+
+
+def _split_f64(x: float) -> tuple[float, float]:
+    return (float(x), 0.0)
+
+
+def _two_sum(a: float, b: float) -> tuple[float, float]:
+    s = a + b
+    bb = s - a
+    return s, (a - (s - bb)) + (b - bb)
+
+
+def _renorm(hi: float, lo: float) -> tuple[float, float]:
+    s = hi + lo
+    return (s, lo - (s - hi))
+
+
+def float_param(name: str, units: str = "", desc: str = "", default: float = 0.0,
+                aliases: tuple[str, ...] = (), par_scale: float = 1.0,
+                kind: str = FLOAT, index: int = -1) -> Param:
+    return Param(name=name, kind=kind, value=(float(default), 0.0), units=units,
+                 description=desc, aliases=aliases, par_scale=par_scale, index=index)
+
+
+def mjd_param(name: str, desc: str = "", aliases: tuple[str, ...] = ()) -> Param:
+    return Param(name=name, kind=MJD, value=(0.0, 0.0), units="d",
+                 description=desc, aliases=aliases)
+
+
+def str_param(name: str, default: str = "", desc: str = "",
+              aliases: tuple[str, ...] = ()) -> Param:
+    return Param(name=name, kind=STR, value=default, description=desc, aliases=aliases)
+
+
+def bool_param(name: str, default: bool = False, desc: str = "",
+               aliases: tuple[str, ...] = ()) -> Param:
+    return Param(name=name, kind=BOOL, value=default, description=desc, aliases=aliases)
+
+
+# ---------------------------------------------------------------------------
+# maskParameter selection semantics (reference src/pint/models/parameter.py
+# :: maskParameter.select_toa_mask)
+# ---------------------------------------------------------------------------
+
+
+def toa_mask(selector: tuple[str, ...], toas) -> np.ndarray:
+    """Boolean mask of TOAs matched by a maskParameter selector.
+
+    Host-side: consumes only static TOA metadata (flags, site names,
+    float64 MJDs/freqs), so it is safe to call at trace time.
+    """
+    n = len(toas)
+    if not selector:
+        return np.ones(n, dtype=bool)
+    key = selector[0].lstrip("-").lower()
+    if key == "tim_jump":
+        return np.asarray(toas.jump_group) == int(selector[1])
+    if key in ("tel", "obs"):
+        from pint_tpu import observatory as obs_mod
+
+        target = obs_mod.get_observatory(selector[1]).name
+        names = np.asarray([toas.obs_names[i] for i in toas.obs_index])
+        return names == target
+    if key == "mjd":
+        mjds = toas.get_mjds()
+        return (mjds >= float(selector[1])) & (mjds <= float(selector[2]))
+    if key == "freq":
+        f = np.asarray(toas.freq_mhz)
+        return (f >= float(selector[1])) & (f <= float(selector[2]))
+    # generic flag match: -fe L-wide, -f 430_PUPPI, -sys ...
+    vals = np.asarray([fl.get(key, "") for fl in toas.flags])
+    return vals == selector[1]

@@ -1,0 +1,72 @@
+"""Solar-system Shapiro delay: GR time delay in the Sun/planet potentials.
+
+Reference equivalent: ``pint.models.solar_system_shapiro.SolarSystemShapiro``
+(src/pint/models/solar_system_shapiro.py). For each body,
+
+    delay = -2 * T_body * ln((r - r.n_hat) / AU)
+
+with r the body position relative to the observatory, n_hat the pulsar
+direction, T_body = G M / c^3. The AU normalization is an arbitrary
+constant absorbed by the phase offset (same convention as the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import bool_param
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+from pint_tpu.constants import AU_LIGHT_S, T_SUN_S
+_MASS_RATIO = {  # M_body / M_sun (IAU nominal values)
+    "jupiter": 9.547919e-4,
+    "saturn": 2.858857e-4,
+    "venus": 2.447838e-6,
+    "uranus": 4.366244e-5,
+    "neptune": 5.151389e-5,
+}
+
+
+class SolarSystemShapiro(Component):
+    category = "solar_system_shapiro"
+    is_delay = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(bool_param("PLANET_SHAPIRO", default=False,
+                                  desc="Include Jupiter/Saturn/Venus/Uranus/Neptune"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        # present whenever astrometry is (the reference adds it by default
+        # for any model with a sky position)
+        return pf.get("RAJ") is not None or pf.get("ELONG") is not None \
+            or pf.get("RA") is not None or pf.get("LAMBDA") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "SolarSystemShapiro":
+        self = cls()
+        self.setup_from_parfile(pf)
+        return self
+
+    @staticmethod
+    def body_shapiro_delay(obj_pos_ls: Array, psr_dir: Array, t_body_s: float) -> Array:
+        """One body's Shapiro delay [s]; obj_pos is body-wrt-observatory (n,3) lt-s."""
+        r = jnp.sqrt(jnp.sum(obj_pos_ls**2, axis=-1))
+        rcostheta = jnp.sum(obj_pos_ls * psr_dir, axis=-1)
+        return -2.0 * t_body_s * jnp.log((r - rcostheta) / AU_LIGHT_S)
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        psr_dir = aux["psr_dir"]
+        total = self.body_shapiro_delay(toas.planet_pos_ls["sun"], psr_dir, T_SUN_S)
+        if self.param("PLANET_SHAPIRO").value:
+            for body, ratio in _MASS_RATIO.items():
+                if body in toas.planet_pos_ls:
+                    total = total + self.body_shapiro_delay(
+                        toas.planet_pos_ls[body], psr_dir, T_SUN_S * ratio
+                    )
+        return total

@@ -1,0 +1,79 @@
+"""Spindown: pulse phase as a Taylor series in rotation frequency.
+
+Reference equivalent: ``pint.models.spindown.Spindown``
+(src/pint/models/spindown.py). phase(t) = sum_k F_k * dt^(k+1) / (k+1)!
+with dt = (t_bary - PEPOCH) in seconds.
+
+Precision: dt spans ~1e9 s and F0 ~ 1e2 Hz, so F0*dt ~ 1e11 turns must be
+carried to 1e-9 turns => ~1e-20 relative. The Horner evaluation therefore
+runs entirely in double-double; this is the reference's longdouble hot
+loop (SURVEY.md §3.2 ♨) recast as branch-free DD ops that XLA fuses into
+a handful of vector FMAs per TOA.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import DDFLOAT, float_param, mjd_param
+from pint_tpu.ops import dd, phase as phase_mod, timescales as ts
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class Spindown(Component):
+    category = "spindown"
+    is_phase = True
+
+    def __init__(self, num_freq_terms: int = 2):
+        super().__init__()
+        self.num_freq_terms = max(1, num_freq_terms)
+        for k in range(self.num_freq_terms):
+            units = "Hz" if k == 0 else f"Hz/s^{k}"
+            aliases = ("F",) if k == 0 else ()
+            self.add_param(
+                float_param(f"F{k}", units=units, kind=DDFLOAT, index=k,
+                            desc=f"Spin frequency derivative {k}", aliases=aliases)
+            )
+        self.add_param(mjd_param("PEPOCH", desc="Epoch of spin parameters"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return pf.get("F0") is not None or pf.get("F") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "Spindown":
+        nf = 1
+        while pf.get(f"F{nf}") is not None:
+            nf += 1
+        self = cls(num_freq_terms=nf)
+        self.setup_from_parfile(pf)
+        return self
+
+    def validate(self) -> None:
+        if self.param("F0").value_f64 <= 0:
+            raise ValueError("F0 must be positive")
+
+    # ------------------------------------------------------------------
+    def dt_seconds(self, p: dict[str, DD], toas, delay: Array) -> DD:
+        """Barycentric time since PEPOCH, in DD seconds."""
+        dt = ts.dt_seconds(toas.tdb, p["PEPOCH"])
+        return dd.sub(dt, delay)
+
+    def phase(self, p: dict[str, DD], toas, delay: Array, aux: dict) -> phase_mod.Phase:
+        dt = self.dt_seconds(p, toas, delay)
+        # Horner in DD over coefficients F_k/(k+1)!
+        acc: DD | None = None
+        for k in reversed(range(self.num_freq_terms)):
+            ck = dd.scale_pow2(p[f"F{k}"], 1.0)  # copy
+            fact = math.factorial(k + 1)
+            if fact != 1:
+                ck = dd.div(ck, float(fact))
+            acc = ck if acc is None else dd.add(dd.mul(acc, dt), ck)
+        turns = dd.mul(acc, dt)
+        return phase_mod.from_dd(turns)

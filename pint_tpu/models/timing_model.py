@@ -1,0 +1,260 @@
+"""TimingModel: ordered component container and composed pure phase function.
+
+Reference equivalent: ``pint.models.timing_model.TimingModel``
+(src/pint/models/timing_model.py). The reference sums per-component
+``delay()``/``phase()`` methods in a Python loop and maintains hand-coded
+analytic derivative chains (``d_phase_d_param``). Here the whole model is
+*one pure function*
+
+    phase(base_params, deltas, toas) -> Phase
+
+with parameters resolved as ``base (+) delta`` in double-double, so
+
+* residual evaluation traces once and runs fused under ``jit``;
+* the design matrix is ``jax.jacfwd`` of that function with respect to
+  the (float64, zero-valued) deltas — exact linearization around the
+  DD-precision base values, replacing the reference's per-parameter
+  derivative loop (SURVEY.md §3.3 ♨).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import DEFAULT_ORDER, Component
+from pint_tpu.models.parameter import Param
+from pint_tpu.ops import dd, phase as phase_mod
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+def _order_key(comp: Component) -> int:
+    try:
+        return DEFAULT_ORDER.index(comp.category)
+    except ValueError:
+        return len(DEFAULT_ORDER)
+
+
+class TimingModel:
+    """Host-side model container; compute goes through pure functions."""
+
+    def __init__(self, components: list[Component], name: str = "",
+                 header: dict[str, str] | None = None):
+        self.name = name
+        self.components: list[Component] = sorted(components, key=_order_key)
+        # header/meta lines preserved for par round-trip (EPHEM, UNITS, ...)
+        self.header: dict[str, str] = dict(header or {})
+        self._validate_unique_params()
+
+    # ------------------------------------------------------------------
+    # parameter access
+    # ------------------------------------------------------------------
+    def _validate_unique_params(self) -> None:
+        seen: dict[str, str] = {}
+        for c in self.components:
+            for p in c.params:
+                if p.name in seen:
+                    raise ValueError(
+                        f"parameter {p.name} defined by both {seen[p.name]} "
+                        f"and {type(c).__name__}"
+                    )
+                seen[p.name] = type(c).__name__
+
+    @property
+    def params(self) -> dict[str, Param]:
+        out: dict[str, Param] = {}
+        for c in self.components:
+            for p in c.params:
+                out[p.name] = p
+        return out
+
+    @property
+    def free_params(self) -> list[str]:
+        return [p.name for p in self.params.values() if not p.frozen and p.fittable]
+
+    def __getitem__(self, name: str) -> Param:
+        return self.params[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.params
+
+    def get_component(self, cls_name: str) -> Component | None:
+        for c in self.components:
+            if type(c).__name__ == cls_name:
+                return c
+        return None
+
+    def has_component(self, cls_name: str) -> bool:
+        return self.get_component(cls_name) is not None
+
+    def add_component(self, comp: Component) -> None:
+        self.components.append(comp)
+        self.components.sort(key=_order_key)
+        self._validate_unique_params()
+
+    def remove_component(self, cls_name: str) -> None:
+        self.components = [c for c in self.components if type(c).__name__ != cls_name]
+
+    def validate(self) -> None:
+        for c in self.components:
+            c.validate()
+
+    @property
+    def ephem(self) -> str:
+        return self.header.get("EPHEM", "builtin_analytic")
+
+    @property
+    def f0_f64(self) -> float:
+        return self.params["F0"].value_f64
+
+    # ------------------------------------------------------------------
+    # pure-function assembly
+    # ------------------------------------------------------------------
+    def base_dd(self) -> dict[str, DD]:
+        """All numeric parameter values as scalar DDs (the linearization point)."""
+        return {p.name: p.as_dd() for p in self.params.values() if p.is_numeric}
+
+    def zero_deltas(self, params: list[str] | None = None) -> dict[str, Array]:
+        names = params if params is not None else self.free_params
+        return {k: jnp.zeros((), jnp.float64) for k in names}
+
+    @staticmethod
+    def resolve(base: dict[str, DD], deltas: dict[str, Array]) -> dict[str, DD]:
+        out = dict(base)
+        for k, d in deltas.items():
+            out[k] = dd.add(base[k], d)
+        return out
+
+    def delay_components(self) -> list[Component]:
+        return [c for c in self.components if c.is_delay]
+
+    def phase_components(self) -> list[Component]:
+        return [c for c in self.components if c.is_phase]
+
+    def get_tzr_toas(self, planets: bool = True):
+        absph = self.get_component("AbsPhase")
+        if absph is None:
+            return None
+        return absph.get_tzr_toas(self.ephem, planets=planets)
+
+    def phase_fn(self, toas, *, abs_phase: bool = True):
+        """Build ``fn(base, deltas) -> Phase`` with `toas` closed over.
+
+        Closing over the TOA table (rather than passing the pytree through
+        jit) embeds the arrays as XLA constants: one compiled executable
+        per dataset, which matches the reference's usage pattern (a fitter
+        is bound to one TOAs table) and sidesteps retracing.
+        """
+        tzr = self.get_tzr_toas() if abs_phase else None
+        delay_comps = self.delay_components()
+        phase_comps = self.phase_components()
+
+        def phase_at(p: dict[str, DD], tt) -> phase_mod.Phase:
+            aux: dict = {}
+            delay = jnp.zeros(len(tt))
+            for c in delay_comps:
+                delay = delay + c.delay(p, tt, delay, aux)
+            ph = phase_mod.zero_like(delay)
+            for c in phase_comps:
+                ph = phase_mod.add(ph, c.phase(p, tt, delay, aux))
+            return ph
+
+        def fn(base: dict[str, DD], deltas: dict[str, Array]) -> phase_mod.Phase:
+            p = self.resolve(base, deltas)
+            ph = phase_at(p, toas)
+            if tzr is not None:
+                ph = phase_mod.add(ph, phase_mod.neg(phase_at(p, tzr)))
+            return ph
+
+        return fn
+
+    # ------------------------------------------------------------------
+    # reference-API conveniences (host entry points)
+    # ------------------------------------------------------------------
+    def phase(self, toas, abs_phase: bool = True) -> phase_mod.Phase:
+        """Model phase at each TOA (reference: TimingModel.phase)."""
+        fn = self.phase_fn(toas, abs_phase=abs_phase)
+        return fn(self.base_dd(), {})
+
+    def delay(self, toas) -> Array:
+        """Total delay [s] (reference: TimingModel.delay)."""
+        p = self.base_dd()
+        aux: dict = {}
+        delay = jnp.zeros(len(toas))
+        for c in self.delay_components():
+            delay = delay + c.delay(p, toas, delay, aux)
+        return delay
+
+    def designmatrix(self, toas, params: list[str] | None = None,
+                     incoffset: bool = True) -> tuple[Array, list[str]]:
+        """Design matrix in seconds per parameter unit.
+
+        Columns follow the reference convention
+        (pint.models.timing_model.TimingModel.designmatrix): an 'Offset'
+        column of 1/F0, then -d_phase/d_param / F0 per free parameter —
+        computed here by one ``jacfwd`` instead of the per-parameter
+        analytic chain.
+        """
+        names = params if params is not None else self.free_params
+        base = self.base_dd()
+        fn = self.phase_fn(toas)
+
+        def total_phase(deltas: dict[str, Array]) -> Array:
+            ph = fn(base, deltas)
+            return ph.int_part + (ph.frac.hi + ph.frac.lo)
+
+        J = jax.jacfwd(total_phase)(self.zero_deltas(names))
+        f0 = self.f0_f64
+        cols = []
+        out_names = []
+        if incoffset:
+            cols.append(jnp.ones(len(toas)) / f0)
+            out_names.append("Offset")
+        for k in names:
+            cols.append(-J[k] / f0)
+            out_names.append(k)
+        return jnp.stack(cols, axis=1), out_names
+
+    # ------------------------------------------------------------------
+    # par-file output (reference: TimingModel.as_parfile)
+    # ------------------------------------------------------------------
+    _HEADER_ORDER = ["PSR", "PSRJ", "EPHEM", "CLK", "CLOCK", "UNITS", "TIMEEPH",
+                     "T2CMETHOD", "DILATEFREQ", "DMDATA", "NTOA", "TRES",
+                     "CHI2", "MODE", "INFO", "BINARY", "SOLARN0", "START",
+                     "FINISH"]
+
+    def as_parfile(self) -> str:
+        lines = [f"# Created by pint_tpu v0 (TimingModel.as_parfile)"]
+        psr = self.header.get("PSR") or self.header.get("PSRJ") or self.name
+        if psr:
+            lines.append(f"{'PSR':<15} {psr}")
+        for key in self._HEADER_ORDER:
+            if key in ("PSR", "PSRJ"):
+                continue
+            if key in self.header:
+                lines.append(f"{key:<15} {self.header[key]}")
+        skip_defaults = {"PMRA", "PMDEC", "PMELONG", "PMELAT", "PX",
+                         "PLANET_SHAPIRO", "TZRFRQ"}
+        for c in self.components:
+            for p in c.params:
+                if p.kind == "bool":
+                    if p.value:
+                        lines.append(f"{p.name:<15} Y")
+                    continue
+                if p.name in skip_defaults and p.frozen and (
+                    not p.is_numeric or p.value_f64 == 0.0
+                ):
+                    continue
+                if p.kind == "str" and not p.value:
+                    continue
+                if p.kind == "float" and not np.isfinite(p.value_f64):
+                    continue
+                lines.append(p.as_parfile_line())
+        return "\n".join(lines) + "\n"
+
+    def __repr__(self) -> str:
+        comps = ", ".join(type(c).__name__ for c in self.components)
+        return f"TimingModel({self.name or '?'}: {comps})"

@@ -40,11 +40,9 @@ from pint_tpu.data.leapseconds import LEAP_MJD, LEAP_TAI_MINUS_UTC
 from pint_tpu.ops import dd
 from pint_tpu.ops.dd import DD
 
-SECS_PER_DAY = 86400.0
-TT_MINUS_TAI_S = 32.184
-MJD_J2000 = 51544.5  # TT
-JULIAN_MILLENNIUM_DAYS = 365250.0
-C_M_S = 299792458.0
+from pint_tpu.constants import (  # noqa: F401  (re-exported)
+    C_M_S, JULIAN_MILLENNIUM_DAYS, MJD_J2000, SECS_PER_DAY, TT_MINUS_TAI_S,
+)
 
 _LEAP_MJD = jnp.asarray(LEAP_MJD, jnp.float64)
 _LEAP_OFF = jnp.asarray(LEAP_TAI_MINUS_UTC, jnp.float64)

@@ -1,0 +1,108 @@
+"""Residuals: model-predicted phase vs observed arrival, in turns and seconds.
+
+Reference equivalent: ``pint.residuals.Residuals`` (src/pint/residuals.py).
+Conventions matched to the reference (SURVEY.md hard-part #5):
+
+* ``track_mode="nearest"``: the fractional part of the model phase (in
+  [-0.5, 0.5]) is the residual — each TOA is compared to its nearest
+  integer pulse.
+* ``track_mode="use_pulse_numbers"``: residual = full phase minus the
+  per-TOA pulse number (from ``-pn`` flags), keeping integer-turn slips.
+* PHASE-command offsets from the tim file enter as added turns.
+* Optional (default on) subtraction of the (weighted) mean phase.
+* ``time_resids = phase_resids / F0``.
+
+Residual magnitudes are < 1 turn, so float64 carries them losslessly once
+the DD phase has been wrapped; chi-square and all downstream linear
+algebra are float64 (TPU-friendly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.ops import phase as phase_mod
+
+Array = jax.Array
+
+
+class Residuals:
+    """Computed once at construction; arrays are device-resident float64."""
+
+    def __init__(self, toas, model, *, subtract_mean: bool = True,
+                 use_weighted_mean: bool = True, track_mode: str | None = None):
+        self.toas = toas
+        self.model = model
+        self.subtract_mean = subtract_mean
+        self.use_weighted_mean = use_weighted_mean
+        if track_mode is None:
+            has_pn = bool(np.any(np.isfinite(np.asarray(toas.pulse_number))))
+            track_mode = "use_pulse_numbers" if has_pn else "nearest"
+        self.track_mode = track_mode
+        self.phase = model.phase(toas, abs_phase=True)
+        self.phase_resids = self._calc_phase_resids()
+        self.time_resids = self.phase_resids / model.f0_f64
+
+    # ------------------------------------------------------------------
+    def _calc_phase_resids(self) -> Array:
+        # PHASE-command offsets enter in phase space *before* wrapping, so
+        # integer PHASE commands are no-ops under nearest tracking
+        # (reference: delta_pulse_number handling in Residuals).
+        ph = phase_mod.add(self.phase, phase_mod.from_f64(self.toas.phase_offset))
+        if self.track_mode == "use_pulse_numbers":
+            pn = self.toas.pulse_number
+            pn_safe = jnp.where(jnp.isfinite(pn), pn, ph.int_part)
+            resid = (ph.int_part - pn_safe) + (ph.frac.hi + ph.frac.lo)
+        elif self.track_mode == "nearest":
+            resid = ph.frac.hi + ph.frac.lo
+        else:
+            raise ValueError(f"unknown track_mode {self.track_mode!r}")
+        if self.subtract_mean:
+            if self.use_weighted_mean:
+                err = self.toas.get_errors_s()
+                w = jnp.where(err > 0, 1.0 / jnp.square(err), 0.0)
+                mean = jnp.sum(resid * w) / jnp.sum(w)
+            else:
+                mean = jnp.mean(resid)
+            resid = resid - mean
+        return resid
+
+    # ------------------------------------------------------------------
+    def get_errors_s(self) -> Array:
+        """Per-TOA uncertainty [s], noise-model-scaled when present.
+
+        Reference: Residuals.get_data_error -> model.scaled_toa_uncertainty.
+        """
+        scaler = getattr(self.model, "scaled_toa_uncertainty", None)
+        if scaler is not None:
+            return scaler(self.toas)
+        return self.toas.get_errors_s()
+
+    @property
+    def chi2(self) -> float:
+        err = self.get_errors_s()
+        return float(jnp.sum(jnp.square(self.time_resids / err)))
+
+    @property
+    def dof(self) -> int:
+        # free params + 1 for the implicit phase offset (reference convention)
+        return len(self.toas) - len(self.model.free_params) - 1
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+    def rms_weighted_s(self) -> float:
+        err = self.get_errors_s()
+        w = 1.0 / jnp.square(err)
+        mean = jnp.sum(self.time_resids * w) / jnp.sum(w)
+        var = jnp.sum(jnp.square(self.time_resids - mean) * w) / jnp.sum(w)
+        return float(jnp.sqrt(var))
+
+    def calc_time_resids(self) -> Array:
+        return self.time_resids
+
+    def calc_phase_resids(self) -> Array:
+        return self.phase_resids

@@ -37,7 +37,7 @@ from pint_tpu.ops.dd import DD
 
 Array = jax.Array
 
-C_M_S = 299792458.0
+from pint_tpu.constants import C_M_S
 PLANET_NAMES = ("sun", "venus", "jupiter", "saturn", "uranus", "neptune")
 
 

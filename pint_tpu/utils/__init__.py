@@ -1,0 +1,3 @@
+"""Host-side utilities (angles, formatting, statistics)."""
+
+from pint_tpu.utils import angles  # noqa: F401

@@ -1,0 +1,113 @@
+"""End-to-end WLS fitting: simulate -> perturb -> fit -> recover.
+
+This is the S3 milestone (SURVEY.md §7): the offline analogue of the
+reference's NGC6440E tutorial fit, with golden values replaced by the
+self-consistency loop (tempo2 and real ephemerides are unavailable —
+SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitting import Fitter, WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+
+@pytest.fixture(scope="module")
+def model_toas():
+    model = get_model(PAR)
+    # two receivers: multi-frequency TOAs break the DM/offset degeneracy
+    toas = make_fake_toas_uniform(53478, 54187, 120, model, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=2.0, add_noise=True, seed=42)
+    return model, toas
+
+
+def test_fit_recovers_perturbation(model_toas):
+    model, toas = model_toas
+    truth = {k: model[k].value_f64 for k in model.free_params}
+
+    perturbed = get_model(PAR)
+    perturbed["F0"].add_delta(3e-10)
+    perturbed["F1"].add_delta(2e-17)
+    perturbed["DM"].add_delta(2e-3)
+    perturbed["RAJ"].add_delta(4e-8)
+    perturbed["DECJ"].add_delta(-6e-8)
+
+    f = WLSFitter(toas, perturbed)
+    pre_chi2 = f.resids_init.chi2
+    chi2 = f.fit_toas(maxiter=2)
+    assert chi2 < pre_chi2
+    n = len(toas)
+    assert chi2 / (n - 6) < 1.6  # statistically clean fit
+
+    for name in ("F0", "F1", "DM", "RAJ", "DECJ"):
+        p = perturbed[name]
+        err = p.uncertainty
+        assert err > 0, name
+        pull = (p.value_f64 - truth[name]) / err
+        assert abs(pull) < 5.0, f"{name}: pull {pull}"
+
+
+def test_fit_uncertainty_scales(model_toas):
+    model, toas = model_toas
+    m = get_model(PAR)
+    f = WLSFitter(toas, m)
+    f.fit_toas()
+    # F0 uncertainty should be tiny relative to F0 and positive
+    assert 0 < m["F0"].uncertainty < 1e-9
+    # covariance matrix is symmetric positive-ish
+    cov = f.parameter_covariance_matrix
+    assert cov.shape == (6, 6)
+    np.testing.assert_allclose(cov, cov.T, rtol=1e-6, atol=1e-30)
+    assert np.all(np.diag(cov) > 0)
+
+
+def test_fitter_auto_picks_wls(model_toas):
+    model, toas = model_toas
+    f = Fitter.auto(toas, get_model(PAR))
+    assert isinstance(f, WLSFitter)
+
+
+def test_noise_free_fit_is_exact(model_toas):
+    """With add_noise=False the fit must land on the truth to ~machine level."""
+    model, _ = model_toas
+    toas = make_fake_toas_uniform(53400, 54400, 80, model, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0)
+    truth = {k: model[k].value_f64 for k in model.free_params}
+    m = get_model(PAR)
+    m["F0"].add_delta(1e-10)
+    m["DM"].add_delta(1e-3)
+    f = WLSFitter(toas, m)
+    f.fit_toas(maxiter=2)
+    r = Residuals(toas, m)
+    assert r.rms_weighted_s() < 1e-9
+    assert abs(m["F0"].value_f64 - truth["F0"]) < 1e-12
+    assert abs(m["DM"].value_f64 - truth["DM"]) < 1e-6
+
+
+def test_summary_renders(model_toas):
+    model, toas = model_toas
+    f = WLSFitter(toas, get_model(PAR))
+    f.fit_toas()
+    s = f.get_summary()
+    assert "F0" in s and "chi2" in s

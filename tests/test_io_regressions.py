@@ -1,0 +1,83 @@
+"""Regression tests for tim/par parsing semantics found in review."""
+
+import numpy as np
+
+from pint_tpu.io.parfile import parse_parfile
+from pint_tpu.io.timfile import parse_timfile
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.io.timfile import RawTOA, TimFile
+from pint_tpu.toas import get_TOAs
+
+
+def test_skip_suppresses_commands(tmp_path):
+    inner = tmp_path / "inner.tim"
+    inner.write_text("FORMAT 1\nhidden 1400 55000.0 1.0 @\n")
+    tim = tmp_path / "outer.tim"
+    tim.write_text(
+        "FORMAT 1\n"
+        "a 1400 55001.0 1.0 @\n"
+        "SKIP\n"
+        "TIME 5.0\n"
+        f"INCLUDE {inner}\n"
+        "NOSKIP\n"
+        "b 1400 55002.0 1.0 @\n"
+    )
+    tf = parse_timfile(str(tim))
+    names = [t.flags["name"] for t in tf.toas]
+    assert names == ["a", "b"]  # 'hidden' skipped
+    assert all(t.time_offset_s == 0.0 for t in tf.toas)  # TIME inside SKIP ignored
+
+
+def test_jump_mjd_range_parses_and_masks():
+    par = """
+    PSR  TESTJ
+    F0   100.0  1
+    PEPOCH  55000
+    RAJ  05:00:00
+    DECJ 10:00:00
+    DM 10
+    JUMP MJD 55050 55150 0.001 1
+    TZRMJD 55000
+    TZRSITE @
+    """
+    m = get_model(par)
+    p = m.params["JUMP1"]
+    assert p.selector == ("-mjd", "55050", "55150")
+    assert p.value_f64 == 0.001
+    assert not p.frozen
+    tf = TimFile(toas=[RawTOA("55100.1", 1.0, 1400.0, "@"),
+                       RawTOA("55200.1", 1.0, 1400.0, "@")])
+    t = get_TOAs(tf, ephem=m.ephem)
+    r = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    m["JUMP1"].set_value_dd(0.0)
+    r0 = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    d = r - r0
+    assert abs(d[0] + 1e-3) < 1e-12  # in-range TOA jumped
+    assert abs(d[1]) < 1e-12  # out-of-range untouched
+
+
+def test_integer_phase_command_is_noop_under_nearest():
+    par = """
+    PSR  TESTP
+    F0   100.0  1
+    PEPOCH  55000
+    RAJ  05:00:00
+    DECJ 10:00:00
+    DM 10
+    TZRMJD 55000
+    TZRSITE @
+    """
+    m = get_model(par)
+    base = TimFile(toas=[RawTOA("55100.1", 1.0, 1400.0, "@"),
+                         RawTOA("55100.2", 1.0, 1400.0, "@")])
+    t0 = get_TOAs(base, ephem=m.ephem)
+    with_phase = TimFile(toas=[RawTOA("55100.1", 1.0, 1400.0, "@"),
+                               RawTOA("55100.2", 1.0, 1400.0, "@",
+                                      phase_offset=1.0)])
+    t1 = get_TOAs(with_phase, ephem=m.ephem)
+    r0 = np.asarray(Residuals(t0, m, subtract_mean=False,
+                              track_mode="nearest").time_resids)
+    r1 = np.asarray(Residuals(t1, m, subtract_mean=False,
+                              track_mode="nearest").time_resids)
+    np.testing.assert_allclose(r0, r1, atol=1e-12)

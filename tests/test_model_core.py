@@ -1,0 +1,213 @@
+"""Model-core tests: builder, components, phase precision, design matrix.
+
+Mirrors the reference test strategy (SURVEY.md §4): derivative checks are
+analytic-vs-numerical (here: jacfwd vs longdouble finite differences);
+phase precision is checked against exact Fraction arithmetic.
+"""
+
+import math
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.parfile import parse_parfile
+from pint_tpu.models import get_model
+from pint_tpu.ops import dd
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas import get_TOAs
+from pint_tpu.io.timfile import RawTOA, TimFile
+
+# NGC 6440E-like tutorial pulsar (same structure as the reference's
+# tests/datafile/NGC6440E.par golden fixture).
+NGC6440E_PAR = """
+PSRJ           1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+SOLARN0        0.00
+EPHEM          DE421
+CLK            TT(TAI)
+UNITS          TDB
+TIMEEPH        FB90
+T2CMETHOD      TEMPO
+CORRECT_TROPOSPHERE  N
+PLANET_SHAPIRO N
+DILATEFREQ     N
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model(NGC6440E_PAR)
+
+
+@pytest.fixture(scope="module")
+def toas(model):
+    return make_fake_toas_uniform(53478, 54187, 62, model, obs="gbt",
+                                  freq_mhz=1400.0, error_us=13.0)
+
+
+def test_builder_components(model):
+    names = {type(c).__name__ for c in model.components}
+    assert names == {"Spindown", "AstrometryEquatorial", "SolarSystemShapiro",
+                     "DispersionDM", "AbsPhase"}
+    # order follows delay/phase category order (astrometry before spindown)
+    assert model.free_params == ["RAJ", "DECJ", "DM", "F0", "F1"]
+    assert model.name == "1748-2021E"
+    f0 = model["F0"]
+    assert abs(f0.value_f64 - 61.485476554) < 1e-12
+    assert not f0.frozen
+    assert model["PEPOCH"].value_f64 == 53750.0
+
+
+def test_angle_parsing(model):
+    # RAJ 17:48:52.75 -> rad
+    expect = (17 + 48 / 60 + 52.75 / 3600) * math.pi / 12
+    assert abs(model["RAJ"].value_f64 - expect) < 1e-15
+    expect_dec = -(20 + 21 / 60 + 29.0 / 3600) * math.pi / 180
+    assert abs(model["DECJ"].value_f64 - expect_dec) < 1e-15
+
+
+def test_par_roundtrip(model):
+    text = model.as_parfile()
+    m2 = get_model(text)
+    for name in ("F0", "F1", "DM", "RAJ", "DECJ", "PEPOCH"):
+        p1, p2 = model[name], m2[name]
+        assert p1.hi == pytest.approx(p2.hi, abs=0, rel=0), name
+        assert abs((p1.hi - p2.hi) + (p1.lo - p2.lo)) < 1e-25 * max(1, abs(p1.hi)), name
+    assert m2.free_params == model.free_params
+    assert m2.header["EPHEM"] == "DE421"
+
+
+def test_spindown_phase_exact_fraction():
+    """DD spindown phase vs exact rational arithmetic over a 30-yr span."""
+    par = """
+    PSR  TEST
+    F0   641.928222312345  1
+    F1   -1.7351D-13  1
+    PEPOCH  55000
+    TZRMJD  55000
+    TZRSITE @
+    """
+    m = get_model(par)
+    # barycentric TOAs: site @, so tdb == parsed mjd exactly
+    mjds = ["49500.1234567890123", "55000.5", "60477.987654321098765"]
+    tf = TimFile(toas=[RawTOA(s, 1.0, 1400.0, "@") for s in mjds])
+    t = get_TOAs(tf, ephem=m.ephem)
+    ph = m.phase(t, abs_phase=False)
+
+    f0 = Fraction("641.928222312345")
+    f1 = Fraction("-1.7351e-13")
+    for i, s in enumerate(mjds):
+        dt = (Fraction(s) - 55000) * 86400
+        exact = f0 * dt + f1 * dt * dt / 2
+        got = Fraction(float(np.asarray(ph.int_part[i]))) \
+            + Fraction(float(np.asarray(ph.frac.hi[i]))) \
+            + Fraction(float(np.asarray(ph.frac.lo[i])))
+        err_turns = abs(float(got - exact))
+        assert err_turns < 1e-9, f"phase error {err_turns} at {s}"
+
+
+def test_phase_frac_is_wrapped(model, toas):
+    ph = model.phase(toas)
+    frac = np.asarray(ph.frac.hi + ph.frac.lo)
+    assert np.all(np.abs(frac) <= 0.5 + 1e-12)
+    ints = np.asarray(ph.int_part)
+    assert np.all(ints == np.round(ints))
+
+
+def test_design_matrix_vs_finite_difference(model, toas):
+    """jacfwd design matrix vs central finite differences of the phase."""
+    M, names = model.designmatrix(toas)
+    M = np.asarray(M)
+    assert names[0] == "Offset"
+    f0 = model.f0_f64
+
+    # steps sized so that delta-phase >> longdouble noise (~1e-8 turns on a
+    # ~1e11-turn total) while curvature stays negligible
+    steps = {"F0": 1e-9, "F1": 1e-17, "DM": 1e-2, "RAJ": 3e-7, "DECJ": 3e-7}
+
+    def phase_total(m):
+        ph = m.phase(toas)
+        return (np.asarray(ph.int_part, np.longdouble)
+                + np.asarray(ph.frac.hi, np.longdouble)
+                + np.asarray(ph.frac.lo, np.longdouble))
+
+    for j, name in enumerate(names):
+        if name == "Offset":
+            continue
+        h = steps[name]
+        p = model[name]
+        orig = p.value
+        p.add_delta(+h)
+        hi_val = phase_total(model)
+        p.value = orig
+        p.add_delta(-h)
+        lo_val = phase_total(model)
+        p.value = orig
+        dnum = np.asarray((hi_val - lo_val) / (2 * h), np.float64)
+        col = -dnum / f0
+        scale = np.max(np.abs(col)) or 1.0
+        np.testing.assert_allclose(M[:, j], col, rtol=2e-6, atol=2e-6 * scale,
+                                   err_msg=name)
+
+
+def test_simulated_toas_have_zero_resids(model, toas):
+    r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+    assert np.max(np.abs(np.asarray(r.time_resids))) < 1e-9  # < 1 ns
+
+
+def test_jump_component():
+    par = """
+    PSR  TESTJ
+    F0   100.0  1
+    PEPOCH  55000
+    RAJ  05:00:00  0
+    DECJ  10:00:00  0
+    DM 10
+    JUMP -fe L-wide 0.0 1
+    TZRMJD  55000
+    TZRSITE @
+    """
+    m = get_model(par)
+    assert "JUMP1" in m.params
+    assert m.params["JUMP1"].selector == ("-fe", "L-wide")
+    assert "JUMP1" in m.free_params
+    # two TOAs, one flagged -fe L-wide: a 1 ms jump moves only that one
+    tf = TimFile(toas=[
+        RawTOA("55100.1", 1.0, 1400.0, "@", {"fe": "L-wide"}),
+        RawTOA("55100.2", 1.0, 1400.0, "@", {"fe": "S-wide"}),
+    ])
+    t = get_TOAs(tf, ephem=m.ephem)
+    r0 = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    m["JUMP1"].set_value_dd(1e-3)
+    r1 = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    d = r1 - r0
+    assert abs(d[0] + 1e-3) < 1e-12  # jumped TOA moves by -JUMP
+    assert abs(d[1]) < 1e-12
+
+
+def test_dispersion_delay_scaling(model, toas):
+    comp = model.get_component("DispersionDM")
+    p = model.base_dd()
+    d1 = np.asarray(comp.delay(p, toas, None, {}))
+    # DM delay at 1400 MHz for DM=223.9: K*DM/f^2
+    expect = (1.0 / 2.41e-4) * 223.9 / 1400.0**2
+    np.testing.assert_allclose(d1, expect, rtol=1e-12)
+
+
+def test_unrecognized_param_warns(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        get_model(NGC6440E_PAR + "\nWIBBLE 42\n")
+    assert any("WIBBLE" in r.message for r in caplog.records)
