@@ -1,0 +1,98 @@
+"""Benchmark harness: one full WLS fit iteration at large TOA count.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is the wall-clock of a complete fit iteration — residual
+evaluation (double-double phase), jacfwd design matrix, and the
+Gram-matrix least-squares solve — as a single jitted XLA program over
+N = PINT_TPU_BENCH_N TOAs (default 100_000) with a 6-parameter model
+(spindown F0/F1, equatorial astrometry, DM, offset).
+
+The reference publishes no speed numbers (BASELINE.md): `vs_baseline`
+is measured against the project's north-star budget scaled to this
+configuration — a full GLS iteration over ~6e5 TOAs in < 30 s on a
+v5e-8 implies a single-chip budget of 30 s * (1e5 / 6e5) = 5 s for 1e5
+TOAs (conservative: ignores the 8x chips). vs_baseline = budget /
+measured, so > 1 means faster than the target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import pint_tpu  # noqa: F401  (enables x64)
+import jax
+import jax.numpy as jnp
+
+
+def build_problem(n: int):
+    from pint_tpu.models import get_model
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.toas import build_TOAs_from_arrays
+
+    par = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+    model = get_model(par)
+    rng = np.random.default_rng(0)
+    mjds = np.sort(rng.uniform(50000.0, 58000.0, size=n))
+    freqs = np.where(rng.random(n) < 0.5, 1400.0, 430.0)
+    errs = np.full(n, 1.0)
+    toas = build_TOAs_from_arrays(
+        DD(jnp.asarray(mjds), jnp.zeros(n)),
+        freq_mhz=freqs, error_us=errs,
+        obs_names=("gbt",), eph=model.ephem,
+    )
+    return model, toas
+
+
+def main() -> None:
+    n = int(os.environ.get("PINT_TPU_BENCH_N", "100000"))
+    reps = int(os.environ.get("PINT_TPU_BENCH_REPS", "5"))
+
+    from pint_tpu.fitting.step import make_wls_step
+
+    model, toas = build_problem(n)
+    step = jax.jit(make_wls_step(model))
+    base = model.base_dd()
+    deltas = model.zero_deltas()
+
+    # warmup/compile
+    out = step(base, deltas, toas)
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = step(base, deltas, toas)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    value = float(np.median(times))
+
+    budget_s = 30.0 * (n / 6e5)
+    print(json.dumps({
+        "metric": f"wls_fit_iter_{n}toas_wall",
+        "value": round(value, 6),
+        "unit": "s",
+        "vs_baseline": round(budget_s / value, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

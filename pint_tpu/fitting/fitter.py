@@ -55,33 +55,73 @@ def wls_solve(M: Array, r: Array, werr: Array,
             "singular_values": s}
 
 
+@jax.jit
+def wls_solve_gram(M: Array, r: Array, werr: Array) -> dict:
+    """Normal-equation WLS via the (p, p) Gram matrix.
+
+    The sharding-friendly form (SURVEY.md §5): with the TOA axis of M and
+    r sharded over a device mesh, ``M^T W M`` and ``M^T W r`` are sharded
+    matmuls whose tiny (p, p)/(p,) outputs XLA reduces with a ``psum``
+    over ICI; the Cholesky solve then runs replicated on every device.
+    Column normalization keeps the Gram matrix conditioned (the dense-SVD
+    path `wls_solve` remains the single-device reference).
+    """
+    w = 1.0 / jnp.square(werr)
+    norm = jnp.sqrt(jnp.sum(jnp.square(M) * w[:, None], axis=0))
+    norm = jnp.where(norm == 0.0, 1.0, norm)
+    A = M / norm
+    G = A.T @ (A * w[:, None])
+    c = A.T @ (r * w)
+    # Tikhonov floor keeps Cholesky PD under degenerate columns
+    G = G + jnp.eye(G.shape[0]) * (jnp.finfo(jnp.float64).eps * jnp.trace(G))
+    L, low = jax.scipy.linalg.cho_factor(G, lower=True)
+    x = jax.scipy.linalg.cho_solve((L, low), c)
+    cov = jax.scipy.linalg.cho_solve((L, low), jnp.eye(G.shape[0]))
+    post = r - A @ x
+    chi2 = jnp.sum(jnp.square(post) * w)
+    return {"x": x / norm, "cov": cov / jnp.outer(norm, norm), "chi2": chi2}
+
+
 class Fitter:
     """Base fitter: holds (toas, model), exposes fit_toas / summaries."""
+
+    resid_cls = Residuals  # subclass hook (wideband overrides)
 
     def __init__(self, toas, model, residuals: Residuals | None = None,
                  track_mode: str | None = None):
         self.toas = toas
         self.model = model
         self.track_mode = track_mode
-        self.resids_init = residuals or Residuals(toas, model, track_mode=track_mode)
-        self.resids: Residuals = self.resids_init
+        self.resids_init = residuals or self._new_resids()
+        self.resids = self.resids_init
         self.parameter_covariance_matrix: np.ndarray | None = None
         self.fit_params: list[str] = []
         self.converged = False
+
+    def _new_resids(self):
+        return self.resid_cls(self.toas, self.model, track_mode=self.track_mode)
 
     # -- reference: pint.fitter.Fitter.auto ----------------------------
     @staticmethod
     def auto(toas, model, downhill: bool = True):
         """Pick the appropriate fitter subclass for the model (reference:
         Fitter.auto chooses WLS/GLS/Wideband x Downhill by model content)."""
+        from pint_tpu.fitting import gls as _gls
+
+        wideband = getattr(toas, "is_wideband", lambda: False)()
+        if wideband:
+            from pint_tpu.fitting import wideband as _wb
+
+            return (_wb.WidebandDownhillFitter(toas, model) if downhill
+                    else _wb.WidebandTOAFitter(toas, model))
         has_noise_basis = any(
             getattr(c, "is_noise_basis", False) for c in model.components
         )
         if has_noise_basis:
-            from pint_tpu.fitting import gls as _gls
-
-            return _gls.GLSFitter(toas, model)
-        return WLSFitter(toas, model)
+            return (_gls.DownhillGLSFitter(toas, model) if downhill
+                    else _gls.GLSFitter(toas, model))
+        return (_gls.DownhillWLSFitter(toas, model) if downhill
+                else WLSFitter(toas, model))
 
     def update_model(self, names: list[str], deltas: np.ndarray,
                      errors: np.ndarray) -> None:
@@ -128,8 +168,7 @@ class WLSFitter(Fitter):
         chi2 = self.resids.chi2
         for it in range(max(1, maxiter)):
             if it > 0:  # self.resids is already current on entry
-                self.resids = Residuals(self.toas, self.model,
-                                        track_mode=self.track_mode)
+                self.resids = self._new_resids()
             M, names = self.get_designmatrix()
             err = self.resids.get_errors_s()
             sol = wls_solve(M, self.resids.time_resids, err, threshold)
@@ -139,6 +178,6 @@ class WLSFitter(Fitter):
             self.update_model(names, x, errors)
             self.fit_params = [n for n in names if n != "Offset"]
             self.parameter_covariance_matrix = cov
-        self.resids = Residuals(self.toas, self.model, track_mode=self.track_mode)
+        self.resids = self._new_resids()
         self.converged = abs(self.resids.chi2 - chi2) < 1e-8 * max(1.0, chi2)
         return self.resids.chi2

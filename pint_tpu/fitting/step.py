@@ -1,0 +1,64 @@
+"""One-shot jitted fit step: residuals + jacfwd design matrix + solve.
+
+This is the whole of the reference's WLS iteration (SURVEY.md §3.3) as a
+single pure function suitable for jit / vmap / sharding: the TOA table is
+a traced argument, so its leaves can carry `NamedSharding` over the TOA
+axis (pint_tpu.parallel) or a leading pulsar-batch axis under `vmap`.
+
+Used by the benchmark harness, the multichip dry run, and the batched
+multi-pulsar fitter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.fitter import wls_solve_gram
+
+Array = jax.Array
+
+
+def make_wls_step(model, tzr=None):
+    """Build ``step(base, deltas, toas) -> (new_deltas, chi2)``.
+
+    `base` is the DD linearization point (model.base_dd()); `deltas` the
+    current float64 corrections per free parameter. One call performs a
+    full damped-free Gauss-Newton iteration: residuals, design matrix by
+    ``jacfwd``, Gram-matrix WLS solve, parameter update, post-fit chi2.
+    """
+    if tzr is None:
+        tzr = model.get_tzr_toas()
+    phase_fn = model.phase_fn_toas(tzr=tzr)
+    names = model.free_params
+    f0 = model.f0_f64
+
+    def step(base, deltas, toas):
+        def total_phase(d):
+            ph = phase_fn(base, d, toas)
+            return ph.int_part + (ph.frac.hi + ph.frac.lo)
+
+        def frac_phase(d):
+            ph = phase_fn(base, d, toas)
+            return ph.frac.hi + ph.frac.lo
+
+        err = toas.error_us * 1e-6
+        w = 1.0 / jnp.square(err)
+
+        resid_turns = frac_phase(deltas)
+        resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
+        r = resid_turns / f0
+
+        J = jax.jacfwd(total_phase)(deltas)
+        cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
+        M = jnp.stack(cols, axis=1)
+
+        sol = wls_solve_gram(M, r, err)
+        new_deltas = {k: deltas[k] + sol["x"][i + 1] for i, k in enumerate(names)}
+
+        post = frac_phase(new_deltas)
+        post = post - jnp.sum(post * w) / jnp.sum(w)
+        chi2 = jnp.sum(jnp.square(post / f0) * w)
+        return new_deltas, chi2
+
+    return step

@@ -1,0 +1,144 @@
+"""Wideband fitting: joint TOA + DM least squares.
+
+Reference equivalent: ``pint.residuals.WidebandTOAResiduals`` and
+``pint.fitter.WidebandTOAFitter`` / ``WidebandDownhillFitter``
+(src/pint/residuals.py, src/pint/fitter.py). Wideband TOAs carry a
+per-TOA DM measurement (``-pp_dm`` / ``-pp_dme`` flags); the fit
+minimizes both blocks jointly:
+
+    [ r_toa / sig_toa ]     [ M_toa / sig_toa ]
+    [ r_dm  / sig_dm  ]  ~  [ M_dm  / sig_dm  ] x
+
+with M_dm = d(model DM)/d(param) (TimingModel.dm_designmatrix). The
+stacked system reuses the whitened SVD solve — one XLA program, rows =
+2n. Correlated noise bases (ECORR etc.) extend the TOA block only,
+zero-padded over the DM block.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.fitter import Fitter, wls_solve
+from pint_tpu.fitting.gls import _DownhillMixin, gls_solve
+from pint_tpu.residuals import Residuals
+
+__all__ = ["WidebandTOAResiduals", "WidebandTOAFitter", "WidebandDownhillFitter"]
+
+
+class WidebandTOAResiduals:
+    """TOA + DM residual blocks (reference: WidebandTOAResiduals)."""
+
+    def __init__(self, toas, model, *, track_mode: str | None = None):
+        self.toas = toas
+        self.model = model
+        self.toa = Residuals(toas, model, track_mode=track_mode)
+        dm_data = jnp.asarray(toas.get_dm_values())
+        self.dm_model = model.total_dm(toas)
+        self.dm_resids = dm_data - self.dm_model
+        self.dm_errors = model.scaled_dm_uncertainty(toas)
+
+    @property
+    def chi2(self) -> float:
+        dm_chi2 = float(jnp.sum(jnp.square(self.dm_resids / self.dm_errors)))
+        return self.toa.chi2 + dm_chi2
+
+    @property
+    def dof(self) -> int:
+        return 2 * len(self.toas) - len(self.model.free_params) - 1
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+    # Fitter API compatibility (mirrors Residuals)
+    @property
+    def time_resids(self):
+        return self.toa.time_resids
+
+    def get_errors_s(self):
+        return self.toa.get_errors_s()
+
+    def rms_weighted_s(self) -> float:
+        return self.toa.rms_weighted_s()
+
+
+class WidebandTOAFitter(Fitter):
+    """Joint TOA+DM WLS/GLS fit (reference: WidebandTOAFitter)."""
+
+    resid_cls = WidebandTOAResiduals
+
+    def __init__(self, toas, model, residuals=None, track_mode=None):
+        if not toas.is_wideband():
+            raise ValueError("WidebandTOAFitter requires TOAs with -pp_dm flags"
+                             " on every TOA")
+        dm_err = toas.get_dm_errors()
+        if not np.all(np.isfinite(dm_err) & (dm_err > 0)):
+            bad = int(np.sum(~(np.isfinite(dm_err) & (dm_err > 0))))
+            raise ValueError(
+                f"{bad} TOA(s) have missing or non-positive -pp_dme DM "
+                f"uncertainties; the whitened wideband solve would be NaN")
+        super().__init__(toas, model, residuals, track_mode)
+        self._noise_cache = None
+
+    def _stacked_system(self):
+        """(M, r, err) with TOA rows on top of DM rows, plus param names."""
+        M_t, names = self.model.designmatrix(self.toas)
+        M_dm, _ = self.model.dm_designmatrix(self.toas)
+        r = jnp.concatenate([self.resids.toa.time_resids, self.resids.dm_resids])
+        err = jnp.concatenate([self.resids.toa.get_errors_s(),
+                               self.resids.dm_errors])
+        return jnp.concatenate([M_t, M_dm], axis=0), r, err, names
+
+    def _noise_arrays_stacked(self):
+        """Correlated-noise basis zero-padded over the DM rows."""
+        if self._noise_cache is not None:
+            return self._noise_cache
+        T = self.model.noise_model_designmatrix(self.toas)
+        if T is None:
+            self._noise_cache = (None, None)
+        else:
+            phi = self.model.noise_model_basis_weight(self.toas)
+            Tz = np.concatenate([T, np.zeros_like(T)], axis=0)
+            self._noise_cache = (jnp.asarray(Tz), jnp.asarray(phi))
+        return self._noise_cache
+
+    def _solve(self):
+        M, r, err, names = self._stacked_system()
+        T, phi = self._noise_arrays_stacked()
+        if T is None:
+            sol = wls_solve(M, r, err)
+        else:
+            sol = gls_solve(M, T, phi, r, err)
+        return sol, names
+
+    def fit_toas(self, maxiter: int = 1, **kw) -> float:
+        for it in range(max(1, maxiter)):
+            if it > 0:
+                self.resids = self._new_resids()
+            sol, names = self._solve()
+            x = np.asarray(sol["x"])
+            cov = np.asarray(sol["cov"])
+            self.update_model(names, x, np.sqrt(np.diag(cov)))
+            self.fit_params = [n for n in names if n != "Offset"]
+            self.parameter_covariance_matrix = cov
+        self.resids = self._new_resids()
+        return self.resids.chi2
+
+    def get_summary(self, nodmx: bool = True) -> str:
+        base = super().get_summary(nodmx=nodmx)
+        dm_rms = float(jnp.sqrt(jnp.mean(jnp.square(self.resids.dm_resids))))
+        return base + f"\n  DM rms: {dm_rms:.3e} pc/cm3"
+
+
+class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
+    """Reference: WidebandDownhillFitter."""
+
+    def _fit_chi2(self) -> float:
+        return self.resids.chi2
+
+    def _step(self, **kw):
+        sol, names = self._solve()
+        cov = np.asarray(sol["cov"])
+        return np.asarray(sol["x"]), names, np.sqrt(np.diag(cov)), cov

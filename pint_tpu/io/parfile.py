@@ -69,7 +69,8 @@ _STRING_PARAMS = {
 # reference src/pint/models/parameter.py :: maskParameter, e.g.
 # "JUMP -fe L-wide 0.0 1" or "EFAC -f 430_PUPPI 1.2")
 _MASK_PARAMS = ("JUMP", "EFAC", "EQUAD", "ECORR", "T2EFAC", "T2EQUAD",
-                "TNECORR", "DMJUMP", "DMEFAC", "DMEQUAD", "FDJUMP", "PHASEJUMP")
+                "TNEQ", "TNECORR", "DMJUMP", "DMEFAC", "DMEQUAD", "FDJUMP",
+                "PHASEJUMP")
 
 
 def _is_mask_param(name: str) -> bool:

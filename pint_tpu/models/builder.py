@@ -17,6 +17,8 @@ from pint_tpu.models.absolute_phase import AbsPhase
 from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
 from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
 from pint_tpu.models.jump import PhaseJump
+from pint_tpu.models.noise import (EcorrNoise, PLDMNoise, PLRedNoise,
+                                   ScaleDmError, ScaleToaError)
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
 from pint_tpu.models.spindown import Spindown
 from pint_tpu.models.timing_model import TimingModel
@@ -33,6 +35,11 @@ COMPONENT_BUILD_ORDER: list[type] = [
     DispersionDM,
     DispersionDMX,
     PhaseJump,
+    ScaleToaError,
+    ScaleDmError,
+    EcorrNoise,
+    PLRedNoise,
+    PLDMNoise,
     AbsPhase,
 ]
 
@@ -89,6 +96,8 @@ def get_model(parfile: str | ParFile) -> TimingModel:
     recognized = set(_HEADER_KEYS) | set(model.params)
     for p in model.params.values():
         recognized.update(p.aliases)
+    for c in model.components:
+        recognized.update(getattr(c, "extra_par_names", ()))
     for line in pf.lines:
         nm = line.name
         if nm in recognized or nm == "JUMP" or nm.startswith(

@@ -108,11 +108,15 @@ class DispersionDMX(Component):
             )
         return self
 
-    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
-        mjds = toas.get_mjds()  # host numpy, static at trace time
-        total = jnp.zeros(len(toas))
+    def dm_value(self, p: dict[str, DD], toas) -> Array:
+        # trace-safe: window masks from the (possibly traced) float64 MJDs
+        mjds = toas.tdb.hi + toas.tdb.lo
+        total = jnp.zeros_like(mjds)
         for i in self.indices:
             lo, hi = self.ranges[i]
             mask = jnp.asarray((mjds >= lo) & (mjds <= hi), jnp.float64)
             total = total + mask * f64(p, f"DMX_{i:04d}")
-        return DM_CONST * total / toas.freq_mhz**2
+        return total
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        return DM_CONST * self.dm_value(p, toas) / toas.freq_mhz**2

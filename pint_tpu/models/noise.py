@@ -1,0 +1,388 @@
+"""Noise models: white-noise scaling and correlated-noise bases for GLS.
+
+Reference equivalent: ``pint.models.noise_model`` (src/pint/models/noise_model.py
+:: ScaleToaError, ScaleDmError, EcorrNoise, PLRedNoise, PLDMNoise). Noise
+components are neither delay nor phase terms; they contribute
+
+* a rescaling of the per-TOA uncertainties (EFAC/EQUAD),
+* a low-rank basis/weight pair (U, phi) consumed by the GLS fitter as the
+  correlated-noise covariance  C = N + U diag(phi) U^T.
+
+Basis matrices are built host-side (numpy) from static TOA metadata and
+cached per TOAs table, then live as device arrays; the GLS solve itself
+is one jitted XLA program (pint_tpu.fitting.gls).
+
+Conventions (matching the reference):
+* scaled sigma = EFAC * sqrt(sigma^2 + EQUAD^2); TNEQ is log10(EQUAD/s).
+* ECORR: quantization epochs of selected TOAs within `dt` seconds
+  (>= nmin TOAs per epoch); weight = (ECORR us)^2 in s^2.
+* PLRedNoise: Fourier basis at f_j = j / T_span, j = 1..nharm; weight
+  phi_j = A^2/(12 pi^2) fyr^-3 (f_j/fyr)^-gamma df  [s^2], with the
+  tempo RNAMP convention A = RNAMP / (86400*365.24*1e6 / (2 pi sqrt(3))).
+* PLDMNoise: same Fourier basis scaled per TOA by (1400 MHz / f)^2.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import Component
+from pint_tpu.models.parameter import Param, float_param, toa_mask
+from pint_tpu.constants import SECS_PER_DAY
+
+Array = jax.Array
+
+FYR_HZ = 1.0 / (365.25 * SECS_PER_DAY)
+# tempo RNAMP -> GWB-convention amplitude (reference noise_model.py)
+RNAMP_FAC = (86400.0 * 365.24 * 1e6) / (2.0 * np.pi * np.sqrt(3.0))
+# DM-noise basis amplitudes are referenced to delay at this frequency
+DM_FREF_MHZ = 1400.0
+
+
+class NoiseComponent(Component):
+    """Base for noise components (no delay/phase contribution)."""
+
+    is_noise_scale = False  # rescales white-noise sigmas
+    is_noise_basis = False  # contributes (basis, weight) to GLS
+
+    def scale_sigma(self, sigma: Array, toas) -> Array:  # pragma: no cover
+        raise NotImplementedError
+
+    def basis_weight(self, toas) -> tuple[np.ndarray, np.ndarray]:  # pragma: no cover
+        """Return (U (n,k) float64, phi (k,) float64) as numpy arrays."""
+        raise NotImplementedError
+
+
+def _mask_lines(pf, names: tuple[str, ...]):
+    for line in pf.lines:
+        base = line.name.rstrip("0123456789")
+        if base in names or line.name in names:
+            yield line
+
+
+class ScaleToaError(NoiseComponent):
+    """EFAC/EQUAD white-noise scaling (reference: ScaleToaError)."""
+
+    category = "scale_toa_error"
+    is_noise_scale = True
+    # par-line base names this component consumes (builder warning filter)
+    extra_par_names = ("EFAC", "T2EFAC", "EQUAD", "T2EQUAD", "TNEQ")
+
+    def __init__(self):
+        super().__init__()
+        self.efac_names: list[str] = []
+        self.equad_names: list[str] = []
+        self.tneq_names: list[str] = []
+
+    def _add(self, kind: str, selector: tuple[str, ...], value: float = 1.0) -> Param:
+        names = {"EFAC": self.efac_names, "EQUAD": self.equad_names,
+                 "TNEQ": self.tneq_names}[kind]
+        idx = len(names) + 1
+        name = f"{kind}{idx}"
+        units = {"EFAC": "", "EQUAD": "us", "TNEQ": "log10(s)"}[kind]
+        p = float_param(name, units=units, desc=f"{kind} for {selector}", index=idx)
+        p.selector = tuple(str(s) for s in selector)
+        p.value = (float(value), 0.0)
+        names.append(name)
+        return self.add_param(p)
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(True for _ in _mask_lines(pf, ("EFAC", "T2EFAC", "EQUAD",
+                                                  "T2EQUAD", "TNEQ")))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "ScaleToaError":
+        self = cls()
+        for line in _mask_lines(pf, ("EFAC", "T2EFAC")):
+            p = self._add("EFAC", tuple(line.rest))
+            p.set_from_par(line.value)
+        for line in _mask_lines(pf, ("EQUAD", "T2EQUAD")):
+            p = self._add("EQUAD", tuple(line.rest), value=0.0)
+            p.set_from_par(line.value)
+        for line in _mask_lines(pf, ("TNEQ",)):
+            p = self._add("TNEQ", tuple(line.rest), value=-32.0)
+            p.set_from_par(line.value)
+        return self
+
+    def scale_sigma(self, sigma: Array, toas) -> Array:
+        var = jnp.square(sigma)
+        for name in self.equad_names:
+            p = self.param(name)
+            mask = jnp.asarray(toa_mask(p.selector, toas), jnp.float64)
+            var = var + mask * jnp.square(p.value_f64 * 1e-6)
+        for name in self.tneq_names:
+            p = self.param(name)
+            mask = jnp.asarray(toa_mask(p.selector, toas), jnp.float64)
+            var = var + mask * 10.0 ** (2.0 * p.value_f64)
+        scale = jnp.ones_like(sigma)
+        for name in self.efac_names:
+            p = self.param(name)
+            mask = jnp.asarray(toa_mask(p.selector, toas), jnp.bool_)
+            scale = jnp.where(mask, p.value_f64, scale)
+        return scale * jnp.sqrt(var)
+
+
+class ScaleDmError(NoiseComponent):
+    """DMEFAC/DMEQUAD scaling of wideband DM uncertainties."""
+
+    category = "scale_dm_error"
+    is_noise_scale = False  # scales DM errors, not TOA errors
+    extra_par_names = ("DMEFAC", "DMEQUAD")
+
+    def __init__(self):
+        super().__init__()
+        self.dmefac_names: list[str] = []
+        self.dmequad_names: list[str] = []
+
+    def _add(self, kind: str, selector: tuple[str, ...], value: float) -> Param:
+        names = self.dmefac_names if kind == "DMEFAC" else self.dmequad_names
+        idx = len(names) + 1
+        name = f"{kind}{idx}"
+        p = float_param(name, units="" if kind == "DMEFAC" else "pc/cm3",
+                        desc=f"{kind} for {selector}", index=idx)
+        p.selector = tuple(str(s) for s in selector)
+        p.value = (float(value), 0.0)
+        names.append(name)
+        return self.add_param(p)
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(True for _ in _mask_lines(pf, ("DMEFAC", "DMEQUAD")))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "ScaleDmError":
+        self = cls()
+        for line in _mask_lines(pf, ("DMEFAC",)):
+            p = self._add("DMEFAC", tuple(line.rest), 1.0)
+            p.set_from_par(line.value)
+        for line in _mask_lines(pf, ("DMEQUAD",)):
+            p = self._add("DMEQUAD", tuple(line.rest), 0.0)
+            p.set_from_par(line.value)
+        return self
+
+    def scale_dm_sigma(self, sigma: Array, toas) -> Array:
+        var = jnp.square(sigma)
+        for name in self.dmequad_names:
+            p = self.param(name)
+            mask = jnp.asarray(toa_mask(p.selector, toas), jnp.float64)
+            var = var + mask * jnp.square(p.value_f64)
+        scale = jnp.ones_like(sigma)
+        for name in self.dmefac_names:
+            p = self.param(name)
+            mask = jnp.asarray(toa_mask(p.selector, toas), jnp.bool_)
+            scale = jnp.where(mask, p.value_f64, scale)
+        return scale * jnp.sqrt(var)
+
+
+def quantize_epochs(t_s: np.ndarray, dt_s: float = 1.0, nmin: int = 2
+                    ) -> list[np.ndarray]:
+    """Group sorted-time indices into epochs separated by > dt seconds.
+
+    Reference: the ECORR quantization matrix (noise_model.py / enterprise's
+    create_quantization_matrix). Returns index arrays of epochs with at
+    least `nmin` members.
+    """
+    order = np.argsort(t_s)
+    ts = t_s[order]
+    breaks = np.nonzero(np.diff(ts) > dt_s)[0] + 1
+    groups = np.split(order, breaks)
+    return [g for g in groups if len(g) >= nmin]
+
+
+class EcorrNoise(NoiseComponent):
+    """Epoch-correlated white noise (reference: EcorrNoise)."""
+
+    category = "ecorr_noise"
+    is_noise_basis = True
+    extra_par_names = ("ECORR", "TNECORR")
+
+    def __init__(self, dt_s: float = 1.0, nmin: int = 2):
+        super().__init__()
+        self.ecorr_names: list[str] = []
+        self.dt_s = dt_s
+        self.nmin = nmin
+
+    def add_ecorr(self, selector: tuple[str, ...], value: float = 0.0) -> Param:
+        idx = len(self.ecorr_names) + 1
+        name = f"ECORR{idx}"
+        p = float_param(name, units="us", desc=f"ECORR for {selector}", index=idx)
+        p.selector = tuple(str(s) for s in selector)
+        p.value = (float(value), 0.0)
+        self.ecorr_names.append(name)
+        return self.add_param(p)
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(True for _ in _mask_lines(pf, ("ECORR", "TNECORR")))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "EcorrNoise":
+        self = cls()
+        for line in _mask_lines(pf, ("ECORR", "TNECORR")):
+            p = self.add_ecorr(tuple(line.rest))
+            p.set_from_par(line.value)
+        return self
+
+    def basis_weight(self, toas) -> tuple[np.ndarray, np.ndarray]:
+        t_s = np.asarray(toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+        n = len(t_s)
+        cols: list[np.ndarray] = []
+        weights: list[float] = []
+        for name in self.ecorr_names:
+            p = self.param(name)
+            mask = np.asarray(toa_mask(p.selector, toas), bool)
+            idx = np.nonzero(mask)[0]
+            if idx.size == 0:
+                continue
+            w = (p.value_f64 * 1e-6) ** 2
+            for grp in quantize_epochs(t_s[idx], self.dt_s, self.nmin):
+                col = np.zeros(n)
+                col[idx[grp]] = 1.0
+                cols.append(col)
+                weights.append(w)
+        if not cols:
+            return np.zeros((n, 0)), np.zeros(0)
+        return np.stack(cols, axis=1), np.asarray(weights)
+
+
+def powerlaw_psd_s2(f_hz: np.ndarray, log10_amp: float, gamma: float,
+                    df_hz: float) -> np.ndarray:
+    """Power-law timing-noise PSD integrated per bin -> variance [s^2]."""
+    amp = 10.0 ** log10_amp
+    return (amp ** 2 / (12.0 * np.pi ** 2) * FYR_HZ ** (-3.0)
+            * (f_hz / FYR_HZ) ** (-gamma) * df_hz)
+
+
+class _PLNoiseBase(NoiseComponent):
+    """Shared machinery for Fourier-basis power-law noise."""
+
+    is_noise_basis = True
+    _amp_name = ""
+    _gam_name = ""
+    _c_name = ""
+    default_nharm = 30
+
+    def nharm(self) -> int:
+        if self.has_param(self._c_name):
+            v = self.param(self._c_name).value_f64
+            if v > 0:
+                return int(v)
+        return self.default_nharm
+
+    def log10_amp_gamma(self) -> tuple[float, float]:
+        raise NotImplementedError
+
+    def _fourier(self, toas, nharm: int) -> tuple[np.ndarray, np.ndarray, float]:
+        t_s = np.asarray(toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+        tspan = float(t_s.max() - t_s.min())
+        tspan = max(tspan, SECS_PER_DAY)  # degenerate single-epoch guard
+        f = np.arange(1, nharm + 1) / tspan
+        arg = 2.0 * np.pi * np.outer(t_s - t_s.min(), f)
+        F = np.empty((len(t_s), 2 * nharm))
+        F[:, ::2] = np.sin(arg)
+        F[:, 1::2] = np.cos(arg)
+        return F, f, 1.0 / tspan
+
+    def basis_weight(self, toas) -> tuple[np.ndarray, np.ndarray]:
+        nharm = self.nharm()
+        F, f, df = self._fourier(toas, nharm)
+        log10_amp, gamma = self.log10_amp_gamma()
+        phi = powerlaw_psd_s2(f, log10_amp, gamma, df)
+        return self._scale_basis(F, toas), np.repeat(phi, 2)
+
+    def _scale_basis(self, F: np.ndarray, toas) -> np.ndarray:
+        return F
+
+
+class PLRedNoise(_PLNoiseBase):
+    """Power-law achromatic red noise (reference: PLRedNoise)."""
+
+    category = "pl_red_noise"
+    _amp_name = "TNREDAMP"
+    _gam_name = "TNREDGAM"
+    _c_name = "TNREDC"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(float_param("RNAMP", units="us*yr^0.5",
+                                   desc="Red-noise amplitude (tempo conv.)",
+                                   default=float("nan")))
+        self.add_param(float_param("RNIDX", units="",
+                                   desc="Red-noise index (tempo conv., negative)",
+                                   default=float("nan")))
+        self.add_param(float_param("TNREDAMP", units="log10",
+                                   desc="log10 red-noise amplitude (GWB conv.)",
+                                   default=float("nan"), aliases=("TNRedAmp",)))
+        self.add_param(float_param("TNREDGAM", units="",
+                                   desc="Red-noise spectral index gamma",
+                                   default=float("nan"), aliases=("TNRedGam",)))
+        self.add_param(float_param("TNREDC", units="",
+                                   desc="Number of red-noise harmonics",
+                                   default=0.0, aliases=("TNRedC",)))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(k in pf for k in ("RNAMP", "TNREDAMP", "TNRedAmp"))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "PLRedNoise":
+        self = cls()
+        self.setup_from_parfile(pf)
+        for p in self.params:
+            p.frozen = True
+        return self
+
+    def log10_amp_gamma(self) -> tuple[float, float]:
+        rnamp = self.param("RNAMP").value_f64
+        if np.isfinite(rnamp):
+            return np.log10(rnamp / RNAMP_FAC), -self.param("RNIDX").value_f64
+        return (self.param("TNREDAMP").value_f64,
+                self.param("TNREDGAM").value_f64)
+
+
+class PLDMNoise(_PLNoiseBase):
+    """Power-law stochastic DM noise (reference: PLDMNoise).
+
+    The Fourier basis is scaled per TOA by (1400 MHz / f)^2 so the
+    amplitude is referenced to delay at 1400 MHz.
+    """
+
+    category = "pl_dm_noise"
+    _amp_name = "TNDMAMP"
+    _gam_name = "TNDMGAM"
+    _c_name = "TNDMC"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(float_param("TNDMAMP", units="log10",
+                                   desc="log10 DM-noise amplitude",
+                                   default=float("nan"), aliases=("TNDMAmp",)))
+        self.add_param(float_param("TNDMGAM", units="",
+                                   desc="DM-noise spectral index gamma",
+                                   default=float("nan"), aliases=("TNDMGam",)))
+        self.add_param(float_param("TNDMC", units="",
+                                   desc="Number of DM-noise harmonics",
+                                   default=0.0, aliases=("TNDMC",)))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(k in pf for k in ("TNDMAMP", "TNDMAmp"))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "PLDMNoise":
+        self = cls()
+        self.setup_from_parfile(pf)
+        for p in self.params:
+            p.frozen = True
+        return self
+
+    def log10_amp_gamma(self) -> tuple[float, float]:
+        return (self.param("TNDMAMP").value_f64,
+                self.param("TNDMGAM").value_f64)
+
+    def _scale_basis(self, F: np.ndarray, toas) -> np.ndarray:
+        scale = (DM_FREF_MHZ / np.asarray(toas.freq_mhz)) ** 2
+        return F * scale[:, None]

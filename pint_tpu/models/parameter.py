@@ -216,30 +216,43 @@ def bool_param(name: str, default: bool = False, desc: str = "",
 # ---------------------------------------------------------------------------
 
 
-def toa_mask(selector: tuple[str, ...], toas) -> np.ndarray:
+def toa_mask(selector: tuple[str, ...], toas):
     """Boolean mask of TOAs matched by a maskParameter selector.
 
-    Host-side: consumes only static TOA metadata (flags, site names,
-    float64 MJDs/freqs), so it is safe to call at trace time.
+    Trace-safe: masks over static metadata (flags) come back as concrete
+    numpy constants; masks over data fields (jump_group, obs_index, MJD,
+    freq) are computed with jnp ops, so the result may be a traced array
+    when `toas` is a jit argument (the sharded fit path). Host callers
+    (e.g. ECORR quantization on a concrete table) can np.asarray() it.
     """
+    import jax.numpy as jnp
+
     n = len(toas)
     if not selector:
         return np.ones(n, dtype=bool)
     key = selector[0].lstrip("-").lower()
     if key == "tim_jump":
-        return np.asarray(toas.jump_group) == int(selector[1])
+        return jnp.asarray(toas.jump_group) == int(selector[1])
     if key in ("tel", "obs"):
         from pint_tpu import observatory as obs_mod
 
         target = obs_mod.get_observatory(selector[1]).name
-        names = np.asarray([toas.obs_names[i] for i in toas.obs_index])
-        return names == target
+        try:
+            ti = toas.obs_names.index(target)
+        except ValueError:
+            return np.zeros(n, dtype=bool)
+        return jnp.asarray(toas.obs_index) == ti
     if key == "mjd":
-        mjds = toas.get_mjds()
+        mjds = toas.tdb.hi + toas.tdb.lo
         return (mjds >= float(selector[1])) & (mjds <= float(selector[2]))
     if key == "freq":
-        f = np.asarray(toas.freq_mhz)
+        f = jnp.asarray(toas.freq_mhz)
         return (f >= float(selector[1])) & (f <= float(selector[2]))
-    # generic flag match: -fe L-wide, -f 430_PUPPI, -sys ...
-    vals = np.asarray([fl.get(key, "") for fl in toas.flags])
-    return vals == selector[1]
+    # generic flag match: -fe L-wide, -f 430_PUPPI, -sys ... The O(n)
+    # flag scan depends only on (selector, toas), so cache it on the
+    # TOAs object — downhill fitters evaluate sigmas per halving step.
+    cache = toas.__dict__.setdefault("_flag_mask_cache", {})
+    if selector not in cache:
+        vals = np.asarray([fl.get(key, "") for fl in toas.flags])
+        cache[selector] = vals == selector[1]
+    return cache[selector]

@@ -140,6 +140,37 @@ class TimingModel:
             return None
         return absph.get_tzr_toas(self.ephem, planets=planets)
 
+    def _phase_at(self, p: dict[str, DD], tt) -> phase_mod.Phase:
+        """Composed pure phase function at resolved params `p` for table `tt`."""
+        aux: dict = {}
+        delay = jnp.zeros(np.shape(tt.freq_mhz)[-1])
+        for c in self.delay_components():
+            delay = delay + c.delay(p, tt, delay, aux)
+        ph = phase_mod.zero_like(delay)
+        for c in self.phase_components():
+            ph = phase_mod.add(ph, c.phase(p, tt, delay, aux))
+        return ph
+
+    def phase_fn_toas(self, *, abs_phase: bool = True, tzr=None):
+        """Build ``fn(base, deltas, toas) -> Phase`` with TOAs as a traced arg.
+
+        This is the sharding-friendly form: the TOA table enters as a jit
+        argument, so its leaves can carry ``NamedSharding`` over the TOA
+        axis of a device mesh (pint_tpu.parallel). ``tzr`` (if any) stays
+        closed over — it is a single replicated reference TOA.
+        """
+        if tzr is None and abs_phase:
+            tzr = self.get_tzr_toas()
+
+        def fn(base: dict[str, DD], deltas: dict[str, Array], toas) -> phase_mod.Phase:
+            p = self.resolve(base, deltas)
+            ph = self._phase_at(p, toas)
+            if tzr is not None:
+                ph = phase_mod.add(ph, phase_mod.neg(self._phase_at(p, tzr)))
+            return ph
+
+        return fn
+
     def phase_fn(self, toas, *, abs_phase: bool = True):
         """Build ``fn(base, deltas) -> Phase`` with `toas` closed over.
 
@@ -148,28 +179,106 @@ class TimingModel:
         per dataset, which matches the reference's usage pattern (a fitter
         is bound to one TOAs table) and sidesteps retracing.
         """
-        tzr = self.get_tzr_toas() if abs_phase else None
-        delay_comps = self.delay_components()
-        phase_comps = self.phase_components()
-
-        def phase_at(p: dict[str, DD], tt) -> phase_mod.Phase:
-            aux: dict = {}
-            delay = jnp.zeros(len(tt))
-            for c in delay_comps:
-                delay = delay + c.delay(p, tt, delay, aux)
-            ph = phase_mod.zero_like(delay)
-            for c in phase_comps:
-                ph = phase_mod.add(ph, c.phase(p, tt, delay, aux))
-            return ph
+        inner = self.phase_fn_toas(abs_phase=abs_phase)
 
         def fn(base: dict[str, DD], deltas: dict[str, Array]) -> phase_mod.Phase:
-            p = self.resolve(base, deltas)
-            ph = phase_at(p, toas)
-            if tzr is not None:
-                ph = phase_mod.add(ph, phase_mod.neg(phase_at(p, tzr)))
-            return ph
+            return inner(base, deltas, toas)
 
         return fn
+
+    # ------------------------------------------------------------------
+    # DM as a function of parameters (wideband support; reference:
+    # TimingModel.total_dm / d_dm_d_param used by WidebandTOAFitter)
+    # ------------------------------------------------------------------
+    def dm_fn(self, toas):
+        """Build ``fn(base, deltas) -> (n,) DM [pc/cm^3]`` at each TOA."""
+        comps = [c for c in self.components if hasattr(c, "dm_value")]
+
+        def fn(base: dict[str, DD], deltas: dict[str, Array]) -> Array:
+            p = self.resolve(base, deltas)
+            total = jnp.zeros(np.shape(toas.freq_mhz)[-1])
+            for c in comps:
+                total = total + c.dm_value(p, toas)
+            return total
+
+        return fn
+
+    def total_dm(self, toas) -> Array:
+        """Model DM at each TOA (reference: TimingModel.total_dm)."""
+        return self.dm_fn(toas)(self.base_dd(), {})
+
+    def dm_designmatrix(self, toas, params: list[str] | None = None
+                        ) -> tuple[Array, list[str]]:
+        """d(DM)/d(param) columns [pc/cm^3 per unit] for the wideband fit.
+
+        Column order matches ``designmatrix`` (Offset column = zeros: a
+        phase offset does not move the DM measurements).
+        """
+        names = params if params is not None else self.free_params
+        base = self.base_dd()
+        fn = self.dm_fn(toas)
+        J = jax.jacfwd(lambda d: fn(base, d))(self.zero_deltas(names))
+        n = np.shape(toas.freq_mhz)[-1]
+        cols = [jnp.zeros(n)]
+        out_names = ["Offset"]
+        for k in names:
+            cols.append(J[k])
+            out_names.append(k)
+        return jnp.stack(cols, axis=1), out_names
+
+    # ------------------------------------------------------------------
+    # noise-model plumbing (reference: TimingModel.scaled_toa_uncertainty,
+    # noise_model_designmatrix, noise_model_basis_weight)
+    # ------------------------------------------------------------------
+    @property
+    def has_correlated_errors(self) -> bool:
+        return any(getattr(c, "is_noise_basis", False) for c in self.components)
+
+    def scaled_toa_uncertainty(self, toas) -> Array:
+        """Per-TOA sigma [s] after EFAC/EQUAD scaling."""
+        sigma = toas.get_errors_s()
+        for c in self.components:
+            if getattr(c, "is_noise_scale", False):
+                sigma = c.scale_sigma(sigma, toas)
+        return sigma
+
+    def scaled_dm_uncertainty(self, toas) -> Array:
+        """Per-TOA wideband-DM sigma [pc/cm^3] after DMEFAC/DMEQUAD."""
+        sigma = jnp.asarray(toas.get_dm_errors())
+        for c in self.components:
+            if hasattr(c, "scale_dm_sigma"):
+                sigma = c.scale_dm_sigma(sigma, toas)
+        return sigma
+
+    def noise_model_designmatrix(self, toas) -> np.ndarray | None:
+        """Stacked correlated-noise basis T (n, k); None if no noise basis."""
+        blocks = [c.basis_weight(toas)[0] for c in self.components
+                  if getattr(c, "is_noise_basis", False)]
+        blocks = [b for b in blocks if b.shape[1] > 0]
+        if not blocks:
+            return None
+        return np.concatenate(blocks, axis=1)
+
+    def noise_model_basis_weight(self, toas) -> np.ndarray | None:
+        """Prior variances phi (k,) matching noise_model_designmatrix columns."""
+        ws = [c.basis_weight(toas)[1] for c in self.components
+              if getattr(c, "is_noise_basis", False)]
+        ws = [w for w in ws if w.size > 0]
+        if not ws:
+            return None
+        return np.concatenate(ws)
+
+    def noise_model_dimensions(self, toas) -> dict[str, tuple[int, int]]:
+        """Map component name -> (start column, size) in the stacked basis."""
+        out: dict[str, tuple[int, int]] = {}
+        start = 0
+        for c in self.components:
+            if getattr(c, "is_noise_basis", False):
+                k = c.basis_weight(toas)[0].shape[1]
+                if k:
+                    out[type(c).__name__] = (start, k)
+                    start += k
+        return out
 
     # ------------------------------------------------------------------
     # reference-API conveniences (host entry points)

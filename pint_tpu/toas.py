@@ -41,6 +41,23 @@ from pint_tpu.constants import C_M_S
 PLANET_NAMES = ("sun", "venus", "jupiter", "saturn", "uranus", "neptune")
 
 
+class Flags(tuple):
+    """Tuple of per-TOA flag dicts, hashable by content.
+
+    TOAs cross jit boundaries as pytrees (the sharded fit path passes the
+    table as a traced argument), so static aux data must be hashable —
+    plain tuples of dicts are not. The content hash is computed once and
+    cached; flag dicts are treated as immutable after construction.
+    """
+
+    def __hash__(self) -> int:  # noqa: D105
+        h = getattr(self, "_hash", None)
+        if h is None:
+            h = hash(tuple(tuple(sorted(d.items())) for d in self))
+            self._hash = h
+        return h
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class TOAs:
@@ -56,12 +73,12 @@ class TOAs:
     phase_offset: Array  # accumulated tim-file PHASE commands
     planet_pos_ls: dict  # name -> (n,3) body position wrt *observatory* [lt-s]
     pulse_number: Array  # tracked pulse numbers (nan = absent)
+    obs_index: Array  # site index per TOA (int32)
+    jump_group: Array  # tim-file JUMP block id per TOA (int32; 0 = none)
 
-    # --- metadata (static aux) ---
-    obs_index: np.ndarray = field(metadata=dict(static=True))  # site per TOA
+    # --- metadata (static aux; must be hashable) ---
     obs_names: tuple = field(metadata=dict(static=True))  # index -> site name
-    flags: tuple = field(metadata=dict(static=True))  # per-TOA flag dicts
-    jump_group: np.ndarray = field(metadata=dict(static=True))
+    flags: Flags = field(metadata=dict(static=True))  # per-TOA flag dicts
     ephem_name: str = field(default="builtin_analytic", metadata=dict(static=True))
     clock_applied: bool = field(default=True, metadata=dict(static=True))
 
@@ -85,6 +102,21 @@ class TOAs:
     def get_flag_value(self, flag: str, default: str = "") -> list[str]:
         return [f.get(flag, default) for f in self.flags]
 
+    # -- wideband DM data (reference: pint.toa wideband "-pp_dm"/"-pp_dme"
+    # flags consumed by WidebandTOAResiduals) --------------------------
+    def get_dm_values(self) -> np.ndarray:
+        """Wideband DM measurements [pc/cm^3] from -pp_dm flags (nan absent)."""
+        return np.asarray([float(f.get("pp_dm", "nan")) for f in self.flags])
+
+    def get_dm_errors(self) -> np.ndarray:
+        """Wideband DM uncertainties [pc/cm^3] from -pp_dme flags."""
+        return np.asarray([float(f.get("pp_dme", "nan")) for f in self.flags])
+
+    def is_wideband(self) -> bool:
+        """True when every TOA carries a wideband DM measurement."""
+        vals = self.get_dm_values()
+        return len(vals) > 0 and bool(np.all(np.isfinite(vals)))
+
     def select(self, mask) -> "TOAs":
         """Boolean-mask subset (host-side; returns a new TOAs)."""
         mask = np.asarray(mask)
@@ -100,10 +132,10 @@ class TOAs:
             phase_offset=take(self.phase_offset),
             planet_pos_ls={k: take(v) for k, v in self.planet_pos_ls.items()},
             pulse_number=take(self.pulse_number),
-            obs_index=self.obs_index[idx],
+            obs_index=take(self.obs_index),
+            jump_group=take(self.jump_group),
             obs_names=self.obs_names,
-            flags=tuple(self.flags[i] for i in idx),
-            jump_group=self.jump_group[idx],
+            flags=Flags(self.flags[i] for i in idx),
             ephem_name=self.ephem_name,
             clock_applied=self.clock_applied,
         )
@@ -128,7 +160,8 @@ def merge_TOAs(toas_list: list[TOAs]) -> TOAs:
             if n not in names:
                 names.append(n)
     obs_index = np.concatenate(
-        [np.asarray([names.index(t.obs_names[i]) for i in t.obs_index]) for t in toas_list]
+        [np.asarray([names.index(t.obs_names[i]) for i in np.asarray(t.obs_index)])
+         for t in toas_list]
     )
     return TOAs(
         tdb=DD(cat(lambda t: t.tdb.hi), cat(lambda t: t.tdb.lo)),
@@ -140,10 +173,10 @@ def merge_TOAs(toas_list: list[TOAs]) -> TOAs:
         phase_offset=cat(lambda t: t.phase_offset),
         planet_pos_ls=planets,
         pulse_number=cat(lambda t: t.pulse_number),
-        obs_index=obs_index,
+        obs_index=jnp.asarray(obs_index, jnp.int32),
+        jump_group=jnp.concatenate([jnp.asarray(t.jump_group) for t in toas_list]),
         obs_names=tuple(names),
-        flags=tuple(f for t in toas_list for f in t.flags),
-        jump_group=np.concatenate([t.jump_group for t in toas_list]),
+        flags=Flags(f for t in toas_list for f in t.flags),
         ephem_name=toas_list[0].ephem_name,
         clock_applied=all(t.clock_applied for t in toas_list),
     )
@@ -195,6 +228,54 @@ def build_TOAs_from_raw(
         if name not in site_names:
             site_names.append(name)
         obs_index[i] = site_names.index(name)
+
+    return build_TOAs_from_arrays(
+        mjd_local,
+        freq_mhz=np.asarray([t.freq_mhz for t in raw]),
+        error_us=np.asarray([t.error_us for t in raw]),
+        obs_index=obs_index,
+        obs_names=tuple(site_names),
+        flags=tuple(dict(t.flags) for t in raw),
+        phase_offset=np.asarray([t.phase_offset for t in raw]),
+        jump_group=np.asarray([t.jump_group for t in raw]),
+        eph=eph,
+        planets=planets,
+        include_clock=include_clock,
+        clock_limits=clock_limits,
+    )
+
+
+def build_TOAs_from_arrays(
+    mjd_local: DD,
+    *,
+    freq_mhz,
+    error_us,
+    obs_index=None,
+    obs_names: tuple = ("@",),
+    flags: tuple | None = None,
+    phase_offset=None,
+    jump_group=None,
+    eph: Ephemeris | str = "builtin_analytic",
+    planets: bool = True,
+    include_clock: bool = True,
+    clock_limits: str = "warn",
+) -> TOAs:
+    """Array-based TOA construction (no per-TOA string parsing).
+
+    The fast path for simulation and benchmarking at large N; the
+    reference's equivalent is building ``pint.toa.TOA`` objects from
+    arrays and running the same clock/TDB/posvel pipeline.
+    """
+    eph = get_ephemeris(eph) if isinstance(eph, str) else eph
+    n = int(np.shape(np.asarray(mjd_local.hi))[0])
+    site_names = list(obs_names)
+    obs_index = (np.zeros(n, dtype=np.int32) if obs_index is None
+                 else np.asarray(obs_index, dtype=np.int32))
+    flags = Flags({} for _ in range(n)) if flags is None else Flags(flags)
+    if phase_offset is None:
+        phase_offset = np.zeros(n)
+    if jump_group is None:
+        jump_group = np.zeros(n, dtype=np.int64)
 
     # clock chain to UTC (host-side numpy; per-site vectorized)
     clock_s = np.zeros(n)
@@ -261,7 +342,6 @@ def build_TOAs_from_raw(
         p, _ = eph.sun_posvel_ssb(tdb_f64)
         planet_pos["sun"] = p - obs_pos
 
-    flags = tuple(dict(t.flags) for t in raw)
     pulse_number = jnp.asarray(
         [float(f.get("pn", "nan")) for f in flags], jnp.float64
     )
@@ -269,17 +349,17 @@ def build_TOAs_from_raw(
     return TOAs(
         tdb=tdb,
         utc=utc,
-        freq_mhz=jnp.asarray([t.freq_mhz for t in raw]),
-        error_us=jnp.asarray([t.error_us for t in raw]),
+        freq_mhz=jnp.asarray(freq_mhz, jnp.float64),
+        error_us=jnp.asarray(error_us, jnp.float64),
         obs_pos_ls=obs_pos,
         obs_vel_c=obs_vel,
-        phase_offset=jnp.asarray([t.phase_offset for t in raw]),
+        phase_offset=jnp.asarray(phase_offset, jnp.float64),
         planet_pos_ls=planet_pos,
         pulse_number=pulse_number,
-        obs_index=obs_index,
+        obs_index=jnp.asarray(obs_index, jnp.int32),
+        jump_group=jnp.asarray(np.asarray(jump_group), jnp.int32),
         obs_names=tuple(site_names),
         flags=flags,
-        jump_group=np.asarray([t.jump_group for t in raw]),
         ephem_name=getattr(eph, "name", "custom"),
         clock_applied=include_clock,
     )
@@ -295,10 +375,10 @@ def save_pickle(toas: TOAs, path: str) -> None:
         obs_pos=np.asarray(toas.obs_pos_ls), obs_vel=np.asarray(toas.obs_vel_c),
         phase_offset=np.asarray(toas.phase_offset),
         pulse_number=np.asarray(toas.pulse_number),
-        obs_index=toas.obs_index,
+        obs_index=np.asarray(toas.obs_index),
         obs_names=np.asarray(toas.obs_names, dtype=object),
         flags=np.asarray([repr(f) for f in toas.flags], dtype=object),
-        jump_group=toas.jump_group,
+        jump_group=np.asarray(toas.jump_group),
         planet_names=np.asarray(list(toas.planet_pos_ls), dtype=object),
         **{f"planet_{k}": np.asarray(v) for k, v in toas.planet_pos_ls.items()},
         ephem_name=np.asarray(toas.ephem_name, dtype=object),
@@ -320,10 +400,10 @@ def load_pickle(path: str) -> TOAs:
         phase_offset=jnp.asarray(z["phase_offset"]),
         planet_pos_ls={str(k): jnp.asarray(z[f"planet_{k}"]) for k in z["planet_names"]},
         pulse_number=jnp.asarray(z["pulse_number"]),
-        obs_index=z["obs_index"],
+        obs_index=jnp.asarray(z["obs_index"], jnp.int32),
+        jump_group=jnp.asarray(z["jump_group"], jnp.int32),
         obs_names=tuple(str(s) for s in z["obs_names"]),
-        flags=tuple(ast.literal_eval(str(f)) for f in z["flags"]),
-        jump_group=z["jump_group"],
+        flags=Flags(ast.literal_eval(str(f)) for f in z["flags"]),
         ephem_name=str(z["ephem_name"]),
         clock_applied=bool(z["clock_applied"]),
     )

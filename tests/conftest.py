@@ -11,12 +11,16 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# Honored in plain environments; the axon TPU-tunnel plugin ignores it, so we
-# also pin the default device below.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the sandbox env pins JAX_PLATFORMS=axon (single-TPU tunnel),
+# which must never be the test backend — DD arithmetic requires IEEE-exact
+# float64 and the multi-device mesh tests need the virtual CPU platform.
+# The axon sitecustomize overrides the env var via jax.config, so the
+# config entry (which wins) must be forced too, before any backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 _cpus = jax.devices("cpu")
