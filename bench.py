@@ -73,7 +73,7 @@ def main() -> None:
     base = model.base_dd()
     deltas = model.zero_deltas()
 
-    # warmup/compile
+    # warmup/compile (step returns (new_deltas, info))
     out = step(base, deltas, toas)
     jax.block_until_ready(out)
 

@@ -3,10 +3,11 @@
 This is the whole of the reference's WLS iteration (SURVEY.md §3.3) as a
 single pure function suitable for jit / vmap / sharding: the TOA table is
 a traced argument, so its leaves can carry `NamedSharding` over the TOA
-axis (pint_tpu.parallel) or a leading pulsar-batch axis under `vmap`.
+axis of a device mesh (pint_tpu.parallel) or a leading pulsar-batch axis
+under `vmap` (independent pulsars — the "expert" axis).
 
-Used by the benchmark harness, the multichip dry run, and the batched
-multi-pulsar fitter.
+Used by the benchmark harness, the multichip dry run, and the sharded /
+batched fitters.
 """
 
 from __future__ import annotations
@@ -19,21 +20,28 @@ from pint_tpu.fitting.fitter import wls_solve_gram
 Array = jax.Array
 
 
-def make_wls_step(model, tzr=None):
-    """Build ``step(base, deltas, toas) -> (new_deltas, chi2)``.
+def make_wls_step(model, tzr=None, *, abs_phase: bool = True):
+    """Build ``step(base, deltas, toas) -> (new_deltas, info)``.
 
     `base` is the DD linearization point (model.base_dd()); `deltas` the
     current float64 corrections per free parameter. One call performs a
-    full damped-free Gauss-Newton iteration: residuals, design matrix by
-    ``jacfwd``, Gram-matrix WLS solve, parameter update, post-fit chi2.
+    full Gauss-Newton iteration: residuals, design matrix by ``jacfwd``,
+    Gram-matrix WLS solve, parameter update, post-fit chi2. ``info``
+    carries {"chi2", "errors": {name: sigma}}.
+
+    F0 is read from the traced `base`, so the same compiled step serves a
+    ``vmap``-ed batch of pulsars with different spin frequencies.
+    ``abs_phase=False`` skips the TZR anchor (the batched path, where the
+    weighted-mean subtraction absorbs the absolute phase anyway).
     """
-    if tzr is None:
+    if tzr is None and abs_phase:
         tzr = model.get_tzr_toas()
-    phase_fn = model.phase_fn_toas(tzr=tzr)
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
     names = model.free_params
-    f0 = model.f0_f64
 
     def step(base, deltas, toas):
+        f0 = base["F0"].hi + base["F0"].lo
+
         def total_phase(d):
             ph = phase_fn(base, d, toas)
             return ph.int_part + (ph.frac.hi + ph.frac.lo)
@@ -42,7 +50,9 @@ def make_wls_step(model, tzr=None):
             ph = phase_fn(base, d, toas)
             return ph.frac.hi + ph.frac.lo
 
-        err = toas.error_us * 1e-6
+        # EFAC/EQUAD-scaled sigmas, matching WLSFitter's weighting
+        # (scale_sigma and toa_mask are trace-safe)
+        err = model.scaled_toa_uncertainty(toas)
         w = 1.0 / jnp.square(err)
 
         resid_turns = frac_phase(deltas)
@@ -55,10 +65,12 @@ def make_wls_step(model, tzr=None):
 
         sol = wls_solve_gram(M, r, err)
         new_deltas = {k: deltas[k] + sol["x"][i + 1] for i, k in enumerate(names)}
+        sig = jnp.sqrt(jnp.diagonal(sol["cov"]))
+        errors = {k: sig[i + 1] for i, k in enumerate(names)}
 
         post = frac_phase(new_deltas)
         post = post - jnp.sum(post * w) / jnp.sum(w)
         chi2 = jnp.sum(jnp.square(post / f0) * w)
-        return new_deltas, chi2
+        return new_deltas, {"chi2": chi2, "errors": errors}
 
     return step

@@ -1,0 +1,27 @@
+"""Binary pulsar models: orbital delay components.
+
+Reference equivalent: ``pint.models.pulsar_binary`` wrappers plus the
+``pint.models.stand_alone_psr_binaries`` engines
+(src/pint/models/stand_alone_psr_binaries/ELL1_model.py, DD_model.py,
+BT_model.py and variants). Structural difference by design: the
+reference keeps a stateful "standalone binary engine" object updated
+from the Component; here each model is a *pure function* of the resolved
+parameter dict, composed into the model's delay chain and traced once —
+analytic orbital-parameter derivatives come from ``jacfwd`` rather than
+the reference's hand-coded ``d_delayR_d_*`` chains.
+
+Precision split: time-since-epoch and orbital phase are computed in
+double-double (a decade of data divided by an hour-long orbital period
+needs ~1e-13-cycle phase accuracy), then the per-orbit geometry (Kepler
+solve, Roemer/Einstein/Shapiro delays, all < 1e3 s) runs in float64.
+"""
+
+from pint_tpu.models.binary.base import PulsarBinary  # noqa: F401
+from pint_tpu.models.binary.ell1 import BinaryELL1, BinaryELL1H, BinaryELL1k  # noqa: F401
+from pint_tpu.models.binary.dd import (  # noqa: F401
+    BinaryDD, BinaryDDGR, BinaryDDH, BinaryDDK, BinaryDDS)
+from pint_tpu.models.binary.bt import BinaryBT, BinaryBTX  # noqa: F401
+
+ALL_BINARY_MODELS = [BinaryELL1, BinaryELL1H, BinaryELL1k, BinaryDD,
+                     BinaryDDS, BinaryDDH, BinaryDDGR, BinaryDDK,
+                     BinaryBT, BinaryBTX]

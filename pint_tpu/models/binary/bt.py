@@ -1,0 +1,119 @@
+"""BT-family binary models (Blandford & Teukolsky 1976).
+
+Reference equivalent: ``pint.models.binary_bt`` +
+``stand_alone_psr_binaries/BT_model.py``. The classic Keplerian model:
+Roemer + Einstein delay with the first-order inverse-timing correction,
+no Shapiro term. BTX replaces PB/PBDOT with a Taylor series of orbital
+frequencies FB0, FB1, ... (reference: BTX_model.py) for systems with
+strong, non-secular orbital-period variation.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.binary.base import (DEG2RAD, PulsarBinary,
+                                         dd_inverse_delay, kepler_E,
+                                         omega_rad)
+from pint_tpu.models.component import f64
+from pint_tpu.models.parameter import DDFLOAT, float_param, mjd_param
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class BinaryBT(PulsarBinary):
+    binary_model_name = "BT"
+    epoch_name = "T0"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(mjd_param("T0", desc="Epoch of periastron"))
+        self.add_param(float_param("ECC", units="", aliases=("E",),
+                                   desc="Eccentricity"))
+        self.add_param(float_param("OM", units="deg",
+                                   desc="Longitude of periastron"))
+        self.add_param(float_param("OMDOT", units="deg/yr",
+                                   desc="Periastron advance"))
+        self.add_param(float_param("EDOT", units="1/s",
+                                   desc="Eccentricity rate"))
+        self.add_param(float_param("GAMMA", units="s",
+                                   desc="Einstein delay amplitude"))
+
+    def binary_delay(self, p, toas, acc_delay, aux) -> Array:
+        M, tt0 = self.mean_anomaly(p, toas, acc_delay)
+        e = jnp.clip(f64(p, "ECC") + f64(p, "EDOT") * tt0, 0.0, 0.999999)
+        E = kepler_E(M, e)
+        sinE, cosE = jnp.sin(E), jnp.cos(E)
+        x = f64(p, "A1") + f64(p, "XDOT") * tt0
+        om = omega_rad(p, tt0)
+        sw, cw = jnp.sin(om), jnp.cos(om)
+        se = jnp.sqrt(1.0 - jnp.square(e))
+
+        alpha = x * sw
+        beta = x * se * cw
+        Dre = alpha * (cosE - e) + (beta + f64(p, "GAMMA")) * sinE
+        Drep = -alpha * sinE + (beta + f64(p, "GAMMA")) * cosE
+        Drepp = -alpha * cosE - (beta + f64(p, "GAMMA")) * sinE
+        nhat = self.angular_rate(p, tt0) / (1.0 - e * cosE)
+        e_fac = e * sinE / (1.0 - e * cosE)
+        return dd_inverse_delay(Dre, Drep, Drepp, nhat, e_fac)
+
+    def angular_rate(self, p: dict[str, DD], tt0: Array) -> Array:
+        return 2.0 * np.pi / (f64(p, "PB") * 86400.0)
+
+
+class BinaryBTX(BinaryBT):
+    """BT with orbital-frequency Taylor series FB0..FBn [Hz, Hz/s, ...]."""
+
+    binary_model_name = "BTX"
+
+    def __init__(self, num_fb_terms: int = 1):
+        super().__init__()
+        self.num_fb_terms = max(1, num_fb_terms)
+        for k in range(self.num_fb_terms):
+            self.add_param(float_param(
+                f"FB{k}", units=f"Hz/s^{k}" if k else "Hz",
+                kind=DDFLOAT if k == 0 else "float", index=k,
+                desc=f"Orbital frequency derivative {k}"))
+
+    @classmethod
+    def from_parfile(cls, pf):
+        nfb = 1
+        while pf.get(f"FB{nfb}") is not None:
+            nfb += 1
+        self = cls(num_fb_terms=nfb)
+        self.setup_from_parfile(pf)
+        for name in self._SCALED_DOT_PARAMS:
+            if self.has_param(name):
+                pp = self.param(name)
+                if abs(pp.value_f64) > 1e-7:
+                    pp.set_value_dd(pp.value_f64 * 1e-12)
+                    pp.uncertainty *= 1e-12
+        return self
+
+    def validate(self) -> None:
+        if self.param("FB0").value_f64 <= 0:
+            raise ValueError("BTX requires FB0 > 0")
+
+    def orbits(self, p: dict[str, DD], tt0) -> tuple[Array, Array]:
+        # orbits = sum_k FB_k tt0^(k+1) / (k+1)!; FB0 term in DD
+        lead = dd.mul(p["FB0"], tt0)
+        _, frac = dd.split_int_frac(lead)
+        frac_f = frac.hi + frac.lo
+        tt0_f = tt0.hi + tt0.lo
+        acc = jnp.zeros_like(tt0_f)
+        for k in range(1, self.num_fb_terms):
+            acc = acc + f64(p, f"FB{k}") * tt0_f ** (k + 1) / math.factorial(k + 1)
+        return frac_f + acc, tt0_f
+
+    def angular_rate(self, p: dict[str, DD], tt0: Array) -> Array:
+        rate = jnp.zeros_like(tt0) + f64(p, "FB0")
+        for k in range(1, self.num_fb_terms):
+            rate = rate + f64(p, f"FB{k}") * tt0 ** k / math.factorial(k)
+        return 2.0 * np.pi * rate

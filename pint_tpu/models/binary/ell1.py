@@ -1,0 +1,140 @@
+"""ELL1-family binary models: low-eccentricity orbits (Lange et al. 2001).
+
+Reference equivalent: ``pint.models.binary_ell1`` +
+``stand_alone_psr_binaries/ELL1_model.py`` / ``ELL1H_model.py`` /
+``ELL1k_model.py``. Closed-form in the mean longitude Phi (no Kepler
+solve): with eta = EPS1 = e sin(omega), kappa = EPS2 = e cos(omega),
+
+    Delta_R = x [ sin Phi + (kappa/2) sin 2Phi - (eta/2) cos 2Phi
+                  - (3/2) eta ]
+
+plus the Damour-Deruelle inverse-timing expansion and the Shapiro delay
+-2 r ln(1 - s sin Phi). ELL1H reparameterizes (r, s) with orthometric
+(H3, H4 | STIG) per Freire & Wex 2010; ELL1k adds OMDOT/LNEDOT secular
+rotation of the eccentricity vector.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import SEC_PER_JULIAN_YEAR, T_SUN_S
+from pint_tpu.models.binary.base import (DEG2RAD, PulsarBinary,
+                                         dd_inverse_delay)
+from pint_tpu.models.component import f64
+from pint_tpu.models.parameter import float_param, mjd_param
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class BinaryELL1(PulsarBinary):
+    binary_model_name = "ELL1"
+    epoch_name = "TASC"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(mjd_param("TASC", desc="Epoch of ascending node"))
+        self.add_param(float_param("EPS1", units="", desc="e sin(omega)"))
+        self.add_param(float_param("EPS2", units="", desc="e cos(omega)"))
+        self.add_param(float_param("EPS1DOT", units="1/s",
+                                   desc="Rate of EPS1"))
+        self.add_param(float_param("EPS2DOT", units="1/s",
+                                   desc="Rate of EPS2"))
+
+    def eps(self, p: dict[str, DD], tt0: Array) -> tuple[Array, Array]:
+        eps1 = f64(p, "EPS1") + f64(p, "EPS1DOT") * tt0
+        eps2 = f64(p, "EPS2") + f64(p, "EPS2DOT") * tt0
+        return eps1, eps2
+
+    def a1(self, p: dict[str, DD], tt0: Array) -> Array:
+        return f64(p, "A1") + f64(p, "XDOT") * tt0
+
+    def roemer_terms(self, p, Phi: Array, tt0: Array):
+        """(Dre, Drep, Drepp): ELL1 Roemer delay and Phi-derivatives."""
+        x = self.a1(p, tt0)
+        eta, kappa = self.eps(p, tt0)
+        sP, cP = jnp.sin(Phi), jnp.cos(Phi)
+        s2P, c2P = jnp.sin(2 * Phi), jnp.cos(2 * Phi)
+        Dre = x * (sP + 0.5 * kappa * s2P - 0.5 * eta * c2P - 1.5 * eta)
+        Drep = x * (cP + kappa * c2P + eta * s2P)
+        Drepp = x * (-sP - 2.0 * kappa * s2P + 2.0 * eta * c2P)
+        return Dre, Drep, Drepp
+
+    def shapiro_rs(self, p: dict[str, DD]) -> tuple[Array, Array]:
+        return self.shapiro_r_s(p)
+
+    def shapiro_delay(self, p: dict[str, DD], Phi: Array) -> Array:
+        r, s = self.shapiro_rs(p)
+        return -2.0 * r * jnp.log(1.0 - s * jnp.sin(Phi))
+
+    def binary_delay(self, p, toas, acc_delay, aux) -> Array:
+        M, tt0 = self.mean_anomaly(p, toas, acc_delay)
+        Phi = M  # mean longitude from the ascending node
+        Dre, Drep, Drepp = self.roemer_terms(p, Phi, tt0)
+        pb_s = f64(p, "PB") * 86400.0
+        nhat = 2.0 * np.pi / pb_s
+        d = dd_inverse_delay(Dre, Drep, Drepp, nhat, jnp.zeros_like(Dre))
+        return d + self.shapiro_delay(p, Phi)
+
+
+class BinaryELL1H(BinaryELL1):
+    """Orthometric Shapiro parameterization (Freire & Wex 2010).
+
+    With STIG given: s = 2 STIG/(1+STIG^2), r = H3/STIG^3 (the exact
+    resummation). With H3/H4 only: STIG = H4/H3.
+    """
+
+    binary_model_name = "ELL1H"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(float_param("H3", units="s",
+                                   desc="Third Shapiro harmonic amplitude"))
+        self.add_param(float_param("H4", units="s",
+                                   desc="Fourth Shapiro harmonic amplitude"))
+        self.add_param(float_param("STIG", units="", aliases=("VARSIGMA",),
+                                   desc="Orthometric ratio H4/H3"))
+
+    def validate(self) -> None:
+        super().validate()
+        if self.param("H3").value_f64 == 0.0:
+            raise ValueError("ELL1H requires H3")
+        if self.param("STIG").value_f64 == 0.0 and self.param("H4").value_f64 == 0.0:
+            raise ValueError(
+                "ELL1H needs STIG or H4 alongside H3 (the H3-only truncated-"
+                "harmonic mode is not implemented; s would silently be 0)")
+
+    def shapiro_rs(self, p: dict[str, DD]) -> tuple[Array, Array]:
+        h3 = f64(p, "H3")
+        stig = f64(p, "STIG")
+        h4 = f64(p, "H4")
+        stig = jnp.where(stig != 0.0, stig,
+                         jnp.where(h3 != 0.0, h4 / jnp.where(h3 != 0.0, h3, 1.0),
+                                   0.0))
+        s = 2.0 * stig / (1.0 + jnp.square(stig))
+        r = h3 / jnp.where(stig != 0.0, stig, 1.0) ** 3
+        return r, s
+
+
+class BinaryELL1k(BinaryELL1):
+    """ELL1 + secular rotation of the eccentricity vector (OMDOT, LNEDOT)."""
+
+    binary_model_name = "ELL1K"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(float_param("OMDOT", units="deg/yr",
+                                   desc="Periastron advance"))
+        self.add_param(float_param("LNEDOT", units="1/s",
+                                   desc="Logarithmic eccentricity rate"))
+
+    def eps(self, p: dict[str, DD], tt0: Array) -> tuple[Array, Array]:
+        eps1, eps2 = f64(p, "EPS1"), f64(p, "EPS2")
+        dom = f64(p, "OMDOT") * DEG2RAD / SEC_PER_JULIAN_YEAR * tt0
+        sd, cd = jnp.sin(dom), jnp.cos(dom)
+        scale = 1.0 + f64(p, "LNEDOT") * tt0
+        # e sin(w0+dw) = EPS1 cos(dw) + EPS2 sin(dw); e cos likewise
+        return scale * (eps1 * cd + eps2 * sd), scale * (eps2 * cd - eps1 * sd)

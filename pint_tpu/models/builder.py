@@ -15,6 +15,7 @@ import logging
 from pint_tpu.io.parfile import ParFile, parse_parfile
 from pint_tpu.models.absolute_phase import AbsPhase
 from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
+from pint_tpu.models.binary import ALL_BINARY_MODELS
 from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
 from pint_tpu.models.jump import PhaseJump
 from pint_tpu.models.noise import (EcorrNoise, PLDMNoise, PLRedNoise,
@@ -34,6 +35,7 @@ COMPONENT_BUILD_ORDER: list[type] = [
     SolarSystemShapiro,
     DispersionDM,
     DispersionDMX,
+    *ALL_BINARY_MODELS,
     PhaseJump,
     ScaleToaError,
     ScaleDmError,
@@ -43,7 +45,7 @@ COMPONENT_BUILD_ORDER: list[type] = [
     AbsPhase,
 ]
 
-_HEADER_KEYS = ["PSR", "PSRJ", "PSRB", "EPHEM", "CLK", "CLOCK", "UNITS",
+_HEADER_KEYS = ["PSR", "PSRJ", "PSRB", "BINARY", "EPHEM", "CLK", "CLOCK", "UNITS",
                 "TIMEEPH", "T2CMETHOD", "DILATEFREQ", "DMDATA", "NTOA",
                 "TRES", "CHI2", "MODE", "INFO", "SOLARN0", "START", "FINISH",
                 "EPHVER"]

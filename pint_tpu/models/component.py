@@ -95,6 +95,11 @@ class Component:
                     break
             if line is None:
                 continue
+            if line.value == "":
+                # bare flag line (e.g. "K96"): true for bools, skip others
+                if p.kind == "bool":
+                    p.value = True
+                continue
             p.set_from_par(line.value)
             p.frozen = not line.fit
             if line.uncertainty:

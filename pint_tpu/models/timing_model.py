@@ -250,20 +250,30 @@ class TimingModel:
                 sigma = c.scale_dm_sigma(sigma, toas)
         return sigma
 
+    def _noise_basis_pairs(self, toas) -> list[tuple[str, np.ndarray, np.ndarray]]:
+        """[(component name, U, phi)] — built once per (toas, noise params).
+
+        The Fourier/ECORR bases are O(n * k) host arrays; memoized so the
+        designmatrix/weight/dimension accessors don't rebuild them.
+        """
+        comps = [c for c in self.components if getattr(c, "is_noise_basis", False)]
+        key = (id(toas), tuple((p.name, p.value) for c in comps for p in c.params))
+        if getattr(self, "_noise_basis_key", None) != key:
+            self._noise_basis_val = [(type(c).__name__, *c.basis_weight(toas))
+                                     for c in comps]
+            self._noise_basis_key = key
+        return self._noise_basis_val
+
     def noise_model_designmatrix(self, toas) -> np.ndarray | None:
         """Stacked correlated-noise basis T (n, k); None if no noise basis."""
-        blocks = [c.basis_weight(toas)[0] for c in self.components
-                  if getattr(c, "is_noise_basis", False)]
-        blocks = [b for b in blocks if b.shape[1] > 0]
+        blocks = [U for _, U, _ in self._noise_basis_pairs(toas) if U.shape[1] > 0]
         if not blocks:
             return None
         return np.concatenate(blocks, axis=1)
 
     def noise_model_basis_weight(self, toas) -> np.ndarray | None:
         """Prior variances phi (k,) matching noise_model_designmatrix columns."""
-        ws = [c.basis_weight(toas)[1] for c in self.components
-              if getattr(c, "is_noise_basis", False)]
-        ws = [w for w in ws if w.size > 0]
+        ws = [phi for _, _, phi in self._noise_basis_pairs(toas) if phi.size > 0]
         if not ws:
             return None
         return np.concatenate(ws)
@@ -272,12 +282,10 @@ class TimingModel:
         """Map component name -> (start column, size) in the stacked basis."""
         out: dict[str, tuple[int, int]] = {}
         start = 0
-        for c in self.components:
-            if getattr(c, "is_noise_basis", False):
-                k = c.basis_weight(toas)[0].shape[1]
-                if k:
-                    out[type(c).__name__] = (start, k)
-                    start += k
+        for name, U, _ in self._noise_basis_pairs(toas):
+            if U.shape[1]:
+                out[name] = (start, U.shape[1])
+                start += U.shape[1]
         return out
 
     # ------------------------------------------------------------------

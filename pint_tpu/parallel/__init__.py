@@ -1,0 +1,22 @@
+"""Parallel execution: device meshes, TOA-axis sharding, batched pulsars.
+
+Reference status (SURVEY.md §2.6): the reference is a single-process
+package whose only parallelism is a process pool in grid_chisq. This
+module is the TPU-native scale story the north star demands:
+
+* **TOA axis = sequence axis** ("long context"): design-matrix rows,
+  residuals and noise weights are sharded over a 1D/2D
+  ``jax.sharding.Mesh``; the (p, p) Gram matrices reduce with XLA
+  ``psum`` over ICI (pint_tpu.fitting.fitter.wls_solve_gram).
+* **Pulsar axis = expert axis**: independent per-pulsar problems are
+  padded to a common shape, stacked, ``vmap``-ed, and sharded over the
+  mesh's "psr" axis (pint_tpu.parallel.batch).
+* Collectives are emitted by XLA from sharding constraints — there is
+  no hand-written communication code, and the same program runs on 1
+  chip, a v5e-8 slice, or multi-host DCN meshes.
+"""
+
+from pint_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, shard_toas, replicate)
+from pint_tpu.parallel.sharded_fit import ShardedWLSFitter, sharded_fit  # noqa: F401
+from pint_tpu.parallel.batch import BatchedPulsarFitter, pad_toas  # noqa: F401

@@ -1,0 +1,108 @@
+"""Batched multi-pulsar fitting: vmap over stacked per-pulsar problems.
+
+The "expert-parallel" analogue (SURVEY.md §2.6): each pulsar is an
+independent fit problem; problems with a common model structure are
+padded to one TOA count, stacked leaf-wise, ``vmap``-ed through the
+single-pulsar fit step, and sharded over the mesh's "psr" axis (with the
+TOA axis optionally sharded too). One compiled program fits the whole
+array — the reference's equivalent is a Python loop over pintempo runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.step import make_wls_step
+from pint_tpu.ops.dd import DD
+from pint_tpu.parallel.mesh import (make_mesh, pad_to_multiple, replicate,
+                                    shard_toas)
+from pint_tpu.parallel.sharded_fit import pad_toas
+from pint_tpu.toas import Flags, TOAs
+
+
+def _strip_static(toas: TOAs) -> TOAs:
+    """Erase per-pulsar static metadata so stacked treedefs match.
+
+    The batched path requires selector-free models (no JUMP/EFAC flags),
+    so flags and site names are not consulted during tracing.
+    """
+    n = len(toas)
+    return dataclasses.replace(
+        toas, flags=Flags({} for _ in range(n)), obs_names=("batched",),
+        ephem_name="batched")
+
+
+def stack_toas(toas_list: list[TOAs], n_pad: int | None = None) -> TOAs:
+    """Pad to a common length and stack along a new leading pulsar axis."""
+    n_max = n_pad or max(len(t) for t in toas_list)
+    stripped = [_strip_static(pad_toas(t, n_max)) for t in toas_list]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stripped)
+
+
+class BatchedPulsarFitter:
+    """Fit many pulsars with one vmapped, mesh-sharded XLA program.
+
+    All models must share the same component structure and free-parameter
+    list (the template is the first model). Per-pulsar parameter values
+    are stacked into (B,)-shaped DD leaves.
+    """
+
+    def __init__(self, problems: list[tuple[TOAs, object]], mesh=None,
+                 psr_axis: int | None = None):
+        if not problems:
+            raise ValueError("no problems given")
+        self.toas_list = [t for t, _ in problems]
+        self.models = [m for _, m in problems]
+        template = self.models[0]
+        names = template.free_params
+        for m in self.models[1:]:
+            if m.free_params != names:
+                raise ValueError(
+                    "batched fitting requires identical free-parameter lists: "
+                    f"{m.free_params} != {names}")
+        self.free_params = names
+        for m in self.models:
+            selector_params = [p.name for p in m.params.values() if p.selector]
+            if selector_params:
+                raise ValueError(
+                    "batched fitting strips per-TOA flags, which would "
+                    f"silently zero selector parameters {selector_params}; "
+                    "fit this pulsar with WLSFitter/ShardedWLSFitter instead")
+        if mesh is None:
+            ndev = len(jax.devices())
+            b = len(problems)
+            axis = psr_axis if psr_axis is not None else int(np.gcd(b, ndev))
+            mesh = make_mesh(psr_axis=axis)
+        self.mesh = mesh
+        # batched parameter state
+        bases = [m.base_dd() for m in self.models]
+        self.base = {
+            k: DD(jnp.asarray([b[k].hi for b in bases]),
+                  jnp.asarray([b[k].lo for b in bases]))
+            for k in bases[0]
+        }
+        n_shards = self.mesh.shape["toa"]
+        n_max = pad_to_multiple(max(len(t) for t in self.toas_list), n_shards)
+        self.toas = shard_toas(stack_toas(self.toas_list, n_max), self.mesh,
+                               batched=True)
+        # abs_phase off: the weighted-mean subtraction absorbs TZR anchors
+        self.step = jax.jit(jax.vmap(make_wls_step(template, abs_phase=False)))
+
+    def fit_toas(self, maxiter: int = 2) -> np.ndarray:
+        """Run the batched fit; updates every model. Returns per-pulsar chi2."""
+        deltas = {k: jnp.zeros(len(self.models)) for k in self.free_params}
+        base = replicate(self.base, self.mesh)
+        info = None
+        with self.mesh:
+            for _ in range(max(1, maxiter)):
+                deltas, info = self.step(base, deltas, self.toas)
+        for i, m in enumerate(self.models):
+            for k in self.free_params:
+                p = m[k]
+                p.add_delta(float(np.asarray(deltas[k][i])))
+                p.uncertainty = float(np.asarray(info["errors"][k][i]))
+        return np.asarray(info["chi2"])

@@ -1,0 +1,63 @@
+"""Mesh construction and TOA-table sharding helpers.
+
+The TOA table is a pytree whose leaves are (n,) / (n, 3) arrays (plus a
+leading batch axis under vmap); these helpers place every leaf with a
+``NamedSharding`` over the mesh's "toa" (and optionally "psr") axis so
+XLA partitions the downstream fit step and inserts the psum reductions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+
+def make_mesh(n_devices: int | None = None, psr_axis: int = 1,
+              devices=None) -> Mesh:
+    """Build a ("psr", "toa") mesh over the first `n_devices` devices.
+
+    psr_axis=1 gives a pure TOA-sharded mesh; psr_axis>1 splits devices
+    between independent-pulsar and TOA parallelism (the "ep x sp" grid).
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = devs.size
+    if n % psr_axis != 0:
+        raise ValueError(f"psr_axis {psr_axis} does not divide {n} devices")
+    return Mesh(devs.reshape(psr_axis, n // psr_axis), ("psr", "toa"))
+
+
+def _leaf_spec(x, batched: bool) -> P:
+    nd = jnp.ndim(x)
+    lead = ("psr",) if batched else ()
+    data_axes = nd - len(lead)
+    if data_axes <= 0:
+        return P(*lead)
+    return P(*lead, "toa", *([None] * (data_axes - 1)))
+
+
+def shard_toas(toas, mesh: Mesh, *, batched: bool = False):
+    """Place every TOA-table leaf on the mesh, TOA axis sharded.
+
+    With ``batched=True`` the leading axis (stacked pulsars) is sharded
+    over the "psr" mesh axis as well.
+    """
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, _leaf_spec(x, batched)))
+
+    return jax.tree.map(put, toas)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree (model parameters) over the mesh."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k

@@ -1,0 +1,142 @@
+"""Sharded and batched fitting on the virtual 8-device CPU mesh (S6).
+
+Per SURVEY.md §4 the multi-device behavior is validated on
+xla_force_host_platform_device_count=8 (conftest): results must match
+the single-device fitters to float64 precision — sharding is a layout,
+not an algorithm change.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pint_tpu.fitting import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.parallel import (BatchedPulsarFitter, ShardedWLSFitter,
+                               make_mesh, sharded_fit)
+from pint_tpu.parallel.sharded_fit import pad_toas
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+
+def _problem(seed=1, ntoas=100, f0_extra=0.0):
+    par = PAR
+    if f0_extra:
+        par = par.replace("61.485476554", f"{61.485476554 + f0_extra:.9f}")
+    model = get_model(par)
+    toas = make_fake_toas_uniform(53478, 54187, ntoas, model, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=2.0, add_noise=True, seed=seed)
+    return model, toas
+
+
+def test_pad_toas_weight_neutral():
+    model, toas = _problem(ntoas=50)
+    padded = pad_toas(toas, 64)
+    assert len(padded) == 64
+    r0 = Residuals(toas, model)
+    r1 = Residuals(padded, model)
+    # chi2 unchanged: padding carries ~zero weight
+    np.testing.assert_allclose(r1.chi2, r0.chi2, rtol=1e-9)
+
+
+def test_sharded_fit_matches_single_device():
+    model, toas = _problem()
+    pert_a = get_model(PAR)
+    pert_a["F0"].add_delta(3e-10)
+    pert_b = get_model(PAR)
+    pert_b["F0"].add_delta(3e-10)
+
+    f_ref = WLSFitter(toas, pert_a)
+    f_ref.fit_toas(maxiter=2)
+
+    mesh = make_mesh(8, psr_axis=1)
+    f_sh = ShardedWLSFitter(toas, pert_b, mesh=mesh)
+    chi2 = f_sh.fit_toas(maxiter=2)
+    assert np.isfinite(chi2)
+
+    for name in ("F0", "F1", "DM"):
+        a, b = pert_a[name], pert_b[name]
+        # identical answers up to solver round-off, far below 0.01 sigma
+        assert abs(a.value_f64 - b.value_f64) < 0.01 * a.uncertainty, name
+        np.testing.assert_allclose(b.uncertainty, a.uncertainty, rtol=1e-3)
+
+
+def test_sharded_fit_2d_mesh():
+    model, toas = _problem(ntoas=96)
+    pert = get_model(PAR)
+    pert["F0"].add_delta(2e-10)
+    mesh = make_mesh(8, psr_axis=2)  # (2, 4): toa axis = 4 shards
+    deltas, info = sharded_fit(toas, pert, mesh=mesh, maxiter=2)
+    assert np.isfinite(float(np.asarray(info["chi2"])))
+    assert abs(float(np.asarray(deltas["F0"])) + 2e-10) < 1e-11
+
+
+def test_batched_pulsar_fitter():
+    problems = []
+    truths = []
+    for i in range(4):
+        model, toas = _problem(seed=10 + i, ntoas=60 + 7 * i,
+                               f0_extra=1e-3 * i)
+        truths.append({k: model[k].value_f64 for k in model.free_params})
+        par = PAR if i == 0 else PAR.replace(
+            "61.485476554", f"{61.485476554 + 1e-3 * i:.9f}")
+        pert = get_model(par)
+        pert["F0"].add_delta(2e-10)
+        problems.append((toas, pert))
+
+    bf = BatchedPulsarFitter(problems, mesh=make_mesh(8, psr_axis=4))
+    chi2 = bf.fit_toas(maxiter=2)
+    assert chi2.shape == (4,)
+    assert np.all(np.isfinite(chi2))
+    for (t, m), truth in zip(problems, truths):
+        for name in ("F0", "DM"):
+            pull = (m[name].value_f64 - truth[name]) / m[name].uncertainty
+            assert abs(pull) < 5.0, f"{name}: {pull}"
+
+
+def test_step_uses_scaled_errors():
+    """The jitted step must weight with EFAC-scaled sigmas like WLSFitter."""
+    import jax.numpy as jnp
+    from pint_tpu.fitting.step import make_wls_step
+
+    model, toas = _problem(ntoas=40)
+    m_efac = get_model(PAR + "EFAC 2.0\n")
+    step_plain = jax.jit(make_wls_step(model))
+    step_efac = jax.jit(make_wls_step(m_efac))
+    _, i0 = step_plain(model.base_dd(), model.zero_deltas(), toas)
+    _, i1 = step_efac(m_efac.base_dd(), m_efac.zero_deltas(), toas)
+    np.testing.assert_allclose(float(i1["chi2"]), float(i0["chi2"]) / 4.0,
+                               rtol=1e-6)
+
+
+def test_batched_rejects_selector_models():
+    m1, t1 = _problem(seed=1)
+    m_jump = get_model(PAR + "JUMP -fe wide 1e-4 1\n")
+    with pytest.raises(ValueError, match="selector"):
+        BatchedPulsarFitter([(t1, m_jump)])
+
+
+def test_batched_rejects_mismatched_params():
+    m1, t1 = _problem(seed=1)
+    par2 = PAR.replace("DM              223.9  1", "DM              223.9")
+    m2 = get_model(par2)
+    with pytest.raises(ValueError, match="identical free-parameter"):
+        BatchedPulsarFitter([(t1, m1), (t1, m2)])
