@@ -1,0 +1,99 @@
+"""Chi-square over parameter grids, evaluated as one vmapped XLA program.
+
+Reference equivalent: ``pint.gridutils.grid_chisq`` /
+``grid_chisq_derived`` (src/pint/gridutils.py) — the reference's only
+parallelism, a ``concurrent.futures`` pool refitting at every grid node
+with a full Fitter. Here the grid is a ``vmap`` axis: at each node the
+gridded parameters are pinned to their offsets and the *remaining* free
+parameters are solved in the same linearized WLS step used everywhere
+else, so an entire (e.g.) 64x64 grid is one compiled program on device
+instead of thousands of Python fits.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.fitter import wls_solve_gram
+
+Array = jax.Array
+
+
+def _chisq_at_points(toas, model, param_names: tuple[str, ...],
+                     points: np.ndarray, *, solve_free: bool = True) -> np.ndarray:
+    """Vmapped chi2 at (npoints, nparams) parameter-offset rows."""
+    free_rest = [n for n in model.free_params if n not in param_names]
+    base = model.base_dd()
+    phase_fn = model.phase_fn_toas()
+    err = model.scaled_toa_uncertainty(toas)
+    w = 1.0 / jnp.square(err)
+    f0 = model.f0_f64
+
+    def frac_phase(deltas):
+        ph = phase_fn(base, deltas, toas)
+        return ph.frac.hi + ph.frac.lo
+
+    def total_phase(deltas):
+        ph = phase_fn(base, deltas, toas)
+        return ph.int_part + (ph.frac.hi + ph.frac.lo)
+
+    def whitened_resid(deltas):
+        resid = frac_phase(deltas)
+        resid = resid - jnp.sum(resid * w) / jnp.sum(w)
+        return resid / f0
+
+    def chi2_at(point):
+        deltas = {n: point[i] for i, n in enumerate(param_names)}
+        deltas.update({n: jnp.zeros(()) for n in free_rest})
+        r = whitened_resid(deltas)
+        if solve_free and free_rest:
+            J = jax.jacfwd(total_phase)(deltas)
+            cols = [jnp.ones_like(r) / f0] + [-J[n] / f0 for n in free_rest]
+            M = jnp.stack(cols, axis=1)
+            sol = wls_solve_gram(M, r, err)
+            fitted = dict(deltas)
+            for i, n in enumerate(free_rest):
+                fitted[n] = sol["x"][i + 1]
+            r = whitened_resid(fitted)
+        return jnp.sum(jnp.square(r) * w)
+
+    return np.asarray(jax.jit(jax.vmap(chi2_at))(jnp.asarray(points)))
+
+
+def grid_chisq(toas, model, param_names: tuple[str, ...], grids,
+               *, solve_free: bool = True) -> np.ndarray:
+    """chi2 over an outer-product grid of parameter *offsets*.
+
+    param_names: gridded parameters; grids: per-parameter 1D arrays of
+    offsets about the current model values (the reference grids around
+    the fitted solution the same way). With ``solve_free`` the other
+    free parameters are re-solved (linearized) at every node. Returns
+    chi2 shaped [len(g) for g in grids].
+    """
+    grids = [np.asarray(g, dtype=np.float64) for g in grids]
+    if len(grids) != len(param_names):
+        raise ValueError("one grid per parameter required")
+    points = np.asarray(list(itertools.product(*grids)))
+    chi2 = _chisq_at_points(toas, model, tuple(param_names), points,
+                            solve_free=solve_free)
+    return chi2.reshape([len(g) for g in grids])
+
+
+def grid_chisq_derived(toas, model, param_names, funcs, grids,
+                       *, solve_free: bool = True) -> np.ndarray:
+    """Grid over derived coordinates: offsets = funcs applied to grid axes.
+
+    Reference: pint.gridutils.grid_chisq_derived. ``funcs[i](*mesh)``
+    maps the grid coordinates to the offset of ``param_names[i]``.
+    """
+    grids = [np.asarray(g, dtype=np.float64) for g in grids]
+    mesh = np.meshgrid(*grids, indexing="ij")
+    offsets = [np.asarray(f(*mesh), dtype=np.float64).ravel() for f in funcs]
+    points = np.stack(offsets, axis=1)
+    chi2 = _chisq_at_points(toas, model, tuple(param_names), points,
+                            solve_free=solve_free)
+    return chi2.reshape(mesh[0].shape)

@@ -17,12 +17,18 @@ from pint_tpu.models.absolute_phase import AbsPhase
 from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
 from pint_tpu.models.binary import ALL_BINARY_MODELS
 from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
+from pint_tpu.models.frequency_dependent import FD
+from pint_tpu.models.glitch import Glitch
+from pint_tpu.models.ifunc import IFunc
 from pint_tpu.models.jump import PhaseJump
 from pint_tpu.models.noise import (EcorrNoise, PLDMNoise, PLRedNoise,
                                    ScaleDmError, ScaleToaError)
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+from pint_tpu.models.solar_wind import SolarWindDispersion
 from pint_tpu.models.spindown import Spindown
 from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.models.troposphere import TroposphereDelay
+from pint_tpu.models.wave import Wave
 
 log = logging.getLogger(__name__)
 
@@ -35,7 +41,13 @@ COMPONENT_BUILD_ORDER: list[type] = [
     SolarSystemShapiro,
     DispersionDM,
     DispersionDMX,
+    SolarWindDispersion,
+    TroposphereDelay,
     *ALL_BINARY_MODELS,
+    Glitch,
+    Wave,
+    IFunc,
+    FD,
     PhaseJump,
     ScaleToaError,
     ScaleDmError,

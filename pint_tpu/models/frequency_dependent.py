@@ -1,0 +1,54 @@
+"""FD: frequency-dependent profile-evolution delay polynomials.
+
+Reference equivalent: ``pint.models.frequency_dependent.FD``
+(src/pint/models/frequency_dependent.py). Unmodeled pulse-profile
+evolution with observing frequency is absorbed by
+
+    delay = sum_i FD_i * log(nu / 1 GHz)^i ,   i = 1..n  [s]
+
+— a polynomial in log-frequency with no time dependence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import float_param
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class FD(Component):
+    category = "frequency_dependent"
+    is_delay = True
+
+    def __init__(self, num_terms: int = 0):
+        super().__init__()
+        self.num_terms = num_terms
+        for i in range(1, num_terms + 1):
+            self.add_param(float_param(f"FD{i}", units="s", index=i,
+                                       desc=f"FD delay coefficient {i}"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return pf.get("FD1") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "FD":
+        n = 0
+        while pf.get(f"FD{n + 1}") is not None:
+            n += 1
+        self = cls(num_terms=n)
+        self.setup_from_parfile(pf)
+        return self
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        log_nu = jnp.log(toas.freq_mhz / 1000.0)
+        # Horner over FD_n..FD_1 with zero constant term
+        acc = jnp.zeros(len(toas))
+        for i in reversed(range(1, self.num_terms + 1)):
+            acc = (acc + f64(p, f"FD{i}")) * log_nu
+        return acc

@@ -1,0 +1,84 @@
+"""IFUNC: tabulated time-offset absorber (interpolated function).
+
+Reference equivalent: ``pint.models.ifunc.IFunc``
+(src/pint/models/ifunc.py). IFUNC_k par lines tabulate (MJD_k,
+offset_k [s]) control points; SIFUNC selects the interpolation type
+(0 = piecewise constant, 2 = linear — tempo2 conventions). The
+interpolated offset enters as an achromatic delay.
+
+The node MJDs are static (tabulated in the par file), so the gather is
+a fixed-shape ``jnp.interp`` over the traced TOA times — no dynamic
+shapes under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import float_param
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class IFunc(Component):
+    category = "ifunc"
+    is_delay = True
+
+    @property
+    def extra_par_names(self) -> tuple[str, ...]:
+        # raw IFUNCk lines carry (MJD, offset) pairs, not param values
+        return tuple(f"IFUNC{k + 1}" for k in range(len(self.node_mjds)))
+
+    def __init__(self, node_mjds: list[float] | None = None, sifunc: int = 2):
+        super().__init__()
+        self.node_mjds = np.asarray(node_mjds or [], dtype=np.float64)
+        self.sifunc = sifunc
+        self.add_param(float_param("SIFUNC", units="", default=float(sifunc),
+                                   desc="IFUNC interpolation type"))
+        for k in range(len(self.node_mjds)):
+            self.add_param(float_param(f"IFUNC{k + 1}", units="s", index=k + 1,
+                                       desc=f"Offset at MJD {self.node_mjds[k]}"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return bool(pf.get_all("IFUNC1"))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "IFunc":
+        mjds, offsets = [], []
+        k = 1
+        while True:
+            line = pf.get(f"IFUNC{k}")
+            if line is None:
+                break
+            mjds.append(float(line.value))
+            offsets.append(float(line.rest[0]) if line.rest else 0.0)
+            k += 1
+        sifunc = int(float(pf.get_value("SIFUNC", "2")))
+        self = cls(node_mjds=mjds, sifunc=sifunc)
+        for k, off in enumerate(offsets):
+            self.param(f"IFUNC{k + 1}").set_value_dd(off)
+        return self
+
+    def validate(self) -> None:
+        if len(self.node_mjds) and not np.all(np.diff(self.node_mjds) > 0):
+            raise ValueError("IFUNC node MJDs must be strictly increasing")
+        if self.sifunc not in (0, 2):
+            raise ValueError(f"SIFUNC {self.sifunc} not supported (0 or 2)")
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        if not len(self.node_mjds):
+            return jnp.zeros(len(toas))
+        t = toas.tdb.hi + toas.tdb.lo
+        vals = jnp.stack([f64(p, f"IFUNC{k + 1}")
+                          for k in range(len(self.node_mjds))])
+        nodes = jnp.asarray(self.node_mjds)
+        if self.sifunc == 0:  # piecewise constant (previous node holds)
+            idx = jnp.clip(jnp.searchsorted(nodes, t, side="right") - 1,
+                           0, len(self.node_mjds) - 1)
+            return vals[idx]
+        return jnp.interp(t, nodes, vals)

@@ -1,0 +1,113 @@
+"""Solar-wind dispersion: electron-density delay from the solar wind.
+
+Reference equivalent: ``pint.models.solar_wind_dispersion.SolarWindDispersion``
+(src/pint/models/solar_wind_dispersion.py), spherical 1/r^2 model
+(SWM 0). For electron density NE_SW [cm^-3] at 1 au, the line-of-sight
+column through the wind is
+
+    DM_sw = NE_SW * AU * (pi - phi) / (r/AU * sin phi)   [converted to pc/cm^3]
+
+with phi the observatory-frame Sun-pulsar angular separation
+(cos phi = p_hat . s_hat) and r the observatory-Sun distance — the
+closed form of the 1/r'^2 integral along the ray. The delay is then the
+usual cold-plasma K * DM / nu^2.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import AU_LIGHT_S, DM_CONST
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import float_param
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+# parsec in light-seconds; AU in cm and pc for the column conversion
+PC_LS = 3.0856775814913673e16 / 299792458.0
+AU_PER_PC = PC_LS / AU_LIGHT_S
+
+
+class SolarWindDispersion(Component):
+    category = "solar_wind"
+    is_delay = True
+    extra_par_names = ("SWM",)
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(float_param("NE_SW", units="cm^-3", aliases=("NE1AU", "SOLARN0"),
+                                   desc="Solar wind electron density at 1 au"))
+        self.add_param(float_param("SWM", units="", default=0.0,
+                                   desc="Solar wind model index"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        for key in ("NE_SW", "NE1AU", "SOLARN0"):
+            line = pf.get(key)
+            if line is not None:
+                try:
+                    if float(line.value.replace("D", "e")) != 0.0:
+                        return True
+                except ValueError:
+                    pass
+        return False
+
+    @classmethod
+    def from_parfile(cls, pf) -> "SolarWindDispersion":
+        self = cls()
+        self.setup_from_parfile(pf)
+        return self
+
+    def validate(self) -> None:
+        if self.param("SWM").value_f64 not in (0.0,):
+            raise ValueError("only SWM 0 (spherical) is implemented")
+
+    def dm_value(self, p: dict[str, DD], toas) -> Array:
+        """Solar-wind DM at each TOA [pc/cm^3] (feeds wideband DM too)."""
+        sun = toas.planet_pos_ls["sun"]  # observatory -> sun [lt-s]
+        r_ls = jnp.linalg.norm(sun, axis=-1)
+        s_hat = sun / r_ls[:, None]
+        p_hat = self._psr_dir(p, toas)
+        cosphi = jnp.clip(jnp.sum(p_hat * s_hat, axis=-1), -1.0, 1.0)
+        phi = jnp.arccos(cosphi)
+        sinphi = jnp.maximum(jnp.sin(phi), 1e-6)
+        r_au = r_ls / AU_LIGHT_S
+        geom = (np.pi - phi) / (r_au * sinphi)
+        # NE_SW [cm^-3] * 1 au path, converted to pc: AU/pc
+        return f64(p, "NE_SW") * geom / AU_PER_PC
+
+    @staticmethod
+    def _psr_dir(p: dict[str, DD], toas) -> Array:
+        # recompute the ICRS unit vector (aux not threaded on this path);
+        # ecliptic coordinates are rotated about x by the obliquity
+        from pint_tpu.constants import OBLIQUITY_RAD
+
+        ecliptic = "RAJ" not in p
+        if ecliptic:
+            lon, lat = p["ELONG"].hi + p["ELONG"].lo, p["ELAT"].hi + p["ELAT"].lo
+        else:
+            lon, lat = p["RAJ"].hi + p["RAJ"].lo, p["DECJ"].hi + p["DECJ"].lo
+        cl = jnp.cos(lat)
+        v = jnp.stack([cl * jnp.cos(lon), cl * jnp.sin(lon), jnp.sin(lat)])
+        if ecliptic:
+            ce, se = np.cos(OBLIQUITY_RAD), np.sin(OBLIQUITY_RAD)
+            v = jnp.stack([v[0], ce * v[1] - se * v[2], se * v[1] + ce * v[2]])
+        return v[None, :] * jnp.ones((np.shape(toas.freq_mhz)[-1], 1))
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        psr_dir = aux.get("psr_dir")
+        if psr_dir is not None:
+            sun = toas.planet_pos_ls["sun"]
+            r_ls = jnp.linalg.norm(sun, axis=-1)
+            s_hat = sun / r_ls[:, None]
+            cosphi = jnp.clip(jnp.sum(psr_dir * s_hat, axis=-1), -1.0, 1.0)
+            phi = jnp.arccos(cosphi)
+            sinphi = jnp.maximum(jnp.sin(phi), 1e-6)
+            geom = (np.pi - phi) / ((r_ls / AU_LIGHT_S) * sinphi)
+            dm = f64(p, "NE_SW") * geom / AU_PER_PC
+        else:
+            dm = self.dm_value(p, toas)
+        return DM_CONST * dm / jnp.square(toas.freq_mhz)

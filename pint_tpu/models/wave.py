@@ -1,0 +1,82 @@
+"""WAVE: harmonic-series absorber for unmodeled red timing noise.
+
+Reference equivalent: ``pint.models.wave.Wave``
+(src/pint/models/wave.py). Tempo-style WAVE parameters define a sum of
+sinusoidal time offsets
+
+    w(t) = sum_k [ WAVE_k^A sin(k w0 dt) + WAVE_k^B cos(k w0 dt) ]
+
+with w0 = WAVE_OM [rad/d] and dt = t - WAVEEPOCH [d], entering the
+timing model as an achromatic delay. Each WAVEk par line carries the
+(A, B) pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import float_param, mjd_param
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class Wave(Component):
+    category = "wave"
+    is_delay = True
+
+    @property
+    def extra_par_names(self) -> tuple[str, ...]:
+        # raw WAVEk par lines (split into A/B params internally)
+        return tuple(f"WAVE{k}" for k in range(1, self.num_waves + 1))
+
+    def __init__(self, num_waves: int = 0):
+        super().__init__()
+        self.num_waves = num_waves
+        self.add_param(mjd_param("WAVEEPOCH", desc="WAVE reference epoch"))
+        self.add_param(float_param("WAVE_OM", units="rad/d",
+                                   desc="Fundamental WAVE frequency"))
+        for k in range(1, num_waves + 1):
+            self.add_param(float_param(f"WAVE{k}A", units="s", index=k,
+                                       desc=f"Sine amplitude of harmonic {k}"))
+            self.add_param(float_param(f"WAVE{k}B", units="s", index=k,
+                                       desc=f"Cosine amplitude of harmonic {k}"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return pf.get("WAVE_OM") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "Wave":
+        n = 0
+        while pf.get(f"WAVE{n + 1}") is not None:
+            n += 1
+        self = cls(num_waves=n)
+        self.setup_from_parfile(pf)
+        # WAVEk lines hold "A B" pairs: value=A, rest/uncertainty column=B
+        for k in range(1, n + 1):
+            line = pf.get(f"WAVE{k}")
+            self.param(f"WAVE{k}A").set_from_par(line.value)
+            b = line.uncertainty or (line.rest[0] if line.rest else "0")
+            self.param(f"WAVE{k}B").set_from_par(str(b))
+        if "WAVEEPOCH" not in [l.name for l in pf.lines] and pf.get("PEPOCH"):
+            self.param("WAVEEPOCH").set_from_par(pf.get("PEPOCH").value)
+        return self
+
+    def validate(self) -> None:
+        if self.num_waves and self.param("WAVE_OM").value_f64 <= 0:
+            raise ValueError("WAVE_OM must be positive")
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        dt_dd = dd.sub(toas.tdb, p["WAVEEPOCH"])
+        dt = dt_dd.hi + dt_dd.lo  # days; f64 ample for ~1e-4 rad/d phases
+        om = f64(p, "WAVE_OM")
+        total = jnp.zeros(len(toas))
+        for k in range(1, self.num_waves + 1):
+            arg = k * om * dt
+            total = total + (f64(p, f"WAVE{k}A") * jnp.sin(arg)
+                             + f64(p, f"WAVE{k}B") * jnp.cos(arg))
+        return total

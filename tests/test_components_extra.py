@@ -1,0 +1,180 @@
+"""Glitch, Wave, IFunc, FD, solar wind, troposphere, TCB conversion.
+
+Reference test analogues: tests/test_glitch.py, test_wave.py,
+test_ifunc.py, test_fd.py, test_solar_wind.py, test_troposphere_model.py,
+test_tcb2tdb.py (strategy per SURVEY.md §4, offline property checks).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pint_tpu.fitting import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.models.tcb_conversion import (convert_tcb_tdb, tcb_to_tdb_mjd,
+                                            tdb_to_tcb_mjd)
+from pint_tpu.io.parfile import parse_parfile
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """
+PSRJ           J0000+0000
+RAJ            12:00:00.0  1
+DECJ           10:00:00.0  1
+F0             100.0  1
+F1             -1e-14  1
+PEPOCH        55000.000000
+POSEPOCH      55000.000000
+DM              30.0
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  55000.1
+TZRFRQ  1400
+TZRSITE @
+"""
+
+
+def test_glitch_phase_step():
+    m = get_model(BASE + """
+GLEP_1 55100
+GLPH_1 0.2
+GLF0_1 1e-7
+GLF1_1 0
+GLF0D_1 5e-8
+GLTD_1 50
+""")
+    assert m.has_component("Glitch")
+    toas = make_fake_toas_uniform(55000, 55200, 80, m, obs="@")
+    # glitch included in simulation -> near-zero resids
+    r = Residuals(toas, m, subtract_mean=False)
+    assert np.max(np.abs(np.asarray(r.time_resids))) < 1e-7
+    # remove glitch -> clear phase structure after GLEP only
+    m0 = get_model(BASE)
+    r0 = Residuals(toas, m0, subtract_mean=False, track_mode="use_pulse_numbers") \
+        if False else Residuals(toas, m0, subtract_mean=False)
+    mjds = toas.get_mjds()
+    pre = np.asarray(r0.phase_resids)[mjds < 55099]
+    post = np.asarray(r0.phase_resids)[mjds > 55105]
+    assert np.std(post) > 10 * max(np.std(pre), 1e-12)
+
+
+def test_glitch_fit_recovers_glf0():
+    par = BASE + "GLEP_1 55100\nGLPH_1 0.0\nGLF0_1 1e-7  1\nGLF0D_1 0\nGLTD_1 0\n"
+    m = get_model(par)
+    toas = make_fake_toas_uniform(55000, 55200, 100, m, obs="@",
+                                  error_us=2.0, add_noise=True, seed=9)
+    pert = get_model(par)
+    pert["GLF0_1"].add_delta(2e-9)
+    f = WLSFitter(toas, pert)
+    f.fit_toas(maxiter=2)
+    pull = (pert["GLF0_1"].value_f64 - 1e-7) / pert["GLF0_1"].uncertainty
+    assert abs(pull) < 5.0
+
+
+def test_wave_delay():
+    m = get_model(BASE + """
+WAVEEPOCH 55000
+WAVE_OM 0.01
+WAVE1 1e-5 -2e-5
+WAVE2 3e-6 0
+""")
+    comp = m.get_component("Wave")
+    assert comp.num_waves == 2
+    toas = make_fake_toas_uniform(55000, 56000, 50, m, obs="@")
+    d = np.asarray(comp.delay(m.base_dd(), toas, jnp.zeros(50), {}))
+    assert np.max(np.abs(d)) <= (1e-5 + 2e-5 + 3e-6) + 1e-12
+    assert np.ptp(d) > 1e-6
+    # t = WAVEEPOCH: delay = B1 + B2
+    t0 = make_fake_toas_uniform(55000, 55000.001, 2, m, obs="@")
+    d0 = np.asarray(comp.delay(m.base_dd(), t0, jnp.zeros(2), {}))
+    np.testing.assert_allclose(d0, -2e-5 + 0.0, atol=1e-8)
+
+
+def test_ifunc_interpolation():
+    m = get_model(BASE + """
+SIFUNC 2
+IFUNC1 55000 1e-5
+IFUNC2 55100 3e-5
+IFUNC3 55200 -1e-5
+""")
+    comp = m.get_component("IFunc")
+    toas = make_fake_toas_uniform(55050, 55050.01, 2, m, obs="@")
+    d = np.asarray(comp.delay(m.base_dd(), toas, jnp.zeros(2), {}))
+    np.testing.assert_allclose(d, 2e-5, rtol=1e-3)  # halfway 1e-5 -> 3e-5
+
+
+def test_fd_delay():
+    m = get_model(BASE + "FD1 1e-5\nFD2 -3e-6\n")
+    comp = m.get_component("FD")
+    toas = make_fake_toas_uniform(55000, 55010, 4, m, obs="@",
+                                  freq_mhz=np.array([1000.0, 2000.0]))
+    d = np.asarray(comp.delay(m.base_dd(), toas, jnp.zeros(4), {}))
+    # at 1 GHz: log term zero -> no delay
+    np.testing.assert_allclose(d[::2], 0.0, atol=1e-15)
+    lg = np.log(2.0)
+    np.testing.assert_allclose(d[1::2], 1e-5 * lg - 3e-6 * lg**2, rtol=1e-12)
+
+
+def test_solar_wind_delay():
+    m = get_model(BASE + "NE_SW 10.0\n")
+    assert m.has_component("SolarWindDispersion")
+    toas = make_fake_toas_uniform(55000, 55365, 73, m, obs="gbt",
+                                  freq_mhz=400.0)
+    comp = m.get_component("SolarWindDispersion")
+    dm = np.asarray(comp.dm_value(m.base_dd(), toas))
+    # typical solar-wind DM: 1e-5..1e-2 pc/cm3 depending on elongation
+    assert np.all(dm > 0)
+    assert 1e-6 < np.max(dm) < 1e-1
+    assert np.max(dm) / np.min(dm) > 1.5  # annual modulation
+
+
+def test_troposphere_delay():
+    m = get_model(BASE + "CORRECT_TROPOSPHERE Y\n")
+    assert m.has_component("TroposphereDelay")
+    toas = make_fake_toas_uniform(55000, 55010, 40, m, obs="gbt")
+    comp = m.get_component("TroposphereDelay")
+    p = m.base_dd()
+    aux = {}
+    # run astrometry first to publish psr_dir
+    astro = m.get_component("AstrometryEquatorial")
+    astro.delay(p, toas, jnp.zeros(40), aux)
+    d = np.asarray(comp.delay(p, toas, jnp.zeros(40), aux))
+    # zenith delay ~7.7 ns; mapping raises it, never below zenith value
+    assert np.all(d > 5e-9)
+    assert np.all(d < 5e-7)
+    # barycentric TOAs get none
+    t2 = make_fake_toas_uniform(55000, 55010, 4, m, obs="@")
+    aux2 = {}
+    astro.delay(p, t2, jnp.zeros(4), aux2)
+    d2 = np.asarray(comp.delay(p, t2, jnp.zeros(4), aux2))
+    np.testing.assert_allclose(d2, 0.0)
+
+
+def test_tcb_tdb_roundtrip():
+    mjd = 55500.123
+    assert abs(tdb_to_tcb_mjd(tcb_to_tdb_mjd(mjd)) - mjd) < 1e-12
+    tcb_par = BASE.replace("UNITS          TDB", "UNITS          TCB")
+    pf = parse_parfile(tcb_par)
+    out = convert_tcb_tdb(pf)
+    assert out.get_value("UNITS") == "TDB"
+    f0_tdb = float(out.get_value("F0"))
+    np.testing.assert_allclose(f0_tdb, 100.0 / (1.0 - 1.550519768e-8),
+                               rtol=1e-12)
+    # TDB elapses less than TCB, so the TDB-units frequency is higher
+    assert f0_tdb > 100.0
+    back = convert_tcb_tdb(out, backwards=True)
+    np.testing.assert_allclose(float(back.get_value("F0")), 100.0, rtol=1e-14)
+    # converted file now loads
+    from pint_tpu.io.parfile import write_parfile
+    m = get_model(write_parfile(out))
+    assert abs(m["F0"].value_f64 - f0_tdb) < 1e-9
+
+
+def test_builder_no_spurious_warnings(caplog):
+    import logging
+
+    par = BASE + "NE_SW 8.0\nFD1 1e-5\nWAVEEPOCH 55000\nWAVE_OM 0.01\nWAVE1 1e-6 0\n"
+    with caplog.at_level(logging.WARNING, logger="pint_tpu.models.builder"):
+        get_model(par)
+    assert not [r for r in caplog.records if "not recognized" in r.message]
