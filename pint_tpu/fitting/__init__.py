@@ -6,5 +6,7 @@ Reference equivalent: ``pint.fitter`` (src/pint/fitter.py).
 from pint_tpu.fitting.fitter import Fitter, WLSFitter  # noqa: F401
 from pint_tpu.fitting.gls import (  # noqa: F401
     DownhillGLSFitter, DownhillWLSFitter, GLSFitter)
+from pint_tpu.fitting.gls_step import (  # noqa: F401
+    NoiseStatics, build_noise_statics, gls_solve_seg, make_gls_step)
 from pint_tpu.fitting.wideband import (  # noqa: F401
     WidebandDownhillFitter, WidebandTOAFitter, WidebandTOAResiduals)

@@ -53,6 +53,10 @@ def gls_solve(M: Array, T: Array, phi: Array, r: Array, sigma: Array) -> dict:
     A = F / norm
     G = A.T @ (A * w[:, None]) + jnp.diag(phiinv / jnp.square(norm))
     c = A.T @ (r * w)
+    # Tikhonov floor: low red-noise harmonics are near-degenerate with the
+    # spindown columns (condition ~1/eps); keeps Cholesky PD like the
+    # reference's SVD threshold does for its extended-lstsq path
+    G = G + jnp.eye(G.shape[0]) * (jnp.finfo(jnp.float64).eps * jnp.trace(G))
     cf = jax.scipy.linalg.cho_factor(G, lower=True)
     xn = jax.scipy.linalg.cho_solve(cf, c)
     Sigma = jax.scipy.linalg.cho_solve(cf, jnp.eye(G.shape[0]))
@@ -90,12 +94,27 @@ def gls_solve_full_cov(M: Array, T: Array, phi: Array, r: Array,
 
 
 class GLSFitter(Fitter):
-    """GLS fit with correlated noise (reference: GLSFitter.fit_toas)."""
+    """GLS fit with correlated noise (reference: GLSFitter.fit_toas).
 
-    def __init__(self, toas, model, residuals=None, track_mode=None):
+    ``solve_device`` optionally places the collapsed-float64 linear
+    algebra (design matrix, noise basis, solve) on a different device
+    than the DD phase evaluation — the CPU/accelerator split documented
+    in pint_tpu.ops.dd for backends whose float64 emulation fails
+    ``dd.self_check()``.
+    """
+
+    def __init__(self, toas, model, residuals=None, track_mode=None,
+                 solve_device=None):
         super().__init__(toas, model, residuals, track_mode)
         self.resids_noise: np.ndarray | None = None
         self.noise_coeffs: np.ndarray | None = None
+        self.solve_device = solve_device
+
+    def _to_solve_device(self, *arrays):
+        if self.solve_device is None:
+            return arrays
+        return tuple(None if a is None else jax.device_put(a, self.solve_device)
+                     for a in arrays)
 
     def _noise_arrays(self):
         # basis depends only on (model noise params, toas) — both fixed for
@@ -119,6 +138,7 @@ class GLSFitter(Fitter):
             M, names = self.get_designmatrix()
             sigma = self.resids.get_errors_s()
             r = self.resids.time_resids
+            M, r, sigma, T, phi = self._to_solve_device(M, r, sigma, T, phi)
             if T is None:
                 sol = wls_solve(M, r, sigma)
                 sol = {"x": sol["x"], "cov": sol["cov"], "chi2": sol["chi2"],

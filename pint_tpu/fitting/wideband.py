@@ -136,7 +136,18 @@ class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
     """Reference: WidebandDownhillFitter."""
 
     def _fit_chi2(self) -> float:
-        return self.resids.chi2
+        # the accept/halve/converge objective must be the same one _solve
+        # minimizes: with a correlated-noise basis that is the GLS
+        # chi2 r^T C^-1 r (zero-column design matrix), not the white chi2
+        T, phi = self._noise_arrays_stacked()
+        if T is None:
+            return self.resids.chi2
+        r = jnp.concatenate([self.resids.toa.time_resids, self.resids.dm_resids])
+        err = jnp.concatenate([self.resids.toa.get_errors_s(),
+                               self.resids.dm_errors])
+        M0 = jnp.zeros((r.shape[0], 0))
+        sol = gls_solve(M0, T, phi, r, err)
+        return float(np.asarray(sol["chi2"]))
 
     def _step(self, **kw):
         sol, names = self._solve()

@@ -1,0 +1,54 @@
+"""Logging setup: level filtering and duplicate suppression.
+
+Reference equivalent: ``pint.logging`` (src/pint/logging.py), which wraps
+loguru with a ``setup()`` entry point and de-duplication filters so the
+per-TOA warning storms of big datasets don't flood the console. Here the
+same surface is built on stdlib logging (no loguru offline): ``setup()``
+configures the ``pint_tpu`` logger tree, and ``DedupFilter`` collapses
+repeated messages past a threshold.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+LOG_FORMAT = "%(levelname)-7s %(name)s: %(message)s"
+
+
+class DedupFilter(logging.Filter):
+    """Suppress the Nth+ repetition of an identical (level, message) pair."""
+
+    def __init__(self, max_repeats: int = 3):
+        super().__init__()
+        self.max_repeats = max_repeats
+        self._counts: dict[tuple[int, str], int] = {}
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        key = (record.levelno, record.getMessage())
+        count = self._counts.get(key, 0) + 1
+        self._counts[key] = count
+        if count == self.max_repeats:
+            record.msg = f"{record.getMessage()} [repeated messages suppressed]"
+            record.args = ()
+        return count <= self.max_repeats
+
+
+def setup(level: str = "INFO", *, dedup: bool = True,
+          max_repeats: int = 3, stream=None) -> logging.Logger:
+    """Configure the ``pint_tpu`` logger (reference: pint.logging.setup).
+
+    Returns the package root logger. Repeated calls reconfigure (old
+    handlers are removed), so scripts can call it unconditionally.
+    """
+    logger = logging.getLogger("pint_tpu")
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    if dedup:
+        handler.addFilter(DedupFilter(max_repeats))
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger

@@ -233,15 +233,29 @@ class BinaryDDK(BinaryDD):
             raise ValueError("DDK requires KIN")
 
     def _sky_basis(self, p) -> tuple[Array, Array]:
-        """(east, north) unit vectors at the pulsar position."""
-        if "RAJ" in p:
-            alpha, delta = f64(p, "RAJ"), f64(p, "DECJ")
-        else:  # ecliptic astrometry: approximate with ecliptic frame axes
+        """(east, north) unit vectors at the pulsar position, in ICRS.
+
+        These are dotted with ICRS observatory positions (toas.obs_pos_ls)
+        in :meth:`xi_omega`, so ecliptic-frame basis vectors must be
+        rotated by the obliquity into ICRS (as solar_wind._psr_dir does)
+        before projection.
+        """
+        from pint_tpu.constants import OBLIQUITY_RAD
+
+        ecliptic = "RAJ" not in p
+        if ecliptic:
             alpha, delta = f64(p, "ELONG"), f64(p, "ELAT")
+        else:
+            alpha, delta = f64(p, "RAJ"), f64(p, "DECJ")
         sa, ca = jnp.sin(alpha), jnp.cos(alpha)
         sd, cd = jnp.sin(delta), jnp.cos(delta)
         east = jnp.stack([-sa, ca, jnp.zeros_like(ca)])
         north = jnp.stack([-sd * ca, -sd * sa, cd])
+        if ecliptic:
+            ce, se = jnp.cos(OBLIQUITY_RAD), jnp.sin(OBLIQUITY_RAD)
+            rot = lambda v: jnp.stack(
+                [v[0], ce * v[1] - se * v[2], se * v[1] + ce * v[2]])
+            east, north = rot(east), rot(north)
         return east, north
 
     def xi_omega(self, p, toas, tt0, pk, aux):

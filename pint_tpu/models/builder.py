@@ -71,9 +71,28 @@ def register_component(cls: type, priority: int | None = None) -> None:
         COMPONENT_BUILD_ORDER.insert(priority, cls)
 
 
-def get_model(parfile: str | ParFile) -> TimingModel:
-    """Build a TimingModel from a par file path, text block, or ParFile."""
+def get_model(parfile: str | ParFile, *, allow_tcb: bool = False) -> TimingModel:
+    """Build a TimingModel from a par file path, text block, or ParFile.
+
+    ``allow_tcb=True`` auto-converts a ``UNITS TCB`` par file to TDB with
+    the scaling conversion (reference: pint.models.model_builder.get_model's
+    ``allow_tcb`` flag / pint.models.tcb_conversion); the default refuses,
+    matching the reference.
+    """
     pf = parse_parfile(parfile) if isinstance(parfile, str) else parfile
+
+    units_in = (pf.get_value("UNITS") or "TDB").upper()
+    if units_in == "TCB":
+        if not allow_tcb:
+            raise ValueError(
+                "par file UNITS is TCB; pass allow_tcb=True to auto-convert "
+                "to TDB (approximate scaling conversion), or convert the "
+                "file explicitly with tcb2tdb")
+        from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+        pf = convert_tcb_tdb(pf)
+        log.warning("converted TCB par file to TDB (scaling conversion; "
+                    "best to re-fit the converted model)")
 
     taken_categories: set[str] = set()
     components = []
@@ -98,11 +117,7 @@ def get_model(parfile: str | ParFile) -> TimingModel:
 
     units = header.get("UNITS", "TDB").upper()
     if units not in ("TDB", ""):
-        # TCB par files need rescaling (reference: pint.models.tcb_conversion);
-        # not yet implemented — refuse rather than silently misfit.
-        raise NotImplementedError(
-            f"UNITS {units} not supported yet (only TDB); convert with tcb2tdb"
-        )
+        raise NotImplementedError(f"UNITS {units} not supported (only TDB/TCB)")
 
     model = TimingModel(components, name=name, header=header)
     model.validate()

@@ -226,26 +226,47 @@ class EcorrNoise(NoiseComponent):
             p.set_from_par(line.value)
         return self
 
-    def basis_weight(self, toas) -> tuple[np.ndarray, np.ndarray]:
+    def epoch_indices(self, toas) -> tuple[np.ndarray, np.ndarray]:
+        """Per-TOA epoch assignment: (idx (n,) int32, phi (ne,) [s^2]).
+
+        ``idx[i] in [0, ne)`` is TOA i's epoch; ``idx[i] == ne`` means "in
+        no epoch" (the dummy segment). This is the scalable form of the
+        quantization basis — the dense (n, ne) indicator matrix is never
+        materialized; the GLS step consumes the indices with
+        ``jax.ops.segment_sum`` (pint_tpu.fitting.gls_step). Epochs from
+        different ECORR selectors must be disjoint (they partition TOAs by
+        backend in real data); overlap raises.
+        """
         t_s = np.asarray(toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
         n = len(t_s)
-        cols: list[np.ndarray] = []
+        idx = np.full(n, -1, dtype=np.int64)
         weights: list[float] = []
         for name in self.ecorr_names:
             p = self.param(name)
             mask = np.asarray(toa_mask(p.selector, toas), bool)
-            idx = np.nonzero(mask)[0]
-            if idx.size == 0:
+            sel = np.nonzero(mask)[0]
+            if sel.size == 0:
                 continue
             w = (p.value_f64 * 1e-6) ** 2
-            for grp in quantize_epochs(t_s[idx], self.dt_s, self.nmin):
-                col = np.zeros(n)
-                col[idx[grp]] = 1.0
-                cols.append(col)
+            for grp in quantize_epochs(t_s[sel], self.dt_s, self.nmin):
+                rows = sel[grp]
+                if np.any(idx[rows] >= 0):
+                    raise ValueError(
+                        f"ECORR selectors overlap: a TOA matched by {name} "
+                        "already belongs to another ECORR epoch")
+                idx[rows] = len(weights)
                 weights.append(w)
-        if not cols:
-            return np.zeros((n, 0)), np.zeros(0)
-        return np.stack(cols, axis=1), np.asarray(weights)
+        ne = len(weights)
+        idx[idx < 0] = ne
+        return idx.astype(np.int32), np.asarray(weights)
+
+    def basis_weight(self, toas) -> tuple[np.ndarray, np.ndarray]:
+        idx, weights = self.epoch_indices(toas)
+        ne = weights.size
+        U = np.zeros((idx.size, ne))
+        rows = np.nonzero(idx < ne)[0]
+        U[rows, idx[rows]] = 1.0
+        return U, weights
 
 
 def powerlaw_psd_s2(f_hz: np.ndarray, log10_amp: float, gamma: float,
@@ -264,6 +285,14 @@ class _PLNoiseBase(NoiseComponent):
     _gam_name = ""
     _c_name = ""
     default_nharm = 30
+    # how the Fourier basis scales per TOA: "none" (achromatic) or "dm"
+    # (chromatic (1400 MHz / f)^2) — consumed by the device-side GLS step
+    basis_scale = "none"
+
+    def pl_spec(self) -> tuple[str, float, float, int]:
+        """(basis_scale, log10_amp, gamma, nharm) for in-jit basis build."""
+        log10_amp, gamma = self.log10_amp_gamma()
+        return (self.basis_scale, float(log10_amp), float(gamma), self.nharm())
 
     def nharm(self) -> int:
         if self.has_param(self._c_name):
@@ -354,6 +383,7 @@ class PLDMNoise(_PLNoiseBase):
     _amp_name = "TNDMAMP"
     _gam_name = "TNDMGAM"
     _c_name = "TNDMC"
+    basis_scale = "dm"
 
     def __init__(self):
         super().__init__()

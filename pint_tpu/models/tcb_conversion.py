@@ -35,10 +35,10 @@ _DIMENSIONS: dict[str, float] = {
     "A1": 1.0, "XDOT": 0.0, "PBDOT": 0.0, "OMDOT": -1.0, "EDOT": -1.0,
     "GAMMA": 1.0, "M2": 1.0, "MTOT": 1.0,
     "PX": -1.0,  # parallax scales inversely with length
-    # DM: the dispersion delay K*DM/f^2 is a time while f is frame-free,
-    # so DM carries d=+1... but the tempo2 convention folds the DM
-    # constant's time units differently: DMs scale with K^-1 * K^2 = K.
-    "DM": 1.0, "DM1": 0.0, "NE_SW": 1.0,
+    # DM: the tempo2/reference convention treats DMconst as carrying the
+    # time units, so DMs scale *up* with K = 1/(1-L_B) on TCB->TDB:
+    # d = -1 (each d/dt derivative adds another -1).
+    "DM": -1.0, "DM1": -2.0, "NE_SW": -1.0,
     "EPS1DOT": -1.0, "EPS2DOT": -1.0,
     "PMRA": -1.0, "PMDEC": -1.0, "PMELONG": -1.0, "PMELAT": -1.0,
 }
@@ -84,9 +84,9 @@ def convert_tcb_tdb(pf: ParFile, backwards: bool = False) -> ParFile:
             if line.uncertainty:
                 nl.uncertainty = _scale_str(line.uncertainty, scale)
         elif base.startswith("DMX_"):
-            nl.value = _scale_str(line.value, kfac ** 1.0)
+            nl.value = _scale_str(line.value, kfac ** -1.0)
             if line.uncertainty:
-                nl.uncertainty = _scale_str(line.uncertainty, kfac ** 1.0)
+                nl.uncertainty = _scale_str(line.uncertainty, kfac ** -1.0)
         out.lines.append(nl)
     return out
 

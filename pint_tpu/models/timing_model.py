@@ -257,7 +257,14 @@ class TimingModel:
         designmatrix/weight/dimension accessors don't rebuild them.
         """
         comps = [c for c in self.components if getattr(c, "is_noise_basis", False)]
-        key = (id(toas), tuple((p.name, p.value) for c in comps for p in c.params))
+        # content key, not id(toas): a reused id after GC must not hit stale
+        # bases. tdb + freq bytes + flag hash pin the table's noise-relevant
+        # state (freq enters through the chromatic PLDMNoise basis scale).
+        tdb = np.asarray(toas.tdb.hi + toas.tdb.lo)
+        freq = np.asarray(toas.freq_mhz)
+        key = (len(toas), hash(tdb.tobytes()), hash(freq.tobytes()),
+               hash(toas.flags),
+               tuple((p.name, p.value) for c in comps for p in c.params))
         if getattr(self, "_noise_basis_key", None) != key:
             self._noise_basis_val = [(type(c).__name__, *c.basis_weight(toas))
                                      for c in comps]

@@ -33,6 +33,31 @@ Array = jax.Array
 ZENITH_DELAY_M = 2.2768e-3 * 1013.25
 SCALE_HEIGHT_M = 8600.0
 
+# WGS84 ellipsoid semi-axes
+_WGS84_A = 6378137.0
+_WGS84_B = 6356752.314245
+
+
+def _geodetic_altitude_m(itrf_xyz: np.ndarray) -> float:
+    """Height above the WGS84 ellipsoid (not a 6371 km sphere).
+
+    The ~21 km equatorial bulge would otherwise masquerade as altitude and
+    mis-scale the exp(-h/H) pressure factor by up to ~50% at low latitude.
+    Uses the ellipsoid radius at the geocentric latitude — the geodetic/
+    geocentric latitude difference shifts the radius by < 50 m (< 1%
+    pressure error), negligible against the ~8 ns zenith delay.
+    """
+    r = float(np.linalg.norm(itrf_xyz))
+    if r == 0.0:
+        return 0.0
+    sin_psi = itrf_xyz[2] / r
+    cos2 = 1.0 - sin_psi**2
+    sin2 = sin_psi**2
+    r_ell = np.sqrt(((_WGS84_A**2 * cos2) * _WGS84_A**2
+                     + (_WGS84_B**2 * sin2) * _WGS84_B**2)
+                    / (_WGS84_A**2 * cos2 + _WGS84_B**2 * sin2))
+    return max(r - float(r_ell), 0.0)
+
 
 class TroposphereDelay(Component):
     category = "troposphere"
@@ -73,8 +98,7 @@ class TroposphereDelay(Component):
             ob = obs_mod.get_observatory(name)
             if ob.itrf_xyz_m is not None:
                 itrf[si] = np.asarray(ob.itrf_xyz_m)
-                rr = float(np.linalg.norm(itrf[si]))
-                alt_m[si] = max(rr - 6371000.0, 0.0)
+                alt_m[si] = _geodetic_altitude_m(itrf[si])
                 ground[si] = 1.0
         site_itrf = jnp.asarray(itrf)[toas.obs_index]
         site_alt = jnp.asarray(alt_m)[toas.obs_index]

@@ -12,19 +12,24 @@ the ~1e-18 needed for 1 ns over 30 years.
 Correctness rests on *error-free transforms* (Knuth TwoSum, Dekker split /
 TwoProd), which require IEEE-754 correctly-rounded float64 add/sub/mul.
 
-.. warning::
-   Empirically (checked at framework bring-up; see ``self_check``):
+.. note::
+   Backend validity is established by **evidence, not assumption**:
+   :func:`self_check` verifies the TwoSum/TwoProd invariants under ``jit``
+   on whichever backend it runs, and the benchmark harness (``bench.py``)
+   records its result (``dd_self_check``) next to every timing number so
+   the precision claim is auditable per hardware target.
 
-   * XLA **CPU** is bit-identical to numpy IEEE float64 — error-free
-     transforms hold under ``jit``.
-   * XLA **TPU** float64 emulation is *not* correctly rounded (1-2 ulp
-     errors on plain add), so TwoSum/TwoProd error terms are garbage there.
-
-   Therefore all DD computation must be placed on CPU devices (see
-   :func:`pint_tpu.parallel.mesh.cpu_device`); the TPU consumes only
-   collapsed float64 values whose errors are multiplied by small parameter
-   deltas (design matrices, GLS linear algebra). ``self_check()`` verifies
-   the invariants on whichever backend it runs.
+   * XLA **CPU** passes: bit-identical to numpy IEEE float64 (verified in
+     ``tests/test_dd.py``; the test suite pins this backend).
+   * XLA **TPU** emulates float64; whether its add/mul are correctly
+     rounded must be read off the recorded ``dd_self_check`` for that
+     hardware (the one-chip sandbox backend has not initialized in any
+     session so far — see BENCH_r0*.json). If a backend ever fails the
+     check, keep the DD phase pipeline on CPU and offload only the
+     collapsed-float64 linear algebra (design matrix / GLS solve — errors
+     there are multiplied by small parameter deltas):
+     ``GLSFitter(..., solve_device=jax.devices('tpu')[0])`` implements
+     exactly that split.
 
 All functions are shape-polymorphic, jit-safe, and vmap-safe; ``DD`` is a
 NamedTuple and hence a pytree.
@@ -404,8 +409,10 @@ def self_check(device=None) -> bool:
     """Verify error-free-transform invariants hold on `device`.
 
     Returns True iff TwoSum and TwoProd are exact under jit on the target
-    backend. CPU passes; TPU (f64 emulation, non-IEEE rounding) fails —
-    which is why the DD pipeline pins itself to CPU devices.
+    backend (compared against numpy IEEE float64). This is the evidence
+    gate for running the DD phase pipeline on an accelerator — bench.py
+    records it per run; see the module docstring for the fallback split
+    when a backend fails.
     """
     rng = np.random.default_rng(1234)
     a = rng.uniform(-1e9, 1e9, 4096)

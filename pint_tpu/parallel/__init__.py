@@ -18,5 +18,6 @@ module is the TPU-native scale story the north star demands:
 
 from pint_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, shard_toas, replicate)
-from pint_tpu.parallel.sharded_fit import ShardedWLSFitter, sharded_fit  # noqa: F401
+from pint_tpu.parallel.sharded_fit import (  # noqa: F401
+    ShardedGLSFitter, ShardedWLSFitter, sharded_fit, sharded_gls_fit)
 from pint_tpu.parallel.batch import BatchedPulsarFitter, pad_toas  # noqa: F401

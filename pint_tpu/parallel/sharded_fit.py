@@ -1,11 +1,12 @@
-"""TOA-axis-sharded WLS fitting: one XLA program over a device mesh.
+"""TOA-axis-sharded WLS/GLS fitting: one XLA program over a device mesh.
 
 The "long-context" path (SURVEY.md §5): the TOA table is the sequence.
 Every (n,)-shaped leaf is sharded over the mesh's "toa" axis; the fit
 step (residuals -> jacfwd design matrix -> Gram solve,
-pint_tpu.fitting.step) then partitions automatically — per-device
-design-matrix blocks, a psum for the (p, p) Gram matrix over ICI, and a
-replicated Cholesky. No hand-written collectives.
+pint_tpu.fitting.step / gls_step) then partitions automatically —
+per-device design-matrix and Fourier-basis blocks, psums for the small
+Gram matrices over ICI, segment-sum scatter-adds for the ECORR epoch
+blocks, and a replicated Cholesky. No hand-written collectives.
 """
 
 from __future__ import annotations
@@ -15,7 +16,11 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pint_tpu.fitting.fitter import Fitter
+from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
+                                       make_gls_step, pad_noise_statics)
 from pint_tpu.fitting.step import make_wls_step
 from pint_tpu.parallel.mesh import (make_mesh, pad_to_multiple, replicate,
                                     shard_toas)
@@ -73,18 +78,16 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2):
     return deltas, info
 
 
-class ShardedWLSFitter:
+class ShardedWLSFitter(Fitter):
     """Fitter-API wrapper around :func:`sharded_fit`.
 
-    Mirrors ``WLSFitter`` results (updated params, uncertainties, chi2)
-    while the compute runs TOA-sharded over the mesh.
+    Mirrors ``WLSFitter`` results (updated params, uncertainties, chi2,
+    summary) while the compute runs TOA-sharded over the mesh.
     """
 
     def __init__(self, toas, model, mesh=None):
-        self.toas = toas
-        self.model = model
+        super().__init__(toas, model)
         self.mesh = mesh or make_mesh()
-        self.converged = False
 
     def fit_toas(self, maxiter: int = 2) -> float:
         deltas, info = sharded_fit(self.toas, self.model, mesh=self.mesh,
@@ -94,5 +97,73 @@ class ShardedWLSFitter:
             p = self.model[name]
             p.add_delta(float(np.asarray(d)))
             p.uncertainty = float(np.asarray(errors[name]))
+        self.fit_params = list(deltas)
+        self.resids = self._new_resids()
+        self.converged = True
+        return float(np.asarray(info["chi2"]))
+
+
+def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
+    """Run `maxiter` TOA-sharded GLS iterations; returns (deltas, info).
+
+    The north-star configuration (SURVEY.md §5): correlated noise
+    (ECORR + power-law Fourier) with every O(n) array — TOA table,
+    design-matrix rows, Fourier blocks, epoch indices — sharded over the
+    mesh's "toa" axis. Noise bases are built inside the jitted step
+    (pint_tpu.fitting.gls_step); the host only precomputes the O(n)
+    epoch-index vector.
+    """
+    mesh = mesh or make_mesh()
+    n_shards = mesh.shape["toa"]
+    n_target = pad_to_multiple(len(toas), n_shards)
+
+    noise, pl_specs = build_noise_statics(model, toas)
+    noise = pad_noise_statics(noise, n_target)
+    padded = pad_toas(toas, n_target)
+
+    toas_sh = shard_toas(padded, mesh)
+    noise_sh = NoiseStatics(
+        epoch_idx=jax.device_put(noise.epoch_idx,
+                                 NamedSharding(mesh, P("toa"))),
+        ecorr_phi=jax.device_put(noise.ecorr_phi, NamedSharding(mesh, P())),
+    )
+    step = jax.jit(make_gls_step(model, pl_specs=pl_specs))
+    base = replicate(model.base_dd(), mesh)
+    deltas = replicate(model.zero_deltas(), mesh)
+    info = None
+    with mesh:
+        for _ in range(max(1, maxiter)):
+            deltas, info = step(base, deltas, toas_sh, noise_sh)
+    return deltas, info
+
+
+class ShardedGLSFitter(Fitter):
+    """TOA-sharded GLS fitter (north star; matches ``GLSFitter`` results).
+
+    Mirrors ``pint_tpu.fitting.gls.GLSFitter`` — correlated-noise GLS
+    with ECORR + power-law components — but runs as one sharded XLA
+    program per iteration with device-side noise bases, so it scales to
+    the 6e5-TOA regime where the dense host basis would need ~20 GB.
+    """
+
+    def __init__(self, toas, model, mesh=None):
+        super().__init__(toas, model)
+        self.mesh = mesh or make_mesh()
+        self.noise_coeffs: np.ndarray | None = None
+
+    def fit_toas(self, maxiter: int = 2) -> float:
+        deltas, info = sharded_gls_fit(self.toas, self.model, mesh=self.mesh,
+                                       maxiter=maxiter)
+        errors = info["errors"]
+        for name, d in deltas.items():
+            p = self.model[name]
+            p.add_delta(float(np.asarray(d)))
+            p.uncertainty = float(np.asarray(errors[name]))
+        self.fit_params = list(deltas)
+        self.noise_coeffs = np.concatenate([
+            np.asarray(info["fourier_coeffs"]),
+            np.asarray(info["ecorr_coeffs"]),
+        ])
+        self.resids = self._new_resids()
         self.converged = True
         return float(np.asarray(info["chi2"]))

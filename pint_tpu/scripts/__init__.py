@@ -1,0 +1,11 @@
+"""Console entry points (reference: src/pint/scripts/).
+
+Each module exposes ``main(argv=None)`` and is wired as a console script
+in ``pyproject.toml``:
+
+* ``pintempo``  — load par+tim, fit, print summary, write post-fit par
+* ``zima``      — simulate fake TOAs from a model and write a tim file
+* ``tcb2tdb``   — convert a TCB par file to TDB
+* ``compare_parfiles`` — parameter-by-parameter model comparison
+* ``pintbary``  — barycenter arrival times with a (minimal) model
+"""

@@ -1,0 +1,60 @@
+"""``compare_parfiles``: parameter-level model diff
+(reference: pint.scripts.compare_parfiles / TimingModel.compare)."""
+
+from __future__ import annotations
+
+import argparse
+
+from pint_tpu import logging as pint_logging
+
+
+def compare_models(m1, m2) -> str:
+    """Tabulate parameter differences between two models.
+
+    For parameters with uncertainties the difference is also expressed in
+    units of the first model's sigma (the reference's compare() column).
+    """
+    lines = [f"{'PAR':<12}{'model1':>24}{'model2':>24}{'diff':>14}{'diff/sig1':>11}"]
+    names = list(dict.fromkeys(list(m1.params) + list(m2.params)))
+    for name in names:
+        p1 = m1.params.get(name)
+        p2 = m2.params.get(name)
+        if p1 is None or p2 is None:
+            only = "model1" if p2 is None else "model2"
+            p = p1 or p2
+            if p.is_numeric or p.kind == "str":
+                lines.append(f"{name:<12}{'(only in ' + only + ')':>24}")
+            continue
+        if not p1.is_numeric or not p2.is_numeric:
+            continue
+        v1, v2 = p1.value_f64, p2.value_f64
+        d = v2 - v1
+        sig = ""
+        if p1.uncertainty:
+            sig = f"{d / p1.uncertainty:10.2f}"
+        if d == 0.0 and not p1.uncertainty:
+            continue
+        lines.append(f"{name:<12}{p1.format_value():>24}{p2.format_value():>24}"
+                     f"{d:>14.4e}{sig:>11}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="compare_parfiles",
+        description="Compare two par files parameter by parameter")
+    parser.add_argument("parfile1")
+    parser.add_argument("parfile2")
+    args = parser.parse_args(argv)
+    pint_logging.setup()
+
+    from pint_tpu.models import get_model
+
+    m1 = get_model(args.parfile1)
+    m2 = get_model(args.parfile2)
+    print(compare_models(m1, m2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

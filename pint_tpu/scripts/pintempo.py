@@ -1,0 +1,100 @@
+"""``pintempo``: command-line fitting (reference: pint.scripts.pintempo).
+
+Usage: pintempo [options] PARFILE TIMFILE
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pint_tpu import logging as pint_logging
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pintempo",
+        description="Fit a pulsar timing model to TOAs (PINT pintempo equivalent)")
+    parser.add_argument("parfile")
+    parser.add_argument("timfile")
+    parser.add_argument("--outfile", default=None,
+                        help="write the post-fit par file here")
+    parser.add_argument("--fitter", default="auto",
+                        choices=["auto", "wls", "gls", "downhill", "sharded"],
+                        help="fitter selection (auto follows the model's noise)")
+    parser.add_argument("--maxiter", type=int, default=10)
+    parser.add_argument("--allow-tcb", action="store_true",
+                        help="auto-convert a TCB par file to TDB")
+    parser.add_argument("--log-level", default="INFO")
+    parser.add_argument("--plotfile", default=None,
+                        help="write a pre/post-fit residual plot (requires "
+                             "matplotlib)")
+    args = parser.parse_args(argv)
+    pint_logging.setup(args.log_level)
+
+    from pint_tpu.fitting import Fitter, GLSFitter, WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toas import get_TOAs
+
+    model = get_model(args.parfile, allow_tcb=args.allow_tcb)
+    toas = get_TOAs(args.timfile, ephem=model.ephem)
+    print(f"Read {len(toas)} TOAs; model {model.name or args.parfile} with "
+          f"{len(model.free_params)} free parameters")
+
+    prefit = Residuals(toas, model)
+    print(f"Prefit residuals: wrms = {prefit.rms_weighted_s() * 1e6:.4f} us, "
+          f"chi2 = {prefit.chi2:.2f}")
+
+    if args.fitter == "auto":
+        fitter = Fitter.auto(toas, model)
+    elif args.fitter == "wls":
+        fitter = WLSFitter(toas, model)
+    elif args.fitter == "gls":
+        fitter = GLSFitter(toas, model)
+    elif args.fitter == "sharded":
+        from pint_tpu.parallel import ShardedGLSFitter, ShardedWLSFitter
+
+        cls = (ShardedGLSFitter if model.has_correlated_errors
+               else ShardedWLSFitter)
+        fitter = cls(toas, model)
+    else:
+        fitter = Fitter.auto(toas, model, downhill=True)
+    fitter.fit_toas(maxiter=args.maxiter)
+    print(fitter.get_summary())
+
+    if args.plotfile:
+        _plot(prefit, fitter, args.plotfile)
+    if args.outfile:
+        with open(args.outfile, "w") as f:
+            f.write(model.as_parfile())
+        print(f"Wrote post-fit model to {args.outfile}")
+    return 0
+
+
+def _plot(prefit, fitter, path: str) -> None:
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:  # pragma: no cover - matplotlib is optional
+        print("matplotlib not available; skipping plot")
+        return
+    import numpy as np
+
+    post = fitter.resids
+    mjds = np.asarray(prefit.toas.get_mjds())
+    fig, axes = plt.subplots(2, 1, sharex=True, figsize=(8, 6))
+    for ax, r, title in ((axes[0], prefit, "Pre-fit"), (axes[1], post, "Post-fit")):
+        ax.errorbar(mjds, np.asarray(r.time_resids) * 1e6,
+                    yerr=np.asarray(r.get_errors_s()) * 1e6, fmt=".", ms=3)
+        ax.set_ylabel("residual [us]")
+        ax.set_title(title)
+    axes[1].set_xlabel("MJD")
+    fig.tight_layout()
+    fig.savefig(path)
+    print(f"Wrote residual plot to {path}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

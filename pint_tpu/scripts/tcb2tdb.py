@@ -1,0 +1,26 @@
+"""``tcb2tdb``: convert a TCB par file to TDB (reference: pint.scripts.tcb2tdb)."""
+
+from __future__ import annotations
+
+import argparse
+
+from pint_tpu import logging as pint_logging
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tcb2tdb", description="Convert a TCB-units par file to TDB")
+    parser.add_argument("input_par")
+    parser.add_argument("output_par")
+    args = parser.parse_args(argv)
+    pint_logging.setup()
+
+    from pint_tpu.models.tcb_conversion import tcb2tdb_file
+
+    tcb2tdb_file(args.input_par, args.output_par)
+    print(f"Wrote TDB par file to {args.output_par}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

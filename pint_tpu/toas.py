@@ -365,6 +365,51 @@ def build_TOAs_from_arrays(
     )
 
 
+def write_TOA_file(toas: TOAs, path: str | None = None) -> str:
+    """Serialize a TOAs table as a tempo2-format ``.tim`` file.
+
+    Reference: ``pint.toa.TOAs.write_TOA_file`` (src/pint/toa.py). The
+    site-local MJD is reconstructed by undoing the clock chain (evaluated
+    at the corrected time — the clock rate is ~us/day, so the inversion
+    error is femtoseconds); sites with no registered clock files round-trip
+    exactly. Returns the text; writes it to `path` when given.
+    """
+    n = len(toas)
+    utc_f64 = np.asarray(toas.utc.hi + toas.utc.lo)
+    clock_s = np.zeros(n)
+    if toas.clock_applied:
+        obs_idx = np.asarray(toas.obs_index)
+        for si, sname in enumerate(toas.obs_names):
+            sel = obs_idx == si
+            if not np.any(sel):
+                continue
+            ob = obs_mod.get_observatory(sname)
+            if ob.is_special:
+                continue
+            clock_s[sel] = obs_mod.clock_corrections_s(sname, utc_f64[sel],
+                                                       limits="warn")
+    local = dd.sub(toas.utc, jnp.asarray(clock_s) / ts.SECS_PER_DAY)
+    local = DD(np.asarray(local.hi), np.asarray(local.lo))  # host once, not per TOA
+
+    freqs = np.asarray(toas.freq_mhz)
+    errs = np.asarray(toas.error_us)
+    obs_idx = np.asarray(toas.obs_index)
+    lines = ["FORMAT 1"]
+    for i in range(n):
+        flags = dict(toas.flags[i])
+        name = flags.pop("name", f"toa_{i}")
+        mjd_str = dd.to_string(local[i], ndigits=20)
+        entry = f"{name} {freqs[i]:.6f} {mjd_str} {errs[i]:.3f} {toas.obs_names[int(obs_idx[i])]}"
+        for k, v in sorted(flags.items()):
+            entry += f" -{k} {v}"
+        lines.append(entry)
+    text = "\n".join(lines) + "\n"
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
 def save_pickle(toas: TOAs, path: str) -> None:
     """Cache a TOAs table (reference: get_TOAs(..., usepickle=True))."""
     np.savez_compressed(

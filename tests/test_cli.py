@@ -1,0 +1,162 @@
+"""Console entry points (reference layer 6: src/pint/scripts/).
+
+pintempo must fit and write a post-fit par; zima must write a tim file
+that reloads with (near-)zero residuals; tcb2tdb converts on disk;
+compare_parfiles reports parameter shifts; write_TOA_file round-trips.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.scripts import compare_parfiles, pintbary, pintempo, tcb2tdb, zima
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas import get_TOAs, write_TOA_file
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+
+@pytest.fixture(scope="module")
+def par_tim(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    par = d / "fake.par"
+    par.write_text(PAR)
+    model = get_model(PAR)
+    toas = make_fake_toas_uniform(53000, 54000, 80, model, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=5)
+    tim = d / "fake.tim"
+    write_TOA_file(toas, str(tim))
+    return str(par), str(tim), d
+
+
+def test_write_toa_file_roundtrip(par_tim):
+    par, tim, _ = par_tim
+    model = get_model(par)
+    toas = get_TOAs(tim, ephem=model.ephem)
+    assert len(toas) == 80
+    r = Residuals(toas, model)
+    # noise is 1 us; round-trip must not add more than ns-level error
+    assert r.rms_weighted_s() < 10e-6
+
+
+def test_pintempo_fits_and_writes(par_tim, tmp_path, capsys):
+    par, tim, _ = par_tim
+    # perturb the model so pintempo has something to recover
+    pert = tmp_path / "pert.par"
+    pert.write_text(PAR.replace("61.485476554", "61.485476556"))
+    out = tmp_path / "post.par"
+    rc = pintempo.main([str(pert), tim, "--outfile", str(out),
+                        "--fitter", "downhill"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Prefit residuals" in text and "chi2" in text
+    post = get_model(str(out))
+    truth = get_model(par)
+    assert (abs(post["F0"].value_f64 - truth["F0"].value_f64)
+            < 5 * post["F0"].uncertainty)
+
+
+def test_pintempo_sharded_fitter(par_tim, tmp_path, capsys):
+    par, tim, _ = par_tim
+    pert = tmp_path / "pert.par"
+    pert.write_text(PAR.replace("61.485476554", "61.485476555"))
+    rc = pintempo.main([str(pert), tim, "--fitter", "sharded", "--maxiter", "2"])
+    assert rc == 0
+    assert "chi2" in capsys.readouterr().out
+
+
+def test_zima_roundtrip(par_tim, tmp_path, capsys):
+    par, _, _ = par_tim
+    out = tmp_path / "sim.tim"
+    rc = zima.main([par, str(out), "--ntoa", "25", "--startMJD", "53100",
+                    "--duration", "300"])
+    assert rc == 0
+    model = get_model(par)
+    toas = get_TOAs(str(out), ephem=model.ephem)
+    r = Residuals(toas, model, subtract_mean=False)
+    assert float(np.max(np.abs(np.asarray(r.time_resids)))) < 1e-9
+
+
+def test_tcb2tdb_script(tmp_path):
+    tcb = tmp_path / "in.par"
+    tcb.write_text(PAR.replace("UNITS          TDB", "UNITS          TCB"))
+    out = tmp_path / "out.par"
+    rc = tcb2tdb.main([str(tcb), str(out)])
+    assert rc == 0
+    m = get_model(str(out))
+    # DM scales up by K on TCB->TDB (ADVICE round-1 fix)
+    assert m["DM"].value_f64 > 223.9
+
+
+def test_get_model_allow_tcb(tmp_path):
+    tcb_par = PAR.replace("UNITS          TDB", "UNITS          TCB")
+    with pytest.raises(ValueError, match="allow_tcb"):
+        get_model(tcb_par)
+    m = get_model(tcb_par, allow_tcb=True)
+    assert m.header["UNITS"] == "TDB"
+    np.testing.assert_allclose(m["F0"].value_f64,
+                               61.485476554 / (1.0 - 1.550519768e-8),
+                               rtol=1e-12)
+
+
+def test_compare_parfiles(par_tim, tmp_path, capsys):
+    par, _, _ = par_tim
+    p2 = tmp_path / "shift.par"
+    p2.write_text(PAR.replace("223.9", "224.1"))
+    rc = compare_parfiles.main([par, str(p2)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DM" in out and "2.0000e-01" in out
+
+
+def test_pintbary(capsys):
+    rc = pintbary.main(["56000.0", "--ra", "17:48:52.75",
+                        "--dec=-20:21:29.0", "--obs", "gbt"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip()
+    # barycentric time within +-500 s (Roemer amplitude) of the input
+    assert abs(float(out.split()[0][:12]) - 56000.0) < 0.01
+
+
+def test_console_scripts_registered():
+    import tomllib
+
+    with open("pyproject.toml", "rb") as f:
+        proj = tomllib.load(f)
+    scripts = proj["project"]["scripts"]
+    for name in ("pintempo", "zima", "tcb2tdb", "compare_parfiles", "pintbary"):
+        assert name in scripts
+
+
+def test_logging_setup_and_dedup(capsys):
+    import logging as stdlog
+
+    from pint_tpu.logging import setup
+
+    log = setup("INFO", max_repeats=2, stream=sys.stderr)
+    child = stdlog.getLogger("pint_tpu.test_child")
+    for _ in range(5):
+        child.warning("repeated message")
+    err = capsys.readouterr().err
+    assert len([l for l in err.splitlines() if "repeated message" in l]) == 2
+    assert "suppressed" in err
