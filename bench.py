@@ -116,9 +116,68 @@ def build_problem(n: int):
     return model, toas
 
 
+def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
+    """BASELINE config 5: joint HD-correlated GLS over a pulsar array.
+
+    Run with PINT_TPU_BENCH_MODE=pta; wall-clock of one full joint
+    iteration (per-pulsar reduced Grams + global GW-coupled solve).
+    """
+    metric = f"pta_gls_iter_{n_psr}psr_{n_psr * toas_per_psr}toas_wall"
+    try:
+        from pint_tpu.models import get_model
+        from pint_tpu.ops.dd import DD
+        from pint_tpu.parallel.pta import PTAGLSFitter
+        from pint_tpu.toas import build_TOAs_from_arrays
+
+        rng = np.random.default_rng(1)
+        problems = []
+        for i in range(n_psr):
+            par = PAR.replace("17:48:52.75", f"{(i * 7) % 24:02d}:48:52.75")
+            par = par.replace("61.485476554", f"{61.485476554 + 0.7 * i:.9f}")
+            model = get_model(par)
+            n = toas_per_psr
+            n_ep = max(1, (n + 3) // 4)
+            centers = np.sort(rng.uniform(50000.0, 58000.0, size=n_ep))
+            mjds = (centers[:, None]
+                    + rng.uniform(0, 0.5 / 86400.0, (n_ep, 4))).ravel()[:n]
+            toas = build_TOAs_from_arrays(
+                DD(jnp.asarray(mjds), jnp.zeros(n)),
+                freq_mhz=np.where(rng.random(n) < 0.5, 1400.0, 430.0),
+                error_us=np.full(n, 1.0), obs_names=("gbt",), eph=model.ephem)
+            problems.append((toas, model))
+
+        fitter = PTAGLSFitter(problems, gw_log10_amp=-14.0, gw_gamma=4.33,
+                              gw_nharm=20)
+        fitter.fit_toas()  # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fitter.fit_toas()
+            times.append(time.perf_counter() - t0)
+        value = float(np.median(times))
+        budget_s = 30.0 * (n_psr * toas_per_psr / 6e5)
+        _emit({"metric": metric, "value": round(value, 6), "unit": "s",
+               "vs_baseline": round(budget_s / value, 3),
+               "backend": jax.default_backend(),
+               "chi2": round(float(fitter.chi2), 3)})
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
 def main() -> None:
     n = int(os.environ.get("PINT_TPU_BENCH_N", str(N_DEFAULT)))
     reps = int(os.environ.get("PINT_TPU_BENCH_REPS", "5"))
+    if os.environ.get("PINT_TPU_BENCH_MODE", "gls") == "pta":
+        try:
+            _init_backend()
+        except Exception as e:  # noqa: BLE001
+            _emit({"metric": "pta_gls_iter_wall", "value": -1.0, "unit": "s",
+                   "vs_baseline": 0.0, "error": f"backend init failed: {e}"})
+            return
+        bench_pta(int(os.environ.get("PINT_TPU_BENCH_PSRS", "16")),
+                  max(1, n // 16), reps)
+        return
     budget_s = 30.0 * (n / 6e5)
     metric = f"gls_fit_iter_{n}toas_wall"
 

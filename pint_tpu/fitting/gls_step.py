@@ -48,11 +48,14 @@ Array = jax.Array
 
 
 class PLSpec(NamedTuple):
-    """Static description of one power-law Fourier noise component."""
+    """Static (shape-determining) description of one power-law component.
+
+    The amplitude/index live in ``NoiseStatics.pl_params`` as *traced*
+    values, so one compiled step serves every pulsar sharing a model
+    structure (the PTA path batches dozens of pulsars through it).
+    """
 
     scale: str        # "none" (achromatic red) | "dm" (chromatic)
-    log10_amp: float
-    gamma: float
     nharm: int
 
 
@@ -60,35 +63,40 @@ class NoiseStatics(NamedTuple):
     """Per-dataset noise data passed through jit alongside the TOA table.
 
     ``epoch_idx`` rides the TOA axis (shard it with the table);
-    ``ecorr_phi`` is tiny and replicated. A pulsar-batched (B, n) /
-    (B, ne) version works under ``vmap`` unchanged.
+    ``ecorr_phi``/``pl_params`` are tiny and replicated. A pulsar-batched
+    (B, n) / (B, ne) version works under ``vmap`` unchanged.
     """
 
     epoch_idx: Array  # (n,) int32 in [0, ne]; ne = "no epoch" dummy
     ecorr_phi: Array  # (ne,) prior variances [s^2]
+    pl_params: Array  # (n_pl, 2) [log10_amp, gamma] per PLSpec entry
 
 
 def build_noise_statics(model, toas) -> tuple[NoiseStatics, tuple[PLSpec, ...]]:
     """Host-side scan of the model's noise components.
 
-    Returns the (device-array) ECORR epoch assignment plus the static
-    power-law specs the jitted step closes over. O(n) host work — no
-    (n, k) basis is formed.
+    Returns the (device-array) ECORR epoch assignment + power-law
+    hyperparameters, plus the static specs the jitted step closes over.
+    O(n) host work — no (n, k) basis is formed.
     """
     n = len(toas)
     epoch_idx = None
     phi_e = np.zeros(0)
     specs: list[PLSpec] = []
+    pl_params: list[tuple[float, float]] = []
     for c in model.components:
         if hasattr(c, "epoch_indices"):
             if epoch_idx is not None:
                 raise ValueError("multiple ECORR components in one model")
             epoch_idx, phi_e = c.epoch_indices(toas)
         elif hasattr(c, "pl_spec"):
-            specs.append(PLSpec(*c.pl_spec()))
+            scale, log10_amp, gamma, nharm = c.pl_spec()
+            specs.append(PLSpec(scale, nharm))
+            pl_params.append((log10_amp, gamma))
     if epoch_idx is None:
         epoch_idx = np.zeros(n, dtype=np.int32)  # ne=0: everything is dummy
-    return (NoiseStatics(jnp.asarray(epoch_idx), jnp.asarray(phi_e)),
+    return (NoiseStatics(jnp.asarray(epoch_idx), jnp.asarray(phi_e),
+                         jnp.asarray(pl_params).reshape(len(specs), 2)),
             tuple(specs))
 
 
@@ -99,46 +107,58 @@ def pad_noise_statics(noise: NoiseStatics, n_target: int) -> NoiseStatics:
         return noise
     ne = int(np.shape(noise.ecorr_phi)[0])
     pad = jnp.full(n_target - n, ne, dtype=jnp.int32)
-    return NoiseStatics(jnp.concatenate([noise.epoch_idx, pad]), noise.ecorr_phi)
+    return NoiseStatics(jnp.concatenate([noise.epoch_idx, pad]),
+                        noise.ecorr_phi, noise.pl_params)
 
 
-def fourier_design(t_s: Array, nharm: int) -> tuple[Array, Array, Array]:
+def fourier_design(t_s: Array, nharm: int, t_ref=None, tspan=None
+                   ) -> tuple[Array, Array, Array]:
     """In-jit Fourier basis: (F (n, 2*nharm), f (nharm,) Hz, df Hz).
 
     Columns interleave sin/cos per harmonic (matching
     pint_tpu.models.noise._PLNoiseBase._fourier). f_j = j / T_span with
     T_span from the traced times — under TOA-axis sharding the min/max
     are XLA collectives; zero-weight padding rows replicate real TOAs so
-    they cannot perturb the span.
+    they cannot perturb the span. Pass explicit ``t_ref``/``tspan``
+    [s] for a basis coherent *across* datasets (the PTA GW basis must
+    share one reference epoch and frequency grid for every pulsar).
     """
-    tmin = jnp.min(t_s)
-    tspan = jnp.maximum(jnp.max(t_s) - tmin, SECS_PER_DAY)
+    if t_ref is None:
+        t_ref = jnp.min(t_s)
+    if tspan is None:
+        tspan = jnp.maximum(jnp.max(t_s) - t_ref, SECS_PER_DAY)
     f = jnp.arange(1, nharm + 1, dtype=jnp.float64) / tspan
-    arg = 2.0 * jnp.pi * (t_s - tmin)[:, None] * f[None, :]
+    arg = 2.0 * jnp.pi * (t_s - t_ref)[:, None] * f[None, :]
     F = jnp.stack([jnp.sin(arg), jnp.cos(arg)], axis=-1)
     return F.reshape(t_s.shape[0], 2 * nharm), f, 1.0 / tspan
 
 
-def _powerlaw_phi(f: Array, log10_amp: float, gamma: float, df: Array) -> Array:
+def powerlaw_phi(f: Array, log10_amp, gamma, df) -> Array:
+    """Per-bin variances [s^2] of a power-law PSD (GWB convention)."""
     amp = 10.0 ** log10_amp
     return (amp * amp / (12.0 * jnp.pi ** 2) * FYR_HZ ** (-3.0)
             * (f / FYR_HZ) ** (-gamma) * df)
 
 
-def pl_bases(toas, specs: tuple[PLSpec, ...]) -> tuple[Array | None, Array | None]:
-    """Stacked Fourier blocks (n, k_F) and prior variances (k_F,), in-jit."""
+def pl_bases(toas, specs: tuple[PLSpec, ...], pl_params: Array
+             ) -> tuple[Array | None, Array | None]:
+    """Stacked Fourier blocks (n, k_F) and prior variances (k_F,), in-jit.
+
+    ``pl_params[i] = [log10_amp, gamma]`` (traced) pairs with specs[i].
+    """
     if not specs:
         return None, None
     t_s = (toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
     blocks, phis = [], []
-    for spec in specs:
+    for i, spec in enumerate(specs):
         F, f, df = fourier_design(t_s, spec.nharm)
         if spec.scale == "dm":
             from pint_tpu.models.noise import DM_FREF_MHZ
 
             F = F * jnp.square(DM_FREF_MHZ / toas.freq_mhz)[:, None]
         blocks.append(F)
-        phis.append(jnp.repeat(_powerlaw_phi(f, spec.log10_amp, spec.gamma, df), 2))
+        phis.append(jnp.repeat(
+            powerlaw_phi(f, pl_params[i, 0], pl_params[i, 1], df), 2))
     return jnp.concatenate(blocks, axis=1), jnp.concatenate(phis)
 
 
@@ -235,7 +255,7 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
         cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
         M = jnp.stack(cols, axis=1)
 
-        F, phi_F = pl_bases(toas, pl_specs)
+        F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
         sol = gls_solve_seg(M, r, err, F, phi_F,
                             noise.epoch_idx, noise.ecorr_phi)
         new_deltas = {k: deltas[k] + sol["x"][i + 1] for i, k in enumerate(names)}

@@ -21,3 +21,4 @@ from pint_tpu.parallel.mesh import (  # noqa: F401
 from pint_tpu.parallel.sharded_fit import (  # noqa: F401
     ShardedGLSFitter, ShardedWLSFitter, sharded_fit, sharded_gls_fit)
 from pint_tpu.parallel.batch import BatchedPulsarFitter, pad_toas  # noqa: F401
+from pint_tpu.parallel.pta import PTAGLSFitter, hellings_downs  # noqa: F401

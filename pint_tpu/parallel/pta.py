@@ -1,0 +1,329 @@
+"""Full-PTA correlated GLS: Hellings-Downs cross-covariance over pulsars.
+
+The flagship "many pulsars x correlated noise" problem (SURVEY.md §5
+long-context row; BASELINE.md config 5). The joint covariance over the
+stacked TOAs of P pulsars is rank-structured,
+
+    C = blkdiag_p( N_p + T_p phi_p T_p^T )  +  GW term
+    GW term[a, b] = Gamma(theta_ab) * F_a diag(phi_gw) F_b^T
+
+with F_p a Fourier basis on a **common** frequency grid / reference
+epoch and Gamma the Hellings-Downs overlap-reduction curve. Writing the
+GW block as columns of the extended design with a *non-diagonal* prior
+``Phi_gw = Gamma (x) diag(phi_gw)`` (Kronecker), the whole fit is still
+one extended-normal-equation solve:
+
+* per pulsar (TOA-shardable, one XLA program reused across pulsars of
+  the same model structure): the reduced Gram block S_p, rhs_p, and a
+  chi2 base, with ECORR epochs eliminated by the diagonal-Schur trick of
+  pint_tpu.fitting.gls_step — nothing O(n^2) is ever formed;
+* globally (replicated, small): assemble blkdiag(S_p), add the GW
+  coupling ``Gamma^-1[a,b] * diag(1/phi_gw)`` between the GW columns of
+  every pulsar pair, Cholesky-solve the (sum_p q_p)^2 core.
+
+This is exactly SURVEY.md §5's "Woodbury solve with per-device blocks +
+small replicated core". Reference: enterprise-style PTA likelihoods; the
+reference package itself has no PTA GLS (single-pulsar fits only), so
+this is capability the TPU design adds on top of parity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import SECS_PER_DAY
+from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
+                                       fourier_design, pl_bases,
+                                       powerlaw_phi)
+
+Array = jax.Array
+
+
+def hellings_downs(cos_theta) -> Array:
+    """HD overlap-reduction coefficient for angular separation theta.
+
+    Off-diagonal convention Gamma(theta) = 3/2 x ln x - x/4 + 1/2 with
+    x = (1 - cos theta)/2; the autocorrelation (theta=0, same pulsar)
+    is 1 (the extra 1/2 pulsar term). The theta->0 limit for *distinct*
+    pulsars is 1/2.
+    """
+    x = jnp.clip((1.0 - cos_theta) / 2.0, 0.0, 1.0)
+    xlnx = jnp.where(x > 0.0, x * jnp.log(jnp.where(x > 0.0, x, 1.0)), 0.0)
+    return 1.5 * xlnx - 0.25 * x + 0.5
+
+
+def hd_matrix(psr_pos: np.ndarray) -> np.ndarray:
+    """(P, P) HD correlation matrix from ICRS unit vectors."""
+    cos = np.clip(psr_pos @ psr_pos.T, -1.0, 1.0)
+    G = np.array(hellings_downs(cos))  # writable copy (jax output is read-only)
+    np.fill_diagonal(G, 1.0)
+    return G
+
+
+def _psr_pos_icrs(model) -> np.ndarray:
+    """Pulsar ICRS unit vector from the model's astrometry parameters."""
+    from pint_tpu.constants import OBLIQUITY_RAD
+
+    p = {name: par for name, par in model.params.items()}
+    if "RAJ" in p:
+        lon, lat = p["RAJ"].value_f64, p["DECJ"].value_f64
+        ecliptic = False
+    elif "ELONG" in p:
+        lon, lat = p["ELONG"].value_f64, p["ELAT"].value_f64
+        ecliptic = True
+    else:
+        raise ValueError(f"model {model.name} has no astrometry parameters")
+    cl = np.cos(lat)
+    v = np.array([cl * np.cos(lon), cl * np.sin(lon), np.sin(lat)])
+    if ecliptic:
+        ce, se = np.cos(OBLIQUITY_RAD), np.sin(OBLIQUITY_RAD)
+        v = np.array([v[0], ce * v[1] - se * v[2], se * v[1] + ce * v[2]])
+    return v
+
+
+class GWSpec(NamedTuple):
+    """Common GW-background basis: one grid/epoch shared by every pulsar."""
+
+    log10_amp: float
+    gamma: float
+    nharm: int
+    t_ref_s: float   # common reference epoch [s]
+    tspan_s: float   # common span [s] -> f_j = j / tspan
+
+
+def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
+    """Build ``gram(base, deltas, toas, noise) -> dict`` for one pulsar.
+
+    One jitted call produces everything the global PTA solve needs from
+    this pulsar: the reduced extended Gram S (q, q) with ECORR epochs
+    Schur-eliminated, the reduced rhs, column norms, and the chi2 base
+    ``r^T N^-1 r - c_e^T D^-1 c_e``. Columns: [Offset + free params |
+    per-pulsar PL noise | GW]. The per-pulsar prior (1/phi) is already
+    inside S; the GW prior is NOT (it couples pulsars — added globally).
+
+    All (n,)-leaves of `toas`/`noise` may carry a TOA-axis sharding; the
+    outputs are small and replicated.
+    """
+    if tzr is None:
+        tzr = model.get_tzr_toas()
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=tzr is not None)
+    names = model.free_params
+
+    def gram(base, deltas, toas, noise: NoiseStatics):
+        f0 = base["F0"].hi + base["F0"].lo
+
+        def total_phase(d):
+            ph = phase_fn(base, d, toas)
+            return ph.int_part + (ph.frac.hi + ph.frac.lo)
+
+        err = model.scaled_toa_uncertainty(toas)
+        w = 1.0 / jnp.square(err)
+
+        ph = phase_fn(base, deltas, toas)
+        resid_turns = ph.frac.hi + ph.frac.lo
+        resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
+        r = resid_turns / f0
+
+        J = jax.jacfwd(total_phase)(deltas)
+        cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
+        M = jnp.stack(cols, axis=1)
+        p = M.shape[1]
+
+        F_pl, phi_pl = pl_bases(toas, pl_specs, noise.pl_params)
+        t_s = (toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+        F_gw, f_gw, _ = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
+                                       tspan=gw.tspan_s)
+        blocks = [M] + ([F_pl] if F_pl is not None else []) + [F_gw]
+        B = jnp.concatenate(blocks, axis=1)
+        q = B.shape[1]
+        k_pl = 0 if F_pl is None else F_pl.shape[1]
+        phiinv = jnp.concatenate([
+            jnp.zeros(p),
+            1.0 / phi_pl if F_pl is not None else jnp.zeros(0),
+            jnp.zeros(2 * gw.nharm),    # GW prior is global, added later
+        ])
+
+        norm = jnp.sqrt(jnp.sum(jnp.square(B) * w[:, None], axis=0))
+        norm = jnp.where(norm == 0.0, 1.0, norm)
+        A = B / norm
+        G = A.T @ (A * w[:, None]) + jnp.diag(phiinv / jnp.square(norm))
+        c = A.T @ (r * w)
+        chi2_base = jnp.sum(jnp.square(r) * w)
+
+        ne = noise.ecorr_phi.shape[0]
+        if ne > 0:
+            def seg(x):
+                return jax.ops.segment_sum(x, noise.epoch_idx,
+                                           num_segments=ne + 1)[:ne]
+
+            d = seg(w) + 1.0 / noise.ecorr_phi
+            Ce = seg(A * w[:, None])
+            c_e = seg(r * w)
+            G = G - Ce.T @ (Ce / d[:, None])
+            c = c - Ce.T @ (c_e / d)
+            chi2_base = chi2_base - jnp.sum(jnp.square(c_e) / d)
+
+        return {"S": G, "rhs": c, "norm": norm, "chi2_base": chi2_base,
+                "p": p, "k_pl": k_pl}
+
+    return gram
+
+
+class PTAGLSFitter:
+    """Joint GLS over a pulsar array with an HD-correlated GW background.
+
+    ``problems`` is a list of (toas, model); ``gw_log10_amp``/``gw_gamma``
+    set the GW prior spectrum on ``gw_nharm`` harmonics of the common
+    span. ``fit_toas()`` updates every model's free parameters and
+    returns the joint GLS chi2. Per-pulsar Gram programs are compiled
+    once per model *structure* (identical structures share one
+    executable); pass ``mesh`` to shard each pulsar's TOA axis.
+    """
+
+    def __init__(self, problems, *, gw_log10_amp: float, gw_gamma: float,
+                 gw_nharm: int = 20, mesh=None):
+        if not problems:
+            raise ValueError("no problems given")
+        self.toas_list = [t for t, _ in problems]
+        self.models = [m for _, m in problems]
+        self.mesh = mesh
+
+        t_all = [np.asarray(t.tdb.hi + t.tdb.lo) * SECS_PER_DAY
+                 for t in self.toas_list]
+        t_ref = min(float(t.min()) for t in t_all)
+        t_max = max(float(t.max()) for t in t_all)
+        self.gw = GWSpec(gw_log10_amp, gw_gamma, int(gw_nharm),
+                         t_ref, max(t_max - t_ref, SECS_PER_DAY))
+
+        pos = np.stack([_psr_pos_icrs(m) for m in self.models])
+        self.hd = hd_matrix(pos)
+        # Gamma^-1 for the Kronecker GW prior; HD matrices of real arrays
+        # are invertible but can be poorly conditioned for tight pairs —
+        # fall back to pinv with a warning rather than blowing up
+        try:
+            self.hd_inv = np.linalg.inv(self.hd)
+        except np.linalg.LinAlgError:  # pragma: no cover
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "HD matrix singular; using pseudo-inverse")
+            self.hd_inv = np.linalg.pinv(self.hd)
+
+        self.chi2: float | None = None
+        self.gw_coeffs: np.ndarray | None = None
+        self._gram_cache: dict = {}  # model structure -> jitted gram program
+
+    def _grams(self):
+        """Run the per-pulsar Gram program for every pulsar."""
+        out = []
+        cache = self._gram_cache
+        for toas, model in zip(self.toas_list, self.models):
+            noise, pl_specs = build_noise_statics(model, toas)
+            base = model.base_dd()
+            deltas = model.zero_deltas()
+            if self.mesh is not None:
+                from pint_tpu.fitting.gls_step import pad_noise_statics
+                from pint_tpu.parallel.mesh import (pad_to_multiple,
+                                                    replicate, shard_toas)
+                from pint_tpu.parallel.sharded_fit import pad_toas
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                n_target = pad_to_multiple(len(toas), self.mesh.shape["toa"])
+                noise = pad_noise_statics(noise, n_target)
+                toas = shard_toas(pad_toas(toas, n_target), self.mesh)
+                rep = NamedSharding(self.mesh, P())
+                noise = NoiseStatics(
+                    jax.device_put(noise.epoch_idx,
+                                   NamedSharding(self.mesh, P("toa"))),
+                    jax.device_put(noise.ecorr_phi, rep),
+                    jax.device_put(noise.pl_params, rep),
+                )
+                base = replicate(base, self.mesh)
+                deltas = replicate(deltas, self.mesh)
+            # one executable per model *structure*: free values flow through
+            # the traced `base`, but frozen values, selectors, and the TZR
+            # anchor are closed over host-side, so they pin the cache key
+            key = (tuple(model.free_params), pl_specs,
+                   tuple(type(c).__name__ for c in model.components),
+                   tuple((p.name, p.value if p.frozen else None, p.selector)
+                         for p in model.params.values()),
+                   len(toas))
+            if key not in cache:
+                cache[key] = jax.jit(make_pta_gram(model, self.gw, pl_specs))
+            gram = cache[key]
+            if self.mesh is not None:
+                with self.mesh:
+                    out.append(gram(base, deltas, toas, noise))
+            else:
+                out.append(gram(base, deltas, toas, noise))
+        return out
+
+    def fit_toas(self, maxiter: int = 1) -> float:
+        for _ in range(max(1, maxiter)):
+            chi2 = self._fit_once()
+        return chi2
+
+    def _fit_once(self) -> float:
+        grams = self._grams()
+        q_list = [int(g["S"].shape[0]) for g in grams]
+        offsets = np.concatenate([[0], np.cumsum(q_list)])
+        Q = int(offsets[-1])
+        k_gw = 2 * self.gw.nharm
+
+        # common GW per-frequency prior phi_gw (f on the shared grid)
+        f = np.arange(1, self.gw.nharm + 1) / self.gw.tspan_s
+        phi_gw = np.repeat(np.asarray(powerlaw_phi(
+            jnp.asarray(f), self.gw.log10_amp, self.gw.gamma,
+            1.0 / self.gw.tspan_s)), 2)
+
+        G = np.zeros((Q, Q))
+        c = np.zeros(Q)
+        chi2_base = 0.0
+        gw_slices = []
+        norms = []
+        for i, g in enumerate(grams):
+            s = slice(offsets[i], offsets[i + 1])
+            G[s, s] = np.asarray(g["S"])
+            c[offsets[i]:offsets[i + 1]] = np.asarray(g["rhs"])
+            chi2_base += float(np.asarray(g["chi2_base"]))
+            norm = np.asarray(g["norm"])
+            norms.append(norm)
+            gw_start = offsets[i + 1] - k_gw
+            gw_slices.append((slice(gw_start, offsets[i + 1]),
+                              norm[-k_gw:]))
+        # GW coupling: Gamma^-1[a,b] * diag(1/phi_gw), rescaled into each
+        # pulsar pair's normalized column coordinates (v = u / norm)
+        for a in range(len(grams)):
+            sa, na = gw_slices[a]
+            for b in range(len(grams)):
+                sb, nb = gw_slices[b]
+                G[sa, sb] += np.diag(self.hd_inv[a, b] / (phi_gw * na * nb))
+
+        # replicated small-core solve (device)
+        Gj = jnp.asarray(G)
+        Gj = Gj + jnp.eye(Q) * (jnp.finfo(jnp.float64).eps * jnp.trace(Gj))
+        cf = jax.scipy.linalg.cho_factor(Gj, lower=True)
+        x = np.asarray(jax.scipy.linalg.cho_solve(cf, jnp.asarray(c)))
+        Sigma = np.asarray(jax.scipy.linalg.cho_solve(cf, jnp.eye(Q)))
+
+        chi2 = chi2_base - float(c @ x)
+        self.chi2 = chi2
+        self.gw_coeffs = np.stack([
+            x[s] / n for (s, n) in gw_slices
+        ])
+        # update the models
+        for i, (g, model) in enumerate(zip(grams, self.models)):
+            s0 = offsets[i]
+            p = int(g["p"])
+            norm = norms[i][:p]
+            xs = x[s0:s0 + p] / norm
+            sig = np.sqrt(np.diag(Sigma[s0:s0 + p, s0:s0 + p])) / norm
+            for j, name in enumerate(model.free_params):
+                par = model[name]
+                par.add_delta(float(xs[j + 1]))
+                par.uncertainty = float(sig[j + 1])
+        return chi2

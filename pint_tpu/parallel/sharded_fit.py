@@ -122,10 +122,12 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
     padded = pad_toas(toas, n_target)
 
     toas_sh = shard_toas(padded, mesh)
+    rep = NamedSharding(mesh, P())
     noise_sh = NoiseStatics(
         epoch_idx=jax.device_put(noise.epoch_idx,
                                  NamedSharding(mesh, P("toa"))),
-        ecorr_phi=jax.device_put(noise.ecorr_phi, NamedSharding(mesh, P())),
+        ecorr_phi=jax.device_put(noise.ecorr_phi, rep),
+        pl_params=jax.device_put(noise.pl_params, rep),
     )
     step = jax.jit(make_gls_step(model, pl_specs=pl_specs))
     base = replicate(model.base_dd(), mesh)

@@ -129,7 +129,7 @@ def test_in_jit_bases_match_host(noise_problem):
     T = model.noise_model_designmatrix(toas)
     phi = model.noise_model_basis_weight(toas)
 
-    F, phi_F = pl_bases(toas, specs)
+    F, phi_F = pl_bases(toas, specs, noise.pl_params)
     s, k = dims["PLRedNoise"]
     np.testing.assert_allclose(np.asarray(F), T[:, s:s + k], atol=1e-12)
     np.testing.assert_allclose(np.asarray(phi_F), phi[s:s + k], rtol=1e-12)
