@@ -20,8 +20,9 @@ from pint_tpu.fitting.fitter import wls_solve_gram
 Array = jax.Array
 
 
-def make_wls_step(model, tzr=None, *, abs_phase: bool = True):
-    """Build ``step(base, deltas, toas) -> (new_deltas, info)``.
+def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
+                  masked: bool = False, params: list[str] | None = None):
+    """Build ``step(base, deltas, toas[, mask]) -> (new_deltas, info)``.
 
     `base` is the DD linearization point (model.base_dd()); `deltas` the
     current float64 corrections per free parameter. One call performs a
@@ -33,13 +34,18 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True):
     ``vmap``-ed batch of pulsars with different spin frequencies.
     ``abs_phase=False`` skips the TZR anchor (the batched path, where the
     weighted-mean subtraction absorbs the absolute phase anyway).
+
+    ``masked=True`` adds a 4th argument ``mask: {name: 0/1 scalar}``
+    that zeroes design-matrix columns — the parameter-superset mechanism
+    letting one compiled step serve heterogeneous pulsars (a masked
+    column solves to a zero delta; the batched fitter skips its update).
     """
     if tzr is None and abs_phase:
         tzr = model.get_tzr_toas()
     phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
-    names = model.free_params
+    names = params if params is not None else model.free_params
 
-    def step(base, deltas, toas):
+    def step(base, deltas, toas, mask=None):
         f0 = base["F0"].hi + base["F0"].lo
 
         def total_phase(d):
@@ -60,7 +66,12 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True):
         r = resid_turns / f0
 
         J = jax.jacfwd(total_phase)(deltas)
-        cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
+        cols = [jnp.ones_like(r) / f0]
+        for k in names:
+            col = -J[k] / f0
+            if mask is not None:
+                col = col * mask[k]
+            cols.append(col)
         M = jnp.stack(cols, axis=1)
 
         sol = wls_solve_gram(M, r, err)
@@ -73,4 +84,9 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True):
         chi2 = jnp.sum(jnp.square(post / f0) * w)
         return new_deltas, {"chi2": chi2, "errors": errors}
 
+    if not masked:
+        def step_unmasked(base, deltas, toas):
+            return step(base, deltas, toas)
+
+        return step_unmasked
     return step

@@ -230,6 +230,12 @@ def toa_mask(selector: tuple[str, ...], toas):
     n = len(toas)
     if not selector:
         return np.ones(n, dtype=bool)
+    # materialized masks (data leaves) win: the batched/stacked paths strip
+    # the static flags, so flag selectors must already be arrays there
+    mk = " ".join(selector)
+    am = getattr(toas, "aux_masks", None)
+    if am and mk in am:
+        return am[mk] != 0.0
     key = selector[0].lstrip("-").lower()
     if key == "tim_jump":
         return jnp.asarray(toas.jump_group) == int(selector[1])
@@ -256,3 +262,28 @@ def toa_mask(selector: tuple[str, ...], toas):
         vals = np.asarray([fl.get(key, "") for fl in toas.flags])
         cache[selector] = vals == selector[1]
     return cache[selector]
+
+
+def materialize_selector_masks(models, toas):
+    """Precompute every maskParameter selector of `models` as data arrays.
+
+    Returns a new TOAs with ``aux_masks[" ".join(selector)]`` set to an
+    (n,) float mask for each selector found. After this, the table's
+    static flags can be stripped (batched/vmapped paths) without losing
+    EFAC/EQUAD/JUMP selection — toa_mask() consults aux_masks first.
+    """
+    import dataclasses
+
+    if not isinstance(models, (list, tuple)):
+        models = [models]
+    masks = dict(toas.aux_masks)
+    for model in models:
+        for p in model.params.values():
+            if not p.selector:
+                continue
+            key = " ".join(p.selector)
+            if key in masks:
+                continue
+            masks[key] = jnp.asarray(
+                np.asarray(toa_mask(p.selector, toas)), jnp.float64)
+    return dataclasses.replace(toas, aux_masks=masks)

@@ -81,6 +81,11 @@ class TOAs:
     flags: Flags = field(metadata=dict(static=True))  # per-TOA flag dicts
     ephem_name: str = field(default="builtin_analytic", metadata=dict(static=True))
     clock_applied: bool = field(default=True, metadata=dict(static=True))
+    # selector masks materialized as data (traced): key "-flag value" ->
+    # (n,) float mask. Lets flag-based maskParameters (EFAC/JUMP/...) ride
+    # vmap/stacking where the static flags must be stripped
+    # (pint_tpu.models.parameter.materialize_selector_masks).
+    aux_masks: dict = field(default_factory=dict)
 
     def __len__(self) -> int:
         return int(np.shape(self.tdb.hi)[0])
@@ -138,6 +143,7 @@ class TOAs:
             flags=Flags(self.flags[i] for i in idx),
             ephem_name=self.ephem_name,
             clock_applied=self.clock_applied,
+            aux_masks={k: take(v) for k, v in self.aux_masks.items()},
         )
 
     def first_mjd(self) -> float:
@@ -179,6 +185,8 @@ def merge_TOAs(toas_list: list[TOAs]) -> TOAs:
         flags=Flags(f for t in toas_list for f in t.flags),
         ephem_name=toas_list[0].ephem_name,
         clock_applied=all(t.clock_applied for t in toas_list),
+        aux_masks={k: jnp.concatenate([t.aux_masks[k] for t in toas_list])
+                   for k in toas_list[0].aux_masks},
     )
 
 
