@@ -1,0 +1,300 @@
+"""Bayesian timing: priors, log-posterior builder, ensemble MCMC fitter.
+
+Reference equivalents: ``pint.bayesian.BayesianTiming`` (prior plumbing +
+lnlikelihood/lnposterior over free parameters, src/pint/bayesian.py) and
+``pint.mcmc_fitter.MCMCFitter`` (emcee-driven fitting,
+src/pint/mcmc_fitter.py). TPU-first differences:
+
+* the log-posterior is one pure jitted function of a flat parameter
+  vector — the same composed phase function the fitters use, with the
+  DD linearization point closed over (samples are float64 *offsets*
+  resolved against the double-double base, so nothing loses precision);
+* the sampler is the in-package pure-JAX stretch move
+  (``pint_tpu.sampler.run_ensemble``): walkers are vmapped, steps are a
+  ``lax.scan`` — the whole chain is a single XLA program, no emcee;
+* white-noise parameters (EFAC/EQUAD) may be sampled: their scaling is
+  rebuilt inside the traced likelihood from materialized selector
+  masks, not read from host parameter objects;
+* correlated noise (ECORR / red noise) with fixed hyperparameters is
+  marginalized analytically via the Woodbury quadratic form + log-det.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import toa_mask
+from pint_tpu.sampler import initialize_walkers, run_ensemble
+
+Array = jax.Array
+LOG2PI = float(np.log(2.0 * np.pi))
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformPrior:
+    lo: float
+    hi: float
+
+    def log_pdf(self, x: Array) -> Array:
+        inside = (x >= self.lo) & (x <= self.hi)
+        return jnp.where(inside, -jnp.log(self.hi - self.lo), -jnp.inf)
+
+    def width(self) -> float:
+        return (self.hi - self.lo) / np.sqrt(12.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class NormalPrior:
+    mu: float
+    sigma: float
+
+    def log_pdf(self, x: Array) -> Array:
+        z = (x - self.mu) / self.sigma
+        return -0.5 * (z * z + LOG2PI) - jnp.log(self.sigma)
+
+    def width(self) -> float:
+        return self.sigma
+
+
+def default_priors(model, *, sigma_factor: float = 10.0) -> dict:
+    """Uniform priors ±sigma_factor x uncertainty around each free value.
+
+    Reference: pint.bayesian's default uniform priors from par-file
+    uncertainties. Parameters without an uncertainty get a broad uniform
+    from a per-kind heuristic scale (documented weakness shared with the
+    reference: you should set real priors).
+    """
+    priors = {}
+    for name in model.free_params:
+        p = model.params[name]
+        v = p.value_f64
+        unc = p.uncertainty or 0.0
+        if unc <= 0.0:
+            unc = max(abs(v) * 1e-6, 1e-12)
+        w = sigma_factor * unc
+        priors[name] = UniformPrior(v - w, v + w)
+    return priors
+
+
+class BayesianTiming:
+    """Log-prior / log-likelihood / log-posterior over free parameters.
+
+    ``param_vector()`` orders the free parameters; every log-density
+    takes a flat (ndim,) float64 vector of *parameter values* in par
+    units. Internally values become offsets from the DD base with the
+    exact two-step subtraction (x - hi) - lo, so F0-scale magnitudes
+    lose nothing.
+
+    Reference: pint.bayesian.BayesianTiming (lnprior/lnlikelihood/
+    lnposterior); correlated noise is marginalized instead of sampled.
+    """
+
+    def __init__(self, toas, model, priors: dict | None = None):
+        self.toas = toas
+        self.model = model
+        self.fit_params = list(model.free_params)
+        # a prior on a frozen EFAC/EQUAD/TNEQ opts that white-noise
+        # parameter into sampling (the reference's pint.bayesian
+        # use_pulse_numbers/white-noise choice); anything else frozen is
+        # an error — freeze/unfreeze is the user's sampling switch.
+        if priors:
+            for k in priors:
+                if k in self.fit_params:
+                    continue
+                p = model.params.get(k)
+                kind = k.rstrip("0123456789")
+                if p is not None and kind in ("EFAC", "EQUAD", "TNEQ"):
+                    self.fit_params.append(k)
+                else:
+                    raise ValueError(
+                        f"prior for non-free parameter {k!r} (only frozen "
+                        "EFAC/EQUAD/TNEQ may be opted into sampling)")
+        self.nparams = len(self.fit_params)
+        self.priors = dict(default_priors(model))
+        if priors:
+            self.priors.update(priors)
+
+        # white-noise scaling terms, in scale_sigma's application order
+        # (EQUAD/TNEQ variances first, then EFAC replace-where): sampled
+        # terms read the traced vector, fixed ones are constants.
+        sampled_noise = {k for k in self.fit_params
+                         if k.rstrip("0123456789") in ("EFAC", "EQUAD", "TNEQ")}
+        self._noise_terms: list[tuple[str, str, Array, float | None]] = []
+        for p in model.params.values():
+            kind = p.name.rstrip("0123456789")
+            if kind not in ("EFAC", "EQUAD", "TNEQ"):
+                continue
+            mask = jnp.asarray(np.asarray(toa_mask(p.selector, toas)),
+                               jnp.float64)
+            fixed = None if p.name in sampled_noise else p.value_f64
+            self._noise_terms.append((p.name, kind, mask, fixed))
+        self._has_sampled_noise = bool(sampled_noise)
+        self._timing_params = [k for k in self.fit_params
+                               if k not in sampled_noise]
+
+        base = model.base_dd()
+        self._base_hi = {k: float(base[k].hi) for k in self.fit_params}
+        self._base_lo = {k: float(base[k].lo) for k in self.fit_params}
+        self._phase_fn = model.phase_fn(toas)
+        self._base = base
+        self._f0 = model.f0_f64
+        self._sigma0 = jnp.asarray(toas.get_errors_s()) \
+            if self._has_sampled_noise \
+            else jnp.asarray(model.scaled_toa_uncertainty(toas))
+
+        # fixed-hyperparameter correlated noise: marginalize analytically
+        pairs = model._noise_basis_pairs(toas) if model.has_correlated_errors \
+            else []
+        if pairs:
+            U = np.concatenate([u for _, u, _ in pairs], axis=1)
+            phi = np.concatenate([w for _, _, w in pairs])
+            self._U = jnp.asarray(U)
+            self._log_phi = jnp.asarray(np.log(phi))
+            self._inv_phi = jnp.asarray(1.0 / phi)
+        else:
+            self._U = None
+
+        self._lnpost = jax.jit(self._build_lnpost())
+
+    # ------------------------------------------------------------------
+    def param_vector(self) -> np.ndarray:
+        return np.asarray([self.model.params[k].value_f64
+                           for k in self.fit_params])
+
+    def param_uncertainties(self) -> np.ndarray:
+        out = []
+        for k in self.fit_params:
+            unc = self.model.params[k].uncertainty or 0.0
+            out.append(unc if unc > 0 else self.priors[k].width())
+        return np.asarray(out)
+
+    def _deltas(self, x: Array) -> dict[str, Array]:
+        """Offsets from the DD base; exact for x near the base value."""
+        out = {}
+        for j, k in enumerate(self.fit_params):
+            out[k] = (x[j] - self._base_hi[k]) - self._base_lo[k]
+        return out
+
+    def _build_lnpost(self) -> Callable[[Array], Array]:
+        prior_fns = [(j, self.priors[k])
+                     for j, k in enumerate(self.fit_params)]
+        timing = self._timing_params
+        noise_terms = self._noise_terms
+        has_sampled = self._has_sampled_noise
+        name_to_idx = {k: j for j, k in enumerate(self.fit_params)}
+
+        def lnprior(x: Array) -> Array:
+            lp = jnp.zeros(())
+            for j, pr in prior_fns:
+                lp = lp + pr.log_pdf(x[j])
+            return lp
+
+        def sigma_of(x: Array) -> Array:
+            sigma = self._sigma0
+            if not has_sampled:
+                return sigma  # already host-scaled
+            var = jnp.square(sigma)
+            for name, kind, mask, fixed in noise_terms:
+                v = fixed if fixed is not None else x[name_to_idx[name]]
+                if kind == "EQUAD":
+                    var = var + mask * jnp.square(v * 1e-6)
+                elif kind == "TNEQ":
+                    var = var + mask * 10.0 ** (2.0 * v)
+            scale = jnp.ones_like(sigma)
+            for name, kind, mask, fixed in noise_terms:
+                if kind == "EFAC":  # replace-where, matching scale_sigma
+                    v = fixed if fixed is not None else x[name_to_idx[name]]
+                    scale = jnp.where(mask > 0, v, scale)
+            return scale * jnp.sqrt(var)
+
+        def lnlike(x: Array) -> Array:
+            deltas = self._deltas(x)
+            d_timing = {k: deltas[k] for k in timing}
+            ph = self._phase_fn(self._base, d_timing)
+            frac = ph.frac.hi + ph.frac.lo
+            sigma = sigma_of(x)
+            w = 1.0 / jnp.square(sigma)
+            mean = jnp.sum(frac * w) / jnp.sum(w)
+            r = (frac - mean) / self._f0
+            rw = r / sigma
+            lnl = -0.5 * jnp.sum(jnp.square(rw)) \
+                - jnp.sum(jnp.log(sigma)) - 0.5 * r.size * LOG2PI
+            if self._U is not None:
+                A = self._U / sigma[:, None]
+                S = jnp.diag(self._inv_phi) + A.T @ A
+                L, low = jax.scipy.linalg.cho_factor(S, lower=True)
+                b = A.T @ rw
+                lnl = lnl + 0.5 * b @ jax.scipy.linalg.cho_solve((L, low), b) \
+                    - jnp.sum(jnp.log(jnp.diag(L))) \
+                    - 0.5 * jnp.sum(self._log_phi)
+            return lnl
+
+        def lnpost(x: Array) -> Array:
+            lp = lnprior(x)
+            ll = jnp.where(jnp.isfinite(lp), lnlike(x), 0.0)
+            return jnp.where(jnp.isfinite(lp), lp + ll, -jnp.inf)
+
+        return lnpost
+
+    # public names mirroring the reference API
+    def lnposterior(self, x) -> float:
+        return float(np.asarray(self._lnpost(jnp.asarray(x, jnp.float64))))
+
+    def lnprior(self, x) -> float:
+        x = jnp.asarray(x, jnp.float64)
+        lp = jnp.zeros(())
+        for j, k in enumerate(self.fit_params):
+            lp = lp + self.priors[k].log_pdf(x[j])
+        return float(np.asarray(lp))
+
+    def lnlikelihood(self, x) -> float:
+        return self.lnposterior(x) - self.lnprior(x)
+
+
+class MCMCFitter:
+    """Posterior sampling fitter (reference: pint.mcmc_fitter.MCMCFitter).
+
+    ``fit_toas`` runs the stretch-move ensemble on the jitted
+    log-posterior and writes the posterior mean / standard deviation
+    into the model's free parameters. The chain (post burn-in) is kept
+    on ``self.chain`` for corner plots / diagnostics.
+    """
+
+    def __init__(self, toas, model, priors: dict | None = None, *,
+                 nwalkers: int | None = None, nsteps: int = 500,
+                 burn_frac: float = 0.25, seed: int = 0):
+        self.bt = BayesianTiming(toas, model, priors)
+        self.toas = toas
+        self.model = model
+        self.nwalkers = nwalkers or max(2 * self.bt.nparams + 2, 16)
+        if self.nwalkers % 2:
+            self.nwalkers += 1
+        self.nsteps = nsteps
+        self.burn_frac = burn_frac
+        self.seed = seed
+        self.chain: np.ndarray | None = None
+        self.acceptance: np.ndarray | None = None
+
+    def fit_toas(self, maxiter: int | None = None) -> float:
+        """Sample; returns the best log-posterior found. maxiter = nsteps."""
+        nsteps = maxiter or self.nsteps
+        center = self.bt.param_vector()
+        scale = self.bt.param_uncertainties()
+        p0 = initialize_walkers(center, scale, self.nwalkers, seed=self.seed)
+        out = run_ensemble(self.bt._lnpost, p0, nsteps, seed=self.seed)
+        burn = int(nsteps * self.burn_frac)
+        chain = out["chain"][burn:]
+        self.chain = chain.reshape(-1, self.bt.nparams)
+        self.acceptance = out["acceptance"]
+        mean = self.chain.mean(axis=0)
+        std = self.chain.std(axis=0)
+        for j, k in enumerate(self.bt.fit_params):
+            p = self.model.params[k]
+            p.add_delta(float(mean[j]) - p.value_f64)
+            p.uncertainty = float(std[j])
+        return float(out["log_prob"][burn:].max())

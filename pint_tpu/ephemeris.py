@@ -306,11 +306,27 @@ def get_ephemeris(name: str = "builtin_analytic", **kwargs) -> Ephemeris:
         return AnalyticEphemeris(**kwargs)
     if name.lower().startswith("de"):
         import logging
+        import os
 
+        # real kernel if available: $PINT_TPU_EPHEM_DIR/<name>.bsp or ./<name>.bsp
+        for d in (os.environ.get("PINT_TPU_EPHEM_DIR"), "."):
+            if not d:
+                continue
+            path = os.path.join(d, f"{name.lower()}.bsp")
+            if os.path.isfile(path):
+                from pint_tpu.io.bsp import SPKEphemeris
+
+                return SPKEphemeris(path, name=name.upper())
+        if os.environ.get("PINT_TPU_STRICT_EPHEM", ""):
+            raise FileNotFoundError(
+                f"JPL ephemeris {name} requested but no {name.lower()}.bsp "
+                "found (PINT_TPU_EPHEM_DIR) and PINT_TPU_STRICT_EPHEM is set; "
+                "refusing the arcsecond-level analytic fallback")
         logging.getLogger(__name__).warning(
             "JPL ephemeris %s not available offline; using builtin analytic "
-            "ephemeris (see pint_tpu.ephemeris docstring for accuracy bounds)",
-            name,
+            "ephemeris (set PINT_TPU_EPHEM_DIR to provide %s.bsp, or "
+            "PINT_TPU_STRICT_EPHEM=1 to make this an error)",
+            name, name.lower(),
         )
         return AnalyticEphemeris(**kwargs)
     raise ValueError(f"unknown ephemeris {name!r}")

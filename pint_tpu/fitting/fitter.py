@@ -135,6 +135,21 @@ class Fitter:
     def get_designmatrix(self):
         return self.model.designmatrix(self.toas)
 
+    # -- labeled-matrix reporting (reference: pint.pint_matrix /
+    #    Fitter.parameter_correlation_matrix) ---------------------------
+    def get_covariance_matrix(self):
+        """Labeled parameter covariance (after fit_toas)."""
+        from pint_tpu.matrix import CovarianceMatrix
+
+        return CovarianceMatrix.from_fitter(self)
+
+    def get_parameter_correlation_matrix(self, pretty_print: bool = False):
+        """Labeled correlation matrix; optionally print the lower triangle."""
+        corr = self.get_covariance_matrix().to_correlation_matrix()
+        if pretty_print:
+            print(corr.prettyprint())
+        return corr
+
     def fit_toas(self, maxiter: int = 1, **kw) -> float:  # pragma: no cover
         raise NotImplementedError
 

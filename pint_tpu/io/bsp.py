@@ -1,0 +1,321 @@
+"""DAF/SPK (.bsp) kernel reader + jittable Chebyshev SPK ephemeris.
+
+Reference equivalent: the ``jplephem`` dependency behind
+``pint.solar_system_ephemerides`` (src/pint/solar_system_ephemerides.py
+:: objPosVel_wrt_SSB), which evaluates JPL DE kernels. astropy/jplephem
+are absent here, and SURVEY.md §2.4 noted "Chebyshev-coefficient
+evaluation is trivially jittable; the data files are the blocker" — this
+module is the loader half: a pure-numpy DAF (Double precision Array
+File) parser for SPK segment types 2 and 3 (Chebyshev position /
+position+velocity — the types every JPL DE kernel uses), plus
+:class:`SPKEphemeris`, which keeps the coefficient tables as device
+arrays and evaluates them inside ``jit`` (record lookup is a clipped
+integer divide; the Chebyshev sum is an unrolled Clenshaw recursion;
+velocities for type-2 segments come from ``jax.jvp`` through the
+polynomial — exact, no finite differences).
+
+DAF layout (NAIF DAF Required Reading): 1024-byte records; record 1 is
+the file record (LOCIDW, ND, NI, FWARD, BWARD, LOCFMT endianness);
+summary records form a doubly-linked list of (NEXT, PREV, NSUM)
+followed by NSUM summaries of ND doubles + NI packed int32s. SPK uses
+ND=2 (etbeg, etend), NI=6 (target, center, frame, type, begin, end
+word addresses, 1-based).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import C_M_S
+
+Array = jax.Array
+RECLEN = 1024
+C_KM_S = C_M_S / 1000.0
+ET_J2000_MJD = 51544.5
+DAY_S = 86400.0
+
+# NAIF integer codes used by DE kernels
+NAIF = {
+    "ssb": 0, "mercury": 1, "venus": 2, "emb": 3, "mars": 4, "jupiter": 5,
+    "saturn": 6, "uranus": 7, "neptune": 8, "pluto": 9, "sun": 10,
+    "moon": 301, "earth": 399,
+}
+
+
+@dataclasses.dataclass
+class SPKSegment:
+    target: int
+    center: int
+    data_type: int
+    et_beg: float
+    et_end: float
+    init: float
+    intlen: float
+    coeffs: np.ndarray  # (n_records, 3, ncoef) position Chebyshev [km]
+
+
+def read_spk(path: str) -> list[SPKSegment]:
+    """Parse every type-2/3 segment of a .bsp kernel."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    locidw = buf[:8].decode("ascii", errors="replace")
+    if not locidw.startswith("DAF/SPK"):
+        raise ValueError(f"{path}: not a DAF/SPK file (LOCIDW={locidw!r})")
+    locfmt = buf[88:96].decode("ascii", errors="replace")
+    if locfmt.startswith("BIG"):
+        f8, i4 = np.dtype(">f8"), np.dtype(">i4")
+    elif locfmt.startswith("LTL"):
+        f8, i4 = np.dtype("<f8"), np.dtype("<i4")
+    else:
+        raise ValueError(f"{path}: unsupported/pre-N0050 DAF format "
+                         f"{locfmt!r}")
+    nd = int(np.frombuffer(buf[8:12], i4)[0])
+    ni = int(np.frombuffer(buf[12:16], i4)[0])
+    fward = int(np.frombuffer(buf[76:80], i4)[0])
+    if (nd, ni) != (2, 6):
+        raise ValueError(f"{path}: ND/NI = {nd}/{ni}, expected 2/6 for SPK")
+    ss = nd + (ni + 1) // 2  # summary size in doubles
+
+    words = np.frombuffer(buf, f8)
+
+    segments: list[SPKSegment] = []
+    rec = fward
+    while rec > 0:
+        base = (rec - 1) * 128  # word index of this summary record
+        nxt = int(words[base])
+        nsum = int(words[base + 2])
+        for k in range(nsum):
+            s0 = base + 3 + k * ss
+            et_beg, et_end = float(words[s0]), float(words[s0 + 1])
+            ints = np.frombuffer(words[s0 + 2:s0 + 5].tobytes(), i4)
+            target, center, _frame, dtype_, begin, end = (int(x) for x in ints)
+            if dtype_ not in (2, 3):
+                continue  # type 13 etc.: not used by DE kernels
+            seg = words[begin - 1:end]
+            init, intlen, rsize, n = (float(seg[-4]), float(seg[-3]),
+                                      int(seg[-2]), int(seg[-1]))
+            ncomp = 3 if dtype_ == 2 else 6
+            ncoef = (rsize - 2) // ncomp
+            recs = seg[:n * rsize].reshape(n, rsize)
+            # per record: MID, RADIUS, then component-major coefficients
+            coeffs = recs[:, 2:2 + 3 * ncoef].reshape(n, 3, ncoef)
+            segments.append(SPKSegment(target, center, dtype_, et_beg,
+                                       et_end, init, intlen,
+                                       np.ascontiguousarray(coeffs)))
+        rec = nxt
+    if not segments:
+        raise ValueError(f"{path}: no type-2/3 SPK segments found")
+    return segments
+
+
+def _cheb_eval(coeffs: Array, s: Array) -> Array:
+    """Clenshaw sum of Chebyshev series; coeffs (..., ncoef), s (...)."""
+    ncoef = coeffs.shape[-1]
+    b1 = jnp.zeros_like(s)
+    b2 = jnp.zeros_like(s)
+    for j in range(ncoef - 1, 0, -1):
+        b1, b2 = 2.0 * s * b1 - b2 + coeffs[..., j], b1
+    return s * b1 - b2 + coeffs[..., 0]
+
+
+@dataclasses.dataclass(frozen=True)
+class _PairTable:
+    init: float
+    intlen: float
+    coeffs: Array  # (n, 3, ncoef) device array, km
+
+    def posvel_km(self, et: Array) -> tuple[Array, Array]:
+        x = (et - self.init) / self.intlen
+        i = jnp.clip(jnp.floor(x).astype(jnp.int32), 0,
+                     self.coeffs.shape[0] - 1)
+        c = self.coeffs[i]  # (..., 3, ncoef)
+
+        # jvp through the polynomial gives d pos / d tau (tau in seconds)
+        # exactly — no finite differences
+        def pos_at(tau):
+            s = 2.0 * (x - i + tau / self.intlen) - 1.0
+            return _cheb_eval(c, s[..., None])
+
+        p, v = jax.jvp(pos_at, (jnp.zeros_like(et),), (jnp.ones_like(et),))
+        return p, v
+
+
+class SPKEphemeris:
+    """Ephemeris provider evaluating a JPL DE kernel under ``jit``.
+
+    Composes the standard DE segment tree (EMB wrt SSB + Earth wrt EMB,
+    Sun wrt SSB, planet barycenters wrt SSB). Positions are returned in
+    light-seconds / lt-s per second wrt the SSB, matching the
+    :class:`pint_tpu.ephemeris.Ephemeris` protocol.
+    """
+
+    def __init__(self, path_or_segments, name: str = "spk"):
+        segs = (read_spk(path_or_segments)
+                if isinstance(path_or_segments, str) else path_or_segments)
+        self.name = name
+        self._pairs: dict[tuple[int, int], _PairTable] = {}
+        for s in segs:
+            self._pairs[(s.target, s.center)] = _PairTable(
+                s.init, s.intlen, jnp.asarray(s.coeffs))
+        self.et_beg = max(s.et_beg for s in segs)
+        self.et_end = min(s.et_end for s in segs)
+
+    def _chain(self, target: int) -> list[tuple[tuple[int, int], float]]:
+        """[(pair, sign), ...] composing `target` wrt SSB."""
+        if (target, 0) in self._pairs:
+            return [((target, 0), 1.0)]
+        # DE layout: earth via EMB; moon via EMB
+        for mid in (3,):
+            if (target, mid) in self._pairs and (mid, 0) in self._pairs:
+                return [((target, mid), 1.0), ((mid, 0), 1.0)]
+        raise KeyError(f"no SPK path from body {target} to the SSB")
+
+    def _posvel_ls(self, target: int, t_tdb_mjd: Array) -> tuple[Array, Array]:
+        et = (jnp.asarray(t_tdb_mjd, jnp.float64) - ET_J2000_MJD) * DAY_S
+        # out-of-coverage times would silently evaluate the Chebyshev
+        # series at |s| > 1 (divergent); raise while still on host
+        # (jplephem/PINT raise the same way). Traced calls skip the
+        # check — the concrete TOA-loading path is what feeds real data.
+        if not isinstance(et, jax.core.Tracer) and et.size:
+            lo, hi = float(jnp.min(et)), float(jnp.max(et))
+            if lo < self.et_beg or hi > self.et_end:
+                raise ValueError(
+                    f"time outside SPK kernel coverage: requested ET "
+                    f"[{lo:.0f}, {hi:.0f}] s vs kernel "
+                    f"[{self.et_beg:.0f}, {self.et_end:.0f}]")
+        pos = vel = 0.0
+        for pair, sign in self._chain(target):
+            p, v = self._pairs[pair].posvel_km(et)
+            pos = pos + sign * p
+            vel = vel + sign * v
+        return pos / C_KM_S, vel / C_KM_S
+
+    def earth_posvel_ssb(self, t_tdb_mjd: Array) -> tuple[Array, Array]:
+        return self._posvel_ls(NAIF["earth"], t_tdb_mjd)
+
+    def sun_posvel_ssb(self, t_tdb_mjd: Array) -> tuple[Array, Array]:
+        return self._posvel_ls(NAIF["sun"], t_tdb_mjd)
+
+    def planet_posvel_ssb(self, name: str, t_tdb_mjd: Array) -> tuple[Array, Array]:
+        return self._posvel_ls(NAIF[name.lower()], t_tdb_mjd)
+
+
+def spk_to_tabulated(path: str, start_mjd: float, end_mjd: float,
+                     dt_days: float = 0.25, bodies=("earth", "sun", "jupiter",
+                                                    "saturn", "venus", "mars",
+                                                    "uranus", "neptune")):
+    """Sample a kernel onto a uniform grid -> TabulatedEphemeris.
+
+    The injection tool the round-1 review asked for: produces the
+    (t, pos, vel) tables :class:`pint_tpu.ephemeris.TabulatedEphemeris`
+    interpolates, for deployments that prefer a small table to shipping
+    the kernel to every host.
+    """
+    from pint_tpu.ephemeris import TabulatedEphemeris
+
+    eph = SPKEphemeris(path)
+    n = int(np.ceil((end_mjd - start_mjd) / dt_days)) + 2
+    t = start_mjd + dt_days * np.arange(n)
+    tables = {}
+    for b in bodies:
+        try:
+            p, v = eph.planet_posvel_ssb(b, jnp.asarray(t))
+        except KeyError:
+            continue
+        tables[b] = (np.asarray(p), np.asarray(v))
+    return TabulatedEphemeris(t0=float(t[0]), dt_days=float(dt_days),
+                              tables=tables, name=f"tab:{eph.name}")
+
+
+# ---------------------------------------------------------------------------
+# minimal type-2 writer (tests / table prep — mirrors the reader's layout)
+# ---------------------------------------------------------------------------
+
+def write_spk_type2(path: str, segments: list[SPKSegment]) -> None:
+    """Write a little-endian DAF/SPK with the given type-2 segments."""
+    f8 = np.dtype("<f8")
+    i4 = np.dtype("<i4")
+    nd, ni = 2, 6
+    ss = nd + (ni + 1) // 2
+
+    # data area starts at record 3 (record 2 is the summary record)
+    data_words: list[np.ndarray] = []
+    summaries = []
+    addr = 2 * 128 + 1  # first data word address (1-based), after 2 records
+    for s in segments:
+        if s.data_type != 2:
+            raise ValueError("writer supports type 2 only")
+        n, _, ncoef = s.coeffs.shape
+        rsize = 2 + 3 * ncoef
+        recs = np.zeros((n, rsize))
+        recs[:, 0] = s.init + s.intlen * (np.arange(n) + 0.5)  # MID
+        recs[:, 1] = s.intlen / 2.0  # RADIUS
+        recs[:, 2:] = s.coeffs.reshape(n, 3 * ncoef)
+        seg_words = np.concatenate([
+            recs.ravel(), [s.init, s.intlen, float(rsize), float(n)]])
+        summaries.append((s.et_beg, s.et_end, s.target, s.center, 1,
+                          2, addr, addr + seg_words.size - 1))
+        data_words.append(seg_words)
+        addr += seg_words.size
+
+    # file record
+    rec1 = bytearray(RECLEN)
+    rec1[0:8] = b"DAF/SPK "
+    rec1[8:12] = np.asarray([nd], i4).tobytes()
+    rec1[12:16] = np.asarray([ni], i4).tobytes()
+    rec1[16:76] = b"pint_tpu synthetic kernel".ljust(60)
+    rec1[76:80] = np.asarray([2], i4).tobytes()  # FWARD
+    rec1[80:84] = np.asarray([2], i4).tobytes()  # BWARD
+    rec1[84:88] = np.asarray([addr], i4).tobytes()  # FREE
+    rec1[88:96] = b"LTL-IEEE"
+
+    # summary record
+    rec2 = np.zeros(128)
+    rec2[0] = 0.0  # NEXT
+    rec2[1] = 0.0  # PREV
+    rec2[2] = float(len(summaries))
+    for k, (eb, ee, tg, ct, fr, ty, ba, ea) in enumerate(summaries):
+        s0 = 3 + k * ss
+        rec2[s0] = eb
+        rec2[s0 + 1] = ee
+        rec2[s0 + 2:s0 + 5] = np.frombuffer(
+            np.asarray([tg, ct, fr, ty, ba, ea], i4).tobytes(), f8)
+
+    payload = np.concatenate(data_words) if data_words else np.zeros(0)
+    pad = (-payload.size) % 128
+    payload = np.concatenate([payload, np.zeros(pad)])
+    with open(path, "wb") as f:
+        f.write(bytes(rec1))
+        f.write(rec2.astype(f8).tobytes())
+        f.write(payload.astype(f8).tobytes())
+
+
+def chebyshev_fit_segment(posfn, et0: float, et1: float, intlen: float,
+                          ncoef: int, target: int, center: int
+                          ) -> SPKSegment:
+    """Fit per-interval Chebyshev coefficients to ``posfn(et) -> (…,3) km``.
+
+    Builds a type-2 segment on [et0, et1] with records of length
+    ``intlen`` seconds — the tool for converting any posvel source
+    (tabulated DE samples, analytic models) into kernel form.
+    """
+    n = int(np.ceil((et1 - et0) / intlen))
+    # Chebyshev nodes per interval
+    k = np.arange(ncoef * 2)
+    nodes = np.cos(np.pi * (k + 0.5) / (ncoef * 2))  # (2m,)
+    coeffs = np.zeros((n, 3, ncoef))
+    for r in range(n):
+        mid = et0 + intlen * (r + 0.5)
+        et = mid + nodes * (intlen / 2.0)
+        p = np.asarray(posfn(et))  # (2m, 3)
+        # discrete Chebyshev transform at the nodes
+        Tm = np.cos(np.arange(ncoef)[:, None] * np.arccos(nodes)[None, :])
+        w = 2.0 / nodes.size
+        c = w * (Tm @ p)  # (ncoef, 3)
+        c[0] *= 0.5
+        coeffs[r] = c.T
+    return SPKSegment(target, center, 2, et0, et1, et0, intlen, coeffs)

@@ -20,7 +20,7 @@ from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
 from pint_tpu.models.frequency_dependent import FD
 from pint_tpu.models.glitch import Glitch
 from pint_tpu.models.ifunc import IFunc
-from pint_tpu.models.jump import PhaseJump
+from pint_tpu.models.jump import DispersionJump, PhaseJump
 from pint_tpu.models.noise import (EcorrNoise, PLDMNoise, PLRedNoise,
                                    ScaleDmError, ScaleToaError)
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
@@ -49,6 +49,7 @@ COMPONENT_BUILD_ORDER: list[type] = [
     IFunc,
     FD,
     PhaseJump,
+    DispersionJump,
     ScaleToaError,
     ScaleDmError,
     EcorrNoise,

@@ -76,3 +76,66 @@ class PhaseJump(Component):
             mask = jnp.asarray(toa_mask(param.selector, toas), jnp.float64)
             total = total + mask * (-f64(p, name)) * f64(p, "F0")
         return phase_mod.from_dd(dd.from_f64(total))
+
+
+class DispersionJump(Component):
+    """DMJUMP: DM offsets on selected wideband DM measurements.
+
+    Reference equivalent: ``pint.models.jump.DispersionJump``
+    (src/pint/models/jump.py): a maskParameter family that shifts the
+    *model* DM prediction by -DMJUMP for the TOAs its selector matches.
+    It deliberately has no time-delay contribution — it calibrates
+    per-system offsets of the measured wideband DMs, entering only the
+    DM block of the wideband joint fit (dm_value / dm_designmatrix).
+    """
+
+    category = "dispersion_jump"
+    extra_par_names = ("DMJUMP",)
+
+    def __init__(self, selectors: list[tuple[str, ...]] | None = None):
+        super().__init__()
+        self.dmjump_names: list[str] = []
+        for sel in selectors or []:
+            self.add_dmjump(sel)
+
+    def add_dmjump(self, selector: tuple[str, ...], value: float = 0.0,
+                   frozen: bool = False) -> Param:
+        idx = len(self.dmjump_names) + 1
+        name = f"DMJUMP{idx}"
+        p = float_param(name, units="pc cm^-3",
+                        desc=f"DM jump for {selector}", index=idx)
+        p.selector = tuple(str(s) for s in selector)
+        p.value = (float(value), 0.0)
+        p.frozen = frozen
+        self.dmjump_names.append(name)
+        return self.add_param(p)
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(l.name == "DMJUMP" or (l.name.startswith("DMJUMP")
+                                          and l.name[6:].isdigit())
+                   for l in pf.lines)
+
+    @classmethod
+    def from_parfile(cls, pf) -> "DispersionJump":
+        self = cls()
+        for line in pf.lines:
+            if line.name != "DMJUMP" and not (
+                line.name.startswith("DMJUMP") and line.name[6:].isdigit()
+            ):
+                continue
+            sel = tuple(line.rest) if (line.rest
+                                       and line.rest[0].startswith("-")) else ()
+            p = self.add_dmjump(sel, frozen=not line.fit)
+            p.set_from_par(line.value)
+            if line.uncertainty:
+                p.set_uncertainty_from_par(line.uncertainty)
+        return self
+
+    def dm_value(self, p: dict[str, DD], toas) -> Array:
+        total = jnp.zeros(len(toas))
+        for name in self.dmjump_names:
+            param = self.param(name)
+            mask = jnp.asarray(toa_mask(param.selector, toas), jnp.float64)
+            total = total - mask * f64(p, name)
+        return total

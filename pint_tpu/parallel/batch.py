@@ -69,17 +69,37 @@ def neutral_value(name: str) -> float:
     return 0.0
 
 
-def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple]]]:
+def _structural_state(c) -> tuple:
+    """Non-parameter component state that must match across a batch.
+
+    Components merged by class share ONE instance in the union, so any
+    state living outside the Param dict (DMX MJD windows, IFunc node
+    epochs) must be identical for every pulsar contributing it.
+    """
+    out = []
+    for attr in ("ranges", "node_mjds", "nodes", "indices"):
+        v = getattr(c, attr, None)
+        if isinstance(v, dict):
+            out.append(tuple(sorted((k, tuple(np.atleast_1d(x)))
+                                    for k, x in v.items())))
+        elif v is not None:
+            out.append(tuple(np.ravel(np.asarray(v, dtype=np.float64))))
+    return tuple(out)
+
+
+def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple, str]]]:
     """Union of the models' components for batched fitting.
 
     Returns (union_model, owners) where ``owners`` maps each merged
     mask-parameter's synthetic selector key to (owner pulsar index,
-    original selector) — non-owners get a zero mask at materialization.
+    original selector, original parameter name) — non-owners get a zero
+    mask at materialization, and fit results are written back to the
+    owner's own parameter (the union name is synthetic).
     """
     plain: dict[str, object] = {}
     scale = ScaleToaError()
     jump = PhaseJump()
-    owners: dict[str, tuple[int, tuple]] = {}
+    owners: dict[str, tuple[int, tuple, str]] = {}
     binary_classes: set[str] = set()
     tag = 0
     for i, m in enumerate(models):
@@ -94,7 +114,8 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple]]
                     sel = ("batched", str(tag))
                     np_ = scale._add(kind, sel, value=p.value_f64)
                     np_.value = p.value
-                    owners[" ".join(sel)] = (i, p.selector)
+                    np_.frozen = p.frozen
+                    owners[" ".join(sel)] = (i, p.selector, p.name)
                     tag += 1
                 continue
             if isinstance(c, PhaseJump):
@@ -102,7 +123,7 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple]]
                     sel = ("batched", str(tag))
                     np_ = jump.add_jump(sel, frozen=p.frozen)
                     np_.value = p.value
-                    owners[" ".join(sel)] = (i, p.selector)
+                    owners[" ".join(sel)] = (i, p.selector, p.name)
                     tag += 1
                 continue
             name = type(c).__name__
@@ -118,6 +139,12 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple]]
                     raise ValueError(
                         f"component {name} has different parameter sets "
                         "across the batch; split the batch")
+                if _structural_state(prev) != _structural_state(c):
+                    raise ValueError(
+                        f"component {name} has different non-parameter state "
+                        "(DMX windows / IFunc nodes) across the batch; the "
+                        "union would apply one pulsar's windows to all — "
+                        "split the batch")
             else:
                 plain[name] = c
     comps = list(plain.values())
@@ -137,7 +164,7 @@ def _materialize_for_pulsar(toas, i, models, union, owners):
     n = len(toas)
     from pint_tpu.models.parameter import toa_mask
 
-    for key, (owner, orig_sel) in owners.items():
+    for key, (owner, orig_sel, _name) in owners.items():
         if owner == i:
             masks[key] = jnp.asarray(
                 np.asarray(toa_mask(orig_sel, toas)), jnp.float64)
@@ -183,14 +210,24 @@ class BatchedPulsarFitter:
         self.models = [m for _, m in problems]
         self.union, owners = build_union_model(self.models)
 
-        # free-parameter union + per-pulsar 0/1 masks
+        # free-parameter union + per-pulsar 0/1 masks. Mask params that
+        # were merged (JUMP/EFAC family) are fitted under their synthetic
+        # union names; the owner's own per-model name is skipped and the
+        # result written back through ``_merged_owner``.
+        merged = {(i, nm) for (i, _sel, nm) in owners.values()}
+        self._merged_owner: dict[str, tuple[int, str]] = {}
+        for p in self.union.params.values():
+            key = " ".join(p.selector) if p.selector else ""
+            if key in owners:
+                owner, _sel, orig_name = owners[key]
+                self._merged_owner[p.name] = (owner, orig_name)
         names: list[str] = []
-        for m in self.models:
+        for i, m in enumerate(self.models):
             for k in m.free_params:
+                if (i, k) in merged:
+                    continue  # fitted via its synthetic union name
                 if k not in names:
                     names.append(k)
-        # merged EFAC/JUMP params live only in the union; free JUMPs fit
-        # per owner
         for p in self.union.params.values():
             if not p.frozen and p.fittable and p.name not in names:
                 names.append(p.name)
@@ -200,9 +237,8 @@ class BatchedPulsarFitter:
         for i, m in enumerate(self.models):
             row = []
             for k in names:
-                if k in self.union.params and " ".join(
-                        self.union[k].selector) in owners:
-                    owner, _ = owners[" ".join(self.union[k].selector)]
+                if k in self._merged_owner:
+                    owner, _ = self._merged_owner[k]
                     row.append(1.0 if owner == i and not self.union[k].frozen
                                else 0.0)
                 else:
@@ -245,9 +281,13 @@ class BatchedPulsarFitter:
         ]
         self.toas = shard_toas(stack_toas(prepped, n_max), self.mesh,
                                batched=True)
-        # abs_phase off: the weighted-mean subtraction absorbs TZR anchors
+        # abs_phase off: the weighted-mean subtraction absorbs TZR anchors.
+        # params= is the fitter's free-param union — a parameter frozen in
+        # the model that contributed the union component may still be free
+        # in another pulsar (its column is masked per pulsar).
         self.step = jax.jit(jax.vmap(
-            make_wls_step(self.union, abs_phase=False, masked=True),
+            make_wls_step(self.union, abs_phase=False, masked=True,
+                          params=self.free_params),
             in_axes=(0, 0, 0, 0)))
 
     def fit_toas(self, maxiter: int = 2) -> np.ndarray:
@@ -264,9 +304,13 @@ class BatchedPulsarFitter:
             for k in self.free_params:
                 if float(np.asarray(self.param_mask[k][i])) == 0.0:
                     continue
-                if k not in m.params:
+                if k in self._merged_owner:
+                    owner, orig_name = self._merged_owner[k]
+                    p = self.models[owner][orig_name]
+                elif k in m.params:
+                    p = m[k]
+                else:
                     continue
-                p = m[k]
                 p.add_delta(float(np.asarray(deltas[k][i])))
                 p.uncertainty = float(np.asarray(info["errors"][k][i]))
         return np.asarray(info["chi2"])

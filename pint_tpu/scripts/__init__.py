@@ -8,4 +8,6 @@ in ``pyproject.toml``:
 * ``tcb2tdb``   — convert a TCB par file to TDB
 * ``compare_parfiles`` — parameter-by-parameter model comparison
 * ``pintbary``  — barycenter arrival times with a (minimal) model
+* ``photonphase`` — phases + H-test for FITS photon events
+* ``event_optimize`` — MCMC timing fit against a profile template
 """

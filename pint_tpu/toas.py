@@ -155,6 +155,13 @@ class TOAs:
 
 def merge_TOAs(toas_list: list[TOAs]) -> TOAs:
     """Concatenate TOA tables (reference: pint.toa.merge_TOAs)."""
+    keys = set(toas_list[0].aux_masks)
+    for t in toas_list[1:]:
+        if set(t.aux_masks) != keys:
+            raise ValueError(
+                "cannot merge TOAs with different aux_masks keys "
+                f"({sorted(keys)} vs {sorted(t.aux_masks)}): materialize "
+                "selector masks consistently before merging")
     cat = lambda getter: jnp.concatenate([np.asarray(getter(t)) for t in toas_list])
     planets = {}
     for name in toas_list[0].planet_pos_ls:

@@ -1,3 +1,12 @@
-"""Host-side utilities (angles, formatting, statistics)."""
+"""Host-side utilities (angles, statistics, DMX reporting).
+
+Reference equivalent: ``pint.utils`` (src/pint/utils.py) — split into
+focused modules here: ``angles`` (sexagesimal), ``stats`` (weighted
+statistics + information criteria), ``dmx`` (dmxparse).
+"""
 
 from pint_tpu.utils import angles  # noqa: F401
+from pint_tpu.utils.dmx import dmxparse  # noqa: F401
+from pint_tpu.utils.stats import (akaike_information_criterion,  # noqa: F401
+                                  bayesian_information_criterion, dmx_ranges,
+                                  mad_std, weighted_mean, weighted_rms)

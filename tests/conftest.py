@@ -32,3 +32,21 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def cpu_devices():
     return _cpus
+
+
+@pytest.fixture(autouse=True)
+def _reset_package_logger():
+    """Undo pint_tpu.logging.setup() side effects between tests.
+
+    setup() adds a handler and sets propagate=False on the "pint_tpu"
+    logger; left in place, later tests' caplog (attached at root) never
+    sees package warnings.
+    """
+    import logging
+
+    yield
+    logger = logging.getLogger("pint_tpu")
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    logger.propagate = True
+    logger.setLevel(logging.NOTSET)

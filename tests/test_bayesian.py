@@ -1,0 +1,124 @@
+"""Bayesian timing + MCMC fitter (VERDICT round-1 task 7).
+
+Reference: pint.bayesian.BayesianTiming / pint.mcmc_fitter.MCMCFitter.
+The acceptance test is the one the VERDICT prescribes: on a 2-parameter
+toy problem the posterior must be consistent with the WLS covariance
+(flat priors, Gaussian white noise -> the posterior IS the WLS normal
+approximation).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.bayesian import (BayesianTiming, MCMCFitter, NormalPrior,
+                               UniformPrior, default_priors)
+from pint_tpu.fitting import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75
+DECJ           -20:21:29.0
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+
+@pytest.fixture(scope="module")
+def problem():
+    truth = get_model(PAR)
+    toas = make_fake_toas_uniform(53478, 54187, 60, truth, obs="gbt",
+                                  freq_mhz=1400.0, error_us=2.0,
+                                  add_noise=True, seed=7)
+    wls_model = get_model(PAR)
+    f = WLSFitter(toas, wls_model)
+    f.fit_toas(maxiter=3)
+    return toas, wls_model
+
+
+def test_priors_and_logpost_finite(problem):
+    toas, wls_model = problem
+    model = get_model(PAR)
+    bt = BayesianTiming(toas, model)
+    x = bt.param_vector()
+    assert np.isfinite(bt.lnposterior(x))
+    assert np.isfinite(bt.lnprior(x))
+    assert bt.lnposterior(x) == pytest.approx(
+        bt.lnprior(x) + bt.lnlikelihood(x))
+    # outside a uniform prior -> -inf
+    pr = default_priors(model)
+    lo = pr["F0"].lo
+    x_bad = x.copy()
+    x_bad[bt.fit_params.index("F0")] = lo - 1.0
+    assert bt.lnposterior(x_bad) == -np.inf
+
+
+def test_prior_override_rejects_unknown(problem):
+    toas, _ = problem
+    model = get_model(PAR)
+    with pytest.raises(ValueError, match="non-free"):
+        BayesianTiming(toas, model, priors={"DM": UniformPrior(0, 1)})
+
+
+def test_posterior_matches_wls_covariance(problem):
+    """2-param toy: posterior mean/std vs WLSFitter values/uncertainties."""
+    toas, wls_model = problem
+    model = get_model(PAR)
+    priors = {k: NormalPrior(wls_model[k].value_f64,
+                             50.0 * wls_model[k].uncertainty)
+              for k in ("F0", "F1")}  # wide: effectively flat over posterior
+    f = MCMCFitter(toas, model, priors, nwalkers=16, nsteps=400, seed=3)
+    best = f.fit_toas()
+    assert np.isfinite(best)
+    assert f.acceptance.mean() > 0.1
+    for k in ("F0", "F1"):
+        wls_val = wls_model[k].value_f64
+        wls_unc = wls_model[k].uncertainty
+        # posterior mean within 3 sigma of the WLS solution
+        assert abs(model[k].value_f64 - wls_val) < 3.0 * wls_unc, k
+        # posterior std consistent with the WLS uncertainty (finite-chain
+        # scatter: generous band)
+        assert 0.5 * wls_unc < model[k].uncertainty < 2.0 * wls_unc, k
+
+
+def test_lnlike_marginalizes_correlated_noise(problem):
+    """With ECORR the marginalized likelihood must differ from white."""
+    from pint_tpu.toas import merge_TOAs
+
+    toas, _ = problem
+    toas2 = merge_TOAs([toas, toas])  # 2-TOA epochs so ECORR quantizes
+    m_white = get_model(PAR)
+    m_corr = get_model(PAR + "ECORR -tel gbt 1.1\n")
+    bt_w = BayesianTiming(toas2, m_white)
+    bt_c = BayesianTiming(toas2, m_corr)
+    assert bt_c._U is not None and bt_c._U.shape[1] > 0
+    x = bt_w.param_vector()
+    lw = bt_w.lnlikelihood(x)
+    lc = bt_c.lnlikelihood(np.asarray(bt_c.param_vector()))
+    assert np.isfinite(lw) and np.isfinite(lc)
+    assert lw != pytest.approx(lc)
+
+
+def test_sampled_efac(problem):
+    """An EFAC opted in via a prior enters the traced likelihood."""
+    toas, _ = problem
+    model = get_model(PAR + "EFAC -tel gbt 1.3\n")
+    bt = BayesianTiming(toas, model,
+                        priors={"EFAC1": UniformPrior(0.3, 4.0)})
+    assert "EFAC1" in bt.fit_params
+    x = bt.param_vector()
+    j = bt.fit_params.index("EFAC1")
+    l1 = bt.lnlikelihood(x)
+    x2 = x.copy()
+    x2[j] = 2.6
+    l2 = bt.lnlikelihood(x2)
+    assert np.isfinite(l1) and np.isfinite(l2) and l1 != pytest.approx(l2)

@@ -1,0 +1,124 @@
+"""DAF/SPK kernel reader + jittable Chebyshev ephemeris (VERDICT #10).
+
+Reference equivalent: jplephem's SPK handling behind
+pint.solar_system_ephemerides. A synthetic type-2 kernel is built from
+the analytic ephemeris (Chebyshev-fit per 16-day interval), written in
+real DAF/SPK bytes, read back, and evaluated under jit — validating the
+whole chain: format round-trip, record selection, Clenshaw evaluation,
+jvp velocities, segment composition (earth = EMB wrt SSB + earth wrt
+EMB), and the TabulatedEphemeris injection tool.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.constants import C_M_S
+from pint_tpu.ephemeris import AnalyticEphemeris, get_ephemeris
+from pint_tpu.io.bsp import (ET_J2000_MJD, NAIF, SPKEphemeris,
+                             chebyshev_fit_segment, read_spk, spk_to_tabulated,
+                             write_spk_type2)
+
+C_KM_S = C_M_S / 1000.0
+DAY_S = 86400.0
+MJD0, MJD1 = 53000.0, 53400.0
+ET0 = (MJD0 - ET_J2000_MJD) * DAY_S
+ET1 = (MJD1 - ET_J2000_MJD) * DAY_S
+
+
+def _pos_km(fn):
+    def posfn(et):
+        mjd = ET_J2000_MJD + np.asarray(et) / DAY_S
+        p, _ = fn(jnp.asarray(mjd))
+        return np.asarray(p) * C_KM_S
+
+    return posfn
+
+
+@pytest.fixture(scope="module")
+def kernel(tmp_path_factory):
+    """Synthetic DE-layout kernel: EMB/SSB, earth/EMB, sun/SSB."""
+    eph = AnalyticEphemeris()
+    intlen = 16.0 * DAY_S
+    ncoef = 12
+
+    emb = _pos_km(lambda t: eph.planet_posvel_ssb("emb", t))
+    earth = _pos_km(eph.earth_posvel_ssb)
+    sun = _pos_km(eph.sun_posvel_ssb)
+    segs = [
+        chebyshev_fit_segment(emb, ET0, ET1, intlen, ncoef, NAIF["emb"], 0),
+        chebyshev_fit_segment(lambda et: earth(et) - emb(et), ET0, ET1,
+                              4.0 * DAY_S, ncoef, NAIF["earth"], NAIF["emb"]),
+        chebyshev_fit_segment(sun, ET0, ET1, intlen, ncoef, NAIF["sun"], 0),
+    ]
+    path = tmp_path_factory.mktemp("spk") / "de999.bsp"
+    write_spk_type2(str(path), segs)
+    return str(path), eph
+
+
+def test_daf_roundtrip(kernel):
+    path, _ = kernel
+    segs = read_spk(path)
+    assert len(segs) == 3
+    pairs = {(s.target, s.center) for s in segs}
+    assert pairs == {(3, 0), (399, 3), (10, 0)}
+    for s in segs:
+        assert s.data_type == 2
+        assert s.et_beg == ET0 and s.et_end == ET1
+        assert s.coeffs.shape[1] == 3
+
+
+def test_spk_matches_source(kernel):
+    """Kernel evaluation reproduces the fitted source to interp error."""
+    path, eph = kernel
+    spk = SPKEphemeris(path)
+    t = jnp.asarray(np.linspace(MJD0 + 1.0, MJD1 - 1.0, 300))
+    for fn_spk, fn_src in ((spk.earth_posvel_ssb, eph.earth_posvel_ssb),
+                           (spk.sun_posvel_ssb, eph.sun_posvel_ssb)):
+        p1, v1 = fn_spk(t)
+        p0, v0 = fn_src(t)
+        # 12 coeffs per 16 d on a 1 au orbit: sub-meter; assert < 30 m
+        assert float(jnp.max(jnp.abs(p1 - p0))) * C_M_S < 30.0
+        assert float(jnp.max(jnp.abs(v1 - v0))) * C_M_S < 1e-4  # m/s
+
+
+def test_spk_eval_is_jittable(kernel):
+    path, _ = kernel
+    spk = SPKEphemeris(path)
+
+    @jax.jit
+    def roemer_like(t):
+        p, v = spk.earth_posvel_ssb(t)
+        return jnp.sum(p, axis=-1) + jnp.sum(v, axis=-1)
+
+    out = roemer_like(jnp.asarray([53100.0, 53200.5]))
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_spk_to_tabulated(kernel):
+    path, eph = kernel
+    tab = spk_to_tabulated(path, MJD0 + 1, MJD0 + 50, dt_days=0.25,
+                           bodies=("earth", "sun"))
+    t = jnp.asarray(np.linspace(MJD0 + 2, MJD0 + 49, 100))
+    p_tab, v_tab = tab.earth_posvel_ssb(t)
+    p_src, _ = eph.earth_posvel_ssb(t)
+    assert float(jnp.max(jnp.abs(p_tab - p_src))) * C_M_S < 50.0
+
+
+def test_get_ephemeris_finds_kernel(kernel, monkeypatch):
+    path, _ = kernel
+    monkeypatch.setenv("PINT_TPU_EPHEM_DIR", os.path.dirname(path))
+    eph = get_ephemeris("DE999")
+    assert isinstance(eph, SPKEphemeris)
+    assert eph.name == "DE999"
+
+
+def test_get_ephemeris_strict_mode(monkeypatch, tmp_path):
+    monkeypatch.setenv("PINT_TPU_EPHEM_DIR", str(tmp_path))
+    monkeypatch.setenv("PINT_TPU_STRICT_EPHEM", "1")
+    with pytest.raises(FileNotFoundError, match="refusing"):
+        get_ephemeris("DE440")

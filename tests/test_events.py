@@ -1,0 +1,206 @@
+"""Photon-event pipeline: FITS reader, event TOAs, templates, H-test,
+event-timing MCMC (VERDICT round-1 missing item 4).
+
+Reference equivalents: pint.event_toas / pint.fermi_toas (loading),
+pint.templates (lctemplate/lcfitters), photonphase (phase assignment +
+H-test), event_optimize (MCMC). Events are synthesized barycentric
+(TIMESYS=TDB), the mode both frameworks support without orbit files.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.event_toas import (get_photon_weights, load_event_TOAs,
+                                 load_nicer_TOAs)
+from pint_tpu.io.fits import read_fits, write_event_fits
+from pint_tpu.models import get_model
+from pint_tpu.templates import (EventFitter, LCTemplate, fit_template,
+                                h_test, photon_phases, template_pdf)
+
+F0 = 61.485476554
+PAR = f"""
+PSRJ           J1748-2021E
+RAJ             17:48:52.75
+DECJ           -20:21:29.0
+F0             {F0}
+F1             0.0
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9
+EPHEM          DE421
+UNITS          TDB
+"""
+
+TEMPLATE = LCTemplate(locs=[0.3], widths=[0.04], norms=[0.7])
+
+
+def _draw_phases(n, rng):
+    """Sample photon phases from TEMPLATE by composition."""
+    peaked = rng.random(n) < 0.7
+    ph = np.where(peaked,
+                  (0.3 + 0.04 * rng.standard_normal(n)) % 1.0,
+                  rng.random(n))
+    return ph
+
+
+def _write_events(path, rng, n=400, weights=False):
+    phases = _draw_phases(n, rng)
+    turns = np.sort(rng.integers(0, int(3 * 86400 * F0), size=n))
+    met = (turns + phases) / F0  # seconds since MJDREF (TDB, barycentered)
+    cols = {"TIME": met.astype(np.float64),
+            "PI": rng.integers(30, 1000, size=n).astype(np.int32)}
+    if weights:
+        cols["WEIGHT"] = np.clip(rng.random(n), 0.05, 1.0)
+    write_event_fits(str(path), cols, header={
+        "MJDREFI": 53750, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+        "TIMESYS": "TDB", "TIMEREF": "SOLARSYSTEM", "TELESCOP": "NICER",
+    })
+    return phases
+
+
+def test_fits_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    p = tmp_path / "ev.fits"
+    t = np.linspace(0.0, 10.0, 17)
+    write_event_fits(str(p), {"TIME": t, "PI": np.arange(17, dtype=np.int32)},
+                     header={"MJDREFI": 50000, "MJDREFF": 7.428703703703703e-4,
+                             "TIMESYS": "TDB"})
+    f = read_fits(str(p))
+    tab = f.table("EVENTS")
+    np.testing.assert_array_equal(tab["TIME"], t)
+    np.testing.assert_array_equal(tab["PI"], np.arange(17))
+    assert tab.header["MJDREFI"] == 50000
+    assert abs(tab.header["MJDREFF"] - 7.428703703703703e-4) < 1e-12
+    assert tab.header["TIMESYS"] == "TDB"
+
+
+def test_load_event_toas_phases(tmp_path):
+    rng = np.random.default_rng(1)
+    p = tmp_path / "bary.fits"
+    true_phases = _write_events(p, rng)
+    toas = load_nicer_TOAs(str(p))
+    assert len(toas) == true_phases.size
+    model = get_model(PAR)
+    phi = photon_phases(model, toas)
+    # barycentric events + pure spindown: model phase tracks the
+    # generated phase up to one constant offset (the ~50 us solar
+    # Shapiro at the SSB, which the generator omits — an absolute-phase
+    # constant the template's peak location absorbs in practice)
+    dphi = (phi - true_phases + 0.5) % 1.0 - 0.5
+    const = np.median(dphi)
+    assert abs(const) < 0.01
+    assert np.max(np.abs(dphi - const)) < 1e-5
+
+
+def test_load_event_weights_and_energy_cut(tmp_path):
+    rng = np.random.default_rng(2)
+    p = tmp_path / "w.fits"
+    _write_events(p, rng, weights=True)
+    toas = load_event_TOAs(str(p), "nicer", weight_column="WEIGHT")
+    w = get_photon_weights(toas)
+    assert w is not None and w.shape == (len(toas),)
+    assert np.all((w > 0) & (w <= 1.0))
+    toas_cut = load_event_TOAs(str(p), "nicer",
+                               energy_range_kev=(1.0, 5.0))  # PI*0.01 keV
+    assert 0 < len(toas_cut) < len(toas)
+
+
+def test_unsupported_timeref_raises(tmp_path):
+    rng = np.random.default_rng(3)
+    p = tmp_path / "topo.fits"
+    n = 10
+    write_event_fits(str(p), {"TIME": rng.random(n)},
+                     header={"MJDREFI": 53750, "MJDREFF": 0.0,
+                             "TIMESYS": "TT", "TIMEREF": "LOCAL"})
+    with pytest.raises(ValueError, match="orbit files"):
+        load_event_TOAs(str(p), "nicer")
+
+
+def test_template_pdf_normalized():
+    phases = np.linspace(0.0, 1.0, 20001)[:-1]
+    f = TEMPLATE(phases)
+    assert np.all(f >= 0)
+    assert np.trapezoid(np.append(f, f[0]),
+                        np.linspace(0, 1, 20001)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_h_test_discriminates():
+    rng = np.random.default_rng(4)
+    peaked = _draw_phases(2000, rng)
+    flat = rng.random(2000)
+    h_peak, p_peak = h_test(peaked)
+    h_flat, p_flat = h_test(flat)
+    assert h_peak > 100.0 and p_peak < 1e-10
+    assert h_flat < 30.0
+
+
+def test_fit_template_recovers():
+    rng = np.random.default_rng(5)
+    phases = _draw_phases(4000, rng)
+    start = LCTemplate(locs=[0.45], widths=[0.08], norms=[0.5])
+    fitted, lnl = fit_template(phases, start, steps=800)
+    assert lnl > start.log_likelihood(phases)
+    assert abs(fitted.locs[0] - 0.3) < 0.01
+    assert abs(fitted.widths[0] - 0.04) < 0.01
+    assert abs(fitted.norms[0] - 0.7) < 0.06
+
+
+def test_event_fitter_recovers_f0(tmp_path):
+    rng = np.random.default_rng(6)
+    p = tmp_path / "fit.fits"
+    _write_events(p, rng, n=600)
+    toas = load_nicer_TOAs(str(p))
+    model = get_model(PAR.replace(f"F0             {F0}",
+                                  f"F0             {F0}  1"))
+    df = 3e-7  # ~0.08 cycles of drift over the 3-day span
+    model["F0"].add_delta(df)
+    from pint_tpu.bayesian import UniformPrior
+
+    f = EventFitter(toas, model, TEMPLATE,
+                    priors={"F0": UniformPrior(F0 - 2e-6, F0 + 2e-6)})
+    best = f.fit_toas(nsteps=400, seed=2)
+    assert np.isfinite(best)
+    # the true F0 maximizes the template likelihood
+    assert abs(model["F0"].value_f64 - F0) < 5e-8
+
+
+def test_photonphase_cli(tmp_path, capsys):
+    from pint_tpu.scripts import photonphase
+
+    rng = np.random.default_rng(7)
+    ev = tmp_path / "cli.fits"
+    _write_events(ev, rng, n=300)
+    par = tmp_path / "cli.par"
+    par.write_text(PAR)
+    out = tmp_path / "phases.txt"
+    rc = photonphase.main([str(ev), str(par), "--mission", "nicer",
+                           "--outfile", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Htest" in text
+    rows = np.loadtxt(out)
+    assert rows.shape == (300, 2)
+    assert np.all((rows[:, 1] >= 0) & (rows[:, 1] < 1))
+
+
+def test_event_optimize_cli(tmp_path, capsys):
+    from pint_tpu.scripts import event_optimize
+
+    rng = np.random.default_rng(8)
+    ev = tmp_path / "opt.fits"
+    _write_events(ev, rng, n=400)
+    par = tmp_path / "opt.par"
+    par.write_text(PAR.replace(f"F0             {F0}",
+                               f"F0             {F0}  1"))
+    tpl = tmp_path / "template.gauss"
+    tpl.write_text("# phase width amplitude\n0.3 0.04 0.7\n")
+    outpar = tmp_path / "post.par"
+    rc = event_optimize.main([str(ev), str(par), str(tpl), "--mission",
+                              "nicer", "--nsteps", "120", "--outpar",
+                              str(outpar)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Htest post-fit" in text
+    assert outpar.exists()
+    post = get_model(outpar.read_text())
+    assert abs(post["F0"].value_f64 - F0) < 1e-6

@@ -1,0 +1,124 @@
+"""Golden-value tests against numbers produced OUTSIDE this repo.
+
+VERDICT round-1 task 5: every other test is self-consistency
+(simulate -> perturb -> fit), which cannot catch a shared systematic.
+These pin the foundation layers to independently published values:
+
+* SOFA/ERFA test vectors (``t_erfa_c.c`` of the ERFA distribution):
+  exact arguments and expected outputs of ``eraDtdb``, ``eraGmst82``
+  and ``eraEpv00`` — the C library PINT itself uses underneath
+  astropy.time (reference: src/pint/toa.py compute_TDBs / astropy).
+* Published post-Keplerian measurements of the Hulse-Taylor binary
+  B1913+16 (Weisberg, Nice & Taylor 2010, ApJ 722, 1030) and the
+  double pulsar J0737-3039A (Kramer et al. 2006, Science 314, 97),
+  against the GR expressions DDGR derives from the masses
+  (reference: src/pint/models/binary_ddgr / DDGRmodel).
+
+Tolerances are set to the *documented accuracy of our implementation*
+(truncated FB1990 series, analytic ephemeris), not to float noise —
+the point is catching sign/convention/constant errors, which show up
+orders of magnitude above these bands.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from pint_tpu.constants import AU_LIGHT_S, SECS_PER_DAY
+from pint_tpu.earth import gmst_rad
+from pint_tpu.ephemeris import AnalyticEphemeris
+from pint_tpu.models import get_model
+from pint_tpu.ops import dd
+from pint_tpu.ops.timescales import tdb_minus_tt
+
+BASE = """
+PSRJ           B1913+16
+RAJ            19:15:27.99  1
+DECJ           16:06:27.4  1
+F0             16.940537  1
+PEPOCH        52144.0
+DM             168.77
+EPHEM          DE421
+UNITS          TDB
+"""
+
+
+def test_erfa_dtdb_vector():
+    """eraDtdb(2448939.5, 0.123, 0, 0, 0, 0) = -0.1280368005936998991e-2 s.
+
+    (ERFA t_erfa_c.c.) Our FB1990 truncation is documented good to
+    ~50 ns geocentric; assert well inside the 1.7 ms signal but outside
+    any plausible truncation error.
+    """
+    t = dd.from_strings(["48939.123"])  # MJD(TT) = JD 2448939.5 + 0.123
+    val = float(np.asarray(tdb_minus_tt(t)).reshape(-1)[0])
+    assert abs(val - (-1.280368005936999e-3)) < 1e-6
+
+
+def test_erfa_gmst82_vector():
+    """eraGmst82(2400000.5, 53736.0) = 1.754174981860675096 rad.
+
+    (ERFA t_erfa_c.c.) gmst_rad implements the same IAU 1982 polynomial,
+    so agreement should be at float64 rounding level.
+    """
+    val = float(np.asarray(gmst_rad(jnp.asarray(53736.0))))
+    assert abs(val - 1.754174981860675096) < 5e-9
+
+
+def test_erfa_epv00_earth_barycentric():
+    """eraEpv00(2400000.5, 53411.52501161): Earth SSB posvel (t_erfa_c.c).
+
+    pvb = (-0.7714104440491, 0.5598412061824, 0.2425996277722) au,
+          (-1.0918742681168e-2, -1.2465254617329e-2, -5.4047731809662e-3)
+          au/day. The built-in analytic ephemeris is documented to
+    arcsecond-level (~1e-4 au) accuracy — assert within 1e-3 au / 2e-5
+    au/day, far below the 1 au / 1.7e-2 au/day signal: catches frame,
+    phase, sign and constant errors.
+    """
+    eph = AnalyticEphemeris()
+    pos_ls, vel_lss = eph.earth_posvel_ssb(np.asarray([53411.52501161]))
+    pos_au = np.asarray(pos_ls)[0] / AU_LIGHT_S
+    vel_aud = np.asarray(vel_lss)[0] / AU_LIGHT_S * SECS_PER_DAY
+    want_pos = np.array([-0.7714104440491, 0.5598412061824, 0.2425996277722])
+    want_vel = np.array([-1.0918742681168e-2, -1.2465254617329e-2,
+                         -5.4047731809662e-3])
+    np.testing.assert_allclose(pos_au, want_pos, atol=1e-3)
+    np.testing.assert_allclose(vel_aud, want_vel, atol=2e-5)
+
+
+def _pk(par_extra: str) -> dict:
+    m = get_model(BASE + par_extra)
+    comp = m.get_component("BinaryDDGR")
+    return {k: float(np.asarray(v))
+            for k, v in comp.pk_params(m.base_dd(), None, None).items()}
+
+
+def test_ddgr_hulse_taylor_omdot_gamma():
+    """B1913+16: OMDOT = 4.226598 deg/yr, GAMMA = 4.2992 ms (WNT 2010)."""
+    pk = _pk("""
+BINARY         DDGR
+PB             0.322997448918
+A1             2.341776
+T0             52144.90097844
+ECC            0.6171340
+OM             292.54450
+M2             1.3886
+MTOT           2.828378
+""")
+    assert abs(pk["omdot"] - 4.226598) < 2e-3
+    assert abs(pk["gamma"] - 4.2992e-3) < 2e-5
+
+
+def test_ddgr_double_pulsar_omdot_gamma():
+    """J0737-3039A: OMDOT = 16.8995 deg/yr, GAMMA = 0.3856 ms (Kramer+06)."""
+    pk = _pk("""
+BINARY         DDGR
+PB             0.10225156248
+A1             1.415032
+T0             53155.9074280
+ECC            0.0877775
+OM             87.0331
+M2             1.2489
+MTOT           2.58708
+""")
+    assert abs(pk["omdot"] - 16.8995) < 0.01
+    assert abs(pk["gamma"] - 0.3856e-3) < 2e-6

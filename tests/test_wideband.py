@@ -140,3 +140,34 @@ def test_traced_toas_with_selector_components():
     deltas, info = step(model.base_dd(), model.zero_deltas(), toas)
     assert np.isfinite(float(info["chi2"]))
     assert all(np.isfinite(np.asarray(v)) for v in deltas.values())
+
+
+def test_dmjump_recovered_in_wideband_fit():
+    """DMJUMP (DispersionJump) shifts masked model-DM; the wideband fit
+    recovers an injected per-band DM offset. Reference:
+    pint.models.jump.DispersionJump."""
+    model = get_model(PAR)
+    toas = make_fake_toas_uniform(54000, 56000, 120, model, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 800.0]),
+                                  error_us=1.0, add_noise=True, seed=21)
+    rng = np.random.default_rng(22)
+    toas = _add_dm_data(toas, model, rng)
+    # inject a +5e-3 DM offset into the measured DMs of the 800 MHz band
+    inj = 5e-3
+    f = np.asarray(toas.freq_mhz)
+    flags = Flags(
+        dict(d, pp_dm=str(float(d["pp_dm"]) + (inj if fi < 1000 else 0.0)))
+        for d, fi in zip(toas.flags, f))
+    toas = dataclasses.replace(toas, flags=flags)
+
+    m_fit = get_model(PAR + "DMJUMP FREQ 300 1000 0.0 1\n")
+    assert m_fit.has_component("DispersionJump")
+    assert "DMJUMP1" in m_fit.free_params
+    fitter = WidebandTOAFitter(toas, m_fit)
+    fitter.fit_toas(maxiter=3)
+    # model dm_value shifts by -DMJUMP on the masked band, so the fitted
+    # value should equal -inj
+    fitted = m_fit["DMJUMP1"].value_f64
+    unc = m_fit["DMJUMP1"].uncertainty
+    assert abs(fitted - (-inj)) < 5 * unc
+    assert unc < abs(inj)
