@@ -52,6 +52,9 @@ import jax.numpy as jnp  # noqa: E402
 
 N_DEFAULT = 100_000
 INIT_TIMEOUT_S = int(os.environ.get("PINT_TPU_BENCH_INIT_TIMEOUT", "300"))
+# the tunnel can also hang mid-compile/mid-execute (observed), not just
+# at init: a whole-run alarm converts that into a diagnostic JSON too
+TOTAL_TIMEOUT_S = int(os.environ.get("PINT_TPU_BENCH_TOTAL_TIMEOUT", "1200"))
 
 PAR = """
 PSRJ           J1748-2021E
@@ -86,11 +89,11 @@ def _init_backend() -> list:
         raise TimeoutError(f"backend init exceeded {INIT_TIMEOUT_S}s")
 
     old = signal.signal(signal.SIGALRM, _timeout)
-    signal.alarm(INIT_TIMEOUT_S)
+    remaining = signal.alarm(INIT_TIMEOUT_S)  # pause the whole-run alarm
     try:
         return jax.devices()
     finally:
-        signal.alarm(0)
+        signal.alarm(max(1, remaining) if remaining else 0)
         signal.signal(signal.SIGALRM, old)
 
 
@@ -165,7 +168,87 @@ def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
+                 backend: str, device: str, dd_ok_accel: bool) -> None:
+    """GLS iteration with the CPU-DD -> accelerator-solve split.
+
+    The numerically valid TPU configuration (see pint_tpu.ops.dd): the
+    primary value is the END-TO-END iteration wall clock — CPU stage 1
+    (DD phase + jacfwd design), host->device transfer, accelerator
+    stage 2 (seg-GLS solve) — with the stage breakdown recorded.
+    """
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.hybrid import HybridGLSFitter, cpu_device
+    from pint_tpu.ops import dd as dd_mod
+
+    dd_ok_cpu = bool(dd_mod.self_check(cpu_device()))
+    model, toas = build_problem(n)
+    f = HybridGLSFitter(toas, model)
+    base = jax.device_put(model.base_dd(), f.cpu)
+    deltas = {k: jnp.zeros((), jnp.float64) for k in f._names}
+
+    t0 = time.perf_counter()
+    _, sol = f._iterate(base, deltas)
+    jax.block_until_ready(sol["chi2"])
+    compile_s = time.perf_counter() - t0
+
+    # the O(n q^2) Gram runs on the chip; the tiny (q, q) finalize runs
+    # on the CPU by construction (covariance entries underflow the
+    # chip's f32-range f64 emulation — see HybridGLSFitter)
+    mode = "hybrid_cpu_dd_accel_gram_cpu_finalize"
+
+    times, s1_times = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s1 = f._stage1(base, deltas)
+        jax.block_until_ready(s1)
+        s1_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _, sol = f._iterate(base, deltas)
+        jax.block_until_ready(sol["chi2"])
+        times.append(time.perf_counter() - t0)
+    value = float(np.median(times))
+    chi2 = float(np.asarray(sol["chi2"]))
+    stage1_s = float(np.median(s1_times))
+
+    _emit({
+        "metric": metric,
+        "value": round(value, 6),
+        "unit": "s",
+        "vs_baseline": round(budget_s / value, 3),
+        "backend": backend,
+        "device": device,
+        "mode": mode,
+        "dd_self_check": dd_ok_cpu,  # the device DD actually runs on
+        "dd_self_check_accel": dd_ok_accel,
+        "stage1_cpu_s": round(stage1_s, 6),
+        "stage2_accel_s": round(max(value - stage1_s, 0.0), 6),
+        "design_matrix_ms_per_toa": round(stage1_s * 1e3 / n, 6),
+        "n_ecorr_epochs": int(np.asarray(f.noise.ecorr_phi).size),
+        "n_rednoise_harmonics": 30,
+        "compile_s": round(compile_s, 3),
+        "chi2": round(chi2, 3),
+    })
+
+
 def main() -> None:
+    def _total_timeout(signum, frame):
+        raise TimeoutError(f"bench exceeded {TOTAL_TIMEOUT_S}s "
+                           "(backend hang mid-compile/execute?)")
+
+    signal.signal(signal.SIGALRM, _total_timeout)
+    signal.alarm(TOTAL_TIMEOUT_S)
+    try:
+        _main_guarded()
+    except TimeoutError as e:
+        _emit({"metric": "gls_fit_iter_wall", "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": str(e)})
+    finally:
+        signal.alarm(0)
+
+
+def _main_guarded() -> None:
     n = int(os.environ.get("PINT_TPU_BENCH_N", str(N_DEFAULT)))
     reps = int(os.environ.get("PINT_TPU_BENCH_REPS", "5"))
     if os.environ.get("PINT_TPU_BENCH_MODE", "gls") == "pta":
@@ -196,6 +279,15 @@ def main() -> None:
         from pint_tpu.ops import dd as dd_mod
 
         dd_ok = bool(dd_mod.self_check())
+        # DD arithmetic needs IEEE-exact f64 (error-free transforms). If
+        # the accelerator fails the self-check (TPU v5e does — measured),
+        # the valid configuration is the hybrid split: DD phase/design on
+        # the CPU backend, GLS linear algebra on the chip
+        # (pint_tpu.fitting.hybrid; see pint_tpu.ops.dd docstring).
+        hybrid = (not dd_ok) and backend != "cpu"
+        if hybrid:
+            bench_hybrid(n, reps, metric, budget_s, backend, device, dd_ok)
+            return
 
         from pint_tpu.fitting.gls_step import (build_noise_statics,
                                                make_gls_step)
@@ -213,6 +305,13 @@ def main() -> None:
         compile_s = time.perf_counter() - t0
 
         times = []
+        # optional XLA trace for the timed region (SURVEY §5 tracing row):
+        # view with tensorboard/xprof. One rep under the profiler.
+        profile_dir = os.environ.get("PINT_TPU_BENCH_PROFILE", "")
+        if profile_dir:
+            with jax.profiler.trace(profile_dir):
+                out = step(base, deltas, toas, noise)
+                jax.block_until_ready(out)
         for _ in range(reps):
             t0 = time.perf_counter()
             out = step(base, deltas, toas, noise)

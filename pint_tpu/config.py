@@ -1,0 +1,72 @@
+"""Runtime configuration + bundled-data locator.
+
+Reference equivalents: ``pint.config`` (runtimefile locator for
+src/pint/data/runtime) and the reference's scattered environment
+switches (clock-file policies etc.). All knobs live in one dataclass
+read from the environment once, overridable programmatically:
+
+* ``PINT_TPU_EPHEM_DIR``     — directory searched for ``deNNN.bsp`` kernels
+* ``PINT_TPU_STRICT_EPHEM``  — refuse the analytic-ephemeris fallback
+* ``PINT_TPU_CLOCK_DIR``     — directory of tempo/tempo2 clock files to
+  auto-register at first use
+* ``PINT_TPU_CACHE_DIR``     — TOA pickle-cache location (defaults beside
+  the tim file)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class Config:
+    ephem_dir: str | None = None
+    strict_ephem: bool = False
+    clock_dir: str | None = None
+    cache_dir: str | None = None
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            ephem_dir=os.environ.get("PINT_TPU_EPHEM_DIR") or None,
+            strict_ephem=bool(os.environ.get("PINT_TPU_STRICT_EPHEM")),
+            clock_dir=os.environ.get("PINT_TPU_CLOCK_DIR") or None,
+            cache_dir=os.environ.get("PINT_TPU_CACHE_DIR") or None,
+        )
+
+
+_override: Config | None = None
+
+
+def set_config(cfg: Config | None) -> None:
+    """Install a programmatic override (None restores env-driven config)."""
+    global _override
+    _override = cfg
+
+
+def get_config(refresh: bool = False) -> Config:
+    """Current config: the programmatic override if set, else the env.
+
+    Env reads are cheap, so without an override every call reflects the
+    live environment (tests monkeypatch env vars freely). ``refresh`` is
+    accepted for API compatibility; it additionally clears an override.
+    """
+    global _override
+    if refresh:
+        _override = None
+    return _override if _override is not None else Config.from_env()
+
+
+def runtimefile(name: str) -> str:
+    """Absolute path of a bundled runtime data file.
+
+    Reference: pint.config.runtimefile — locates files shipped inside
+    the package (here ``pint_tpu/data``). Raises FileNotFoundError with
+    the searched path if absent.
+    """
+    base = os.path.join(os.path.dirname(__file__), "data")
+    path = os.path.join(base, name)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no bundled runtime file {name!r} in {base}")
+    return path

@@ -308,8 +308,11 @@ def get_ephemeris(name: str = "builtin_analytic", **kwargs) -> Ephemeris:
         import logging
         import os
 
-        # real kernel if available: $PINT_TPU_EPHEM_DIR/<name>.bsp or ./<name>.bsp
-        for d in (os.environ.get("PINT_TPU_EPHEM_DIR"), "."):
+        from pint_tpu.config import get_config
+
+        cfg = get_config()
+        # real kernel if available: <config.ephem_dir>/<name>.bsp or ./<name>.bsp
+        for d in (cfg.ephem_dir, "."):
             if not d:
                 continue
             path = os.path.join(d, f"{name.lower()}.bsp")
@@ -317,7 +320,7 @@ def get_ephemeris(name: str = "builtin_analytic", **kwargs) -> Ephemeris:
                 from pint_tpu.io.bsp import SPKEphemeris
 
                 return SPKEphemeris(path, name=name.upper())
-        if os.environ.get("PINT_TPU_STRICT_EPHEM", ""):
+        if cfg.strict_ephem:
             raise FileNotFoundError(
                 f"JPL ephemeris {name} requested but no {name.lower()}.bsp "
                 "found (PINT_TPU_EPHEM_DIR) and PINT_TPU_STRICT_EPHEM is set; "

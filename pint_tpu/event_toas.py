@@ -27,18 +27,17 @@ import numpy as np
 import jax.numpy as jnp
 
 from pint_tpu.io.fits import read_fits
+from pint_tpu.constants import SECS_PER_DAY
 from pint_tpu.ops import dd, timescales as ts
 from pint_tpu.toas import TOAs, build_TOAs_from_arrays
 
-SECS_PER_DAY = 86400.0
-
-# mission -> (extension name, energy column, energy unit scale to keV)
+# mission -> (extension name, energy column, column-unit -> keV multiplier)
 MISSIONS = {
-    "fermi": ("EVENTS", "ENERGY", 1e-3),  # MeV -> keV... (doc only)
-    "nicer": ("EVENTS", "PI", 0.01),
+    "fermi": ("EVENTS", "ENERGY", 1e3),  # FT1 ENERGY is MeV
+    "nicer": ("EVENTS", "PI", 0.01),  # PI channel = 10 eV
     "nustar": ("EVENTS", "PI", 0.04),
     "rxte": ("XTE_SE", "PHA", 1.0),
-    "xmm": ("EVENTS", "PI", 1e-3),
+    "xmm": ("EVENTS", "PI", 1e-3),  # PI channel = 1 eV
     "generic": ("EVENTS", "PI", 1.0),
 }
 
@@ -101,7 +100,12 @@ def load_event_TOAs(eventfile: str, mission: str = "generic", *,
 
     met = np.asarray(tab["TIME"], dtype=np.float64)
     keep = np.ones(met.size, dtype=bool)
-    if energy_range_kev is not None and energy_col in tab:
+    if energy_range_kev is not None:
+        if energy_col not in tab:
+            raise ValueError(
+                f"energy cut requested but the {mission} energy column "
+                f"{energy_col!r} is not in the event table "
+                f"(columns: {sorted(tab.columns)})")
         e = np.asarray(tab[energy_col], dtype=np.float64) * _scale
         keep &= (e >= energy_range_kev[0]) & (e <= energy_range_kev[1])
     weights = None

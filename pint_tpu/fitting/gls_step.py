@@ -162,16 +162,18 @@ def pl_bases(toas, specs: tuple[PLSpec, ...], pl_params: Array
     return jnp.concatenate(blocks, axis=1), jnp.concatenate(phis)
 
 
-def gls_solve_seg(M: Array, r: Array, sigma: Array,
-                  F: Array | None, phi_F: Array | None,
-                  epoch_idx: Array, phi_e: Array) -> dict:
-    """Extended-normal-equation GLS with the ECORR block eliminated.
+def gls_gram_seg(M: Array, r: Array, sigma: Array,
+                 F: Array | None, phi_F: Array | None,
+                 epoch_idx: Array, phi_e: Array) -> dict:
+    """The O(n)/O(ne) reduction of the seg-GLS solve.
 
-    M: (n, p) timing design matrix; F/phi_F: stacked Fourier noise block
-    and its priors (or None); epoch_idx/phi_e: ECORR epoch assignment
-    (idx == ne means "no epoch"). All n-axis inputs may be sharded; the
-    output is replicated. Matches ``pint_tpu.fitting.gls.gls_solve`` to
-    float64 roundoff (tests/test_sharded_gls.py).
+    Everything that touches the (sharded) TOA axis: whitened Gram
+    matrix, ECORR segment sums, Schur elimination of the diagonal epoch
+    block. Returns the small Schur system plus the pieces
+    :func:`gls_finalize_seg` needs — S/rhs are (q, q)/(q,), C is
+    (ne, q). Split out so the hybrid fitter can run this part on the
+    accelerator and the (tiny) Cholesky finalize wherever it is
+    numerically safe.
     """
     p = M.shape[1]
     if F is not None:
@@ -200,8 +202,24 @@ def gls_solve_seg(M: Array, r: Array, sigma: Array,
         S = G_BB - C.T @ (C / d[:, None])
         rhs = c_B - C.T @ (c_e / d)
     else:
+        d = jnp.ones(0)
+        C = jnp.zeros((0, q))
+        c_e = jnp.zeros(0)
         S, rhs = G_BB, c_B
+    return {"S": S, "rhs": rhs, "c_B": c_B, "norm": norm,
+            "quad0": jnp.sum(jnp.square(r) * w), "C": C, "c_e": c_e, "d": d}
 
+
+def gls_finalize_seg(parts: dict, p: int) -> dict:
+    """Cholesky of the (q, q) Schur system + covariance/chi2 assembly.
+
+    ``p`` (static) is the timing-parameter count — the first p columns
+    of the extended system. Jittable; O(q^3) + O(ne q) — negligible next
+    to the Gram reduction, so it can run on whichever device has
+    trustworthy f64 Cholesky.
+    """
+    S, rhs, norm = parts["S"], parts["rhs"], parts["norm"]
+    q = S.shape[0]
     S = S + jnp.eye(q) * (jnp.finfo(jnp.float64).eps * jnp.trace(S))
     cf = jax.scipy.linalg.cho_factor(S, lower=True)
     xB = jax.scipy.linalg.cho_solve(cf, rhs)
@@ -209,14 +227,88 @@ def gls_solve_seg(M: Array, r: Array, sigma: Array,
 
     x = xB / norm
     cov = Sigma / jnp.outer(norm, norm)
-    chi2 = jnp.sum(jnp.square(r) * w) - c_B @ xB
-    if ne > 0:
-        x_e = (c_e - C @ xB) / d
-        chi2 = chi2 - c_e @ x_e
+    chi2 = parts["quad0"] - parts["c_B"] @ xB
+    if parts["d"].shape[0] > 0:
+        x_e = (parts["c_e"] - parts["C"] @ xB) / parts["d"]
+        chi2 = chi2 - parts["c_e"] @ x_e
     else:
         x_e = jnp.zeros(0)
     return {"x": x[:p], "cov": cov[:p, :p], "chi2": chi2,
             "fourier_coeffs": x[p:], "ecorr_coeffs": x_e}
+
+
+def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
+                      F: Array | None, phi_F: Array | None,
+                      epoch_idx: Array, phi_e: Array) -> dict:
+    """Gram reduction from pre-whitened inputs, range-safe for TPU f64.
+
+    The TPU's emulated float64 carries float32 *dynamic range* (measured:
+    ``sum(M^2 w)`` at ~1e40 overflows to inf/NaN for spin-derivative
+    design columns). This variant therefore takes the whitening done on
+    the CPU — ``A_M = M sqrt(w) / ||M sqrt(w)||`` (unit columns),
+    ``rw = r sqrt(w)``, ``sw = sqrt(w)`` — and keeps every on-chip
+    intermediate below ~1e17. Algebraically identical to
+    :func:`gls_gram_seg`; composed with the same
+    :func:`gls_finalize_seg`.
+    """
+    p = A_M.shape[1]
+    if F is not None:
+        Fw = F * sw[:, None]
+        norm_F = jnp.sqrt(jnp.sum(jnp.square(Fw), axis=0))
+        norm_F = jnp.where(norm_F == 0.0, 1.0, norm_F)
+        A = jnp.concatenate([A_M, Fw / norm_F], axis=1)
+        norm = jnp.concatenate([norm_M, norm_F])
+        # floor keeps 1/phi inside the f32 exponent range; 1e-36 s^2 is
+        # 1e-18 s rms — physically nothing. The prior diagonal is built
+        # from norm_F ONLY and by sequential division: norm_M can be
+        # ~1e21+ (spin-derivative columns) and squaring it overflows the
+        # chip's f32-range f64 (timing columns carry no prior anyway).
+        phiinv = 1.0 / jnp.maximum(phi_F, 1e-36)
+        diag_prior = jnp.concatenate(
+            [jnp.zeros(p), phiinv / norm_F / norm_F])
+    else:
+        A = A_M
+        norm = norm_M
+        diag_prior = jnp.zeros(p)
+    q = A.shape[1]
+
+    G_BB = A.T @ A + jnp.diag(diag_prior)
+    c_B = A.T @ rw
+
+    ne = phi_e.shape[0]
+    if ne > 0:
+        def seg(x):
+            return jax.ops.segment_sum(x, epoch_idx, num_segments=ne + 1)[:ne]
+
+        d = seg(jnp.square(sw)) + 1.0 / phi_e
+        C = seg(A * sw[:, None])
+        c_e = seg(rw * sw)
+        S = G_BB - C.T @ (C / d[:, None])
+        rhs = c_B - C.T @ (c_e / d)
+    else:
+        d = jnp.ones(0)
+        C = jnp.zeros((0, q))
+        c_e = jnp.zeros(0)
+        S, rhs = G_BB, c_B
+    return {"S": S, "rhs": rhs, "c_B": c_B, "norm": norm,
+            "quad0": jnp.sum(jnp.square(rw)), "C": C, "c_e": c_e, "d": d}
+
+
+def gls_solve_seg(M: Array, r: Array, sigma: Array,
+                  F: Array | None, phi_F: Array | None,
+                  epoch_idx: Array, phi_e: Array) -> dict:
+    """Extended-normal-equation GLS with the ECORR block eliminated.
+
+    M: (n, p) timing design matrix; F/phi_F: stacked Fourier noise block
+    and its priors (or None); epoch_idx/phi_e: ECORR epoch assignment
+    (idx == ne means "no epoch"). All n-axis inputs may be sharded; the
+    output is replicated. Matches ``pint_tpu.fitting.gls.gls_solve`` to
+    float64 roundoff (tests/test_sharded_gls.py). Composed from
+    :func:`gls_gram_seg` + :func:`gls_finalize_seg` (XLA fuses them
+    when jitted together).
+    """
+    return gls_finalize_seg(gls_gram_seg(M, r, sigma, F, phi_F,
+                                         epoch_idx, phi_e), M.shape[1])
 
 
 def make_gls_step(model, tzr=None, *, abs_phase: bool = True,

@@ -30,13 +30,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.constants import C_M_S
+from pint_tpu.constants import C_M_S, SECS_PER_DAY
 
 Array = jax.Array
 RECLEN = 1024
 C_KM_S = C_M_S / 1000.0
 ET_J2000_MJD = 51544.5
-DAY_S = 86400.0
+DAY_S = SECS_PER_DAY
 
 # NAIF integer codes used by DE kernels
 NAIF = {
@@ -218,8 +218,16 @@ def spk_to_tabulated(path: str, start_mjd: float, end_mjd: float,
     from pint_tpu.ephemeris import TabulatedEphemeris
 
     eph = SPKEphemeris(path)
+    kbeg = ET_J2000_MJD + eph.et_beg / DAY_S
+    kend = ET_J2000_MJD + eph.et_end / DAY_S
+    # the Hermite table needs one node past end_mjd; stay inside coverage
+    if start_mjd < kbeg or end_mjd + dt_days > kend:
+        raise ValueError(
+            f"requested table [{start_mjd}, {end_mjd}] (+1 bracket step) "
+            f"exceeds kernel coverage [{kbeg:.1f}, {kend:.1f}] MJD")
     n = int(np.ceil((end_mjd - start_mjd) / dt_days)) + 2
     t = start_mjd + dt_days * np.arange(n)
+    t = t[t <= kend]
     tables = {}
     for b in bodies:
         try:

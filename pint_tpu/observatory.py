@@ -82,6 +82,38 @@ def register_clock(obs_name: str, clock_files: list[ClockFile]) -> None:
     _CLOCKS[get_observatory(obs_name).name.lower()] = clock_files
 
 
+def _discover_clock_chain(name: str):
+    """Auto-register a chain from $PINT_TPU_CLOCK_DIR, once per site.
+
+    Conventions searched (the IPTA clock-repo layouts the reference
+    downloads into its cache): tempo2 ``<name>2gps.clk`` (+
+    ``gps2utc.clk`` if present) or tempo ``time_<name>.dat``. Returns
+    the chain, or None if the env var is unset / no file matches.
+    """
+    import os
+
+    from pint_tpu.config import get_config
+
+    clock_dir = get_config().clock_dir
+    if not clock_dir:
+        return None
+    chain: list[ClockFile] = []
+    t2 = os.path.join(clock_dir, f"{name}2gps.clk")
+    t1 = os.path.join(clock_dir, f"time_{name}.dat")
+    if os.path.isfile(t2):
+        chain.append(ClockFile.read_tempo2(t2))
+        gps = os.path.join(clock_dir, "gps2utc.clk")
+        if os.path.isfile(gps):
+            chain.append(ClockFile.read_tempo2(gps))
+    elif os.path.isfile(t1):
+        chain.append(ClockFile.read_tempo(t1))
+    if not chain:
+        return None
+    log.info("auto-registered clock chain for %s from %s", name, clock_dir)
+    _CLOCKS[name] = chain
+    return chain
+
+
 def clock_corrections_s(obs_name: str, mjd_utc: np.ndarray, *, limits: str = "warn") -> np.ndarray:
     """Total clock correction to add to site TOAs [s] at the given MJDs.
 
@@ -91,12 +123,15 @@ def clock_corrections_s(obs_name: str, mjd_utc: np.ndarray, *, limits: str = "wa
     """
     obs = get_observatory(obs_name)
     chain = _CLOCKS.get(obs.name.lower())
+    if chain is None and not obs.is_special:
+        chain = _discover_clock_chain(obs.name.lower())
     mjd_utc = np.asarray(mjd_utc, np.float64)
     if chain is None:
         if not obs.is_special:
             log.warning(
                 "no clock chain registered for %s; assuming perfect site clock "
-                "(offline default — register files via register_clock)",
+                "(offline default — register files via register_clock or set "
+                "PINT_TPU_CLOCK_DIR)",
                 obs.name,
             )
         return np.zeros_like(mjd_utc)

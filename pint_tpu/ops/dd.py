@@ -21,15 +21,16 @@ TwoProd), which require IEEE-754 correctly-rounded float64 add/sub/mul.
 
    * XLA **CPU** passes: bit-identical to numpy IEEE float64 (verified in
      ``tests/test_dd.py``; the test suite pins this backend).
-   * XLA **TPU** emulates float64; whether its add/mul are correctly
-     rounded must be read off the recorded ``dd_self_check`` for that
-     hardware (the one-chip sandbox backend has not initialized in any
-     session so far — see BENCH_r0*.json). If a backend ever fails the
-     check, keep the DD phase pipeline on CPU and offload only the
-     collapsed-float64 linear algebra (design matrix / GLS solve — errors
-     there are multiplied by small parameter deltas):
-     ``GLSFitter(..., solve_device=jax.devices('tpu')[0])`` implements
-     exactly that split.
+   * XLA **TPU** emulates float64 and **fails the check on TPU v5e**
+     (measured: ``dd_self_check: false`` in BENCH_r02; DD phase evaluated
+     there yields NaN chi2). Consequence: the DD phase pipeline must stay
+     on the CPU backend, with only the collapsed-float64 linear algebra
+     (design matrix / GLS solve — errors there multiply small parameter
+     deltas) offloaded to the chip. Two implementations of that split:
+     ``pint_tpu.fitting.hybrid.HybridGLSFitter`` (CPU stage-1 phase/
+     design -> accelerator stage-2 seg-GLS solve; used by bench.py) and
+     ``GLSFitter(..., solve_device=jax.devices('tpu')[0])`` (dense-basis
+     variant).
 
 All functions are shape-polymorphic, jit-safe, and vmap-safe; ``DD`` is a
 NamedTuple and hence a pytree.

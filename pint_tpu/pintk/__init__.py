@@ -1,0 +1,38 @@
+"""pintk: interactive timing GUI (reference: src/pint/pintk/).
+
+The reference's Tk application splits into plk (residual plot panel),
+paredit/timedit (model/TOA editors) and a Tk shell. Here the same
+surface is two layers:
+
+* :mod:`pint_tpu.pintk.controller` — a headless state machine holding
+  (TOAs, model, fits, selection, random-model draws). Every GUI action
+  is a plain method, unit-testable without a display, and all numerics
+  go through the same jitted fitters the CLI uses.
+* :mod:`pint_tpu.pintk.app` — the thin Tk + matplotlib view binding
+  buttons/clicks to controller calls.
+
+Run via the ``pintk`` console script.
+"""
+
+from pint_tpu.pintk.controller import PintkController  # noqa: F401
+
+
+def main(argv=None) -> int:
+    """Console entry point: ``pintk par tim``."""
+    import argparse
+
+    from pint_tpu import logging as pint_logging
+
+    parser = argparse.ArgumentParser(
+        prog="pintk", description="Interactive pulsar-timing GUI")
+    parser.add_argument("parfile")
+    parser.add_argument("timfile")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    pint_logging.setup(args.log_level)
+
+    from pint_tpu.models import get_model_and_toas
+    from pint_tpu.pintk.app import run_app
+
+    model, toas = get_model_and_toas(args.parfile, args.timfile)
+    return run_app(PintkController(toas, model))

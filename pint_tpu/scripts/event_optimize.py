@@ -70,11 +70,14 @@ def main(argv=None) -> int:
     if not model.free_params:
         raise SystemExit("no free parameters in the par file")
 
-    h0, _ = h_test(photon_phases(model, toas))
+    from pint_tpu.event_toas import get_photon_weights
+
+    weights = get_photon_weights(toas)
+    h0, _ = h_test(photon_phases(model, toas), weights)
     fitter = EventFitter(toas, model, template)
     best = fitter.fit_toas(args.nsteps, nwalkers=args.nwalkers,
                            seed=args.seed, burn_frac=args.burnfrac)
-    h1, p1 = h_test(photon_phases(model, toas))
+    h1, p1 = h_test(photon_phases(model, toas), weights)
     print(f"Photons: {len(toas)}   walkers x steps: "
           f"{fitter.chain.shape[0] // max(1, args.nsteps - int(args.nsteps * args.burnfrac))} x {args.nsteps}")
     print(f"log-posterior (best): {best:.3f}")

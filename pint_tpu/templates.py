@@ -28,12 +28,21 @@ _WRAPS = jnp.arange(-3.0, 4.0)  # alias sum covers widths up to ~0.3 cycles
 
 
 def wrapped_gaussian_pdf(phases: Array, loc: Array, width: Array) -> Array:
-    """Periodic (wrapped) normal density on [0, 1)."""
-    d = phases[..., None] - loc - _WRAPS[None, :] if np.ndim(loc) == 0 else \
-        phases[..., None, None] - loc[None, :, None] - _WRAPS[None, None, :]
-    z = d / width if np.ndim(loc) == 0 else d / width[None, :, None]
-    g = jnp.exp(-0.5 * jnp.square(z)) / (width * jnp.sqrt(2.0 * jnp.pi))
-    return jnp.sum(g, axis=-1)
+    """Periodic (wrapped) normal density on [0, 1).
+
+    Returns shape ``phases.shape + loc.shape`` for 1-D ``loc``/``width``
+    (one density column per component), or ``phases.shape`` for scalars.
+    """
+    scalar = np.ndim(loc) == 0
+    loc = jnp.atleast_1d(loc)
+    width = jnp.atleast_1d(width)
+    # (..., k, wraps): alias sum over the wrap axis, per component
+    d = phases[..., None, None] - loc[:, None] - _WRAPS[None, :]
+    z = d / width[:, None]
+    g = jnp.exp(-0.5 * jnp.square(z)) / (width[:, None]
+                                         * jnp.sqrt(2.0 * jnp.pi))
+    out = jnp.sum(g, axis=-1)
+    return out[..., 0] if scalar else out
 
 
 def template_pdf(params: dict[str, Array], phases: Array) -> Array:

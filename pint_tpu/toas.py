@@ -204,18 +204,44 @@ def get_TOAs(
     planets: bool = True,
     include_clock: bool = True,
     clock_limits: str = "warn",
+    usepickle: bool = False,
 ) -> TOAs:
     """Load a `.tim` file into a fully-corrected TOAs table.
 
     Mirrors reference ``pint.toa.get_TOAs(timfile, ...)`` including the
-    clock chain and posvel computation (src/pint/toa.py).
+    clock chain, posvel computation, and the ``usepickle`` load cache
+    (src/pint/toa.py): with ``usepickle`` the built table is cached as
+    ``<tim>.<ephem>.npz`` (in PINT_TPU_CACHE_DIR if set, else beside the
+    tim file) and reused while newer than the tim file.
     """
+    import os
+
+    cache_path = None
+    if usepickle and isinstance(timfile, str) and os.path.isfile(timfile):
+        from pint_tpu.config import get_config
+
+        ename = ephem if isinstance(ephem, str) else getattr(ephem, "name", "eph")
+        cdir = get_config().cache_dir or os.path.dirname(os.path.abspath(timfile))
+        os.makedirs(cdir, exist_ok=True)
+        # every value-affecting option is part of the key: a cache built
+        # with clock corrections must not serve an include_clock=False call
+        cache_path = os.path.join(
+            cdir, f"{os.path.basename(timfile)}.{ename}"
+                  f".p{int(planets)}c{int(include_clock)}.npz")
+        if (os.path.isfile(cache_path)
+                and os.path.getmtime(cache_path) > os.path.getmtime(timfile)):
+            return load_pickle(cache_path)
+
     tf = parse_timfile(timfile) if isinstance(timfile, str) else timfile
     if not tf.toas:
         raise ValueError("tim file contains no TOAs")
     eph = get_ephemeris(ephem) if isinstance(ephem, str) else ephem
-    return build_TOAs_from_raw(tf, eph, planets=planets,
-                               include_clock=include_clock, clock_limits=clock_limits)
+    toas = build_TOAs_from_raw(tf, eph, planets=planets,
+                               include_clock=include_clock,
+                               clock_limits=clock_limits)
+    if cache_path is not None:
+        save_pickle(toas, cache_path)
+    return toas
 
 
 def build_TOAs_from_raw(

@@ -237,3 +237,38 @@ def test_pulse_number_flag(tmp_path):
     t = get_TOAs(str(p))
     assert float(t.pulse_number[0]) == 12345.0
     assert np.isnan(float(t.pulse_number[1]))
+
+
+def test_clock_dir_auto_discovery(tmp_path, monkeypatch):
+    """PINT_TPU_CLOCK_DIR auto-registers <obs>2gps.clk (+gps2utc.clk)."""
+    (tmp_path / "gbt2gps.clk").write_text(
+        "# UTC(gbt) UTC(gps)\n50000.0 2.0e-6\n60000.0 2.0e-6\n")
+    (tmp_path / "gps2utc.clk").write_text(
+        "# UTC(gps) UTC\n50000.0 1.0e-6\n60000.0 1.0e-6\n")
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+    obs_mod._CLOCKS.pop("gbt", None)
+    try:
+        corr = obs_mod.clock_corrections_s("gbt", np.asarray([55000.0]))
+        assert corr[0] == pytest.approx(3.0e-6)
+    finally:
+        obs_mod._CLOCKS.pop("gbt", None)
+
+
+def test_get_toas_usepickle(tmp_path, monkeypatch):
+    """usepickle caches beside the tim (or in PINT_TPU_CACHE_DIR)."""
+    import os
+
+    p = tmp_path / "c.tim"
+    p.write_text(TIM)
+    cdir = tmp_path / "cache"
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(cdir))
+    t1 = get_TOAs(str(p), usepickle=True)
+    cache = cdir / "c.tim.builtin_analytic.p1c1.npz"
+    assert cache.exists()
+    t2 = get_TOAs(str(p), usepickle=True)  # served from the cache
+    np.testing.assert_array_equal(np.asarray(t1.tdb.hi), np.asarray(t2.tdb.hi))
+    assert len(t2) == len(t1)
+    # stale cache (tim newer) is rebuilt
+    os.utime(p, (os.path.getmtime(p) + 10, os.path.getmtime(p) + 10))
+    t3 = get_TOAs(str(p), usepickle=True)
+    assert len(t3) == len(t1)

@@ -204,3 +204,19 @@ def test_event_optimize_cli(tmp_path, capsys):
     assert outpar.exists()
     post = get_model(outpar.read_text())
     assert abs(post["F0"].value_f64 - F0) < 1e-6
+
+
+def test_multi_component_template():
+    """Two-peak templates must evaluate/normalize (review regression:
+    the wrap-axis broadcast failed for k != 1 components)."""
+    t = LCTemplate(locs=[0.2, 0.6], widths=[0.03, 0.08], norms=[0.4, 0.3])
+    grid = np.linspace(0.0, 1.0, 10001)[:-1]
+    f = t(grid)
+    assert f.shape == grid.shape and np.all(f >= 0)
+    assert np.trapezoid(np.append(f, f[0]),
+                        np.linspace(0, 1, 10001)) == pytest.approx(1.0,
+                                                                   abs=1e-5)
+    # peaks where they were put
+    assert abs(grid[np.argmax(f)] - 0.2) < 0.02
+    ll = t.log_likelihood(np.array([0.2, 0.6, 0.9]))
+    assert np.isfinite(ll)

@@ -1,0 +1,133 @@
+"""pintk controller logic, headless (reference: pint.pintk.pulsar).
+
+The GUI layer (pint_tpu.pintk.app) is a thin Tk binding; everything it
+can do routes through PintkController, which is what these tests drive
+— fit/reset cycles, selection/deletion, fit-flag toggles, random-model
+envelopes, axis data, and par/tim output.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.pintk import PintkController
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+ELL1 = """
+BINARY         ELL1
+PB             0.60467
+A1             0.58182  1
+TASC           53749.92
+EPS1           1.2e-5
+EPS2           -0.5e-5
+"""
+
+
+@pytest.fixture()
+def ctrl():
+    truth = get_model(PAR)
+    toas = make_fake_toas_uniform(53478, 54187, 60, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=2.0, add_noise=True, seed=30)
+    model = get_model(PAR)
+    model["F0"].add_delta(3e-10)
+    return PintkController(toas, model)
+
+
+def test_prefit_then_fit_then_reset(ctrl):
+    y0, e0, lbl0 = ctrl.y_data("prefit")
+    assert y0.shape == (60,) and "prefit" in lbl0
+    with pytest.raises(ValueError, match="fit first"):
+        ctrl.y_data("postfit")
+    info = ctrl.fit()
+    assert info["chi2"] > 0 and info["dof"] > 0
+    y1, _, _ = ctrl.y_data("postfit")
+    # the F0 perturbation is removed by the fit
+    assert np.abs(y1).max() < np.abs(y0).max()
+    assert "chi2" in ctrl.summary()
+    ctrl.reset()
+    assert ctrl.postfit_model is None
+    assert ctrl.model["F0"].value_f64 == ctrl.base_model["F0"].value_f64
+
+
+def test_fit_flags_roundtrip(ctrl):
+    flags = ctrl.fit_flags()
+    assert flags["F0"] and flags["F1"]
+    assert "PEPOCH" not in flags  # epochs are not fittable
+    ctrl.set_fit_flag("F1", False)
+    ctrl.fit()
+    assert "F1" not in ctrl.fitter.fit_params
+    assert "F0" in ctrl.fitter.fit_params
+
+
+def test_selection_and_deletion(ctrl):
+    mjds = ctrl.all_toas.get_mjds()
+    lo, hi = np.quantile(mjds, [0.0, 0.25])
+    n = ctrl.select_range(lo, hi)
+    assert 0 < n < 60
+    remain = ctrl.delete_selected()
+    assert remain == 60 - n
+    x, _ = ctrl.x_data("mjd")
+    assert x.size == remain
+    info = ctrl.fit()  # fit runs on the surviving TOAs
+    assert info["dof"] < 60 - 6
+    ctrl.undelete_all()
+    assert ctrl.n_active == 60
+
+
+def test_random_models_envelope(ctrl):
+    with pytest.raises(ValueError, match="fit first"):
+        ctrl.random_models()
+    ctrl.fit()
+    env = ctrl.random_models(12, seed=4)
+    assert env.shape == (12, ctrl.n_active)
+    assert np.all(np.isfinite(env))
+
+
+def test_x_axes(ctrl):
+    for axis in ("mjd", "serial", "day of year", "frequency"):
+        x, label = ctrl.x_data(axis)
+        assert x.shape == (60,) and label
+    with pytest.raises(ValueError, match="no binary"):
+        ctrl.x_data("orbital phase")
+
+
+def test_orbital_phase_axis():
+    truth = get_model(PAR + ELL1)
+    toas = make_fake_toas_uniform(53478, 53578, 40, truth, obs="gbt",
+                                  freq_mhz=1400.0, error_us=2.0,
+                                  add_noise=True, seed=31)
+    c = PintkController(toas, get_model(PAR + ELL1))
+    x, label = c.x_data("orbital phase")
+    assert label == "Orbital phase"
+    assert np.all((x >= 0) & (x < 1))
+
+
+def test_write_par_tim(ctrl, tmp_path):
+    ctrl.fit()
+    par = tmp_path / "out.par"
+    tim = tmp_path / "out.tim"
+    text = ctrl.write_par(str(par))
+    assert "F0" in text and par.exists()
+    post = get_model(par.read_text())
+    assert abs(post["F0"].value_f64 - 61.485476554) < 1e-8
+    ctrl.write_tim(str(tim))
+    from pint_tpu.toas import get_TOAs
+
+    assert len(get_TOAs(str(tim), ephem="builtin_analytic")) == 60

@@ -183,3 +183,28 @@ def test_sharded_gls_2d_mesh(noise_problem):
     assert np.isfinite(chi2)
     assert (abs(pert_a["F0"].value_f64 - pert_b["F0"].value_f64)
             < 0.01 * pert_a["F0"].uncertainty)
+
+
+def test_hybrid_fitter_matches_gls(noise_problem):
+    """HybridGLSFitter (CPU DD stage -> accelerator solve; both CPU here)
+    must match GLSFitter values/uncertainties. On real TPU hardware the
+    same class keeps DD on the exact CPU backend (pint_tpu.ops.dd)."""
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.fitting.hybrid import (HybridGLSFitter, accelerator_device,
+                                         cpu_device)
+
+    model, toas = noise_problem
+    m_ref = get_model(PAR + NOISE)
+    m_hyb = get_model(PAR + NOISE)
+    f_ref = GLSFitter(toas, m_ref)
+    f_ref.fit_toas(maxiter=2)
+    f_hyb = HybridGLSFitter(toas, m_hyb)
+    chi2 = f_hyb.fit_toas(maxiter=2)
+    assert np.isfinite(chi2)
+    assert cpu_device().platform == "cpu"
+    assert accelerator_device() is not None
+    for name in m_ref.free_params:
+        a, b = m_ref[name], m_hyb[name]
+        assert abs(a.value_f64 - b.value_f64) < 0.02 * a.uncertainty, name
+        np.testing.assert_allclose(b.uncertainty, a.uncertainty, rtol=2e-2,
+                                   err_msg=name)

@@ -184,3 +184,38 @@ def test_calculate_random_models(fitted):
     spread = dphase.std(axis=0)
     assert np.all(np.isfinite(spread))
     assert spread.max() > 0
+
+
+# ----------------------------------------------------- config + fit report
+def test_config_from_env(monkeypatch):
+    from pint_tpu.config import get_config, runtimefile
+
+    monkeypatch.setenv("PINT_TPU_EPHEM_DIR", "/tmp/eph")
+    monkeypatch.setenv("PINT_TPU_STRICT_EPHEM", "1")
+    cfg = get_config(refresh=True)
+    assert cfg.ephem_dir == "/tmp/eph"
+    assert cfg.strict_ephem is True
+    monkeypatch.delenv("PINT_TPU_EPHEM_DIR")
+    monkeypatch.delenv("PINT_TPU_STRICT_EPHEM")
+    cfg = get_config(refresh=True)
+    assert cfg.ephem_dir is None and cfg.strict_ephem is False
+    with pytest.raises(FileNotFoundError, match="no bundled"):
+        runtimefile("nope.dat")
+    # a real bundled module resolves
+    import os
+
+    assert os.path.isfile(runtimefile("leapseconds.py"))
+
+
+def test_fit_report_structure(fitted):
+    import json
+
+    f, toas, model = fitted
+    rep = f.get_fit_report()
+    json.dumps(rep)  # json-able end to end
+    assert rep["ntoas"] == len(toas)
+    assert rep["pulsar"] == model.name
+    assert set(rep["fit_params"]) == set(f.fit_params)
+    assert rep["params"]["F0"]["fitted"] is True
+    assert rep["params"]["F0"]["uncertainty"] > 0
+    assert rep["chi2"] == pytest.approx(f.resids.chi2)
