@@ -239,7 +239,8 @@ def gls_finalize_seg(parts: dict, p: int) -> dict:
 
 def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
                       F: Array | None, phi_F: Array | None,
-                      epoch_idx: Array, phi_e: Array) -> dict:
+                      epoch_idx: Array, phi_e: Array,
+                      *, mxu: bool = False) -> dict:
     """Gram reduction from pre-whitened inputs, range-safe for TPU f64.
 
     The TPU's emulated float64 carries float32 *dynamic range* (measured:
@@ -250,7 +251,16 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
     intermediate below ~1e17. Algebraically identical to
     :func:`gls_gram_seg`; composed with the same
     :func:`gls_finalize_seg`.
+
+    ``mxu=True`` computes the two O(n q^2)/O(ne q^2) matmuls (the Gram
+    and the ECORR Schur term) as double-single f32 MXU products
+    (:func:`pint_tpu.ops.mxu.ds32_gram`, ~1e-7 relative) while the
+    gradient c_B, the segment sums and everything O(n q) stay exact f64
+    — the Gauss-Newton fixed point is unchanged, only the step operator
+    is approximate.
     """
+    if mxu:
+        from pint_tpu.ops.mxu import ds32_gram
     p = A_M.shape[1]
     if F is not None:
         Fw = F * sw[:, None]
@@ -272,7 +282,8 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
         diag_prior = jnp.zeros(p)
     q = A.shape[1]
 
-    G_BB = A.T @ A + jnp.diag(diag_prior)
+    gram = (lambda X: ds32_gram(X)) if mxu else (lambda X: X.T @ X)
+    G_BB = gram(A) + jnp.diag(diag_prior)
     c_B = A.T @ rw
 
     ne = phi_e.shape[0]
@@ -283,7 +294,8 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
         d = seg(jnp.square(sw)) + 1.0 / phi_e
         C = seg(A * sw[:, None])
         c_e = seg(rw * sw)
-        S = G_BB - C.T @ (C / d[:, None])
+        Cs = C * jax.lax.rsqrt(d)[:, None]
+        S = G_BB - gram(Cs)
         rhs = c_B - C.T @ (c_e / d)
     else:
         d = jnp.ones(0)

@@ -126,11 +126,16 @@ class HybridGLSFitter(Fitter):
         pl_specs = self.pl_specs
         n_params = len(names) + 1  # + offset column
 
+        # on a real accelerator the O(n q^2) matmuls run as double-single
+        # f32 on the MXU (emulated f64 matmul measured ~100x slower than
+        # host CPU); the gradient and segment sums stay exact f64
+        use_mxu = self.accel.platform != "cpu"
+
         def stage2_gram(A_M, rw, sw, norm_M, t_s, inv_f2, epoch_idx,
                         ecorr_phi, pl_params):
             F, phi_F = _accel_pl_bases(t_s, inv_f2, pl_specs, pl_params)
             return gls_gram_whitened(A_M, rw, sw, norm_M, F, phi_F,
-                                     epoch_idx, ecorr_phi)
+                                     epoch_idx, ecorr_phi, mxu=use_mxu)
 
         self._stage1 = jax.jit(stage1)
         self._stage2_gram = jax.jit(stage2_gram)

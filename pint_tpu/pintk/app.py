@@ -58,12 +58,14 @@ def run_app(ctrl) -> int:
         ax.clear()
         x, xlabel = ctrl.x_data(xaxis.get())
         y, e, ylabel = ctrl.y_data("prefit")
+        ydisp = y  # whichever residuals are front-most for overlays
         ax.errorbar(x, y, yerr=e, fmt=".", color="0.6", label="prefit",
                     alpha=0.7)
         if ctrl.postfit_model is not None:
             yp, ep, _ = ctrl.y_data("postfit")
             ax.errorbar(x, yp, yerr=ep, fmt=".", color="C0", label="postfit")
             ylabel = "residual (us)"
+            ydisp = yp
             if show_random.get() and ctrl.random_dphase is not None:
                 order = np.argsort(x)
                 for row in ctrl.random_dphase * 1e6:
@@ -71,7 +73,7 @@ def run_app(ctrl) -> int:
                             alpha=0.15, lw=0.6)
         sel = ctrl.selected[~ctrl.deleted]
         if sel.any() and not sel.all():
-            ax.plot(x[sel], y[sel], "o", mfc="none", mec="C3", ms=9,
+            ax.plot(x[sel], ydisp[sel], "o", mfc="none", mec="C3", ms=9,
                     label="selected")
         ax.axhline(0.0, color="k", lw=0.5)
         ax.set_xlabel(xlabel)

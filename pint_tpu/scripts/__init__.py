@@ -10,4 +10,5 @@ in ``pyproject.toml``:
 * ``pintbary``  — barycenter arrival times with a (minimal) model
 * ``photonphase`` — phases + H-test for FITS photon events
 * ``event_optimize`` — MCMC timing fit against a profile template
+* ``pintpublish`` — LaTeX/plain publication parameter table
 """

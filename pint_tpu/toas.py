@@ -224,9 +224,15 @@ def get_TOAs(
         cdir = get_config().cache_dir or os.path.dirname(os.path.abspath(timfile))
         os.makedirs(cdir, exist_ok=True)
         # every value-affecting option is part of the key: a cache built
-        # with clock corrections must not serve an include_clock=False call
+        # with clock corrections must not serve an include_clock=False
+        # call; a path hash keeps same-basename tim files in a shared
+        # cache dir from colliding
+        import hashlib
+
+        tag = hashlib.sha1(
+            os.path.abspath(timfile).encode()).hexdigest()[:8]
         cache_path = os.path.join(
-            cdir, f"{os.path.basename(timfile)}.{ename}"
+            cdir, f"{os.path.basename(timfile)}.{tag}.{ename}"
                   f".p{int(planets)}c{int(include_clock)}.npz")
         if (os.path.isfile(cache_path)
                 and os.path.getmtime(cache_path) > os.path.getmtime(timfile)):

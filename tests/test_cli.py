@@ -160,3 +160,30 @@ def test_logging_setup_and_dedup(capsys):
     err = capsys.readouterr().err
     assert len([l for l in err.splitlines() if "repeated message" in l]) == 2
     assert "suppressed" in err
+
+
+def test_pintpublish(par_tim, capsys):
+    from pint_tpu.scripts import pintpublish
+
+    par, tim, d = par_tim
+    rc = pintpublish.main([par, tim, "--format", "latex"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "\\begin{table}" in out and "F0 &" in out
+    assert "Characteristic age" in out
+    rc = pintpublish.main([par, "--format", "text", "--all"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "PEPOCH" in out
+
+
+def test_value_with_unc_notation():
+    from pint_tpu.scripts.pintpublish import value_with_unc
+
+    assert value_with_unc(61.4854765540, 6.8e-13) == "61.48547655400000(68)"
+    assert value_with_unc(223.9, 0.012) == "223.900(12)"
+    assert value_with_unc(1.5, 0.0) == "1.5"
+    # rounding carry must shift the decade, not drop it (review regression)
+    assert value_with_unc(123.0, 9.99) == "123(10)"
+    assert value_with_unc(123.0, 99.5) == "123(100)"
+    assert value_with_unc(0.5, 0.0999) == "0.50(10)"

@@ -263,8 +263,8 @@ def test_get_toas_usepickle(tmp_path, monkeypatch):
     cdir = tmp_path / "cache"
     monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(cdir))
     t1 = get_TOAs(str(p), usepickle=True)
-    cache = cdir / "c.tim.builtin_analytic.p1c1.npz"
-    assert cache.exists()
+    caches = list(cdir.glob("c.tim.*.builtin_analytic.p1c1.npz"))
+    assert len(caches) == 1
     t2 = get_TOAs(str(p), usepickle=True)  # served from the cache
     np.testing.assert_array_equal(np.asarray(t1.tdb.hi), np.asarray(t2.tdb.hi))
     assert len(t2) == len(t1)
