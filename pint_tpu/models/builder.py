@@ -28,7 +28,8 @@ from pint_tpu.models.solar_wind import SolarWindDispersion
 from pint_tpu.models.spindown import Spindown
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.models.troposphere import TroposphereDelay
-from pint_tpu.models.wave import Wave
+from pint_tpu.models.chromatic import ChromaticCM, CMWaveX
+from pint_tpu.models.wave import DMWaveX, Wave, WaveX
 
 log = logging.getLogger(__name__)
 
@@ -46,6 +47,10 @@ COMPONENT_BUILD_ORDER: list[type] = [
     *ALL_BINARY_MODELS,
     Glitch,
     Wave,
+    WaveX,
+    DMWaveX,
+    ChromaticCM,
+    CMWaveX,
     IFunc,
     FD,
     PhaseJump,

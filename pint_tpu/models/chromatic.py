@@ -1,0 +1,147 @@
+"""Chromatic (variable-index frequency-dependent) delay variations.
+
+Reference equivalent: ``pint.models.chromatic_model`` (ChromaticCM with
+CM Taylor series + CMX windows) and ``pint.models.cmwavex.CMWaveX``
+(src/pint/models/chromatic_model.py, cmwavex.py). Scattering-type
+delays scale as (1400 MHz / f)^TNCHROMIDX with a fittable index
+(defaulting to 4, the thin-screen scattering value), unlike
+dispersion's fixed f^-2:
+
+    delay = CM(t) * K * (1400 / f_MHz)^alpha / 1400^2
+
+with CM in pc/cm^3 units at the 1400 MHz reference (the reference's
+"cmu" convention: delay = K * CM * f_ref^alpha... expressed so that
+alpha = 2 reproduces the DM delay exactly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.constants import DM_CONST
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import float_param, mjd_param
+from pint_tpu.models.wave import WaveX
+from pint_tpu.ops import dd
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+FREF_MHZ = 1400.0
+
+
+def chromatic_scale(freq_mhz: Array, alpha) -> Array:
+    """(1400/f)^alpha / 1400^2 — equals 1/f^2 at alpha = 2."""
+    return (FREF_MHZ / freq_mhz) ** alpha / (FREF_MHZ * FREF_MHZ)
+
+
+class ChromaticCM(Component):
+    """CM Taylor series + CMX windows with a fittable chromatic index.
+
+    Parameters: CM, CM1, ... [pc/cm^3] about CMEPOCH; TNCHROMIDX
+    (alpha); CMX_####/CMXR1/CMXR2 piecewise windows.
+    """
+
+    category = "chromatic_cm"
+    is_delay = True
+
+    def __init__(self, num_terms: int = 1, indices: list[int] | None = None):
+        super().__init__()
+        self.num_terms = max(1, num_terms)
+        self.indices = list(indices or [])
+        self.ranges: dict[int, tuple[float, float]] = {}
+        for k in range(self.num_terms):
+            name = "CM" if k == 0 else f"CM{k}"
+            self.add_param(float_param(
+                name, units=f"pc cm^-3 / yr^{k}" if k else "pc cm^-3",
+                index=k, desc=f"Chromatic measure derivative {k}"))
+        self.add_param(mjd_param("CMEPOCH", desc="CM reference epoch"))
+        self.add_param(float_param("TNCHROMIDX", default=4.0,
+                                   desc="Chromatic index alpha"))
+        for i in self.indices:
+            self.add_param(float_param(f"CMX_{i:04d}", units="pc cm^-3",
+                                       index=i,
+                                       desc=f"CM offset in window {i}"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        # TNCHROMIDX alone is NOT enough: CMWaveX carries its own copy
+        # and must not drag this component in
+        return pf.get("CM") is not None or bool(pf.get_all("CMX_"))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "ChromaticCM":
+        n = 1
+        while pf.get(f"CM{n}") is not None:
+            n += 1
+        idx = sorted(int(l.name.split("_")[1]) for l in pf.get_all("CMX_"))
+        self = cls(num_terms=n, indices=idx)
+        self.setup_from_parfile(pf)
+        for i in idx:
+            r1 = pf.get(f"CMXR1_{i:04d}")
+            r2 = pf.get(f"CMXR2_{i:04d}")
+            self.ranges[i] = (float(r1.value) if r1 else 0.0,
+                              float(r2.value) if r2 else 1e9)
+        if pf.get("CMEPOCH") is None and pf.get("PEPOCH"):
+            self.param("CMEPOCH").set_from_par(pf.get("PEPOCH").value)
+        return self
+
+    def cm_value(self, p: dict[str, DD], toas) -> Array:
+        """CM(t) [pc/cm^3 at the 1400 MHz reference]."""
+        dt_dd = dd.sub(toas.tdb, p["CMEPOCH"])
+        dt_yr = (dt_dd.hi + dt_dd.lo) / 365.25
+        total = jnp.zeros(len(toas))
+        fact = 1.0
+        for k in range(self.num_terms):
+            name = "CM" if k == 0 else f"CM{k}"
+            if k:
+                fact = fact * dt_yr / k
+            total = total + f64(p, name) * (fact if k else 1.0)
+        mjds = toas.tdb.hi + toas.tdb.lo
+        for i in self.indices:
+            lo, hi = self.ranges[i]
+            mask = jnp.asarray((mjds >= lo) & (mjds <= hi), jnp.float64)
+            total = total + mask * f64(p, f"CMX_{i:04d}")
+        return total
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        alpha = f64(p, "TNCHROMIDX")
+        return DM_CONST * self.cm_value(p, toas) \
+            * chromatic_scale(toas.freq_mhz, alpha)
+
+
+class CMWaveX(WaveX):
+    """Fourier-mode chromatic variations (reference: pint.models.cmwavex).
+
+    Amplitudes CMWXSIN_/CMWXCOS_ [pc/cm^3] on frequencies CMWXFREQ_
+    [1/d]; the series scales with the model's TNCHROMIDX (own param,
+    default 4). Combine with ChromaticCM is not supported (both own
+    TNCHROMIDX; the builder's unique-parameter check rejects the pair
+    with a clear error) — use CMX windows or CMWaveX modes, not both.
+    """
+
+    category = "cmwavex"
+
+    def __init__(self, indices: list[int] | None = None):
+        Component.__init__(self)
+        self.indices = list(indices or [])
+        self.add_param(mjd_param("CMWXEPOCH", desc="CMWaveX reference epoch"))
+        self.add_param(float_param("TNCHROMIDX", default=4.0,
+                                   desc="Chromatic index alpha"))
+        for k in self.indices:
+            self.add_param(float_param(f"CMWXFREQ_{k:04d}", units="1/d",
+                                       index=k,
+                                       desc=f"Frequency of CMWaveX mode {k}"))
+            self.add_param(float_param(f"CMWXSIN_{k:04d}", units="pc cm^-3",
+                                       index=k,
+                                       desc=f"Sine CM amplitude of mode {k}"))
+            self.add_param(float_param(f"CMWXCOS_{k:04d}", units="pc cm^-3",
+                                       index=k,
+                                       desc=f"Cosine CM amplitude of mode {k}"))
+
+    _freq_prefix = "CMWXFREQ_"
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        alpha = f64(p, "TNCHROMIDX")
+        return DM_CONST * self._series(p, toas) \
+            * chromatic_scale(toas.freq_mhz, alpha)

@@ -80,3 +80,107 @@ class Wave(Component):
             total = total + (f64(p, f"WAVE{k}A") * jnp.sin(arg)
                              + f64(p, f"WAVE{k}B") * jnp.cos(arg))
         return total
+
+
+class WaveX(Component):
+    """WaveX: fittable Fourier-mode delays at explicit frequencies.
+
+    Reference equivalent: ``pint.models.wavex.WaveX``
+    (src/pint/models/wavex.py): unlike WAVE's fixed harmonic ladder,
+    each mode k carries its own frequency WXFREQ_000k [1/d] with
+    fittable sine/cosine amplitudes WXSIN_000k / WXCOS_000k [s],
+
+        w(t) = sum_k [ WXSIN_k sin(2 pi f_k dt) + WXCOS_k cos(2 pi f_k dt) ]
+
+    dt = t - WXEPOCH [d]. The deterministic (fittable) counterpart of
+    PLRedNoise's Fourier basis.
+    """
+
+    category = "wavex"
+    is_delay = True
+
+    def __init__(self, indices: list[int] | None = None):
+        super().__init__()
+        self.indices = list(indices or [])
+        self.add_param(mjd_param("WXEPOCH", desc="WaveX reference epoch"))
+        for k in self.indices:
+            self.add_param(float_param(f"WXFREQ_{k:04d}", units="1/d", index=k,
+                                       desc=f"Frequency of WaveX mode {k}"))
+            self.add_param(float_param(f"WXSIN_{k:04d}", units="s", index=k,
+                                       desc=f"Sine amplitude of mode {k}"))
+            self.add_param(float_param(f"WXCOS_{k:04d}", units="s", index=k,
+                                       desc=f"Cosine amplitude of mode {k}"))
+
+    _freq_prefix = "WXFREQ_"
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return bool(pf.get_all(cls._freq_prefix))
+
+    @classmethod
+    def from_parfile(cls, pf):
+        idx = sorted(int(l.name[len(cls._freq_prefix):])
+                     for l in pf.get_all(cls._freq_prefix))
+        self = cls(indices=idx)
+        self.setup_from_parfile(pf)
+        ep = self._freq_prefix.replace("FREQ_", "EPOCH")
+        if pf.get(ep) is None and pf.get("PEPOCH"):
+            self.param(ep).set_from_par(pf.get("PEPOCH").value)
+        return self
+
+    def validate(self) -> None:
+        for k in self.indices:
+            if self.param(f"{self._freq_prefix}{k:04d}").value_f64 <= 0:
+                raise ValueError(f"{self._freq_prefix}{k:04d} must be positive")
+
+    def _series(self, p: dict[str, DD], toas) -> Array:
+        # shared by WaveX/DMWaveX/CMWaveX: prefix-derived param names
+        pre = self._freq_prefix[:-len("FREQ_")]
+        dt_dd = dd.sub(toas.tdb, p[f"{pre}EPOCH"])
+        dt = dt_dd.hi + dt_dd.lo  # days
+        total = jnp.zeros(len(toas))
+        for k in self.indices:
+            arg = 2.0 * jnp.pi * f64(p, f"{pre}FREQ_{k:04d}") * dt
+            total = total + (f64(p, f"{pre}SIN_{k:04d}") * jnp.sin(arg)
+                             + f64(p, f"{pre}COS_{k:04d}") * jnp.cos(arg))
+        return total
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        return self._series(p, toas)
+
+
+class DMWaveX(WaveX):
+    """DMWaveX: Fourier-mode DM variations at explicit frequencies.
+
+    Reference equivalent: ``pint.models.wavex.DMWaveX``: amplitudes
+    DMWXSIN_/DMWXCOS_ [pc/cm^3] on frequencies DMWXFREQ_ [1/d]; the DM
+    series enters as a dispersive delay K DM(t)/f^2 and feeds the
+    wideband DM fit via ``dm_value``.
+    """
+
+    category = "dmwavex"
+
+    def __init__(self, indices: list[int] | None = None):
+        Component.__init__(self)
+        self.indices = list(indices or [])
+        self.add_param(mjd_param("DMWXEPOCH", desc="DMWaveX reference epoch"))
+        for k in self.indices:
+            self.add_param(float_param(f"DMWXFREQ_{k:04d}", units="1/d",
+                                       index=k,
+                                       desc=f"Frequency of DMWaveX mode {k}"))
+            self.add_param(float_param(f"DMWXSIN_{k:04d}", units="pc cm^-3",
+                                       index=k,
+                                       desc=f"Sine DM amplitude of mode {k}"))
+            self.add_param(float_param(f"DMWXCOS_{k:04d}", units="pc cm^-3",
+                                       index=k,
+                                       desc=f"Cosine DM amplitude of mode {k}"))
+
+    _freq_prefix = "DMWXFREQ_"
+
+    def dm_value(self, p: dict[str, DD], toas) -> Array:
+        return self._series(p, toas)
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
+        from pint_tpu.constants import DM_CONST
+
+        return DM_CONST * self._series(p, toas) / toas.freq_mhz**2

@@ -21,7 +21,7 @@ def main(argv=None) -> int:
     """Console entry point: ``pintk par tim``."""
     import argparse
 
-    from pint_tpu import logging as pint_logging
+    from pint_tpu.scripts import script_init
 
     parser = argparse.ArgumentParser(
         prog="pintk", description="Interactive pulsar-timing GUI")
@@ -29,7 +29,7 @@ def main(argv=None) -> int:
     parser.add_argument("timfile")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
-    pint_logging.setup(args.log_level)
+    script_init(args.log_level)
 
     from pint_tpu.models import get_model_and_toas
     from pint_tpu.pintk.app import run_app

@@ -12,3 +12,44 @@ in ``pyproject.toml``:
 * ``event_optimize`` — MCMC timing fit against a profile template
 * ``pintpublish`` — LaTeX/plain publication parameter table
 """
+
+
+def script_init(log_level: str = "INFO") -> None:
+    """One-call console-script initialization: logging + f64 safety.
+
+    Every entry point calls this (and ONLY this) after argument
+    parsing, so a new tool cannot forget the exact-f64 guard without
+    also forgetting its logging setup.
+    """
+    from pint_tpu import logging as pint_logging
+
+    pint_logging.setup(log_level)
+    ensure_exact_f64()
+
+
+def ensure_exact_f64() -> None:
+    """Pin the default device to the CPU if the current backend's float64
+    is not IEEE-exact (``pint_tpu.ops.dd.self_check``).
+
+    The interactive tools are single-dataset workflows whose DD phase
+    arithmetic silently produces garbage on a backend with emulated
+    f64 (measured on TPU v5e — see pint_tpu.ops.dd). The big-N TPU
+    paths go through the hybrid/sharded fitters, which manage device
+    placement themselves; everything a console script touches should
+    just run on the exact CPU backend.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return
+    from pint_tpu.ops import dd
+
+    if not dd.self_check():
+        import logging
+
+        cpu = jax.devices("cpu")[0]
+        jax.config.update("jax_default_device", cpu)
+        logging.getLogger("pint_tpu.scripts").warning(
+            "backend %s fails the float64 exactness self-check; pinning "
+            "computation to %s (see pint_tpu.ops.dd)",
+            jax.default_backend(), cpu)

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import argparse
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
 
 
 def compare_models(m1, m2) -> str:
@@ -46,7 +46,7 @@ def main(argv=None) -> int:
     parser.add_argument("parfile1")
     parser.add_argument("parfile2")
     args = parser.parse_args(argv)
-    pint_logging.setup()
+    script_init()
 
     from pint_tpu.models import get_model
 

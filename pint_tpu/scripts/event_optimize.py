@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import argparse
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
 
 
 def read_gaussian_template(path: str):
@@ -57,7 +57,7 @@ def main(argv=None) -> int:
                         help="write the max-posterior model here")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
-    pint_logging.setup(args.log_level)
+    script_init(args.log_level)
 
     from pint_tpu.event_toas import load_event_TOAs
     from pint_tpu.models import get_model

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import argparse
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
 
 _MIN_PAR = """PSR BARY
 RAJ {ra}
@@ -35,7 +35,7 @@ def main(argv=None) -> int:
     parser.add_argument("--freq", type=float, default=1e8,
                         help="MHz (default: effectively infinite -> no DM delay)")
     args = parser.parse_args(argv)
-    pint_logging.setup()
+    script_init()
 
     import numpy as np
 

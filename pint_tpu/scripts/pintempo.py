@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import argparse
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
 
 
 def main(argv=None) -> int:
@@ -29,7 +29,7 @@ def main(argv=None) -> int:
                         help="write a pre/post-fit residual plot (requires "
                              "matplotlib)")
     args = parser.parse_args(argv)
-    pint_logging.setup(args.log_level)
+    script_init(args.log_level)
 
     from pint_tpu.fitting import Fitter, GLSFitter, WLSFitter
     from pint_tpu.models import get_model

@@ -10,7 +10,14 @@ from __future__ import annotations
 import argparse
 import math
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
+
+
+def tex_escape(s: str) -> str:
+    """Escape LaTeX text-mode specials in parameter names/units."""
+    return (s.replace("\\", "\\textbackslash{}").replace("_", "\\_")
+            .replace("^", "\\^{}").replace("&", "\\&").replace("%", "\\%")
+            .replace("#", "\\#").replace("$", "\\$"))
 
 
 def value_with_unc(value: float, unc: float) -> str:
@@ -41,7 +48,7 @@ def main(argv=None) -> int:
                         help="include frozen parameters too")
     parser.add_argument("--log-level", default="WARNING")
     args = parser.parse_args(argv)
-    pint_logging.setup(args.log_level)
+    script_init(args.log_level)
 
     from pint_tpu.derived_quantities import (pulsar_age_yr, pulsar_B_gauss,
                                              pulsar_period_s)
@@ -78,16 +85,16 @@ def main(argv=None) -> int:
 
     if args.format == "latex":
         print("\\begin{table}")
-        print(f"\\caption{{Timing parameters for {model.name}}}")
+        print(f"\\caption{{Timing parameters for {tex_escape(model.name)}}}")
         print("\\begin{tabular}{lll}")
         print("\\hline")
         print("Parameter & Value & Units \\\\")
         print("\\hline")
         for name, val, units in rows:
-            print(f"{name} & {val} & {units} \\\\")
+            print(f"{tex_escape(name)} & {val} & {tex_escape(units)} \\\\")
         print("\\hline")
         for label, val in derived:
-            print(f"{label} & {val} & \\\\")
+            print(f"{tex_escape(label)} & {val} & \\\\")
         if ntoa is not None:
             print(f"Number of TOAs & {ntoa} & \\\\")
             print(f"$\\chi^2$ & {chi2:.2f} & \\\\")

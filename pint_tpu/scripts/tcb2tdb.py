@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import argparse
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
 
 
 def main(argv=None) -> int:
@@ -13,7 +13,7 @@ def main(argv=None) -> int:
     parser.add_argument("input_par")
     parser.add_argument("output_par")
     args = parser.parse_args(argv)
-    pint_logging.setup()
+    script_init()
 
     from pint_tpu.models.tcb_conversion import tcb2tdb_file
 

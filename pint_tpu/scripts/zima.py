@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import argparse
 
-from pint_tpu import logging as pint_logging
+from pint_tpu.scripts import script_init
 
 
 def main(argv=None) -> int:
@@ -33,7 +33,7 @@ def main(argv=None) -> int:
                              "instead of a uniform grid")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
-    pint_logging.setup(args.log_level)
+    script_init(args.log_level)
 
     import numpy as np
 

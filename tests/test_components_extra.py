@@ -178,3 +178,135 @@ def test_builder_no_spurious_warnings(caplog):
     with caplog.at_level(logging.WARNING, logger="pint_tpu.models.builder"):
         get_model(par)
     assert not [r for r in caplog.records if "not recognized" in r.message]
+
+
+WAVEX_LINES = """
+WXEPOCH 53750
+WXFREQ_0001 0.01
+WXSIN_0001 2.0e-5 1
+WXCOS_0001 -1.0e-5 1
+WXFREQ_0002 0.02
+WXSIN_0002 5.0e-6 1
+WXCOS_0002 3.0e-6 1
+"""
+
+
+def test_wavex_delay_and_fit_recovery():
+    """WaveX modes inject and a fit recovers the amplitudes.
+
+    Reference: pint.models.wavex.WaveX."""
+    from pint_tpu.fitting import WLSFitter
+
+    truth = get_model(BASE + WAVEX_LINES)
+    assert truth.has_component("WaveX")
+    toas = make_fake_toas_uniform(53400, 54100, 120, truth, obs="gbt",
+                                  freq_mhz=1400.0, error_us=1.0,
+                                  add_noise=True, seed=17)
+    pert = get_model(BASE + WAVEX_LINES
+                     .replace("2.0e-5", "0.0").replace("-1.0e-5", "0.0")
+                     .replace("5.0e-6", "0.0").replace("3.0e-6", "0.0"))
+    f = WLSFitter(toas, pert)
+    f.fit_toas(maxiter=3)
+    for name, want in (("WXSIN_0001", 2.0e-5), ("WXCOS_0001", -1.0e-5),
+                       ("WXSIN_0002", 5.0e-6), ("WXCOS_0002", 3.0e-6)):
+        got = pert[name].value_f64
+        unc = pert[name].uncertainty
+        assert abs(got - want) < 5 * unc, f"{name}: {got} vs {want}"
+
+
+def test_dmwavex_chromatic_and_wideband():
+    """DMWaveX delays scale as 1/f^2 and feed total_dm."""
+    import jax.numpy as jnp
+
+    par = BASE + """
+DMWXEPOCH 53750
+DMWXFREQ_0001 0.005
+DMWXSIN_0001 1.0e-3
+DMWXCOS_0001 5.0e-4
+"""
+    m = get_model(par)
+    assert m.has_component("DMWaveX")
+    toas = make_fake_toas_uniform(53500, 54000, 40, get_model(BASE),
+                                  obs="gbt", freq_mhz=np.array([1400.0, 700.0]),
+                                  error_us=1.0)
+    comp = m.get_component("DMWaveX")
+    p = m.base_dd()
+    d = np.asarray(comp.delay(p, toas, jnp.zeros(len(toas)), {}))
+    f = np.asarray(toas.freq_mhz)
+    # chromatic: the 700 MHz TOAs see 4x the 1400 MHz delay at equal DM
+    dmv = np.asarray(comp.dm_value(p, toas))
+    from pint_tpu.constants import DM_CONST
+    np.testing.assert_allclose(d, DM_CONST * dmv / f**2, rtol=1e-9)
+    assert np.abs(dmv).max() > 1e-4
+    total = np.asarray(m.total_dm(toas))
+    np.testing.assert_allclose(total - 30.0, dmv, atol=1e-12)
+
+
+def test_chromatic_cm_index_scaling():
+    """ChromaticCM: alpha=2 reproduces the DM delay exactly; alpha=4
+    quadruples the ratio between two octave-separated bands.
+    Reference: pint.models.chromatic_model.ChromaticCM."""
+    import jax.numpy as jnp
+    from pint_tpu.constants import DM_CONST
+
+    par4 = BASE + "CM 1.0e-3\nTNCHROMIDX 4\n"
+    par2 = BASE + "CM 1.0e-3\nTNCHROMIDX 2\n"
+    m4 = get_model(par4)
+    m2 = get_model(par2)
+    assert m4.has_component("ChromaticCM")
+    toas = make_fake_toas_uniform(54900, 55100, 20, get_model(BASE),
+                                  obs="gbt",
+                                  freq_mhz=np.array([1400.0, 700.0]),
+                                  error_us=1.0)
+    c4 = m4.get_component("ChromaticCM")
+    c2 = m2.get_component("ChromaticCM")
+    z = jnp.zeros(len(toas))
+    d4 = np.asarray(c4.delay(m4.base_dd(), toas, z, {}))
+    d2 = np.asarray(c2.delay(m2.base_dd(), toas, z, {}))
+    f = np.asarray(toas.freq_mhz)
+    # alpha=2 == dispersion with DM = CM
+    np.testing.assert_allclose(d2, DM_CONST * 1.0e-3 / f**2, rtol=1e-12)
+    lo, hi = d4[f < 1000].mean(), d4[f > 1000].mean()
+    assert lo / hi == pytest.approx(16.0, rel=1e-9)  # (2x freq)^4
+
+
+def test_cmx_window_and_fit():
+    par = BASE + """
+CM 0.0
+TNCHROMIDX 4
+CMX_0001 5.0e-4 1
+CMXR1_0001 54900
+CMXR2_0001 55000
+"""
+    truth = get_model(par)
+    toas = make_fake_toas_uniform(54850, 55150, 60, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 700.0]),
+                                  error_us=1.0, add_noise=True, seed=23)
+    pert = get_model(par.replace("5.0e-4", "0.0"))
+    f = WLSFitter(toas, pert)
+    f.fit_toas(maxiter=3)
+    got = pert["CMX_0001"].value_f64
+    assert abs(got - 5.0e-4) < 5 * pert["CMX_0001"].uncertainty
+
+
+def test_cmwavex_component():
+    par = BASE + """
+CMWXEPOCH 55000
+TNCHROMIDX 4
+CMWXFREQ_0001 0.01
+CMWXSIN_0001 1.0e-4 1
+CMWXCOS_0001 -5.0e-5 1
+"""
+    m = get_model(par)
+    assert m.has_component("CMWaveX")
+    truth = get_model(par)
+    toas = make_fake_toas_uniform(54800, 55200, 80, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 700.0]),
+                                  error_us=1.0, add_noise=True, seed=29)
+    pert = get_model(par.replace("1.0e-4", "0.0").replace("-5.0e-5", "0.0"))
+    f = WLSFitter(toas, pert)
+    f.fit_toas(maxiter=3)
+    assert abs(pert["CMWXSIN_0001"].value_f64 - 1.0e-4) \
+        < 5 * pert["CMWXSIN_0001"].uncertainty
+    assert abs(pert["CMWXCOS_0001"].value_f64 - (-5.0e-5)) \
+        < 5 * pert["CMWXCOS_0001"].uncertainty
