@@ -212,10 +212,11 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
     jax.block_until_ready(sol["chi2"])
     compile_s = time.perf_counter() - t0
 
-    # the O(n q^2) Gram runs on the chip; the tiny (q, q) finalize runs
-    # on the CPU by construction (covariance entries underflow the
-    # chip's f32-range f64 emulation — see HybridGLSFitter)
-    mode = "hybrid_cpu_dd_accel_gram_cpu_finalize"
+    # the O(n q^2) Gram AND the normalized-domain solve run on the chip
+    # in one round trip; only the un-normalization (covariance entries
+    # underflow the chip's f32-range f64 emulation) runs on the host —
+    # see HybridGLSFitter / gls_solve_normalized
+    mode = "hybrid_cpu_dd_accel_solve_host_unnorm"
 
     times, s1_times = [], []
     for _ in range(reps):

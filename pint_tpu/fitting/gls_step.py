@@ -55,8 +55,9 @@ class PLSpec(NamedTuple):
     structure (the PTA path batches dozens of pulsars through it).
     """
 
-    scale: str        # "none" (achromatic red) | "dm" (chromatic)
+    scale: str        # "none" (achromatic) | "dm" (nu^-2) | "chrom" (nu^-alpha)
     nharm: int
+    alpha: float = 2.0  # chromatic index (used when scale != "none")
 
 
 class NoiseStatics(NamedTuple):
@@ -90,8 +91,10 @@ def build_noise_statics(model, toas) -> tuple[NoiseStatics, tuple[PLSpec, ...]]:
                 raise ValueError("multiple ECORR components in one model")
             epoch_idx, phi_e = c.epoch_indices(toas)
         elif hasattr(c, "pl_spec"):
-            scale, log10_amp, gamma, nharm = c.pl_spec()
-            specs.append(PLSpec(scale, nharm))
+            if hasattr(c, "refresh_from_model"):
+                c.refresh_from_model(model)
+            scale, log10_amp, gamma, nharm, alpha = c.pl_spec()
+            specs.append(PLSpec(scale, nharm, alpha))
             pl_params.append((log10_amp, gamma))
     if epoch_idx is None:
         epoch_idx = np.zeros(n, dtype=np.int32)  # ne=0: everything is dummy
@@ -152,10 +155,12 @@ def pl_bases(toas, specs: tuple[PLSpec, ...], pl_params: Array
     blocks, phis = [], []
     for i, spec in enumerate(specs):
         F, f, df = fourier_design(t_s, spec.nharm)
-        if spec.scale == "dm":
+        if spec.scale != "none":
             from pint_tpu.models.noise import DM_FREF_MHZ
 
-            F = F * jnp.square(DM_FREF_MHZ / toas.freq_mhz)[:, None]
+            ratio = (DM_FREF_MHZ / toas.freq_mhz)[:, None]
+            F = F * (jnp.square(ratio) if spec.alpha == 2.0
+                     else ratio ** spec.alpha)
         blocks.append(F)
         phis.append(jnp.repeat(
             powerlaw_phi(f, pl_params[i, 0], pl_params[i, 1], df), 2))
@@ -210,31 +215,47 @@ def gls_gram_seg(M: Array, r: Array, sigma: Array,
             "quad0": jnp.sum(jnp.square(r) * w), "C": C, "c_e": c_e, "d": d}
 
 
-def gls_finalize_seg(parts: dict, p: int) -> dict:
-    """Cholesky of the (q, q) Schur system + covariance/chi2 assembly.
+def gls_solve_normalized(parts: dict) -> dict:
+    """Cholesky solve of the Schur system, entirely in normalized units.
 
-    ``p`` (static) is the timing-parameter count — the first p columns
-    of the extended system. Jittable; O(q^3) + O(ne q) — negligible next
-    to the Gram reduction, so it can run on whichever device has
-    trustworthy f64 Cholesky.
+    Every input and output here is O(1)-to-O(chi2)-scaled — the design
+    block arrives whitened with unit columns (see
+    :func:`gls_gram_whitened`), so S, rhs, xB, Sigma and chi2 all sit
+    comfortably inside float32 dynamic *range*. That makes this function
+    safe to run on an accelerator whose emulated f64 carries f32 range
+    (the TPU): only the un-normalization (x = xB/norm,
+    cov = Sigma/norm·normᵀ — entries down to ~1e-42) must happen on a
+    full-range device, and it is O(q²) host work.
     """
-    S, rhs, norm = parts["S"], parts["rhs"], parts["norm"]
+    S, rhs = parts["S"], parts["rhs"]
     q = S.shape[0]
     S = S + jnp.eye(q) * (jnp.finfo(jnp.float64).eps * jnp.trace(S))
     cf = jax.scipy.linalg.cho_factor(S, lower=True)
     xB = jax.scipy.linalg.cho_solve(cf, rhs)
     Sigma = jax.scipy.linalg.cho_solve(cf, jnp.eye(q))
-
-    x = xB / norm
-    cov = Sigma / jnp.outer(norm, norm)
     chi2 = parts["quad0"] - parts["c_B"] @ xB
     if parts["d"].shape[0] > 0:
         x_e = (parts["c_e"] - parts["C"] @ xB) / parts["d"]
         chi2 = chi2 - parts["c_e"] @ x_e
     else:
         x_e = jnp.zeros(0)
-    return {"x": x[:p], "cov": cov[:p, :p], "chi2": chi2,
-            "fourier_coeffs": x[p:], "ecorr_coeffs": x_e}
+    return {"xB": xB, "Sigma": Sigma, "chi2": chi2, "x_e": x_e}
+
+
+def gls_finalize_seg(parts: dict, p: int) -> dict:
+    """Normalized solve + un-normalization to physical parameter units.
+
+    ``p`` (static) is the timing-parameter count — the first p columns
+    of the extended system. Jittable; O(q^3) + O(ne q) — negligible next
+    to the Gram reduction, so it can run on whichever device has
+    trustworthy f64 Cholesky.
+    """
+    sol = gls_solve_normalized(parts)
+    norm = parts["norm"]
+    x = sol["xB"] / norm
+    cov = sol["Sigma"] / jnp.outer(norm, norm)
+    return {"x": x[:p], "cov": cov[:p, :p], "chi2": sol["chi2"],
+            "fourier_coeffs": x[p:], "ecorr_coeffs": sol["x_e"]}
 
 
 def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
@@ -257,10 +278,13 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
     (:func:`pint_tpu.ops.mxu.ds32_gram`, ~1e-7 relative) while the
     gradient c_B, the segment sums and everything O(n q) stay exact f64
     — the Gauss-Newton fixed point is unchanged, only the step operator
-    is approximate.
+    is approximate. ``mxu="pallas"`` additionally routes the square
+    Grams through the hand-tiled TPU kernel
+    (:mod:`pint_tpu.ops.pallas_gram`).
     """
     if mxu:
         from pint_tpu.ops.mxu import ds32_gram
+    use_pallas = mxu == "pallas"
     p = A_M.shape[1]
     if F is not None:
         Fw = F * sw[:, None]
@@ -282,7 +306,8 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
         diag_prior = jnp.zeros(p)
     q = A.shape[1]
 
-    gram = (lambda X: ds32_gram(X)) if mxu else (lambda X: X.T @ X)
+    gram = ((lambda X: ds32_gram(X, use_pallas=use_pallas)) if mxu
+            else (lambda X: X.T @ X))
     G_BB = gram(A) + jnp.diag(diag_prior)
     c_B = A.T @ rw
 
@@ -339,6 +364,10 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
         tzr = model.get_tzr_toas()
     phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
     names = model.free_params
+    # explicit PHOFF replaces the implicit offset column + mean
+    # subtraction (see TimingModel.designmatrix)
+    has_phoff = model.has_component("PhaseOffset")
+    off = 0 if has_phoff else 1
 
     def step(base, deltas, toas, noise: NoiseStatics):
         f0 = base["F0"].hi + base["F0"].lo
@@ -352,19 +381,22 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
 
         ph = phase_fn(base, deltas, toas)
         resid_turns = ph.frac.hi + ph.frac.lo
-        resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
+        if not has_phoff:
+            resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
 
         J = jax.jacfwd(total_phase)(deltas)
-        cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
+        cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
+            + [-J[k] / f0 for k in names]
         M = jnp.stack(cols, axis=1)
 
         F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
         sol = gls_solve_seg(M, r, err, F, phi_F,
                             noise.epoch_idx, noise.ecorr_phi)
-        new_deltas = {k: deltas[k] + sol["x"][i + 1] for i, k in enumerate(names)}
+        new_deltas = {k: deltas[k] + sol["x"][i + off]
+                      for i, k in enumerate(names)}
         sig = jnp.sqrt(jnp.diagonal(sol["cov"]))
-        errors = {k: sig[i + 1] for i, k in enumerate(names)}
+        errors = {k: sig[i + off] for i, k in enumerate(names)}
         return new_deltas, {"chi2": sol["chi2"], "errors": errors,
                             "fourier_coeffs": sol["fourier_coeffs"],
                             "ecorr_coeffs": sol["ecorr_coeffs"]}

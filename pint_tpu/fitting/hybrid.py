@@ -31,10 +31,9 @@ import numpy as np
 
 from pint_tpu.constants import SECS_PER_DAY
 from pint_tpu.fitting.fitter import Fitter
-from pint_tpu.fitting.gls_step import (NoiseStatics, PLSpec,
-                                       build_noise_statics, fourier_design,
-                                       gls_finalize_seg, gls_gram_whitened,
-                                       powerlaw_phi)
+from pint_tpu.fitting.gls_step import (PLSpec, build_noise_statics,
+                                       fourier_design, gls_gram_whitened,
+                                       gls_solve_normalized, powerlaw_phi)
 
 Array = jax.Array
 
@@ -63,8 +62,9 @@ def _accel_pl_bases(t_s, inv_f2, specs: tuple[PLSpec, ...], pl_params):
     blocks, phis = [], []
     for i, spec in enumerate(specs):
         F, f, df = fourier_design(t_s, spec.nharm)
-        if spec.scale == "dm":
-            F = F * inv_f2[:, None]
+        if spec.scale != "none":
+            s = inv_f2[:, None]
+            F = F * (s if spec.alpha == 2.0 else s ** (spec.alpha / 2.0))
         blocks.append(F)
         phis.append(jnp.repeat(
             powerlaw_phi(f, pl_params[i, 0], pl_params[i, 1], df), 2))
@@ -80,14 +80,20 @@ class HybridGLSFitter(Fitter):
     stays on the (exact) CPU backend.
     """
 
-    def __init__(self, toas, model, *, accel=None):
+    def __init__(self, toas, model, *, accel=None,
+                 force_mxu: bool | None = None):
         super().__init__(toas, model)
+        self._force_mxu = force_mxu
         self.cpu = cpu_device()
         self.accel = accel if accel is not None else accelerator_device()
         self.noise, self.pl_specs = build_noise_statics(model, toas)
 
         names = model.free_params
         self._names = names
+        # explicit PHOFF replaces the implicit offset column + mean
+        # subtraction (see TimingModel.designmatrix)
+        has_phoff = model.has_component("PhaseOffset")
+        self._off = 0 if has_phoff else 1
         tzr = model.get_tzr_toas()
         phase_fn = model.phase_fn_toas(tzr=tzr)
         toas_cpu = jax.device_put(toas, self.cpu)
@@ -97,17 +103,21 @@ class HybridGLSFitter(Fitter):
 
             def total_phase(d):
                 ph = phase_fn(base, d, toas_cpu)
-                return ph.int_part + (ph.frac.hi + ph.frac.lo)
+                # aux carries the wrapped fractional phase from the SAME
+                # primal evaluation — one DD pipeline pass serves both
+                # the residual and the jacobian (has_aux below)
+                return (ph.int_part + (ph.frac.hi + ph.frac.lo),
+                        ph.frac.hi + ph.frac.lo)
 
             err = model.scaled_toa_uncertainty(toas_cpu)
             w = 1.0 / jnp.square(err)
             sw = jnp.sqrt(w)
-            ph = phase_fn(base, deltas, toas_cpu)
-            resid = ph.frac.hi + ph.frac.lo
-            resid = resid - jnp.sum(resid * w) / jnp.sum(w)
+            J, resid = jax.jacfwd(total_phase, has_aux=True)(deltas)
+            if not has_phoff:
+                resid = resid - jnp.sum(resid * w) / jnp.sum(w)
             r = resid / f0
-            J = jax.jacfwd(total_phase)(deltas)
-            cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
+            cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
+                + [-J[k] / f0 for k in names]
             M = jnp.stack(cols, axis=1)
             # whiten + unit-normalize columns HERE: the accelerator's
             # emulated f64 has f32 dynamic range, and sum(M^2 w) on raw
@@ -117,54 +127,120 @@ class HybridGLSFitter(Fitter):
             norm_M = jnp.where(norm_M == 0.0, 1.0, norm_M)
             A_M = Mw / norm_M
             rw = r * sw
-            t_s = (toas_cpu.tdb.hi + toas_cpu.tdb.lo) * SECS_PER_DAY
-            from pint_tpu.models.noise import DM_FREF_MHZ
-
-            inv_f2 = jnp.square(DM_FREF_MHZ / toas_cpu.freq_mhz)
-            return A_M, rw, sw, norm_M, t_s, inv_f2
+            # ONE flat output buffer: the accelerator sits behind a
+            # transfer link whose per-transfer latency dominates at
+            # these sizes (measured: ~17 round trips cost ~0.7 s/iter,
+            # the on-chip compute <1 ms), so stage 1 packs everything
+            # iteration-dependent into a single array for a single
+            # host->device put (t_s/inv_f2 are TOA-only: shipped once).
+            return jnp.concatenate([A_M.ravel(), rw, sw, norm_M])
 
         pl_specs = self.pl_specs
-        n_params = len(names) + 1  # + offset column
+        n_params = len(names) + (0 if has_phoff else 1)  # + offset column
+        self._n_params = n_params
+        n = len(toas)
+        k_f = int(sum(2 * s.nharm for s in pl_specs))
+        q = n_params + k_f
+        ne = int(np.asarray(self.noise.ecorr_phi).shape[0])
+        self._q, self._ne = q, ne
+
+        # noise statics and TOA-only arrays never change across
+        # iterations: ship them once
+        from pint_tpu.models.noise import DM_FREF_MHZ
+
+        t_s_host = np.asarray(toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+        inv_f2_host = np.square(DM_FREF_MHZ / np.asarray(toas.freq_mhz))
+        self._noise_dev = (
+            jax.device_put(self.noise.epoch_idx, self.accel),
+            jax.device_put(self.noise.ecorr_phi, self.accel),
+            jax.device_put(self.noise.pl_params, self.accel),
+            jax.device_put(jnp.asarray(t_s_host), self.accel),
+            jax.device_put(jnp.asarray(inv_f2_host), self.accel),
+        )
 
         # on a real accelerator the O(n q^2) matmuls run as double-single
         # f32 on the MXU (emulated f64 matmul measured ~100x slower than
-        # host CPU); the gradient and segment sums stay exact f64
-        use_mxu = self.accel.platform != "cpu"
+        # host CPU); on a TPU the square Grams additionally go through
+        # the hand-tiled pallas kernel. The gradient and segment sums
+        # stay exact f64. force_mxu overrides (tests exercise the ds32
+        # path on CPU).
+        if self._force_mxu is not None:
+            use_mxu = self._force_mxu
+        elif self.accel.platform == "tpu":
+            use_mxu = "pallas"
+        else:
+            use_mxu = self.accel.platform != "cpu"
 
-        def stage2_gram(A_M, rw, sw, norm_M, t_s, inv_f2, epoch_idx,
-                        ecorr_phi, pl_params):
-            F, phi_F = _accel_pl_bases(t_s, inv_f2, pl_specs, pl_params)
-            return gls_gram_whitened(A_M, rw, sw, norm_M, F, phi_F,
-                                     epoch_idx, ecorr_phi, mxu=use_mxu)
+        def make_stage2(mxu_mode):
+            def stage2(packed, epoch_idx, ecorr_phi, pl_params,
+                       t_s, inv_f2):
+                # unpack stage 1's flat buffer (static slicing)
+                o = n * n_params
+                A_M = packed[:o].reshape(n, n_params)
+                rw = packed[o:o + n]; o += n
+                sw = packed[o:o + n]; o += n
+                norm_M = packed[o:o + n_params]
+                F, phi_F = _accel_pl_bases(t_s, inv_f2, pl_specs,
+                                           pl_params)
+                parts = gls_gram_whitened(A_M, rw, sw, norm_M, F, phi_F,
+                                          epoch_idx, ecorr_phi,
+                                          mxu=mxu_mode)
+                # the full solve stays on-chip: in the normalized domain
+                # every quantity is range-safe for the chip's f32-range
+                # f64 (gls_solve_normalized docstring); only the
+                # un-normalization happens back on the host. ONE packed
+                # result buffer.
+                sol = gls_solve_normalized(parts)
+                return jnp.concatenate([
+                    sol["xB"], sol["Sigma"].ravel(), parts["norm"],
+                    jnp.reshape(sol["chi2"], (1,)), sol["x_e"],
+                ])
+            return stage2
 
         self._stage1 = jax.jit(stage1)
-        self._stage2_gram = jax.jit(stage2_gram)
-        self._finalize = jax.jit(lambda parts: gls_finalize_seg(parts,
-                                                                n_params))
-        # the (q, q) Cholesky finalize runs on the CPU whenever the
-        # accelerator is not one: beyond the chip's f64 emulation having
-        # f32 *range*, the un-normalized covariance entries themselves
-        # (e.g. var(F1) ~ 1e-40 s^-2 Hz^2) sit below the f32 floor, so
-        # the finalize output cannot even be represented there. It is
-        # O(q^3) — microseconds — next to the O(n q^2) on-chip Gram.
-        self.finalize_device = (self.cpu if self.accel.platform != "cpu"
-                                else self.accel)
+        self._make_stage2 = make_stage2
+        self._use_mxu = use_mxu
+        self._stage2 = jax.jit(make_stage2(use_mxu))
+        self._stage2_ok = False
+
+    def _run_stage2(self, packed_dev):
+        try:
+            out = self._stage2(packed_dev, *self._noise_dev)
+        except Exception:  # noqa: BLE001
+            # fall back ONLY on the first call (i.e. a pallas lowering/
+            # compile failure on this backend); a runtime error after a
+            # successful compile is a real error and must propagate
+            if self._use_mxu != "pallas" or self._stage2_ok:
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas gram kernel failed to compile on %s; "
+                "falling back to XLA ds32", self.accel)
+            self._use_mxu = True
+            self._stage2 = jax.jit(self._make_stage2(True))
+            out = self._stage2(packed_dev, *self._noise_dev)
+        self._stage2_ok = True
+        return out
 
     def _iterate(self, base, deltas) -> tuple[dict, dict]:
-        s1 = self._stage1(base, deltas)
-        noise = self.noise
-        moved = [jax.device_put(x, self.accel) for x in s1] + [
-            jax.device_put(noise.epoch_idx, self.accel),
-            jax.device_put(noise.ecorr_phi, self.accel),
-            jax.device_put(noise.pl_params, self.accel),
-        ]
-        parts = self._stage2_gram(*moved)
-        if self.finalize_device is not self.accel:
-            parts = {k: jax.device_put(v, self.finalize_device)
-                     for k, v in parts.items()}
-        sol = self._finalize(parts)
-        x = np.asarray(sol["x"])
-        new_deltas = {k: deltas[k] + x[i + 1]
+        packed = self._stage1(base, deltas)
+        out = self._run_stage2(jax.device_put(packed, self.accel))
+        # one device->host fetch; un-normalize on the full-range host
+        # (covariance entries reach ~1e-42 — below f32-range f64)
+        out = np.asarray(out)
+        q, ne, p = self._q, self._ne, self._n_params
+        o = 0
+        xB = out[:q]; o = q
+        Sigma = out[o:o + q * q].reshape(q, q); o += q * q
+        norm = out[o:o + q]; o += q
+        chi2 = out[o]; o += 1
+        x_e = out[o:o + ne]
+        x = xB / norm
+        cov = Sigma / np.outer(norm, norm)
+        sol = {"x": x[:p], "cov": cov[:p, :p], "chi2": chi2,
+               "fourier_coeffs": x[p:], "ecorr_coeffs": x_e}
+        new_deltas = {k: deltas[k] + sol["x"][i + self._off]
                       for i, k in enumerate(self._names)}
         return new_deltas, sol
 
@@ -179,7 +255,7 @@ class HybridGLSFitter(Fitter):
         for i, k in enumerate(self._names):
             p = self.model[k]
             p.add_delta(float(np.asarray(deltas[k])))
-            p.uncertainty = float(errors[i + 1])
+            p.uncertainty = float(errors[i + self._off])
         self.fit_params = list(self._names)
         self.parameter_covariance_matrix = cov
         self.resids = self._new_resids()

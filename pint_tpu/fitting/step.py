@@ -44,6 +44,10 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
         tzr = model.get_tzr_toas()
     phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
     names = params if params is not None else model.free_params
+    # explicit PHOFF replaces the implicit offset column + mean
+    # subtraction (see TimingModel.designmatrix)
+    has_phoff = model.has_component("PhaseOffset")
+    off = 0 if has_phoff else 1
 
     def step(base, deltas, toas, mask=None):
         f0 = base["F0"].hi + base["F0"].lo
@@ -62,11 +66,12 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
         w = 1.0 / jnp.square(err)
 
         resid_turns = frac_phase(deltas)
-        resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
+        if not has_phoff:
+            resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
 
         J = jax.jacfwd(total_phase)(deltas)
-        cols = [jnp.ones_like(r) / f0]
+        cols = [] if has_phoff else [jnp.ones_like(r) / f0]
         for k in names:
             col = -J[k] / f0
             if mask is not None:
@@ -75,12 +80,14 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
         M = jnp.stack(cols, axis=1)
 
         sol = wls_solve_gram(M, r, err)
-        new_deltas = {k: deltas[k] + sol["x"][i + 1] for i, k in enumerate(names)}
+        new_deltas = {k: deltas[k] + sol["x"][i + off]
+                      for i, k in enumerate(names)}
         sig = jnp.sqrt(jnp.diagonal(sol["cov"]))
-        errors = {k: sig[i + 1] for i, k in enumerate(names)}
+        errors = {k: sig[i + off] for i, k in enumerate(names)}
 
         post = frac_phase(new_deltas)
-        post = post - jnp.sum(post * w) / jnp.sum(w)
+        if not has_phoff:
+            post = post - jnp.sum(post * w) / jnp.sum(w)
         chi2 = jnp.sum(jnp.square(post / f0) * w)
         return new_deltas, {"chi2": chi2, "errors": errors}
 

@@ -14,6 +14,7 @@ names (JUMP, DMX_, glitches, FD) accumulate.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 
 
@@ -74,7 +75,10 @@ _MASK_PARAMS = ("JUMP", "EFAC", "EQUAD", "ECORR", "T2EFAC", "T2EQUAD",
 
 
 def _is_mask_param(name: str) -> bool:
-    return any(name == m or name.startswith(m) for m in _MASK_PARAMS)
+    if any(name == m or name.startswith(m) for m in _MASK_PARAMS):
+        return True
+    # FD-order jumps: FD1JUMP, FD2JUMP3, ... (pint.models.fdjump)
+    return bool(re.match(r"^FD\d+JUMP\d*$", name))
 
 
 def parse_parfile(path_or_text: str) -> ParFile:

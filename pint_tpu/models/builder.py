@@ -17,12 +17,15 @@ from pint_tpu.models.absolute_phase import AbsPhase
 from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
 from pint_tpu.models.binary import ALL_BINARY_MODELS
 from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
+from pint_tpu.models.fdjump import FDJump
 from pint_tpu.models.frequency_dependent import FD
 from pint_tpu.models.glitch import Glitch
 from pint_tpu.models.ifunc import IFunc
 from pint_tpu.models.jump import DispersionJump, PhaseJump
-from pint_tpu.models.noise import (EcorrNoise, PLDMNoise, PLRedNoise,
-                                   ScaleDmError, ScaleToaError)
+from pint_tpu.models.noise import (EcorrNoise, PLChromNoise, PLDMNoise,
+                                   PLRedNoise, ScaleDmError, ScaleToaError)
+from pint_tpu.models.phase_offset import PhaseOffset
+from pint_tpu.models.piecewise import PiecewiseSpindown
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
 from pint_tpu.models.solar_wind import SolarWindDispersion
 from pint_tpu.models.spindown import Spindown
@@ -46,6 +49,7 @@ COMPONENT_BUILD_ORDER: list[type] = [
     TroposphereDelay,
     *ALL_BINARY_MODELS,
     Glitch,
+    PiecewiseSpindown,
     Wave,
     WaveX,
     DMWaveX,
@@ -53,13 +57,16 @@ COMPONENT_BUILD_ORDER: list[type] = [
     CMWaveX,
     IFunc,
     FD,
+    FDJump,
     PhaseJump,
     DispersionJump,
+    PhaseOffset,
     ScaleToaError,
     ScaleDmError,
     EcorrNoise,
     PLRedNoise,
     PLDMNoise,
+    PLChromNoise,
     AbsPhase,
 ]
 

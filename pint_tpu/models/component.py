@@ -45,9 +45,12 @@ DEFAULT_ORDER = [
     "dispersion_jump",
     "pulsar_system",
     "frequency_dependent",
+    "frequency_dependent_jump",
     "absolute_phase",
     "spindown",
+    "piecewise_spindown",
     "phase_jump",
+    "phase_offset",
     "wave",
     "ifunc",
     "glitch",

@@ -1,0 +1,86 @@
+"""FDJump: system-dependent frequency-dependent delay polynomials.
+
+Reference equivalent: ``pint.models.fdjump.FDJump``
+(src/pint/models/fdjump.py). Per-system corrections to the FD
+profile-evolution polynomial: each ``FDiJUMP`` line is a mask parameter
+
+    FD1JUMP -f L-wide <value> <fit>
+
+adding  FDiJUMP * log(nu / 1 GHz)^i  seconds of delay to the TOAs its
+selector matches (i = polynomial order). Unlike the global
+:class:`pint_tpu.models.frequency_dependent.FD` terms, these absorb
+profile-evolution differences between receiver/backend systems.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import Param, float_param, toa_mask
+
+Array = jax.Array
+
+_FDJUMP_RE = re.compile(r"^FD(\d+)JUMP(\d*)$")
+
+
+class FDJump(Component):
+    category = "frequency_dependent_jump"
+    is_delay = True
+    extra_par_names = tuple(f"FD{i}JUMP" for i in range(1, 10))
+
+    def __init__(self):
+        super().__init__()
+        # name -> log-frequency order i
+        self.fdjump_orders: dict[str, int] = {}
+
+    def add_fdjump(self, order: int, selector: tuple[str, ...],
+                   value: float = 0.0, frozen: bool = False,
+                   index: int | None = None) -> Param:
+        if index is None:
+            index = 1
+            while f"FD{order}JUMP{index}" in self.fdjump_orders:
+                index += 1
+        idx = index
+        name = f"FD{order}JUMP{idx}"
+        if name in self.fdjump_orders:
+            raise ValueError(f"duplicate {name}")
+        p = float_param(name, units="s", index=idx,
+                        desc=f"FD{order} jump for {selector}")
+        p.selector = tuple(str(s) for s in selector)
+        p.value = (float(value), 0.0)
+        p.frozen = frozen
+        self.fdjump_orders[name] = order
+        return self.add_param(p)
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(_FDJUMP_RE.match(l.name) for l in pf.lines)
+
+    @classmethod
+    def from_parfile(cls, pf) -> "FDJump":
+        self = cls()
+        for line in pf.lines:
+            m = _FDJUMP_RE.match(line.name)
+            if m is None:
+                continue
+            sel = tuple(line.rest) if (line.rest
+                                       and line.rest[0].startswith("-")) else ()
+            p = self.add_fdjump(int(m.group(1)), sel, frozen=not line.fit,
+                                index=int(m.group(2)) if m.group(2) else None)
+            p.set_from_par(line.value)
+            if line.uncertainty:
+                p.set_uncertainty_from_par(line.uncertainty)
+        return self
+
+    def delay(self, p, toas, acc_delay: Array, aux: dict) -> Array:
+        log_nu = jnp.log(toas.freq_mhz / 1000.0)
+        total = jnp.zeros(len(toas))
+        for name, order in self.fdjump_orders.items():
+            param = self.param(name)
+            mask = jnp.asarray(toa_mask(param.selector, toas), jnp.float64)
+            total = total + mask * f64(p, name) * log_nu ** order
+        return total

@@ -289,10 +289,15 @@ class _PLNoiseBase(NoiseComponent):
     # (chromatic (1400 MHz / f)^2) — consumed by the device-side GLS step
     basis_scale = "none"
 
-    def pl_spec(self) -> tuple[str, float, float, int]:
-        """(basis_scale, log10_amp, gamma, nharm) for in-jit basis build."""
+    def pl_spec(self) -> tuple[str, float, float, int, float]:
+        """(basis_scale, log10_amp, gamma, nharm, alpha) for in-jit build."""
         log10_amp, gamma = self.log10_amp_gamma()
-        return (self.basis_scale, float(log10_amp), float(gamma), self.nharm())
+        return (self.basis_scale, float(log10_amp), float(gamma),
+                self.nharm(), self.basis_alpha())
+
+    def basis_alpha(self) -> float:
+        """Chromatic index of the per-TOA basis scaling (nu^-alpha)."""
+        return 2.0
 
     def nharm(self) -> int:
         if self.has_param(self._c_name):
@@ -415,4 +420,71 @@ class PLDMNoise(_PLNoiseBase):
 
     def _scale_basis(self, F: np.ndarray, toas) -> np.ndarray:
         scale = (DM_FREF_MHZ / np.asarray(toas.freq_mhz)) ** 2
+        return F * scale[:, None]
+
+
+class PLChromNoise(_PLNoiseBase):
+    """Power-law chromatic noise with a fittable frequency index.
+
+    Reference equivalent: ``pint.models.noise_model.PLChromNoise``
+    (src/pint/models/noise_model.py). Same Fourier-basis construction as
+    PLDMNoise, but the per-TOA scaling is (1400 MHz / f)^alpha with
+    alpha = TNCHROMIDX (the model's chromatic index, shared with
+    ChromaticCM; default 4), instead of the fixed DM exponent 2.
+    """
+
+    category = "pl_chrom_noise"
+    _amp_name = "TNCHROMAMP"
+    _gam_name = "TNCHROMGAM"
+    _c_name = "TNCHROMC"
+    basis_scale = "chrom"
+    extra_par_names = ("TNCHROMIDX",)
+
+    def __init__(self, alpha: float = 4.0):
+        super().__init__()
+        self._alpha = float(alpha)
+        self.add_param(float_param("TNCHROMAMP", units="log10",
+                                   desc="log10 chromatic-noise amplitude",
+                                   default=float("nan"),
+                                   aliases=("TNChromAmp",)))
+        self.add_param(float_param("TNCHROMGAM", units="",
+                                   desc="Chromatic-noise spectral index gamma",
+                                   default=float("nan"),
+                                   aliases=("TNChromGam",)))
+        self.add_param(float_param("TNCHROMC", units="",
+                                   desc="Number of chromatic-noise harmonics",
+                                   default=0.0, aliases=("TNChromC",)))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return any(k in pf for k in ("TNCHROMAMP", "TNChromAmp"))
+
+    @classmethod
+    def from_parfile(cls, pf) -> "PLChromNoise":
+        idx = pf.get_value("TNCHROMIDX")
+        self = cls(alpha=float(idx) if idx else 4.0)
+        self.setup_from_parfile(pf)
+        for p in self.params:
+            p.frozen = True
+        return self
+
+    def basis_alpha(self) -> float:
+        return self._alpha
+
+    def refresh_from_model(self, model) -> None:
+        """Track the model's live TNCHROMIDX (owned by ChromaticCM/
+        CMWaveX when present) so the noise basis and the deterministic
+        chromatic delay always share one frequency index. Called by the
+        noise-plumbing consumers before every basis build."""
+        try:
+            self._alpha = model["TNCHROMIDX"].value_f64
+        except KeyError:
+            pass
+
+    def log10_amp_gamma(self) -> tuple[float, float]:
+        return (self.param("TNCHROMAMP").value_f64,
+                self.param("TNCHROMGAM").value_f64)
+
+    def _scale_basis(self, F: np.ndarray, toas) -> np.ndarray:
+        scale = (DM_FREF_MHZ / np.asarray(toas.freq_mhz)) ** self._alpha
         return F * scale[:, None]

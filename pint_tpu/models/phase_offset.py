@@ -1,0 +1,50 @@
+"""PhaseOffset: explicit overall phase offset (PHOFF).
+
+Reference equivalent: ``pint.models.phase_offset.PhaseOffset``
+(src/pint/models/phase_offset.py). An explicit fittable constant phase
+ offset between the TZR-anchored model phase and the data:
+
+    phase += -PHOFF   [turns]
+
+When PHOFF is present the implicit weighted-mean subtraction in
+:class:`pint_tpu.residuals.Residuals` is disabled (the offset is a real
+model parameter with an uncertainty instead of a silent projection) —
+matching the reference's ``Residuals`` behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.models.component import Component, f64
+from pint_tpu.models.parameter import float_param
+from pint_tpu.ops import dd, phase as phase_mod
+from pint_tpu.ops.dd import DD
+
+Array = jax.Array
+
+
+class PhaseOffset(Component):
+    category = "phase_offset"
+    is_phase = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(float_param("PHOFF", units="turns",
+                                   desc="Overall phase offset"))
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return pf.get("PHOFF") is not None
+
+    @classmethod
+    def from_parfile(cls, pf) -> "PhaseOffset":
+        self = cls()
+        self.setup_from_parfile(pf)
+        return self
+
+    def phase(self, p: dict[str, DD], toas, delay: Array, aux: dict
+              ) -> phase_mod.Phase:
+        off = -f64(p, "PHOFF") * jnp.ones(len(toas))
+        return phase_mod.from_dd(dd.from_f64(off))

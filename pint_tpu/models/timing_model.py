@@ -140,7 +140,8 @@ class TimingModel:
             return None
         return absph.get_tzr_toas(self.ephem, planets=planets)
 
-    def _phase_at(self, p: dict[str, DD], tt) -> phase_mod.Phase:
+    def _phase_at(self, p: dict[str, DD], tt,
+                  skip_categories: tuple[str, ...] = ()) -> phase_mod.Phase:
         """Composed pure phase function at resolved params `p` for table `tt`."""
         aux: dict = {}
         delay = jnp.zeros(np.shape(tt.freq_mhz)[-1])
@@ -148,6 +149,8 @@ class TimingModel:
             delay = delay + c.delay(p, tt, delay, aux)
         ph = phase_mod.zero_like(delay)
         for c in self.phase_components():
+            if c.category in skip_categories:
+                continue
             ph = phase_mod.add(ph, c.phase(p, tt, delay, aux))
         return ph
 
@@ -166,7 +169,13 @@ class TimingModel:
             p = self.resolve(base, deltas)
             ph = self._phase_at(p, toas)
             if tzr is not None:
-                ph = phase_mod.add(ph, phase_mod.neg(self._phase_at(p, tzr)))
+                # PHOFF is applied AFTER the TZR anchor (skip it in the
+                # reference phase, else the constant offset cancels
+                # exactly; reference: PhaseOffset.offset_phase is added
+                # outside the TZR subtraction)
+                ph = phase_mod.add(ph, phase_mod.neg(
+                    self._phase_at(p, tzr,
+                                   skip_categories=("phase_offset",))))
             return ph
 
         return fn
@@ -219,8 +228,10 @@ class TimingModel:
         fn = self.dm_fn(toas)
         J = jax.jacfwd(lambda d: fn(base, d))(self.zero_deltas(names))
         n = np.shape(toas.freq_mhz)[-1]
-        cols = [jnp.zeros(n)]
-        out_names = ["Offset"]
+        cols, out_names = [], []
+        if not self.has_component("PhaseOffset"):
+            cols.append(jnp.zeros(n))
+            out_names.append("Offset")
         for k in names:
             cols.append(J[k])
             out_names.append(k)
@@ -257,6 +268,10 @@ class TimingModel:
         designmatrix/weight/dimension accessors don't rebuild them.
         """
         comps = [c for c in self.components if getattr(c, "is_noise_basis", False)]
+        for c in comps:
+            # e.g. PLChromNoise tracks the model's live TNCHROMIDX
+            if hasattr(c, "refresh_from_model"):
+                c.refresh_from_model(self)
         # content key, not id(toas): a reused id after GC must not hit stale
         # bases. tdb + freq bytes + flag hash pin the table's noise-relevant
         # state (freq enters through the chromatic PLDMNoise basis scale).
@@ -264,7 +279,8 @@ class TimingModel:
         freq = np.asarray(toas.freq_mhz)
         key = (len(toas), hash(tdb.tobytes()), hash(freq.tobytes()),
                hash(toas.flags),
-               tuple((p.name, p.value) for c in comps for p in c.params))
+               tuple((p.name, p.value) for c in comps for p in c.params),
+               tuple(getattr(c, "_alpha", None) for c in comps))
         if getattr(self, "_noise_basis_key", None) != key:
             self._noise_basis_val = [(type(c).__name__, *c.basis_weight(toas))
                                      for c in comps]
@@ -323,6 +339,10 @@ class TimingModel:
         analytic chain.
         """
         names = params if params is not None else self.free_params
+        # explicit PHOFF replaces the implicit offset column (its
+        # derivative is exactly collinear; reference: designmatrix's
+        # incoffset &= "PhaseOffset" not in components)
+        incoffset = incoffset and not self.has_component("PhaseOffset")
         base = self.base_dd()
         fn = self.phase_fn(toas)
 

@@ -33,15 +33,22 @@ import numpy as np
 Array = jax.Array
 
 
-def ds32_gram(A: Array, B: Array | None = None, *, block: int = 32768
-              ) -> Array:
+def ds32_gram(A: Array, B: Array | None = None, *, block: int = 32768,
+              use_pallas: bool = False) -> Array:
     """A^T B (f64 in/out) via double-single f32 MXU matmuls.
 
     A: (n, p); B: (n, q) (defaults to A -> the Gram A^T A). The n axis
     is chunked into `block`-row slabs whose f32 partial products are
-    accumulated in f64.
+    accumulated in f64. ``use_pallas`` routes the square Gram through
+    the hand-tiled kernel (:mod:`pint_tpu.ops.pallas_gram`), which
+    carries the cross-block reduction in compensated hardware-f32 pairs
+    instead of emulated f64 — same precision band, zero emulated ops.
     """
     if B is None:
+        if use_pallas:
+            from pint_tpu.ops.pallas_gram import ds32_gram_pallas
+
+            return ds32_gram_pallas(A)
         B = A
     n, p = A.shape
     q = B.shape[1]

@@ -1,0 +1,118 @@
+"""Pallas TPU kernel: double-single f32 Gram matrix with compensated
+accumulation.
+
+The hot op of the north-star GLS iteration (SURVEY §5: the
+``(p+k)² `` Gram of the whitened design+noise block over 6×10⁵ TOAs) as
+a hand-tiled TPU kernel. Rationale over the XLA formulation in
+:mod:`pint_tpu.ops.mxu`:
+
+* Pallas on TPU has **no float64** — but it doesn't need it. The three
+  double-single products A1ᵀA1 + A1ᵀA2 + A2ᵀA1 run on the MXU in f32,
+  and the cross-block reduction is carried in a **compensated (hi, lo)
+  f32 pair** via the TwoSum error-free transform, which *is* exact in
+  hardware f32 (unlike the chip's emulated f64, whose error-free
+  transforms fail — the measured fact behind the whole hybrid design;
+  see ``pint_tpu.ops.dd``). Net precision matches
+  :func:`pint_tpu.ops.mxu.ds32_gram`'s f64 block accumulation
+  (~2⁻⁴⁸ representation + ~√B·2⁻²⁴ per-block MXU floor).
+* One kernel = one pass over A in VMEM: the split products and the
+  reduction fuse, with no (nb, q, q) f64 intermediates in HBM and no
+  emulated-f64 adds at all.
+
+Reference equivalent: none — upstream PINT runs LAPACK dgemm on the
+host (SURVEY §2.5); this kernel is the TPU-native replacement for the
+same linear-algebra step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _gram_kernel(a1_ref, a2_ref, hi_ref, lo_ref):
+    """One n-block: ds32 partial product + compensated accumulation."""
+    import jax.experimental.pallas as pl
+
+    a1 = a1_ref[:]
+    a2 = a2_ref[:]
+
+    def xtx(x, y):  # x^T y on the MXU, f32 accumulate
+        return jax.lax.dot_general(
+            x, y, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    p = xtx(a1, a1) + (xtx(a1, a2) + xtx(a2, a1))
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        hi_ref[:] = p
+        lo_ref[:] = jnp.zeros_like(p)
+
+    @pl.when(i > 0)
+    def _accumulate():
+        # TwoSum(hi, p): exact in hardware f32 (IEEE round-to-nearest)
+        a = hi_ref[:]
+        s = a + p
+        bv = s - a
+        err = (a - (s - bv)) + (p - bv)
+        hi_ref[:] = s
+        lo_ref[:] = lo_ref[:] + err
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def ds32_gram_pallas(A: Array, *, block: int = 1024,
+                     interpret: bool = False) -> Array:
+    """AᵀA (f64 in/out) via the pallas double-single kernel.
+
+    A: (n, q) float64, columns pre-whitened/normalized to O(1) (the
+    GLS callers guarantee this — see gls_gram_whitened). ``interpret``
+    runs the kernel in the pallas interpreter (CPU tests).
+    """
+    import jax.experimental.pallas as pl
+
+    n, q = A.shape
+    qp = _round_up(max(q, 1), 128)
+    bn = min(block, _round_up(max(n, 1), 8))
+    nb = -(-n // bn)
+
+    a1 = A.astype(jnp.float32)
+    a2 = (A - a1.astype(jnp.float64)).astype(jnp.float32)
+    # zero-pad: extra rows/cols contribute exact zeros to the Gram
+    a1 = jnp.pad(a1, ((0, nb * bn - n), (0, qp - q)))
+    a2 = jnp.pad(a2, ((0, nb * bn - n), (0, qp - q)))
+
+    out_shape = jax.ShapeDtypeStruct((qp, qp), jnp.float32)
+    hi, lo = pl.pallas_call(
+        _gram_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((bn, qp), lambda i: (i, 0)),
+            pl.BlockSpec((bn, qp), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((qp, qp), lambda i: (0, 0)),
+            pl.BlockSpec((qp, qp), lambda i: (0, 0)),
+        ],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(a1, a2)
+    return (hi[:q, :q].astype(jnp.float64)
+            + lo[:q, :q].astype(jnp.float64))
+
+
+def gram_error_bound(n: int, block: int = 1024) -> float:
+    """Loose relative error estimate (mirrors mxu.ds32_gram_error_bound)."""
+    per_block = np.sqrt(min(n, block)) * 2.0 ** -24
+    return float(per_block * 3.0 + 2.0 ** -48)

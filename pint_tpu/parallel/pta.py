@@ -112,6 +112,9 @@ def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
         tzr = model.get_tzr_toas()
     phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=tzr is not None)
     names = model.free_params
+    # explicit PHOFF replaces the implicit offset column + mean
+    # subtraction (see TimingModel.designmatrix)
+    has_phoff = model.has_component("PhaseOffset")
 
     def gram(base, deltas, toas, noise: NoiseStatics):
         f0 = base["F0"].hi + base["F0"].lo
@@ -125,11 +128,13 @@ def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
 
         ph = phase_fn(base, deltas, toas)
         resid_turns = ph.frac.hi + ph.frac.lo
-        resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
+        if not has_phoff:
+            resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
 
         J = jax.jacfwd(total_phase)(deltas)
-        cols = [jnp.ones_like(r) / f0] + [-J[k] / f0 for k in names]
+        cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
+            + [-J[k] / f0 for k in names]
         M = jnp.stack(cols, axis=1)
         p = M.shape[1]
 
@@ -319,11 +324,12 @@ class PTAGLSFitter:
         for i, (g, model) in enumerate(zip(grams, self.models)):
             s0 = offsets[i]
             p = int(g["p"])
+            off = 0 if model.has_component("PhaseOffset") else 1
             norm = norms[i][:p]
             xs = x[s0:s0 + p] / norm
             sig = np.sqrt(np.diag(Sigma[s0:s0 + p, s0:s0 + p])) / norm
             for j, name in enumerate(model.free_params):
                 par = model[name]
-                par.add_delta(float(xs[j + 1]))
-                par.uncertainty = float(sig[j + 1])
+                par.add_delta(float(xs[j + off]))
+                par.uncertainty = float(sig[j + off])
         return chi2

@@ -35,6 +35,11 @@ class Residuals:
                  use_weighted_mean: bool = True, track_mode: str | None = None):
         self.toas = toas
         self.model = model
+        # an explicit PHOFF parameter replaces the implicit mean
+        # subtraction (reference: Residuals disables subtract_mean when
+        # a PhaseOffset component is present)
+        if model.has_component("PhaseOffset"):
+            subtract_mean = False
         self.subtract_mean = subtract_mean
         self.use_weighted_mean = use_weighted_mean
         if track_mode is None:

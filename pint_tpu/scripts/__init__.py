@@ -21,10 +21,31 @@ def script_init(log_level: str = "INFO") -> None:
     parsing, so a new tool cannot forget the exact-f64 guard without
     also forgetting its logging setup.
     """
+    _pin_platform()
     from pint_tpu import logging as pint_logging
 
     pint_logging.setup(log_level)
     ensure_exact_f64()
+
+
+def _pin_platform() -> None:
+    """Select the JAX platform BEFORE any backend initialization.
+
+    Two measured sandbox facts force this: (1) the axon sitecustomize
+    force-selects its TPU platform via ``jax.config``, silently
+    overriding a user's ``JAX_PLATFORMS=cpu``; (2) merely *initializing*
+    that tunnel backend (which ``dd.self_check`` would trigger) can hang
+    for minutes when the tunnel is busy (round-1 bench failure mode).
+    Console tools are single-dataset workflows that must run on an
+    IEEE-exact-f64 backend anyway, so default them to CPU outright; an
+    explicit ``JAX_PLATFORMS`` naming an accelerator still wins.
+    """
+    import os
+
+    import jax
+
+    env = os.environ.get("JAX_PLATFORMS", "")
+    jax.config.update("jax_platforms", env if env else "cpu")
 
 
 def ensure_exact_f64() -> None:

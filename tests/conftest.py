@@ -23,6 +23,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: the suite's runtime is dominated by
+# recompiles of closed-over-TOAs programs (round-1 review, "weak" #8);
+# caching executables across test processes cuts repeat runs sharply.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), "..",
+                               ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 _cpus = jax.devices("cpu")
 jax.config.update("jax_default_device", _cpus[0])
 

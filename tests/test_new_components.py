@@ -1,0 +1,195 @@
+"""PhaseOffset (PHOFF), FDJump, PiecewiseSpindown, PLChromNoise.
+
+Reference test analogues: tests/test_phase_offset.py, test_fdjump.py,
+test_piecewise.py, and the PLChromNoise cases of test_noise_model.py
+(strategy per SURVEY.md §4, offline property checks).
+"""
+
+import numpy as np
+
+from pint_tpu.fitting import WLSFitter
+from pint_tpu.fitting.gls import GLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """
+PSRJ           J0001+0001
+RAJ            12:00:00.0
+DECJ           10:00:00.0
+F0             100.0  1
+F1             -1e-14  1
+PEPOCH        55000.000000
+POSEPOCH      55000.000000
+DM              30.0
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  55000.1
+TZRFRQ  1400
+TZRSITE @
+"""
+
+
+def test_phoff_replaces_offset_column():
+    m = get_model(BASE + "PHOFF 0.0 1\n")
+    assert m.has_component("PhaseOffset")
+    toas = make_fake_toas_uniform(55000, 55200, 60, m, obs="@")
+    M, names = m.designmatrix(toas)
+    assert "Offset" not in names
+    assert "PHOFF" in names
+    # PHOFF column is +1/F0 per TOA (phase -PHOFF => -dphase/dPHOFF/F0)
+    col = np.asarray(M[:, names.index("PHOFF")])
+    np.testing.assert_allclose(col, 1.0 / 100.0, rtol=1e-12)
+    # residuals must not mean-subtract with PHOFF in the model
+    r = Residuals(toas, m)
+    assert r.subtract_mean is False
+
+
+def test_phoff_fit_recovery():
+    m = get_model(BASE + "PHOFF 0.0 1\n")
+    toas = make_fake_toas_uniform(55000, 55200, 80, m, obs="@",
+                                  error_us=1.0, add_noise=True, seed=3)
+    m["PHOFF"].add_delta(0.123)
+    f = WLSFitter(toas, m)
+    f.fit_toas(maxiter=3)
+    # fitted PHOFF returns to ~0 with a finite uncertainty
+    assert abs(m["PHOFF"].value_f64) < 5 * m["PHOFF"].uncertainty + 1e-4
+    assert m["PHOFF"].uncertainty > 0
+
+
+def test_fdjump_masked_delay():
+    # select the 430 MHz band; the 1400 MHz TZR anchor stays outside the
+    # selector (a selector containing TZRFRQ folds the jump into the
+    # absolute-phase anchor instead — reference behavior, but opaque to
+    # assert against)
+    m = get_model(BASE + "FD1JUMP -freq 300 500 1e-4 1\n")
+    assert m.has_component("FDJump")
+    toas = make_fake_toas_uniform(55000, 55200, 100, m, obs="@",
+                                  freq_mhz=np.array([1400.0, 430.0]))
+    # simulation included the jump -> near-zero residuals
+    r = np.asarray(Residuals(toas, m, subtract_mean=False).time_resids)
+    assert np.max(np.abs(r)) < 1e-7
+    # removing the jump exposes it only on the selected (430 MHz) TOAs
+    m0 = get_model(BASE)
+    r0 = np.asarray(Residuals(toas, m0, subtract_mean=False).time_resids)
+    freqs = np.asarray(toas.freq_mhz)
+    jumped = r0[freqs < 1000]
+    clean = r0[freqs > 1000]
+    expect = abs(1e-4 * np.log(0.43))  # |FD1JUMP * log(430 MHz / 1 GHz)|
+    assert np.allclose(np.abs(jumped), expect, atol=2e-7)
+    assert np.max(np.abs(clean)) < 1e-7
+
+
+def test_fdjump_fit_recovery():
+    m = get_model(BASE + "FD1JUMP -freq 300 500 0.0 1\n")
+    toas = make_fake_toas_uniform(55000, 55200, 120, m, obs="@",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=5)
+    m["FD1JUMP1"].add_delta(5e-5)
+    f = WLSFitter(toas, m)
+    f.fit_toas(maxiter=3)
+    assert abs(m["FD1JUMP1"].value_f64) < 5 * m["FD1JUMP1"].uncertainty + 1e-7
+
+
+def test_piecewise_spindown_window():
+    seg = """
+PWEP_1 55100
+PWSTART_1 55050
+PWSTOP_1 55150
+PWF0_1 2e-8
+PWF1_1 0
+PWF2_1 0
+"""
+    m = get_model(BASE + seg)
+    assert m.has_component("PiecewiseSpindown")
+    toas = make_fake_toas_uniform(55000, 55200, 120, m, obs="@")
+    r = np.asarray(Residuals(toas, m, subtract_mean=False).time_resids)
+    assert np.max(np.abs(r)) < 1e-7
+    # removing the segment exposes phase drift ONLY inside the window
+    m0 = get_model(BASE)
+    r0 = np.asarray(Residuals(toas, m0, subtract_mean=False).phase_resids)
+    mjds = toas.get_mjds()
+    outside = r0[(mjds < 55050) | (mjds >= 55150)]
+    inside = r0[(mjds > 55060) & (mjds < 55140)]
+    assert np.max(np.abs(outside)) < 1e-9
+    assert np.max(np.abs(inside)) > 1e-5
+
+
+def test_piecewise_fit_recovery():
+    seg = """
+PWEP_1 55100
+PWSTART_1 55050
+PWSTOP_1 55150
+PWF0_1 0.0 1
+"""
+    m = get_model(BASE + seg)
+    toas = make_fake_toas_uniform(55000, 55200, 120, m, obs="@",
+                                  error_us=1.0, add_noise=True, seed=7)
+    m["PWF0_1"].add_delta(3e-8)
+    f = WLSFitter(toas, m)
+    f.fit_toas(maxiter=3)
+    assert abs(m["PWF0_1"].value_f64) < 5 * m["PWF0_1"].uncertainty + 1e-11
+
+
+def test_plchrom_basis_scaling():
+    m = get_model(BASE + """
+TNCHROMAMP -12.5
+TNCHROMGAM 3.1
+TNCHROMC 8
+TNCHROMIDX 4.0
+""")
+    comp = next(c for c in m.components if type(c).__name__ == "PLChromNoise")
+    assert comp.basis_alpha() == 4.0
+    scale, amp, gam, nharm, alpha = comp.pl_spec()
+    assert (scale, nharm, alpha) == ("chrom", 8, 4.0)
+    assert (amp, gam) == (-12.5, 3.1)
+    toas = make_fake_toas_uniform(55000, 55200, 60, m, obs="@",
+                                  freq_mhz=np.array([1400.0, 700.0]))
+    U, phi = comp.basis_weight(toas)
+    assert U.shape == (60, 16) and phi.shape == (16,)
+    # per-TOA scaling ratio between the two receivers is (1400/700)^4
+    freqs = np.asarray(toas.freq_mhz)
+    i_hi = np.argmax(freqs == 1400.0)
+    i_lo = np.argmax(freqs == 700.0)
+    # compare against the unscaled fourier rows via PLRedNoise-like ratio:
+    # column-wise |U| ratio at equal |sin| rows is not fixed, so check the
+    # analytic per-row scale directly
+    base = U / ((1400.0 / freqs) ** 4)[:, None]
+    # base rows must have unit-amplitude sin/cos structure: |base| <= 1
+    assert np.max(np.abs(base)) <= 1.0 + 1e-12
+    assert np.max(np.abs(U[i_lo])) > np.max(np.abs(U[i_hi]))
+
+
+def test_plchrom_gls_fit_runs():
+    m = get_model(BASE + """
+TNCHROMAMP -13.0
+TNCHROMGAM 3.0
+TNCHROMC 5
+TNCHROMIDX 4.0
+""")
+    toas = make_fake_toas_uniform(55000, 55200, 80, m, obs="@",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=11)
+    f = GLSFitter(toas, m)
+    chi2 = f.fit_toas(maxiter=2)
+    assert np.isfinite(chi2) and chi2 > 0
+    # chromatic basis with alpha=2 must reproduce PLDMNoise exactly
+    m_dm = get_model(BASE + """
+TNDMAMP -13.0
+TNDMGAM 3.0
+TNDMC 5
+""")
+    m_chrom2 = get_model(BASE + """
+TNCHROMAMP -13.0
+TNCHROMGAM 3.0
+TNCHROMC 5
+TNCHROMIDX 2.0
+""")
+    c_dm = next(c for c in m_dm.components
+                if type(c).__name__ == "PLDMNoise")
+    c_ch = next(c for c in m_chrom2.components
+                if type(c).__name__ == "PLChromNoise")
+    U1, phi1 = c_dm.basis_weight(toas)
+    U2, phi2 = c_ch.basis_weight(toas)
+    np.testing.assert_allclose(U1, U2, rtol=1e-12)
+    np.testing.assert_allclose(phi1, phi2, rtol=1e-12)

@@ -236,22 +236,9 @@ def test_hybrid_mxu_gram_matches_f64(noise_problem):
     f_ref = HybridGLSFitter(toas, m_ref)
     f_ref.fit_toas(maxiter=2)
 
-    f_mxu = HybridGLSFitter(toas, m_mxu)
     # force the ds32 path even though the test accel is the CPU: the
     # split arithmetic is platform-independent; only speed differs
-    from pint_tpu.fitting.gls_step import gls_gram_whitened
-    from pint_tpu.fitting.hybrid import _accel_pl_bases
-    import jax
-
-    pl_specs = f_mxu.pl_specs
-
-    def stage2_mxu(A_M, rw, sw, norm_M, t_s, inv_f2, epoch_idx,
-                   ecorr_phi, pl_params):
-        F, phi_F = _accel_pl_bases(t_s, inv_f2, pl_specs, pl_params)
-        return gls_gram_whitened(A_M, rw, sw, norm_M, F, phi_F,
-                                 epoch_idx, ecorr_phi, mxu=True)
-
-    f_mxu._stage2_gram = jax.jit(stage2_mxu)
+    f_mxu = HybridGLSFitter(toas, m_mxu, force_mxu=True)
     chi2 = f_mxu.fit_toas(maxiter=3)
     assert np.isfinite(chi2)
     for name in m_ref.free_params:
