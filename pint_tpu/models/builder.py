@@ -138,13 +138,17 @@ def get_model(parfile: str | ParFile, *, allow_tcb: bool = False) -> TimingModel
     recognized = set(_HEADER_KEYS) | set(model.params)
     for p in model.params.values():
         recognized.update(p.aliases)
+    extra_res = []
     for c in model.components:
         recognized.update(getattr(c, "extra_par_names", ()))
+        pat = getattr(c, "extra_par_regex", None)
+        if pat is not None:
+            extra_res.append(pat)
     for line in pf.lines:
         nm = line.name
         if nm in recognized or nm == "JUMP" or nm.startswith(
             ("DMXR1_", "DMXR2_", "DMX_", "JUMP")
-        ):
+        ) or any(p.match(nm) for p in extra_res):
             continue
         log.warning("par parameter %s not recognized by any component; ignored", nm)
     return model

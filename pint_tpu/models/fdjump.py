@@ -30,7 +30,9 @@ _FDJUMP_RE = re.compile(r"^FD(\d+)JUMP(\d*)$")
 class FDJump(Component):
     category = "frequency_dependent_jump"
     is_delay = True
-    extra_par_names = tuple(f"FD{i}JUMP" for i in range(1, 10))
+    # any FD<i>JUMP order is consumed (the builder's recognized-name
+    # check matches this, so orders >= 10 don't warn as ignored)
+    extra_par_regex = _FDJUMP_RE
 
     def __init__(self):
         super().__init__()

@@ -78,6 +78,47 @@ class PhaseJump(Component):
         return phase_mod.from_dd(dd.from_f64(total))
 
 
+class DelayJump(PhaseJump):
+    """JUMP applied in the *delay* chain (tempo-style time jump).
+
+    Reference equivalent: ``pint.models.jump.DelayJump``
+    (src/pint/models/jump.py). Upstream never instantiates this from a
+    par file — ``JUMP`` lines always build :class:`PhaseJump` — so the
+    par-file trigger is deliberately disabled here too
+    (``applicable() -> False``); construct it programmatically. The
+    delay contribution is +JUMP seconds on the selected TOAs, which for
+    constant spin frequency equals PhaseJump's ``phase -= JUMP * F0``.
+    Unlike PhaseJump, the jump shifts the barycentric time seen by every
+    *later* delay/phase component (it participates in the delay
+    accumulation), matching the tempo convention.
+
+    Parameters are the same ``JUMP<i>`` family as PhaseJump (upstream
+    names them identically too), so — exactly as upstream — the two
+    components cannot coexist in one model: route every jump through
+    one or the other.
+    """
+
+    category = "jump_delay"
+    is_delay = True
+    is_phase = False
+
+    @classmethod
+    def applicable(cls, pf) -> bool:
+        return False  # JUMP lines build PhaseJump (upstream convention)
+
+    def phase(self, p: dict[str, DD], toas, delay: Array, aux: dict):
+        raise NotImplementedError("DelayJump contributes delay, not phase")
+
+    def delay(self, p: dict[str, DD], toas, acc_delay: Array,
+              aux: dict) -> Array:
+        total = jnp.zeros(len(toas))
+        for name in self.jump_names:
+            param = self.param(name)
+            mask = jnp.asarray(toa_mask(param.selector, toas), jnp.float64)
+            total = total + mask * f64(p, name)
+        return total
+
+
 class DispersionJump(Component):
     """DMJUMP: DM offsets on selected wideband DM measurements.
 

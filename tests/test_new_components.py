@@ -193,3 +193,36 @@ TNCHROMIDX 2.0
     U2, phi2 = c_ch.basis_weight(toas)
     np.testing.assert_allclose(U1, U2, rtol=1e-12)
     np.testing.assert_allclose(phi1, phi2, rtol=1e-12)
+
+
+def test_delay_jump_matches_phase_jump():
+    """DelayJump(+J s) ~ PhaseJump(phase -= J*F0) for slow spindown.
+
+    Reference: pint.models.jump.DelayJump (programmatic-only upstream;
+    applicable() is disabled the same way here).
+    """
+    from pint_tpu.models.jump import DelayJump
+    from pint_tpu.io.parfile import parse_parfile
+
+    m0 = get_model(BASE)
+    toas = make_fake_toas_uniform(55000, 55200, 60, m0, obs="@")
+
+    # par-file JUMP lines must never construct a DelayJump
+    assert not DelayJump.applicable(parse_parfile(BASE + "JUMP -fe x 1e-4"))
+
+    J = 3.25e-5  # seconds
+    lo, hi = 55080.0, 55120.0
+    m = get_model(BASE)
+    dj = DelayJump()
+    dj.add_jump(("mjd", str(lo), str(hi)), value=J, frozen=True)
+    m.add_component(dj)
+
+    r0 = np.asarray(Residuals(toas, m0, subtract_mean=False).phase_resids)
+    r1 = np.asarray(Residuals(toas, m, subtract_mean=False).phase_resids)
+    mjds = np.asarray(toas.get_mjds())
+    sel = (mjds >= lo) & (mjds <= hi)
+    f0 = m0.f0_f64
+    # selected TOAs shifted by -J*F0 cycles (F1 correction ~ J*F1*T ~ 1e-11)
+    np.testing.assert_allclose(r1[sel] - r0[sel], -J * f0,
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(r1[~sel], r0[~sel], rtol=0, atol=1e-12)
