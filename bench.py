@@ -267,24 +267,55 @@ def main() -> None:
     if os.environ.get("PINT_TPU_BENCH_CHILD"):
         _main_guarded()
         return
-    env = dict(os.environ, PINT_TPU_BENCH_CHILD="1")
-    try:
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, timeout=TOTAL_TIMEOUT_S,
-                              capture_output=True, text=True)
+
+    def run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
+        """(parsed last JSON line or None, failure description)."""
+        env = dict(os.environ, PINT_TPU_BENCH_CHILD="1", **extra_env)
+        try:
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, timeout=timeout_s,
+                                  capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            return None, (f"bench exceeded {timeout_s:.0f}s (backend hang "
+                          "mid-compile/execute)")
         out = proc.stdout.strip()
-        if out:
-            print(out.splitlines()[-1])
-        else:
-            _emit({"metric": "gls_fit_iter_wall", "value": -1.0, "unit": "s",
-                   "vs_baseline": 0.0,
-                   "error": f"child rc={proc.returncode}: "
-                            f"{(proc.stderr or '')[-400:]}"})
-    except subprocess.TimeoutExpired:
-        _emit({"metric": "gls_fit_iter_wall", "value": -1.0, "unit": "s",
-               "vs_baseline": 0.0,
-               "error": f"bench exceeded {TOTAL_TIMEOUT_S}s (backend hang "
-                        "mid-compile/execute)"})
+        if not out:
+            return None, (f"child rc={proc.returncode}: "
+                          f"{(proc.stderr or '')[-400:]}")
+        try:
+            return json.loads(out.splitlines()[-1]), ""
+        except json.JSONDecodeError:
+            return None, f"unparseable child output: {out[-200:]}"
+
+    # TOTAL_TIMEOUT_S bounds the WHOLE bench including the CPU fallback:
+    # the accelerator attempt gets 60% of the budget, the fallback the
+    # remainder (the CPU run itself takes ~1 min at the default N).
+    t_start = time.perf_counter()
+    result, fail = run_child({}, 0.6 * TOTAL_TIMEOUT_S)
+    if result is not None and result.get("value", -1.0) > 0:
+        print(json.dumps(result))
+        return
+    if result is not None:
+        fail = result.get("error", fail) or fail
+    # The accelerator tunnel is flaky (hangs at init for whole sessions —
+    # observed repeatedly). A measured CPU-backend number, clearly
+    # labeled, beats a diagnostic-only line: rerun pinned to CPU and
+    # record why. Skip when the failed run was already on the CPU
+    # backend (an identical rerun cannot succeed).
+    if (result or {}).get("backend") == "cpu":
+        print(json.dumps(result))
+        return
+    remaining = TOTAL_TIMEOUT_S - (time.perf_counter() - t_start)
+    cpu_result, cpu_fail = run_child({"JAX_PLATFORMS": "cpu"},
+                                     max(60.0, remaining))
+    if cpu_result is not None and cpu_result.get("value", -1.0) > 0:
+        cpu_result["fallback_reason"] = f"accelerator backend failed: {fail}"
+        print(json.dumps(cpu_result))
+        return
+    _emit({"metric": "gls_fit_iter_wall", "value": -1.0, "unit": "s",
+           "vs_baseline": 0.0,
+           "error": f"accelerator: {fail}; cpu fallback: "
+                    f"{(cpu_result or {}).get('error', cpu_fail)}"})
 
 
 def _main_guarded() -> None:

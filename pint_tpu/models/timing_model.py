@@ -19,12 +19,16 @@ with parameters resolved as ``base (+) delta`` in double-double, so
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.models.component import DEFAULT_ORDER, Component
 from pint_tpu.models.parameter import Param
+
+log = logging.getLogger(__name__)
 from pint_tpu.ops import dd, phase as phase_mod
 from pint_tpu.ops.dd import DD
 
@@ -383,6 +387,17 @@ class TimingModel:
         skip_defaults = {"PMRA", "PMDEC", "PMELONG", "PMELAT", "PX",
                          "PLANET_SHAPIRO", "TZRFRQ"}
         for c in self.components:
+            if type(c).__name__ == "DelayJump":
+                # par syntax cannot express delay-chain jumps: re-reading
+                # this file reconstructs them as PhaseJump (same numbers,
+                # different chain position for later delay components).
+                # Tag the lines so the degradation is visible.
+                log.warning(
+                    "as_parfile: DelayJump params serialize as plain JUMP "
+                    "lines and will re-load as PhaseJump")
+                lines.append("# NB: the JUMP lines below were a DelayJump "
+                             "(delay-chain); par syntax re-loads them as "
+                             "PhaseJump")
             for p in c.params:
                 if p.kind == "bool":
                     if p.value:

@@ -118,7 +118,15 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple, 
                     owners[" ".join(sel)] = (i, p.selector, p.name)
                     tag += 1
                 continue
-            if isinstance(c, PhaseJump):
+            # exact type: DelayJump subclasses PhaseJump but applies in
+            # the delay chain — absorbing it here would silently turn it
+            # into a phase term, and the generic union path would share
+            # one pulsar's jump windows with the whole batch
+            if isinstance(c, PhaseJump) and type(c) is not PhaseJump:
+                raise ValueError(
+                    f"batched fitting does not support {type(c).__name__}; "
+                    "use per-pulsar fitters or PhaseJump")
+            if type(c) is PhaseJump:
                 for p in c.params:
                     sel = ("batched", str(tag))
                     np_ = jump.add_jump(sel, frozen=p.frozen)
