@@ -305,9 +305,11 @@ def main() -> None:
     if (result or {}).get("backend") == "cpu":
         print(json.dumps(result))
         return
+    # the fallback gets only the remaining budget: TOTAL_TIMEOUT_S is a
+    # hard bound on the whole bench (CI harnesses size timeouts from it)
     remaining = TOTAL_TIMEOUT_S - (time.perf_counter() - t_start)
     cpu_result, cpu_fail = run_child({"JAX_PLATFORMS": "cpu"},
-                                     max(60.0, remaining))
+                                     max(1.0, remaining))
     if cpu_result is not None and cpu_result.get("value", -1.0) > 0:
         cpu_result["fallback_reason"] = f"accelerator backend failed: {fail}"
         print(json.dumps(cpu_result))

@@ -27,12 +27,12 @@ import numpy as np
 
 from pint_tpu.models.component import DEFAULT_ORDER, Component
 from pint_tpu.models.parameter import Param
-
-log = logging.getLogger(__name__)
 from pint_tpu.ops import dd, phase as phase_mod
 from pint_tpu.ops.dd import DD
 
 Array = jax.Array
+
+log = logging.getLogger(__name__)
 
 
 def _order_key(comp: Component) -> int:
@@ -413,6 +413,12 @@ class TimingModel:
                     continue
                 lines.append(p.as_parfile_line())
         return "\n".join(lines) + "\n"
+
+    def compare(self, other: "TimingModel") -> str:
+        """Parameter-level diff table (reference: TimingModel.compare)."""
+        from pint_tpu.scripts.compare_parfiles import compare_models
+
+        return compare_models(self, other)
 
     def __repr__(self) -> str:
         comps = ", ".join(type(c).__name__ for c in self.components)

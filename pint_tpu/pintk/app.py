@@ -36,6 +36,7 @@ def run_app(ctrl) -> int:
     status = tk.StringVar(value=ctrl.summary())
     xaxis = tk.StringVar(value="mjd")
     show_random = tk.BooleanVar(value=False)
+    show_avg = tk.BooleanVar(value=False)
 
     # ---------------------------------------------------------------- params
     side = ttk.Frame(root)
@@ -71,6 +72,11 @@ def run_app(ctrl) -> int:
                 for row in ctrl.random_dphase * 1e6:
                     ax.plot(x[order], (yp + row)[order], color="C1",
                             alpha=0.15, lw=0.6)
+        if show_avg.get() and xaxis.get() == "mjd":
+            which = "postfit" if ctrl.postfit_model is not None else "prefit"
+            am, ay, ae, albl = ctrl.averaged_y_data(which)
+            ax.errorbar(am, ay, yerr=ae, fmt="s", color="C2", ms=5,
+                        label=albl, zorder=5)
         sel = ctrl.selected[~ctrl.deleted]
         if sel.any() and not sel.all():
             ax.plot(x[sel], ydisp[sel], "o", mfc="none", mec="C3", ms=9,
@@ -141,6 +147,8 @@ def run_app(ctrl) -> int:
                       ("Delete selected", do_delete),
                       ("Write par", do_write_par), ("Write tim", do_write_tim)):
         ttk.Button(bar, text=text, command=cmd).pack(side="left", padx=2)
+    ttk.Checkbutton(bar, text="Avg", variable=show_avg,
+                    command=redraw).pack(side="left", padx=4)
     ttk.Label(bar, text="  x:").pack(side="left")
     opt = ttk.Combobox(bar, textvariable=xaxis, values=list(X_AXES), width=13,
                        state="readonly")

@@ -38,6 +38,7 @@ class PintkController:
         self.random_dphase = None
         self._prefit_cache = None
         self._postfit_cache = None
+        self._avg_cache = {}
 
     # ---------------------------------------------------------------- state
     @property
@@ -63,6 +64,7 @@ class PintkController:
     def _invalidate(self):
         self._prefit_cache = None
         self._postfit_cache = None
+        self._avg_cache = {}
 
     # ------------------------------------------------------------ selection
     def select_range(self, mjd_lo: float, mjd_hi: float, *,
@@ -179,6 +181,26 @@ class PintkController:
         return (np.asarray(r.time_resids) * 1e6,
                 np.asarray(r.get_errors_s()) * 1e6,
                 f"{which} residual (us)")
+
+    def averaged_y_data(self, which: str = "prefit"
+                        ) -> tuple[np.ndarray, np.ndarray, np.ndarray, str]:
+        """Epoch-averaged residuals (plk 'avg' view; Residuals.ecorr_average).
+
+        Returns (mjds, residuals_us, errors_us, label).
+        """
+        if which == "prefit":
+            r = self.prefit_resids()
+        elif which == "postfit":
+            r = self.postfit_resids()
+            if r is None:
+                raise ValueError("no postfit model yet: fit first")
+        else:
+            raise ValueError(f"unknown y axis {which!r}; have {Y_AXES}")
+        if which not in self._avg_cache:  # invalidated with the resids
+            self._avg_cache[which] = r.ecorr_average()
+        avg = self._avg_cache[which]
+        return (avg["mjds"], avg["time_resids"] * 1e6,
+                avg["errors"] * 1e6, f"avg {which} residual (us)")
 
     # ---------------------------------------------------------------- output
     def write_par(self, path: str) -> str:

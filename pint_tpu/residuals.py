@@ -111,3 +111,54 @@ class Residuals:
 
     def calc_phase_resids(self) -> Array:
         return self.phase_resids
+
+    # ------------------------------------------------------------------
+    def ecorr_average(self, *, use_noise_model: bool = True,
+                      dt_s: float = 1.0) -> dict[str, np.ndarray]:
+        """Epoch-averaged residuals (reference: Residuals.ecorr_average).
+
+        Groups TOAs into near-simultaneous epochs (the ECORR
+        quantization grouping, ``nmin=1`` so singletons survive) and
+        weighted-averages the time residuals within each. With
+        ``use_noise_model`` the per-epoch uncertainty adds the matching
+        ECORR value in quadrature and weights use the scaled (EFAC/
+        EQUAD) errors — the plk-style "averaged residuals" view.
+
+        Returns a dict of per-epoch arrays: ``mjds``, ``freqs``,
+        ``time_resids`` [s], ``errors`` [s], ``indices`` (list of
+        member-index arrays).
+        """
+        from pint_tpu.constants import SECS_PER_DAY
+        from pint_tpu.models.noise import quantize_epochs
+
+        mjds = np.asarray(self.toas.tdb.hi) + np.asarray(self.toas.tdb.lo)
+        groups = quantize_epochs(mjds * SECS_PER_DAY, dt_s=dt_s, nmin=1)
+        err = np.asarray(self.get_errors_s() if use_noise_model
+                         else self.toas.get_errors_s())
+        # per-TOA ECORR value [s] (zero where no ECORR selector matches)
+        ecorr_s = np.zeros(len(self.toas))
+        ec = self.model.get_component("EcorrNoise") if use_noise_model else None
+        if ec is not None:
+            from pint_tpu.models.parameter import toa_mask
+
+            for name in ec.ecorr_names:
+                p = ec.param(name)
+                m = np.asarray(toa_mask(p.selector, self.toas))
+                ecorr_s[m.astype(bool)] = p.value_f64 * 1e-6
+        r = np.asarray(self.time_resids)
+        freqs = np.asarray(self.toas.freq_mhz)
+        out = {"mjds": [], "freqs": [], "time_resids": [], "errors": [],
+               "indices": []}
+        for g in groups:
+            w = np.where(err[g] > 0, 1.0 / np.square(err[g]), 0.0)
+            sw = np.sum(w)
+            if sw == 0.0:  # all-zero-error epoch: unweighted average
+                w = np.ones(len(g))
+                sw = float(len(g))
+            out["mjds"].append(np.sum(mjds[g] * w) / sw)
+            out["freqs"].append(np.sum(freqs[g] * w) / sw)
+            out["time_resids"].append(np.sum(r[g] * w) / sw)
+            out["errors"].append(np.sqrt(1.0 / sw + np.max(ecorr_s[g]) ** 2))
+            out["indices"].append(g)
+        return {k: (np.asarray(v) if k != "indices" else v)
+                for k, v in out.items()}

@@ -152,6 +152,32 @@ class TOAs:
     def last_mjd(self) -> float:
         return float(np.max(self.get_mjds()))
 
+    def get_summary(self) -> str:
+        """Human-readable table description (reference: TOAs.get_summary)."""
+        mjds = self.get_mjds()
+        err = np.asarray(self.error_us)
+        freq = np.asarray(self.freq_mhz)
+        obs_idx = np.asarray(self.obs_index)
+        lines = [
+            f"Number of TOAs: {len(self)}",
+            f"MJD span: {mjds.min():.4f} to {mjds.max():.4f} "
+            f"({(mjds.max() - mjds.min()) / 365.25:.2f} yr)",
+            f"Frequency range: {freq.min():.1f} to {freq.max():.1f} MHz",
+            f"TOA errors: median {np.median(err):.3g} us "
+            f"(min {err.min():.3g}, max {err.max():.3g})",
+            f"Ephemeris: {self.ephem_name}; clock corrections "
+            f"{'applied' if self.clock_applied else 'NOT applied'}",
+            "Observatories:",
+        ]
+        for i, name in enumerate(self.obs_names):
+            n = int(np.sum(obs_idx == i))
+            if n:
+                lines.append(f"  {name}: {n} TOAs")
+        return "\n".join(lines)
+
+    def print_summary(self) -> None:
+        print(self.get_summary())
+
 
 def merge_TOAs(toas_list: list[TOAs]) -> TOAs:
     """Concatenate TOA tables (reference: pint.toa.merge_TOAs)."""

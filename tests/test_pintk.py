@@ -131,3 +131,10 @@ def test_write_par_tim(ctrl, tmp_path):
     from pint_tpu.toas import get_TOAs
 
     assert len(get_TOAs(str(tim), ephem="builtin_analytic")) == 60
+
+
+def test_controller_averaged_y_data(ctrl):
+    m, y, e, lbl = ctrl.averaged_y_data("prefit")
+    assert len(m) == len(y) == len(e) > 0
+    assert np.all(np.diff(m) > 0)
+    assert "avg" in lbl

@@ -219,3 +219,51 @@ def test_fit_report_structure(fitted):
     assert rep["params"]["F0"]["fitted"] is True
     assert rep["params"]["F0"]["uncertainty"] > 0
     assert rep["chi2"] == pytest.approx(f.resids.chi2)
+
+
+def test_model_compare(fitted):
+    """TimingModel.compare (reference: pint TimingModel.compare)."""
+    f, toas, model = fitted
+    m2 = get_model(model.as_parfile())
+    m2["F0"].add_delta(1e-9)
+    txt = model.compare(m2)
+    assert "F0" in txt and "diff" in txt
+    # the shifted parameter shows a nonzero diff column
+    f0_line = next(l for l in txt.splitlines() if l.startswith("F0"))
+    assert "1.0000e-09" in f0_line or "1e-09" in f0_line
+
+
+def test_toas_get_summary(fitted):
+    _, toas, _ = fitted
+    s = toas.get_summary()
+    assert "Number of TOAs: 50" in s
+    assert "gbt" in s
+    assert "MJD span" in s and "Frequency range" in s
+
+
+def test_ecorr_average(fitted):
+    """Epoch-averaged residuals (reference: Residuals.ecorr_average)."""
+    from pint_tpu.models import get_model as gm
+    from pint_tpu.residuals import Residuals
+
+    model = gm(PAR + "EFAC -f fake 1.0\nECORR -f fake 0.5\n")
+    # 2 TOAs per epoch: duplicate each observation second-apart
+    t0 = make_fake_toas_uniform(53478, 54187, 30, model, obs="gbt",
+                                error_us=1.0, add_noise=True, seed=7)
+    from pint_tpu.toas import Flags, merge_TOAs
+    import dataclasses
+    toas = merge_TOAs([t0, t0])
+    toas = dataclasses.replace(
+        toas, flags=Flags(dict(d, f="fake") for d in toas.flags))
+    r = Residuals(toas, model)
+    avg = r.ecorr_average()
+    assert len(avg["mjds"]) == 30          # pairs collapsed
+    assert np.all(np.diff(avg["mjds"]) > 0)
+    # averaged uncertainty includes the 0.5us ECORR floor in quadrature:
+    # two 1us TOAs -> white 1/sqrt(2) us, + (0.5us)^2 => ~0.866us
+    np.testing.assert_allclose(avg["errors"], np.sqrt(0.5 + 0.25) * 1e-6,
+                               rtol=1e-6)
+    # weighted mean of each pair (identical resids -> equals member value)
+    member = np.asarray(r.time_resids)[avg["indices"][0]]
+    np.testing.assert_allclose(avg["time_resids"][0], member.mean(),
+                               atol=1e-15)
