@@ -113,6 +113,7 @@ class PintkController:
         self.postfit_model = fit_model
         self.random_dphase = None
         self._postfit_cache = None
+        self._avg_cache.pop("postfit", None)
         return {"chi2": float(chi2), "dof": self.fitter.resids.dof,
                 "wrms_us": self.fitter.resids.rms_weighted_s() * 1e6,
                 "fitter": type(self.fitter).__name__}
@@ -168,16 +169,19 @@ class PintkController:
             return ((mjds - epoch) / pb) % 1.0, "Orbital phase"
         raise ValueError(f"unknown x axis {axis!r}; have {X_AXES}")
 
-    def y_data(self, which: str = "prefit") -> tuple[np.ndarray, np.ndarray, str]:
-        """(residuals_us, errors_us, label) for the active TOAs."""
+    def _resids_for(self, which: str) -> Residuals:
         if which == "prefit":
-            r = self.prefit_resids()
-        elif which == "postfit":
+            return self.prefit_resids()
+        if which == "postfit":
             r = self.postfit_resids()
             if r is None:
                 raise ValueError("no postfit model yet: fit first")
-        else:
-            raise ValueError(f"unknown y axis {which!r}; have {Y_AXES}")
+            return r
+        raise ValueError(f"unknown y axis {which!r}; have {Y_AXES}")
+
+    def y_data(self, which: str = "prefit") -> tuple[np.ndarray, np.ndarray, str]:
+        """(residuals_us, errors_us, label) for the active TOAs."""
+        r = self._resids_for(which)
         return (np.asarray(r.time_resids) * 1e6,
                 np.asarray(r.get_errors_s()) * 1e6,
                 f"{which} residual (us)")
@@ -188,14 +192,7 @@ class PintkController:
 
         Returns (mjds, residuals_us, errors_us, label).
         """
-        if which == "prefit":
-            r = self.prefit_resids()
-        elif which == "postfit":
-            r = self.postfit_resids()
-            if r is None:
-                raise ValueError("no postfit model yet: fit first")
-        else:
-            raise ValueError(f"unknown y axis {which!r}; have {Y_AXES}")
+        r = self._resids_for(which)
         if which not in self._avg_cache:  # invalidated with the resids
             self._avg_cache[which] = r.ecorr_average()
         avg = self._avg_cache[which]

@@ -114,51 +114,66 @@ class Residuals:
 
     # ------------------------------------------------------------------
     def ecorr_average(self, *, use_noise_model: bool = True,
-                      dt_s: float = 1.0) -> dict[str, np.ndarray]:
+                      dt_s: float | None = None) -> dict[str, np.ndarray]:
         """Epoch-averaged residuals (reference: Residuals.ecorr_average).
 
-        Groups TOAs into near-simultaneous epochs (the ECORR
-        quantization grouping, ``nmin=1`` so singletons survive) and
-        weighted-averages the time residuals within each. With
-        ``use_noise_model`` the per-epoch uncertainty adds the matching
-        ECORR value in quadrature and weights use the scaled (EFAC/
-        EQUAD) errors — the plk-style "averaged residuals" view.
+        Epochs are the model's own ECORR grouping when an ``EcorrNoise``
+        component is present (``EcorrNoise.epoch_indices`` — per
+        selector, the component's ``dt_s``/``nmin``); TOAs outside any
+        ECORR epoch, or the whole set when no ECORR exists, are grouped
+        by time adjacency (``dt_s`` seconds, default the component's or
+        1.0). Residuals are weighted-averaged within each epoch; with
+        ``use_noise_model`` the weights use the scaled (EFAC/EQUAD)
+        errors and the per-epoch uncertainty adds the epoch's ECORR in
+        quadrature — the plk-style "averaged residuals" view.
 
-        Returns a dict of per-epoch arrays: ``mjds``, ``freqs``,
-        ``time_resids`` [s], ``errors`` [s], ``indices`` (list of
-        member-index arrays).
+        Returns a dict of per-epoch arrays sorted by time: ``mjds``,
+        ``freqs``, ``time_resids`` [s], ``errors`` [s] (NaN for an
+        all-zero-error epoch), ``indices`` (list of member-index
+        arrays).
         """
         from pint_tpu.constants import SECS_PER_DAY
         from pint_tpu.models.noise import quantize_epochs
 
         mjds = np.asarray(self.toas.tdb.hi) + np.asarray(self.toas.tdb.lo)
-        groups = quantize_epochs(mjds * SECS_PER_DAY, dt_s=dt_s, nmin=1)
+        n = len(self.toas)
+        ec = self.model.get_component("EcorrNoise") if use_noise_model else None
+        groups: list[np.ndarray] = []
+        group_var: list[float] = []  # per-epoch ECORR variance [s^2]
+        ungrouped = np.ones(n, dtype=bool)
+        if ec is not None:
+            idx, phi = ec.epoch_indices(self.toas)
+            for e in range(len(phi)):
+                g = np.nonzero(idx == e)[0]
+                groups.append(g)
+                group_var.append(float(phi[e]))
+                ungrouped[g] = False
+        if dt_s is None:
+            dt_s = ec.dt_s if ec is not None else 1.0
+        rest = np.nonzero(ungrouped)[0]
+        if rest.size:
+            for g in quantize_epochs(mjds[rest] * SECS_PER_DAY,
+                                     dt_s=dt_s, nmin=1):
+                groups.append(rest[g])
+                group_var.append(0.0)
         err = np.asarray(self.get_errors_s() if use_noise_model
                          else self.toas.get_errors_s())
-        # per-TOA ECORR value [s] (zero where no ECORR selector matches)
-        ecorr_s = np.zeros(len(self.toas))
-        ec = self.model.get_component("EcorrNoise") if use_noise_model else None
-        if ec is not None:
-            from pint_tpu.models.parameter import toa_mask
-
-            for name in ec.ecorr_names:
-                p = ec.param(name)
-                m = np.asarray(toa_mask(p.selector, self.toas))
-                ecorr_s[m.astype(bool)] = p.value_f64 * 1e-6
         r = np.asarray(self.time_resids)
         freqs = np.asarray(self.toas.freq_mhz)
         out = {"mjds": [], "freqs": [], "time_resids": [], "errors": [],
                "indices": []}
-        for g in groups:
+        for g, var in zip(groups, group_var):
             w = np.where(err[g] > 0, 1.0 / np.square(err[g]), 0.0)
             sw = np.sum(w)
-            if sw == 0.0:  # all-zero-error epoch: unweighted average
-                w = np.ones(len(g))
-                sw = float(len(g))
+            if sw == 0.0:  # all-zero-error epoch: unweighted, unknown sigma
+                w, sw, white_var = np.ones(len(g)), float(len(g)), np.nan
+            else:
+                white_var = 1.0 / sw
             out["mjds"].append(np.sum(mjds[g] * w) / sw)
             out["freqs"].append(np.sum(freqs[g] * w) / sw)
             out["time_resids"].append(np.sum(r[g] * w) / sw)
-            out["errors"].append(np.sqrt(1.0 / sw + np.max(ecorr_s[g]) ** 2))
+            out["errors"].append(np.sqrt(white_var + var))
             out["indices"].append(g)
-        return {k: (np.asarray(v) if k != "indices" else v)
-                for k, v in out.items()}
+        order = np.argsort(np.asarray(out["mjds"]))
+        return {k: (np.asarray(v)[order] if k != "indices"
+                    else [v[i] for i in order]) for k, v in out.items()}

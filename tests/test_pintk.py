@@ -138,3 +138,16 @@ def test_controller_averaged_y_data(ctrl):
     assert len(m) == len(y) == len(e) > 0
     assert np.all(np.diff(m) > 0)
     assert "avg" in lbl
+
+
+def test_averaged_cache_invalidated_by_fit(ctrl):
+    ctrl.fit()
+    ctrl.averaged_y_data("postfit")
+    assert "postfit" in ctrl._avg_cache
+    ctrl.fit()  # refit must drop the cached postfit average
+    assert "postfit" not in ctrl._avg_cache
+    ctrl.delete_selected()  # _invalidate clears every cached view
+    ctrl.averaged_y_data("prefit")
+    assert "prefit" in ctrl._avg_cache
+    ctrl.undelete_all()
+    assert ctrl._avg_cache == {}

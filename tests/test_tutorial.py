@@ -1,0 +1,25 @@
+"""Execute every python code block in docs/TUTORIAL.md, in order.
+
+The tutorial is the user-facing workflow doc; this test keeps it
+truthful (reference analogue: PINT's executed tutorial notebooks,
+SURVEY.md §4's integration-shaped strategy).
+"""
+
+import pathlib
+import re
+
+TUTORIAL = pathlib.Path(__file__).parent.parent / "docs" / "TUTORIAL.md"
+
+
+def test_tutorial_blocks_run():
+    text = TUTORIAL.read_text()
+    blocks = re.findall(r"```python\n(.*?)```", text, flags=re.DOTALL)
+    assert len(blocks) >= 7, "tutorial lost its code blocks"
+    ns: dict = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"{TUTORIAL.name}[block {i}]", "exec"), ns)
+        except Exception as e:  # pragma: no cover - diagnostic
+            raise AssertionError(
+                f"tutorial block {i} failed: {type(e).__name__}: {e}\n"
+                f"---\n{block}") from e
