@@ -119,6 +119,72 @@ def build_problem(n: int):
     return model, toas
 
 
+def _dd_pin_ctx():
+    """(ctx, backend-suffix): CPU pin when the accelerator breaks DD.
+
+    The mode benches run the full DD phase pipeline on the default
+    backend; that needs IEEE f64 (error-free transforms). When the
+    accelerator fails ``dd.self_check`` (TPU v5e does — measured), a
+    valid CPU number beats NaN on-chip (the hybrid split covers the
+    default gls mode only).
+    """
+    import contextlib
+
+    from pint_tpu.ops import dd as dd_mod
+
+    if dd_mod.self_check():
+        return contextlib.nullcontext(), ""
+    from pint_tpu.fitting.hybrid import cpu_device
+
+    return (jax.default_device(cpu_device()),
+            " (pinned to cpu: accelerator fails dd self-check)")
+
+
+def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
+    """Shared mode-bench harness: build, warm, time reps, emit JSON.
+
+    ``setup()`` runs under the DD-validity pin and returns
+    ``(fit, extras)`` — ``fit()`` performs one full iteration;
+    ``extras()`` contributes additional JSON fields after timing.
+    """
+    try:
+        ctx, pinned = _dd_pin_ctx()
+        with ctx:
+            fit, extras = setup()
+            fit()  # compile + warm
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fit()
+                times.append(time.perf_counter() - t0)
+            value = float(np.median(times))
+            out = {"metric": metric, "value": round(value, 6), "unit": "s",
+                   "vs_baseline": round(budget_s / value, 3),
+                   "backend": jax.default_backend() + pinned}
+            out.update(extras())
+        _emit(out)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
+def _random_toas(model, n: int, rng, *, epochs4: bool = False):
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.toas import build_TOAs_from_arrays
+
+    if epochs4:  # 4-TOA ECORR epochs within 0.5 s
+        n_ep = max(1, (n + 3) // 4)
+        centers = np.sort(rng.uniform(50000.0, 58000.0, size=n_ep))
+        mjds = (centers[:, None]
+                + rng.uniform(0, 0.5 / 86400.0, (n_ep, 4))).ravel()[:n]
+    else:
+        mjds = np.sort(rng.uniform(50000.0, 58000.0, size=n))
+    return build_TOAs_from_arrays(
+        DD(jnp.asarray(mjds), jnp.zeros(n)),
+        freq_mhz=np.where(rng.random(n) < 0.5, 1400.0, 430.0),
+        error_us=np.full(n, 1.0), obs_names=("gbt",), eph=model.ephem)
+
+
 def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
     """BASELINE config 5: joint HD-correlated GLS over a pulsar array.
 
@@ -126,65 +192,88 @@ def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
     iteration (per-pulsar reduced Grams + global GW-coupled solve).
     """
     metric = f"pta_gls_iter_{n_psr}psr_{n_psr * toas_per_psr}toas_wall"
-    try:
-        import contextlib
 
+    def setup():
         from pint_tpu.models import get_model
-        from pint_tpu.ops import dd as dd_mod
-        from pint_tpu.ops.dd import DD
         from pint_tpu.parallel.pta import PTAGLSFitter
-        from pint_tpu.toas import build_TOAs_from_arrays
-
-        # the PTA fitter's DD phase pipeline needs IEEE f64: pin to the
-        # CPU backend when the accelerator fails the self-check (the PTA
-        # hybrid split is future work; better a valid CPU number than
-        # NaN on-chip — see pint_tpu.ops.dd)
-        pinned = ""
-        ctx = contextlib.nullcontext()
-        if not dd_mod.self_check():
-            from pint_tpu.fitting.hybrid import cpu_device
-
-            ctx = jax.default_device(cpu_device())
-            pinned = " (pinned to cpu: accelerator fails dd self-check)"
 
         rng = np.random.default_rng(1)
-        with ctx:
-            problems = []
-            for i in range(n_psr):
-                par = PAR.replace("17:48:52.75",
-                                  f"{(i * 7) % 24:02d}:48:52.75")
-                par = par.replace("61.485476554",
-                                  f"{61.485476554 + 0.7 * i:.9f}")
-                model = get_model(par)
-                n = toas_per_psr
-                n_ep = max(1, (n + 3) // 4)
-                centers = np.sort(rng.uniform(50000.0, 58000.0, size=n_ep))
-                mjds = (centers[:, None]
-                        + rng.uniform(0, 0.5 / 86400.0, (n_ep, 4))).ravel()[:n]
-                toas = build_TOAs_from_arrays(
-                    DD(jnp.asarray(mjds), jnp.zeros(n)),
-                    freq_mhz=np.where(rng.random(n) < 0.5, 1400.0, 430.0),
-                    error_us=np.full(n, 1.0), obs_names=("gbt",),
-                    eph=model.ephem)
-                problems.append((toas, model))
+        problems = []
+        for i in range(n_psr):
+            par = PAR.replace("17:48:52.75", f"{(i * 7) % 24:02d}:48:52.75")
+            par = par.replace("61.485476554", f"{61.485476554 + 0.7 * i:.9f}")
+            model = get_model(par)
+            problems.append((_random_toas(model, toas_per_psr, rng,
+                                          epochs4=True), model))
+        fitter = PTAGLSFitter(problems, gw_log10_amp=-14.0,
+                              gw_gamma=4.33, gw_nharm=20)
+        return (fitter.fit_toas,
+                lambda: {"chi2": round(float(fitter.chi2), 3)})
 
-            fitter = PTAGLSFitter(problems, gw_log10_amp=-14.0,
-                                  gw_gamma=4.33, gw_nharm=20)
-            fitter.fit_toas()  # compile + warm
-            times = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                fitter.fit_toas()
-                times.append(time.perf_counter() - t0)
-            value = float(np.median(times))
-        budget_s = 30.0 * (n_psr * toas_per_psr / 6e5)
-        _emit({"metric": metric, "value": round(value, 6), "unit": "s",
-               "vs_baseline": round(budget_s / value, 3),
-               "backend": jax.default_backend() + pinned,
-               "chi2": round(float(fitter.chi2), 3)})
-    except Exception as e:  # noqa: BLE001
-        _emit({"metric": metric, "value": -1.0, "unit": "s",
-               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+    _run_timed(metric, 30.0 * (n_psr * toas_per_psr / 6e5), reps, setup)
+
+
+def bench_wideband(n: int, reps: int) -> None:
+    """BASELINE config 3: joint TOA+DM wideband fit iteration.
+
+    Run with PINT_TPU_BENCH_MODE=wideband; wall-clock of one
+    WidebandTOAFitter iteration (stacked TOA+DM design matrix).
+    """
+    metric = f"wideband_fit_iter_{n}toas_wall"
+
+    def setup():
+        import dataclasses
+
+        from pint_tpu.fitting.wideband import WidebandTOAFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.toas import Flags
+
+        # white-noise wideband config (config 3 measures the stacked
+        # TOA+DM design/solve, not correlated noise)
+        par = PAR
+        for line in ("ECORR 1.2\n", "TNREDAMP -13.5\n", "TNREDGAM 3.5\n",
+                     "TNREDC 30\n"):
+            par = par.replace(line, "")
+        model = get_model(par)
+        toas = _random_toas(model, n, np.random.default_rng(2))
+        dm_true = np.asarray(model.total_dm(toas))
+        flags = Flags(dict(d, pp_dm=str(float(m)), pp_dme="1e-4")
+                      for d, m in zip(toas.flags, dm_true))
+        toas = dataclasses.replace(toas, flags=flags)
+        f = WidebandTOAFitter(toas, model)
+        return (lambda: f.fit_toas(maxiter=1)), dict
+
+    _run_timed(metric, 30.0 * (n / 6e5), reps, setup)
+
+
+def bench_batch(n_psr: int, toas_per_psr: int, reps: int) -> None:
+    """BASELINE config 4: vmapped multi-pulsar WLS batch.
+
+    Run with PINT_TPU_BENCH_MODE=batch; wall-clock of one batched fit
+    step over n_psr pulsars (union model, superset masks, one XLA
+    program).
+    """
+    metric = f"batch_fit_iter_{n_psr}psr_{n_psr * toas_per_psr}toas_wall"
+
+    def setup():
+        from pint_tpu.models import get_model
+        from pint_tpu.parallel.batch import BatchedPulsarFitter
+
+        base_par = PAR.replace("EFAC 1.1\n", "").replace("ECORR 1.2\n", "") \
+                      .replace("TNREDAMP -13.5\n", "") \
+                      .replace("TNREDGAM 3.5\n", "").replace("TNREDC 30\n", "")
+        rng = np.random.default_rng(3)
+        problems = []
+        for i in range(n_psr):
+            par = base_par.replace("17:48:52.75",
+                                   f"{(i * 5) % 24:02d}:48:52.75")
+            par = par.replace("61.485476554", f"{61.485476554 + 0.3 * i:.9f}")
+            model = get_model(par)
+            problems.append((_random_toas(model, toas_per_psr, rng), model))
+        f = BatchedPulsarFitter(problems)
+        return (lambda: f.fit_toas(maxiter=1)), dict
+
+    _run_timed(metric, 30.0 * (n_psr * toas_per_psr / 6e5), reps, setup)
 
 
 def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
@@ -287,6 +376,8 @@ def main() -> None:
         except json.JSONDecodeError:
             return None, f"unparseable child output: {out[-200:]}"
 
+    mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
+    diag_metric = f"{mode}_fit_iter_wall"
     # TOTAL_TIMEOUT_S bounds the WHOLE bench including the CPU fallback:
     # the accelerator attempt gets 60% of the budget, the fallback the
     # remainder (the CPU run itself takes ~1 min at the default N).
@@ -310,7 +401,7 @@ def main() -> None:
     # Below ~30 s there is no point spawning it (jax import alone ~5 s).
     remaining = TOTAL_TIMEOUT_S - (time.perf_counter() - t_start)
     if remaining < 30.0:
-        _emit({"metric": "gls_fit_iter_wall", "value": -1.0, "unit": "s",
+        _emit({"metric": diag_metric, "value": -1.0, "unit": "s",
                "vs_baseline": 0.0,
                "error": f"accelerator: {fail}; no budget left for cpu "
                         "fallback"})
@@ -320,7 +411,7 @@ def main() -> None:
         cpu_result["fallback_reason"] = f"accelerator backend failed: {fail}"
         print(json.dumps(cpu_result))
         return
-    _emit({"metric": "gls_fit_iter_wall", "value": -1.0, "unit": "s",
+    _emit({"metric": diag_metric, "value": -1.0, "unit": "s",
            "vs_baseline": 0.0,
            "error": f"accelerator: {fail}; cpu fallback: "
                     f"{(cpu_result or {}).get('error', cpu_fail)}"})
@@ -329,15 +420,22 @@ def main() -> None:
 def _main_guarded() -> None:
     n = int(os.environ.get("PINT_TPU_BENCH_N", str(N_DEFAULT)))
     reps = int(os.environ.get("PINT_TPU_BENCH_REPS", "5"))
-    if os.environ.get("PINT_TPU_BENCH_MODE", "gls") == "pta":
+    mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
+    if mode in ("pta", "wideband", "batch"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
-            _emit({"metric": "pta_gls_iter_wall", "value": -1.0, "unit": "s",
-                   "vs_baseline": 0.0, "error": f"backend init failed: {e}"})
+            _emit({"metric": f"{mode}_fit_iter_wall", "value": -1.0,
+                   "unit": "s", "vs_baseline": 0.0,
+                   "error": f"backend init failed: {e}"})
             return
-        bench_pta(int(os.environ.get("PINT_TPU_BENCH_PSRS", "16")),
-                  max(1, n // 16), reps)
+        n_psr = int(os.environ.get("PINT_TPU_BENCH_PSRS", "16"))
+        if mode == "pta":
+            bench_pta(n_psr, max(1, n // n_psr), reps)
+        elif mode == "wideband":
+            bench_wideband(n, reps)
+        else:
+            bench_batch(n_psr, max(1, n // n_psr), reps)
         return
     budget_s = 30.0 * (n / 6e5)
     metric = f"gls_fit_iter_{n}toas_wall"

@@ -143,8 +143,13 @@ class Residuals:
         ungrouped = np.ones(n, dtype=bool)
         if ec is not None:
             idx, phi = ec.epoch_indices(self.toas)
-            for e in range(len(phi)):
-                g = np.nonzero(idx == e)[0]
+            ne = len(phi)
+            # one argsort over idx instead of an O(ne * n) per-epoch scan
+            order_i = np.argsort(idx, kind="stable")
+            sorted_idx = idx[order_i]
+            starts = np.searchsorted(sorted_idx, np.arange(ne + 1))
+            for e in range(ne):
+                g = order_i[starts[e]:starts[e + 1]]
                 groups.append(g)
                 group_var.append(float(phi[e]))
                 ungrouped[g] = False
