@@ -1,0 +1,63 @@
+"""Hypothesis property tests for the double-double core.
+
+Split from test_dd.py so a missing optional ``hypothesis`` package
+skips only these (SURVEY §4: the reference uses hypothesis in a handful
+of property tests).
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+
+import numpy as np
+import jax.numpy as jnp
+
+from pint_tpu.ops import dd
+# ------------------------------------------------------------ hypothesis
+# Property tests (SURVEY §4: hypothesis usage in the reference's suite).
+# Exactness of the error-free transforms is checked against rational
+# arithmetic: fl(a op b) + err == a op b exactly in Q.
+from fractions import Fraction
+
+from hypothesis import assume, given, settings, strategies as st
+
+finite = st.floats(allow_nan=False, allow_infinity=False,
+                   allow_subnormal=False, min_value=-1e150, max_value=1e150)
+
+
+@settings(max_examples=200, deadline=None)
+@given(finite, finite)
+def test_two_sum_exact_property(a, b):
+    hi, lo = dd.two_sum(jnp.float64(a), jnp.float64(b))
+    assert Fraction(float(hi)) + Fraction(float(lo)) == \
+        Fraction(a) + Fraction(b)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.floats(allow_nan=False, allow_infinity=False,
+                 allow_subnormal=False, min_value=-1e100, max_value=1e100),
+       st.floats(allow_nan=False, allow_infinity=False,
+                 allow_subnormal=False, min_value=-1e100, max_value=1e100))
+def test_two_prod_exact_property(a, b):
+    # TwoProd exactness needs every intermediate normal: the Dekker
+    # split halves (~|x| * 2^-27) and the error term (~ulp(a*b)); keep
+    # factors and product well inside the normal range
+    assume(a == 0 or 1e-100 < abs(a) < 1e100)
+    assume(b == 0 or 1e-100 < abs(b) < 1e100)
+    assume(a == 0 or b == 0 or 1e-150 < abs(a * b) < 1e150)
+    hi, lo = dd.two_prod(jnp.float64(a), jnp.float64(b))
+    assert Fraction(float(hi)) + Fraction(float(lo)) == \
+        Fraction(a) * Fraction(b)
+
+
+@settings(max_examples=100, deadline=None)
+@given(finite, finite)
+def test_dd_add_faithful_property(a, b):
+    """DD add of exact inputs is correctly rounded to ~2^-105."""
+    x = dd.add(dd.from_f64(jnp.float64(a)), dd.from_f64(jnp.float64(b)))
+    got = Fraction(float(x.hi)) + Fraction(float(x.lo))
+    want = Fraction(a) + Fraction(b)
+    if want == 0:
+        assert got == 0
+    else:
+        assert abs(got - want) <= abs(want) * Fraction(1, 2 ** 100)

@@ -211,3 +211,16 @@ def test_unrecognized_param_warns(caplog):
     with caplog.at_level(logging.WARNING):
         get_model(NGC6440E_PAR + "\nWIBBLE 42\n")
     assert any("WIBBLE" in r.message for r in caplog.records)
+
+
+def test_d_phase_d_param_matches_finite_difference():
+    """jacfwd column vs central difference (reference derivative check)."""
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = get_model(NGC6440E_PAR)
+    toas = make_fake_toas_uniform(53500, 53700, 30, m, obs="@")
+    for param in ("F0", "F1", "DM"):
+        ana = np.asarray(m.d_phase_d_param(toas, param))
+        num = np.asarray(m.d_phase_d_param_num(toas, param))
+        scale = np.max(np.abs(ana)) or 1.0
+        np.testing.assert_allclose(ana / scale, num / scale, atol=5e-6)
