@@ -50,6 +50,13 @@ if _env_platforms and "axon" not in _env_platforms:
 import pint_tpu  # noqa: F401, E402  (enables x64)
 import jax.numpy as jnp  # noqa: E402
 
+# persistent XLA compile cache: repeat bench runs (driver, probes) skip
+# the ~5-40 s compile; same cache dir the test suite uses (.gitignored)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 N_DEFAULT = 100_000
 INIT_TIMEOUT_S = int(os.environ.get("PINT_TPU_BENCH_INIT_TIMEOUT", "300"))
 # the tunnel can also hang mid-compile/mid-execute (observed), not just

@@ -339,16 +339,11 @@ class TimingModel:
         hand-coded per-component derivative chains; here it is one
         jacfwd column of the composed pure phase function (exact
         autodiff, works for every parameter including mask/prefix
-        params).
+        params). Shares the designmatrix path so the two can never
+        diverge: the design column is -dphase/dparam / F0.
         """
-        base = self.base_dd()
-        fn = self.phase_fn(toas)
-
-        def total_phase(delta: Array) -> Array:
-            ph = fn(base, {param: delta})
-            return ph.int_part + (ph.frac.hi + ph.frac.lo)
-
-        return jax.jacfwd(total_phase)(jnp.zeros((), jnp.float64))
+        M, _ = self.designmatrix(toas, [param], incoffset=False)
+        return -self.f0_f64 * M[:, 0]
 
     def d_phase_d_param_num(self, toas, param: str,
                             step: float | None = None) -> Array:

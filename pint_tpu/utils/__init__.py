@@ -7,6 +7,7 @@ statistics + information criteria), ``dmx`` (dmxparse).
 
 from pint_tpu.utils import angles  # noqa: F401
 from pint_tpu.utils.dmx import dmxparse  # noqa: F401
-from pint_tpu.utils.stats import (akaike_information_criterion,  # noqa: F401
+from pint_tpu.utils.stats import (ELL1_check, FTest,  # noqa: F401
+                                  akaike_information_criterion,
                                   bayesian_information_criterion, dmx_ranges,
                                   mad_std, weighted_mean, weighted_rms)

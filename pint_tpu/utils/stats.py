@@ -72,6 +72,46 @@ def bayesian_information_criterion(fitter) -> float:
     return float(fitter.resids.chi2 + k * np.log(n))
 
 
+def FTest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:
+    """F-test probability that the chi2 improvement is by chance.
+
+    Reference: pint.utils.FTest — compares a simpler model (chi2_1,
+    dof_1) against a nested model with extra parameters (chi2_2,
+    dof_2 < dof_1). Small p => the extra parameters are significant.
+    Returns 1.0 when the fuller model is not actually better.
+    """
+    from scipy.stats import f as f_dist
+
+    delta_chi2 = chi2_1 - chi2_2
+    delta_dof = dof_1 - dof_2
+    if delta_chi2 <= 0 or delta_dof <= 0 or dof_2 <= 0:
+        return 1.0
+    if chi2_2 <= 0:  # perfect fuller fit: infinitely significant
+        return 0.0
+    F = (delta_chi2 / delta_dof) / (chi2_2 / dof_2)
+    return float(f_dist.sf(F, delta_dof, dof_2))
+
+
+def ELL1_check(a1_ls: float, ecc: float, tres_us: float, ntoas: int,
+               *, warn: bool = True) -> bool:
+    """Is the ELL1 small-eccentricity binary model adequate?
+
+    Reference: pint.utils.ELL1_check — ELL1 drops O(e^2) orbital terms;
+    it is safe when asini/c * e^2 is well below the TOA precision,
+    i.e. a1 * e^2 << tres / sqrt(ntoas).
+    """
+    lhs_us = a1_ls * ecc ** 2 * 1e6
+    rhs_us = tres_us / np.sqrt(max(ntoas, 1))
+    ok = lhs_us <= rhs_us
+    if warn and not ok:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ELL1 residual error %.3g us exceeds %.3g us: use a "
+            "full-eccentricity binary model (DD)", lhs_us, rhs_us)
+    return bool(ok)
+
+
 def dmx_ranges(toas, *, bin_width_days: float = 6.5,
                min_toas: int = 1) -> list[tuple[float, float]]:
     """Greedy DMX windows covering the TOAs (reference: pint.utils.dmx_ranges).

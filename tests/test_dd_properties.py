@@ -9,7 +9,6 @@ import pytest
 
 pytest.importorskip("hypothesis")
 
-import numpy as np
 import jax.numpy as jnp
 
 from pint_tpu.ops import dd

@@ -267,3 +267,18 @@ def test_ecorr_average(fitted):
     member = np.asarray(r.time_resids)[avg["indices"][0]]
     np.testing.assert_allclose(avg["time_resids"][0], member.mean(),
                                atol=1e-15)
+
+
+def test_ftest_and_ell1_check():
+    """Reference: pint.utils.FTest / ELL1_check."""
+    from pint_tpu.utils import ELL1_check, FTest
+
+    # big chi2 drop for 1 extra parameter -> highly significant
+    assert FTest(200.0, 50, 60.0, 49) < 1e-6
+    # no improvement -> p = 1
+    assert FTest(60.0, 50, 60.0, 49) == 1.0
+    assert FTest(60.0, 50, 61.0, 49) == 1.0
+    # a1 e^2 far below the TOA precision -> ELL1 fine
+    assert ELL1_check(3.0, 1e-5, 1.0, 100, warn=False)
+    # large eccentricity -> ELL1 inadequate
+    assert not ELL1_check(30.0, 0.05, 0.5, 10000, warn=False)
