@@ -182,6 +182,55 @@ class Fitter:
             "params": params,
         }
 
+    def get_derived_params(self) -> dict:
+        """Derived quantities with first-order propagated uncertainties.
+
+        Reference: pint.fitter.Fitter.get_derived_params — spin-derived
+        (period, age, B field, Edot) plus binary mass function when a
+        binary model is present. Uncertainties propagate linearly from
+        the fitted parameter uncertainties (jacfwd of each scalar
+        derived function would be equivalent; these are simple enough
+        for closed forms).
+        """
+        from pint_tpu import derived_quantities as dq
+
+        out: dict[str, tuple[float, float]] = {}
+        p = self.model.params
+        f0 = p["F0"].value_f64
+        s0 = p["F0"].uncertainty or 0.0
+        out["P0_s"] = (dq.pulsar_period_s(f0), s0 / f0 ** 2)
+        if "F1" in p and p["F1"].is_numeric:
+            f1 = p["F1"].value_f64
+            s1 = p["F1"].uncertainty or 0.0
+            # P1 = -F1/F0^2: absolute partials (valid at F1 == 0 too)
+            p1 = dq.period_derivative(f0, f1)
+            out["P1"] = (p1, np.hypot(s1 / f0 ** 2,
+                                      2.0 * f1 * s0 / f0 ** 3))
+            if f1 < 0:
+                # age = -F0/(2 F1): d ln age = d ln F0 - d ln F1
+                age = dq.pulsar_age_yr(f0, f1)
+                out["age_yr"] = (age, age * np.hypot(s0 / f0, s1 / f1))
+                # B ~ sqrt(-F1) * F0^(-3/2):
+                # d ln B = 0.5 d ln(-F1) - 1.5 d ln F0
+                B = dq.pulsar_B_gauss(f0, f1)
+                out["B_surface_G"] = (B, B * np.hypot(
+                    0.5 * s1 / f1, 1.5 * s0 / f0))
+                # Edot ~ F0 * F1: d ln E = d ln F0 + d ln F1
+                E = dq.pulsar_edot_erg_s(f0, f1)
+                out["Edot_erg_s"] = (E, E * np.hypot(
+                    s0 / f0, s1 / f1))
+        if "PB" in p and "A1" in p:
+            pb, a1 = p["PB"].value_f64, p["A1"].value_f64
+            spb = p["PB"].uncertainty or 0.0
+            sa1 = p["A1"].uncertainty or 0.0
+            fm = dq.mass_funct_msun(pb, a1)
+            out["mass_function_Msun"] = (fm, fm * np.hypot(
+                3.0 * sa1 / a1 if a1 else 0.0,
+                2.0 * spb / pb if pb else 0.0))
+            out["companion_mass_min_Msun"] = (
+                dq.companion_mass_msun(pb, a1, inc_rad=np.pi / 2), 0.0)
+        return out
+
     def fit_toas(self, maxiter: int = 1, **kw) -> float:  # pragma: no cover
         raise NotImplementedError
 

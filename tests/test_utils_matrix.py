@@ -282,3 +282,49 @@ def test_ftest_and_ell1_check():
     assert ELL1_check(3.0, 1e-5, 1.0, 100, warn=False)
     # large eccentricity -> ELL1 inadequate
     assert not ELL1_check(30.0, 0.05, 0.5, 10000, warn=False)
+
+
+def test_get_derived_params(fitted):
+    f, toas, model = fitted
+    d = f.get_derived_params()
+    assert d["P0_s"][0] == pytest.approx(1.0 / model.f0_f64)
+    assert d["P0_s"][1] > 0              # propagated from fitted F0
+    assert d["age_yr"][0] > 1e8          # an old recycled-ish pulsar
+    assert d["B_surface_G"][0] > 0 and d["Edot_erg_s"][0] > 0
+    assert "mass_function_Msun" not in d  # no binary in this model
+
+
+def test_derived_param_error_propagation():
+    """Propagated sigmas match finite-difference Jacobians."""
+    from pint_tpu import derived_quantities as dq
+
+    class P:
+        def __init__(self, v, u):
+            self.value_f64, self.uncertainty, self.is_numeric = v, u, True
+
+    class FakeFitter:
+        get_derived_params = __import__(
+            "pint_tpu.fitting.fitter", fromlist=["Fitter"]
+        ).Fitter.get_derived_params
+
+        def __init__(self, params):
+            self.model = type("M", (), {"params": params})()
+
+    f0, f1 = 100.0, -1e-14
+    s0, s1 = 1e-6, 0.0   # F0-dominant: the case that exposed 2x/3x errors
+    d = FakeFitter({"F0": P(f0, s0), "F1": P(f1, s1)}).get_derived_params()
+
+    def fd(fun, i):
+        h0 = s0 if i == 0 else 0.0
+        h1 = s1 if i == 1 else 0.0
+        return abs(fun(f0 + h0, f1 + h1) - fun(f0 - h0, f1 - h1)) / 2.0
+
+    sig_p1 = np.hypot(fd(dq.period_derivative, 0), 0.0)
+    np.testing.assert_allclose(d["P1"][1], sig_p1, rtol=1e-5)
+    sig_b = np.hypot(fd(dq.pulsar_B_gauss, 0), 0.0)
+    np.testing.assert_allclose(d["B_surface_G"][1], sig_b, rtol=1e-5)
+
+    # F1 fitted but exactly zero: P1 sigma must not collapse to 0
+    d0 = FakeFitter({"F0": P(f0, 0.0), "F1": P(0.0, 1e-16)}
+                    ).get_derived_params()
+    np.testing.assert_allclose(d0["P1"][1], 1e-16 / f0 ** 2, rtol=1e-12)
