@@ -62,9 +62,66 @@ def _tt_to_utc(mjd_tt: dd.DD) -> dd.DD:
     return utc
 
 
+def load_orbit_file(orbfile: str) -> tuple[np.ndarray, np.ndarray]:
+    """(met_s, gcrs_pos_m (n,3)) from a spacecraft orbit FITS file.
+
+    Reference: pint.observatory.satellite_obs orbit ingestion. Supported
+    shapes: NICER/NuSTAR-style ``ORBIT`` extensions (TIME + POSITION
+    vector or X/Y/Z scalars; meters or km via TUNIT/POSUNIT) and
+    Fermi FT2 ``SC_DATA`` (START + SC_POSITION, meters). Positions are
+    J2000 ECI, treated as GCRS.
+    """
+    f = read_fits(orbfile)
+    tab = None
+    for name in ("ORBIT", "SC_DATA", "PREFILTER"):
+        try:
+            tab = f.table(name)
+            break
+        except KeyError:
+            continue
+    if tab is None:
+        tab = f.tables[0]
+    tcol = "START" if "START" in tab else "TIME"
+    met = np.asarray(tab[tcol], dtype=np.float64)
+    unit_scale = 1.0
+    unit = str(tab.header.get("POSUNIT", "")).strip().lower()
+    for j in range(1, int(tab.header.get("TFIELDS", 0)) + 1):
+        if str(tab.header.get(f"TTYPE{j}", "")).strip().upper() in (
+                "POSITION", "SC_POSITION", "X", "Y", "Z"):
+            unit = unit or str(tab.header.get(f"TUNIT{j}", "")).strip().lower()
+    if unit in ("km", "kilometers"):
+        unit_scale = 1e3
+    if "POSITION" in tab:
+        pos = np.asarray(tab["POSITION"], dtype=np.float64)
+    elif "SC_POSITION" in tab:
+        pos = np.asarray(tab["SC_POSITION"], dtype=np.float64)
+    elif "X" in tab:
+        pos = np.stack([np.asarray(tab[c], dtype=np.float64)
+                        for c in ("X", "Y", "Z")], axis=1)
+    else:
+        raise ValueError(
+            f"orbit file has no POSITION/SC_POSITION/X,Y,Z columns "
+            f"(columns: {sorted(tab.columns)})")
+    order = np.argsort(met)
+    return met[order], pos[order] * unit_scale
+
+
+def _interp_orbit(met_s: np.ndarray, orbit: tuple[np.ndarray, np.ndarray]
+                  ) -> np.ndarray:
+    """Linear per-axis interpolation of orbit positions at event METs."""
+    t, pos = orbit
+    if np.any(met_s < t[0] - 1.0) or np.any(met_s > t[-1] + 1.0):
+        raise ValueError(
+            f"event times [{met_s.min():.1f}, {met_s.max():.1f}] extend "
+            f"outside the orbit file span [{t[0]:.1f}, {t[-1]:.1f}]")
+    return np.stack([np.interp(met_s, t, pos[:, k]) for k in range(3)],
+                    axis=1)
+
+
 def load_event_TOAs(eventfile: str, mission: str = "generic", *,
                     weight_column: str | None = None,
                     energy_range_kev: tuple[float, float] | None = None,
+                    orbfile: str | None = None,
                     ephem: str = "builtin_analytic",
                     planets: bool = True, error_us: float = 1.0) -> TOAs:
     """Load a FITS photon event list as a TOAs table.
@@ -73,6 +130,12 @@ def load_event_TOAs(eventfile: str, mission: str = "generic", *,
     'MODEL_WEIGHT') are carried on ``toas.aux_masks['photon_weight']``
     as a traced (n,) array — the unbinned template likelihood consumes
     them on-device (the reference stashes them in per-TOA flag dicts).
+
+    ``orbfile`` enables unbarycentered spacecraft events
+    (``TIMEREF='LOCAL'``): per-event GCRS positions are interpolated
+    from the orbit file and injected into the TOA pipeline, so the
+    Roemer/Einstein terms see the true orbiting-observatory position
+    (reference: photonphase --orbfile / satellite_obs).
     """
     mission = mission.lower()
     if mission not in MISSIONS:
@@ -91,12 +154,17 @@ def load_event_TOAs(eventfile: str, mission: str = "generic", *,
                   ).strip().upper()
     barycentered = timesys == "TDB" or timeref in ("SOLARSYSTEM", "BARYCENTER")
     geocentered = not barycentered and timeref in ("GEOCENTRIC", "GEOCENTER")
-    if not barycentered and not geocentered:
+    local = not barycentered and not geocentered
+    if local and orbfile is None:
         raise ValueError(
-            f"events are TIMESYS={timesys!r}/TIMEREF={timeref!r}; only "
-            "barycentered (TDB) or geocentered (TT) events are supported "
-            "without spacecraft orbit files (same constraint as the "
-            "reference's photonphase)")
+            f"events are TIMESYS={timesys!r}/TIMEREF={timeref!r}; "
+            "unbarycentered spacecraft events need an orbit file "
+            "(orbfile=...), matching the reference's photonphase "
+            "--orbfile")
+    if orbfile is not None and not local:
+        raise ValueError(
+            "orbfile given but events are already "
+            + ("barycentered" if barycentered else "geocentered"))
 
     met = np.asarray(tab["TIME"], dtype=np.float64)
     keep = np.ones(met.size, dtype=bool)
@@ -121,11 +189,16 @@ def load_event_TOAs(eventfile: str, mission: str = "generic", *,
     mjd = dd.add(dd.add(dd.from_f64(jnp.full(met.shape, refi)), reff),
                  met_days)
 
+    gcrs_pos_m = None
     if barycentered:
         obs_names = ("barycenter",)
-    else:
+    elif geocentered:
         obs_names = ("geocenter",)
         mjd = _tt_to_utc(mjd)  # pipeline re-derives the exact TT
+    else:
+        obs_names = ("spacecraft",)
+        gcrs_pos_m = _interp_orbit(met + timezero, load_orbit_file(orbfile))
+        mjd = _tt_to_utc(mjd)
 
     toas = build_TOAs_from_arrays(
         mjd,
@@ -135,6 +208,7 @@ def load_event_TOAs(eventfile: str, mission: str = "generic", *,
         eph=ephem,
         planets=planets,
         include_clock=False,
+        gcrs_pos_m=gcrs_pos_m,
     )
     if weights is not None:
         import dataclasses

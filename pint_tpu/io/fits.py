@@ -217,9 +217,12 @@ def write_event_fits(path: str, columns: dict[str, np.ndarray],
         a = np.asarray(columns[n])
         code = {"f8": "D", "f4": "E", "i8": "K", "i4": "J", "i2": "I",
                 "u1": "B"}[a.dtype.str[1:]]
+        if a.ndim == 2:  # vector column, e.g. POSITION (n, 3) -> "3D"
+            code = f"{a.shape[1]}{code}"
         arrs.append((a.astype(a.dtype.newbyteorder(">")), code))
     nrows = len(arrs[0][0])
-    rowlen = sum(a.dtype.itemsize for a, _ in arrs)
+    rowlen = sum(a.dtype.itemsize * (a.shape[1] if a.ndim == 2 else 1)
+                 for a, _ in arrs)
     cards = (_card("XTENSION", "BINTABLE") + _card("BITPIX", 8)
              + _card("NAXIS", 2) + _card("NAXIS1", rowlen)
              + _card("NAXIS2", nrows) + _card("PCOUNT", 0)
@@ -232,7 +235,8 @@ def write_event_fits(path: str, columns: dict[str, np.ndarray],
     cards += b"END".ljust(CARD)
     out.append(_pad_block(cards))
 
-    row = np.zeros(nrows, dtype=[(n, a.dtype) for n, (a, _) in zip(names, arrs)])
+    row = np.zeros(nrows, dtype=[
+        (n, a.dtype, a.shape[1:]) for n, (a, _) in zip(names, arrs)])
     for n, (a, _) in zip(names, arrs):
         row[n] = a
     out.append(_pad_block(row.tobytes(), b"\x00"))

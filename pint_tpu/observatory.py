@@ -170,6 +170,11 @@ _BUILTIN = [
     Observatory("barycenter", None, ("@", "ssb", "bary", "bat"), "@", is_barycenter=True),
     Observatory("geocenter", None, ("coe", "0"), "o", is_geocenter=True),
     Observatory("stl_geo", None, ("stl",), "", is_geocenter=True),  # spacecraft placeholder
+    # orbiting observatory: GCRS offsets are injected per-TOA from an
+    # orbit file (pint_tpu.event_toas.load_orbit_file) instead of an
+    # ITRF rotation; neither barycentric nor geocentric, no site clock
+    # (reference: pint.observatory.satellite_obs)
+    Observatory("spacecraft", None, ("orb", "satellite"), ""),
 ]
 
 for _obs in _BUILTIN:

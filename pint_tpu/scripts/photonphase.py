@@ -27,6 +27,9 @@ def main(argv=None) -> int:
     parser.add_argument("--emin", type=float, default=None, help="keV")
     parser.add_argument("--emax", type=float, default=None, help="keV")
     parser.add_argument("--maxharmonics", type=int, default=20)
+    parser.add_argument("--orbfile", default=None,
+                        help="spacecraft orbit FITS file (required for "
+                             "unbarycentered TIMEREF=LOCAL events)")
     parser.add_argument("--outfile", default=None,
                         help="write 'mjd_tdb phase [weight]' rows here")
     parser.add_argument("--log-level", default="INFO")
@@ -44,7 +47,7 @@ def main(argv=None) -> int:
         erange = (args.emin or 0.0, args.emax or np.inf)
     toas = load_event_TOAs(args.eventfile, args.mission,
                            weight_column=args.weightcol,
-                           energy_range_kev=erange)
+                           energy_range_kev=erange, orbfile=args.orbfile)
     model = get_model(args.parfile)
     phases = photon_phases(model, toas)
     weights = get_photon_weights(toas)

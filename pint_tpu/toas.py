@@ -332,6 +332,8 @@ def build_TOAs_from_arrays(
     planets: bool = True,
     include_clock: bool = True,
     clock_limits: str = "warn",
+    gcrs_pos_m=None,
+    gcrs_vel_m_s=None,
 ) -> TOAs:
     """Array-based TOA construction (no per-TOA string parsing).
 
@@ -379,9 +381,38 @@ def build_TOAs_from_arrays(
         if ob.itrf_xyz_m is not None:
             itrf[obs_index == si] = np.asarray(ob.itrf_xyz_m)
 
+    is_spacecraft = [obs_mod.get_observatory(s).is_special
+                     and not obs_mod.get_observatory(s).is_barycenter
+                     and not obs_mod.get_observatory(s).is_geocenter
+                     for s in site_names]
+    if any(is_spacecraft) and gcrs_pos_m is None:
+        raise ValueError(
+            "spacecraft observatory needs per-TOA GCRS positions: pass "
+            "gcrs_pos_m (from pint_tpu.event_toas.load_orbit_file) — "
+            "refusing to silently treat orbit TOAs as geocentric")
+
     tt = ts.utc_to_tt(utc)
     tt_f64 = np.asarray(tt.hi + tt.lo)
-    obs_gcrs_pos, obs_gcrs_vel = earth.itrf_to_gcrs_posvel(jnp.asarray(itrf), np.asarray(utc.hi + utc.lo))
+    if gcrs_pos_m is not None:
+        # explicit GCRS offsets (spacecraft orbit data) replace the
+        # ITRF-rotation path wholesale; they feed the topocentric
+        # Einstein term below exactly like a ground site's position
+        if not all(is_spacecraft):
+            raise ValueError(
+                "gcrs_pos_m overrides every TOA's observatory position; "
+                f"mixed sites {site_names} would be silently wrong — "
+                "build spacecraft and ground TOAs separately and merge")
+        gcrs_pos_m = np.asarray(gcrs_pos_m, dtype=np.float64)
+        if gcrs_pos_m.shape != (n, 3):
+            raise ValueError(
+                f"gcrs_pos_m shape {gcrs_pos_m.shape} != ({n}, 3)")
+        obs_gcrs_pos = jnp.asarray(gcrs_pos_m)
+        obs_gcrs_vel = (jnp.zeros_like(obs_gcrs_pos)
+                        if gcrs_vel_m_s is None
+                        else jnp.asarray(gcrs_vel_m_s, jnp.float64))
+    else:
+        obs_gcrs_pos, obs_gcrs_vel = earth.itrf_to_gcrs_posvel(
+            jnp.asarray(itrf), np.asarray(utc.hi + utc.lo))
 
     # Earth posvel for the Einstein topocentric term (evaluated at TT ~ TDB)
     earth_pos, earth_vel = eph.earth_posvel_ssb(jnp.asarray(tt_f64))

@@ -112,7 +112,7 @@ def test_unsupported_timeref_raises(tmp_path):
     write_event_fits(str(p), {"TIME": rng.random(n)},
                      header={"MJDREFI": 53750, "MJDREFF": 0.0,
                              "TIMESYS": "TT", "TIMEREF": "LOCAL"})
-    with pytest.raises(ValueError, match="orbit files"):
+    with pytest.raises(ValueError, match="orbit file"):
         load_event_TOAs(str(p), "nicer")
 
 
@@ -220,3 +220,80 @@ def test_multi_component_template():
     assert abs(grid[np.argmax(f)] - 0.2) < 0.02
     ll = t.log_likelihood(np.array([0.2, 0.6, 0.9]))
     assert np.isfinite(ll)
+
+
+def test_orbit_file_spacecraft_events(tmp_path):
+    """TIMEREF=LOCAL events + orbit file: the interpolated spacecraft
+    position must feed the TOA pipeline (reference: photonphase
+    --orbfile / satellite_obs)."""
+    from pint_tpu.event_toas import load_orbit_file
+    import dataclasses
+
+    rng = np.random.default_rng(5)
+    n = 50
+    met = np.sort(rng.uniform(1000.0, 80000.0, n))
+    # circular LEO in the GCRS x-y plane, r = 7000 km, period 5400 s
+    r_m, period = 7.0e6, 5400.0
+
+    def sc_pos(t):
+        w = 2 * np.pi / period
+        return np.stack([r_m * np.cos(w * t), r_m * np.sin(w * t),
+                         np.zeros_like(t)], axis=1)
+
+    # orbit file sampled every 10 s, NICER-style ORBIT extension in km
+    t_orb = np.arange(0.0, 86400.0, 2.0)
+    write_event_fits(str(tmp_path / "orb.fits"),
+                     {"TIME": t_orb, "POSITION": sc_pos(t_orb) / 1e3},
+                     header={"MJDREFI": 53750, "MJDREFF": 0.0,
+                             "TUNIT2": "km"},
+                     extname="ORBIT")
+    t, pos = load_orbit_file(str(tmp_path / "orb.fits"))
+    np.testing.assert_allclose(pos[0], sc_pos(t_orb[:1])[0], rtol=1e-12)
+
+    write_event_fits(str(tmp_path / "ev.fits"),
+                     {"TIME": met, "PI": np.full(n, 100, np.int32)},
+                     header={"MJDREFI": 53750, "MJDREFF": 0.0,
+                             "TIMEZERO": 0.0, "TIMESYS": "TT",
+                             "TIMEREF": "LOCAL"})
+    # without an orbit file: hard error
+    with pytest.raises(ValueError, match="orbit file"):
+        load_event_TOAs(str(tmp_path / "ev.fits"), "nicer")
+
+    toas = load_event_TOAs(str(tmp_path / "ev.fits"), "nicer",
+                           orbfile=str(tmp_path / "orb.fits"))
+    assert toas.obs_names == ("spacecraft",)
+    # observatory position = Earth + spacecraft offset: differs from the
+    # geocenter by |r_orbit|/c light-seconds
+    ev_geo = tmp_path / "ev_geo.fits"
+    write_event_fits(str(ev_geo),
+                     {"TIME": met, "PI": np.full(n, 100, np.int32)},
+                     header={"MJDREFI": 53750, "MJDREFF": 0.0,
+                             "TIMEZERO": 0.0, "TIMESYS": "TT",
+                             "TIMEREF": "GEOCENTRIC"})
+    toas_geo = load_event_TOAs(str(ev_geo), "nicer")
+    d = np.asarray(toas.obs_pos_ls) - np.asarray(toas_geo.obs_pos_ls)
+    # linear orbit interpolation leaves a sagitta error ~ r (w dt)^2 / 8
+    # (~5 m at 2 s sampling) — tolerance sized accordingly
+    np.testing.assert_allclose(np.linalg.norm(d, axis=1), r_m / 299792458.0,
+                               rtol=1e-6, atol=2e-8)
+    # and the offset direction tracks the orbit at each event time
+    np.testing.assert_allclose(d * 299792458.0, sc_pos(met), rtol=1e-5,
+                               atol=0.5)
+
+
+def test_spacecraft_guards():
+    import jax.numpy as jnp
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.toas import build_TOAs_from_arrays
+
+    mjd = DD(jnp.asarray([53750.1, 53750.2]), jnp.zeros(2))
+    kw = dict(freq_mhz=np.full(2, np.inf), error_us=np.ones(2),
+              include_clock=False)
+    with pytest.raises(ValueError, match="needs per-TOA GCRS"):
+        build_TOAs_from_arrays(mjd, obs_names=("spacecraft",), **kw)
+    with pytest.raises(ValueError, match="mixed sites"):
+        build_TOAs_from_arrays(mjd, obs_names=("gbt",),
+                               gcrs_pos_m=np.zeros((2, 3)), **kw)
+    with pytest.raises(ValueError, match="shape"):
+        build_TOAs_from_arrays(mjd, obs_names=("spacecraft",),
+                               gcrs_pos_m=np.zeros((3, 3)), **kw)
