@@ -6,14 +6,17 @@ mission table, src/pint/event_toas.py) and ``pint.fermi_toas``
 astropy.io.fits dependency is replaced by the pure-numpy reader in
 :mod:`pint_tpu.io.fits`.
 
-Scope (matches what the reference supports *without* spacecraft orbit
-files): events must be either
+Supported event timestamps:
 
 * **barycentered** (``TIMESYS='TDB'`` / ``TIMEREF='SOLARSYSTEM'``):
-  TOAs are built at the solar-system barycenter ("@"), or
+  TOAs are built at the solar-system barycenter ("@"),
 * **geocentered** (``TIMEREF='GEOCENTRIC'``, TT times): TOAs are built
   at the geocenter after a TT->UTC conversion so the standard pipeline
-  reproduces the event TT exactly.
+  reproduces the event TT exactly, or
+* **spacecraft-local** (``TIMEREF='LOCAL'``, TT times) with an orbit
+  file (``orbfile=`` / photonphase ``--orbfile``): per-event GCRS
+  positions interpolated from the orbit data feed the TOA pipeline
+  (reference: pint.observatory.satellite_obs).
 
 Mission defaults mirror the reference's table: the FITS time columns,
 MJDREF handling (NICER/RXTE split MJDREFI/MJDREFF; Fermi single
@@ -103,7 +106,17 @@ def load_orbit_file(orbfile: str) -> tuple[np.ndarray, np.ndarray]:
             f"orbit file has no POSITION/SC_POSITION/X,Y,Z columns "
             f"(columns: {sorted(tab.columns)})")
     order = np.argsort(met)
-    return met[order], pos[order] * unit_scale
+    pos = pos[order] * unit_scale
+    r = np.linalg.norm(pos, axis=1)
+    # sanity: geocentric orbit radii live between Earth's surface and
+    # ~lunar distance; anything else means wrong units (e.g. km data
+    # with no TUNIT read as meters) — fail loudly, not 1000x off
+    if np.any(r < 6.2e6) or np.any(r > 5e8):
+        raise ValueError(
+            f"orbit radii [{r.min():.3g}, {r.max():.3g}] m are outside "
+            "the plausible geocentric range [6.2e6, 5e8] m — check the "
+            "orbit file's position units (TUNIT/POSUNIT)")
+    return met[order], pos
 
 
 def _interp_orbit(met_s: np.ndarray, orbit: tuple[np.ndarray, np.ndarray]

@@ -7,6 +7,8 @@ statistics + information criteria), ``dmx`` (dmxparse).
 
 from pint_tpu.utils import angles  # noqa: F401
 from pint_tpu.utils.dmx import dmxparse  # noqa: F401
+from pint_tpu.utils.wavex import (cmwavex_setup, dmwavex_setup,  # noqa: F401
+                                  wavex_setup)
 from pint_tpu.utils.stats import (ELL1_check, FTest,  # noqa: F401
                                   akaike_information_criterion,
                                   bayesian_information_criterion, dmx_ranges,

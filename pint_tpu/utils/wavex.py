@@ -1,0 +1,76 @@
+"""WaveX/DMWaveX/CMWaveX setup helpers.
+
+Reference equivalent: ``pint.utils.wavex_setup`` / ``dmwavex_setup`` /
+``cmwavex_setup`` — the modern red-noise workflow builds a deterministic
+Fourier absorber with n harmonics of 1/T_span and fits the amplitudes
+instead of (or alongside) sampling PLRedNoise hyperparameters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _span_freqs(toas, n_freqs: int, freqs=None) -> np.ndarray:
+    if freqs is not None:
+        f = np.atleast_1d(np.asarray(freqs, dtype=np.float64))
+        if np.any(f <= 0):
+            raise ValueError("WaveX frequencies must be positive")
+        return f
+    span_d = toas.last_mjd() - toas.first_mjd()
+    if span_d <= 0:
+        raise ValueError("TOA span is empty; cannot choose harmonics")
+    return np.arange(1, n_freqs + 1) / span_d
+
+
+def _setup(model, toas, comp_cls, prefix: str, n_freqs: int, freqs,
+           epoch_mjd) -> list[int]:
+    name = comp_cls.__name__
+    if model.has_component(name):
+        raise ValueError(f"model already has a {name} component")
+    f = _span_freqs(toas, n_freqs, freqs)
+    indices = list(range(1, len(f) + 1))
+    comp = comp_cls(indices)
+    ep = comp.param(f"{prefix}EPOCH")
+    if epoch_mjd is not None:
+        ep.set_from_par(str(epoch_mjd))
+    elif "PEPOCH" in model.params:
+        ep.value = model.params["PEPOCH"].value
+    else:
+        ep.set_from_par(str(0.5 * (toas.first_mjd() + toas.last_mjd())))
+    for k, fk in zip(indices, f):
+        comp.param(f"{prefix}FREQ_{k:04d}").value = (float(fk), 0.0)
+        comp.param(f"{prefix}FREQ_{k:04d}").frozen = True
+        for kind in ("SIN", "COS"):
+            p = comp.param(f"{prefix}{kind}_{k:04d}")
+            p.value = (0.0, 0.0)
+            p.frozen = False
+    model.add_component(comp)
+    return indices
+
+
+def wavex_setup(model, toas, *, n_freqs: int = 10, freqs=None,
+                epoch_mjd=None) -> list[int]:
+    """Add a WaveX component with harmonics of 1/T_span (amplitudes free).
+
+    Returns the mode indices. Reference: pint.utils.wavex_setup.
+    """
+    from pint_tpu.models.wave import WaveX
+
+    return _setup(model, toas, WaveX, "WX", n_freqs, freqs, epoch_mjd)
+
+
+def dmwavex_setup(model, toas, *, n_freqs: int = 10, freqs=None,
+                  epoch_mjd=None) -> list[int]:
+    """Add a DMWaveX component (reference: pint.utils.dmwavex_setup)."""
+    from pint_tpu.models.wave import DMWaveX
+
+    return _setup(model, toas, DMWaveX, "DMWX", n_freqs, freqs, epoch_mjd)
+
+
+def cmwavex_setup(model, toas, *, n_freqs: int = 10, freqs=None,
+                  epoch_mjd=None) -> list[int]:
+    """Add a CMWaveX component (reference: pint.utils.cmwavex_setup)."""
+    from pint_tpu.models.chromatic import CMWaveX
+
+    return _setup(model, toas, CMWaveX, "CMWX", n_freqs, freqs, epoch_mjd)

@@ -240,7 +240,7 @@ def test_orbit_file_spacecraft_events(tmp_path):
         return np.stack([r_m * np.cos(w * t), r_m * np.sin(w * t),
                          np.zeros_like(t)], axis=1)
 
-    # orbit file sampled every 10 s, NICER-style ORBIT extension in km
+    # orbit file sampled every 2 s, NICER-style ORBIT extension in km
     t_orb = np.arange(0.0, 86400.0, 2.0)
     write_event_fits(str(tmp_path / "orb.fits"),
                      {"TIME": t_orb, "POSITION": sc_pos(t_orb) / 1e3},

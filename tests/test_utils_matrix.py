@@ -328,3 +328,29 @@ def test_derived_param_error_propagation():
     d0 = FakeFitter({"F0": P(f0, 0.0), "F1": P(0.0, 1e-16)}
                     ).get_derived_params()
     np.testing.assert_allclose(d0["P1"][1], 1e-16 / f0 ** 2, rtol=1e-12)
+
+
+def test_wavex_setup_helpers(fitted):
+    """Reference: pint.utils.wavex_setup / dmwavex_setup."""
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.utils.wavex import dmwavex_setup, wavex_setup
+
+    _, toas, _ = fitted
+    m = get_model(PAR)
+    idx = wavex_setup(m, toas, n_freqs=3)
+    assert idx == [1, 2, 3]
+    assert m.has_component("WaveX")
+    span = toas.last_mjd() - toas.first_mjd()
+    np.testing.assert_allclose(m.params["WXFREQ_0001"].value_f64, 1.0 / span)
+    assert m.params["WXFREQ_0001"].frozen        # frequencies pinned
+    assert not m.params["WXSIN_0002"].frozen     # amplitudes fittable
+    assert m.params["WXEPOCH"].value_f64 == m.params["PEPOCH"].value_f64
+    # zero amplitudes -> identical residuals to the base model
+    r0 = np.asarray(Residuals(toas, get_model(PAR)).time_resids)
+    r1 = np.asarray(Residuals(toas, m).time_resids)
+    np.testing.assert_allclose(r0, r1, atol=1e-15)
+    with pytest.raises(ValueError, match="already has"):
+        wavex_setup(m, toas, n_freqs=2)
+    dmwavex_setup(m, toas, freqs=[0.01, 0.02])
+    assert m.params["DMWXFREQ_0002"].value_f64 == 0.02
