@@ -16,6 +16,10 @@ def _span_freqs(toas, n_freqs: int, freqs=None) -> np.ndarray:
         f = np.atleast_1d(np.asarray(freqs, dtype=np.float64))
         if np.any(f <= 0):
             raise ValueError("WaveX frequencies must be positive")
+        if len(np.unique(f)) != len(f):
+            raise ValueError(
+                "duplicated WaveX frequencies give exactly collinear "
+                "design columns (singular fit); de-duplicate them")
         return f
     span_d = toas.last_mjd() - toas.first_mjd()
     if span_d <= 0:
@@ -32,10 +36,12 @@ def _setup(model, toas, comp_cls, prefix: str, n_freqs: int, freqs,
     indices = list(range(1, len(f) + 1))
     comp = comp_cls(indices)
     ep = comp.param(f"{prefix}EPOCH")
+    pepoch = model.params.get("PEPOCH")
     if epoch_mjd is not None:
         ep.set_from_par(str(epoch_mjd))
-    elif "PEPOCH" in model.params:
-        ep.value = model.params["PEPOCH"].value
+    elif pepoch is not None and pepoch.value_f64 != 0.0:
+        # PEPOCH exists on every spindown model; only a SET one counts
+        ep.value = pepoch.value
     else:
         ep.set_from_par(str(0.5 * (toas.first_mjd() + toas.last_mjd())))
     for k, fk in zip(indices, f):

@@ -224,3 +224,40 @@ def test_d_phase_d_param_matches_finite_difference():
         num = np.asarray(m.d_phase_d_param_num(toas, param))
         scale = np.max(np.abs(ana)) or 1.0
         np.testing.assert_allclose(ana / scale, num / scale, atol=5e-6)
+
+
+def test_frame_conversion_roundtrip():
+    """Equatorial <-> ecliptic astrometry conversion (pint.modelutils).
+
+    The two frames must predict identical residuals (same sky direction
+    and proper motion), and the round trip must return the start values.
+    """
+    from pint_tpu.models.modelutils import (model_ecliptic_to_equatorial,
+                                            model_equatorial_to_ecliptic)
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = get_model(NGC6440E_PAR + "PMRA -3.0 1\nPMDEC 5.5 1\nPX 0.5\n")
+    m["RAJ"].uncertainty = 1e-9
+    m["PMRA"].uncertainty = 0.1
+    toas = make_fake_toas_uniform(53500, 53700, 40, m, obs="gbt")
+
+    ecl = model_equatorial_to_ecliptic(m)
+    assert ecl.has_component("AstrometryEcliptic")
+    assert not ecl.has_component("AstrometryEquatorial")
+    assert not ecl["ELONG"].frozen and ecl["ELONG"].uncertainty > 0
+    assert ecl["PMELONG"].uncertainty > 0
+
+    r0 = np.asarray(Residuals(toas, m, subtract_mean=False).time_resids)
+    r1 = np.asarray(Residuals(toas, ecl, subtract_mean=False).time_resids)
+    np.testing.assert_allclose(r1, r0, atol=2e-10)  # sub-ns agreement
+
+    back = model_ecliptic_to_equatorial(ecl)
+    np.testing.assert_allclose(back["RAJ"].value_f64, m["RAJ"].value_f64,
+                               rtol=0, atol=1e-13)
+    np.testing.assert_allclose(back["DECJ"].value_f64, m["DECJ"].value_f64,
+                               rtol=0, atol=1e-13)
+    np.testing.assert_allclose(back["PMRA"].value_f64, -3.0, atol=1e-9)
+    np.testing.assert_allclose(back["PMDEC"].value_f64, 5.5, atol=1e-9)
+    # idempotent when already in the target frame
+    assert model_equatorial_to_ecliptic(ecl) is ecl

@@ -354,3 +354,19 @@ def test_wavex_setup_helpers(fitted):
         wavex_setup(m, toas, n_freqs=2)
     dmwavex_setup(m, toas, freqs=[0.01, 0.02])
     assert m.params["DMWXFREQ_0002"].value_f64 == 0.02
+
+
+def test_wavex_setup_guards(fitted):
+    from pint_tpu.models import get_model
+    from pint_tpu.utils.wavex import wavex_setup
+
+    _, toas, _ = fitted
+    m = get_model(PAR)
+    with pytest.raises(ValueError, match="duplicated"):
+        wavex_setup(m, toas, freqs=[0.01, 0.01])
+    # unset PEPOCH -> TOA-midpoint epoch, not MJD 0
+    m3 = get_model(PAR.replace("PEPOCH        53750.000000", "PEPOCH 0"))
+    wavex_setup(m3, toas, n_freqs=1)
+    mid = 0.5 * (toas.first_mjd() + toas.last_mjd())
+    np.testing.assert_allclose(m3.params["WXEPOCH"].value_f64, mid,
+                               atol=1e-6)
