@@ -1,0 +1,204 @@
+"""Binary-model conversion: ELL1-family <-> DD parameterizations.
+
+Reference equivalent: ``pint.binaryconvert`` (convert_binary), used by
+publishing workflows to re-express an orbit in another model family.
+The closed-form maps:
+
+    ECC = sqrt(EPS1^2 + EPS2^2)     OM = atan2(EPS1, EPS2)
+    T0  = TASC + PB * OM / (2 pi)
+
+and their inverses; first-derivative parameters (EPS1DOT/EPS2DOT <->
+EDOT/OMDOT) and 1-sigma uncertainties transform through the exact
+Jacobians. Parameters shared by both families (PB/FB*, A1, XDOT, M2,
+SINI, PBDOT, ...) are copied by name.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from pint_tpu.constants import SEC_PER_JULIAN_YEAR, SECS_PER_DAY
+from pint_tpu.models.timing_model import TimingModel
+
+log = logging.getLogger(__name__)
+
+# parameters consumed by the closed-form maps (not "dropped")
+_TRANSFORMED = {"EPS1", "EPS2", "TASC", "EPS1DOT", "EPS2DOT",
+                "ECC", "OM", "T0", "EDOT", "OMDOT", "FB0"}
+
+
+def _copy_shared(src, dst) -> None:
+    """Copy same-named params; refuse to silently drop set variant params.
+
+    Variant-specific physics (H3/H4/STIG, SHAPMAX, GAMMA, LNEDOT, ...)
+    has no representation on the base target class — losing a nonzero
+    one would silently change the predicted TOAs, so that is an error
+    (the reference's convert_binary maps these per-variant; converting
+    such models here requires zeroing or refitting them explicitly).
+    """
+    dst_names = {p.name for p in dst.params}
+    dropped = []
+    for p in src.params:
+        if p.name in dst_names:
+            q = dst.param(p.name)
+            q.value = p.value
+            q.uncertainty = p.uncertainty
+            q.frozen = p.frozen
+        elif (p.name not in _TRANSFORMED and p.is_numeric
+              and p.value_f64 != 0.0):
+            dropped.append(p.name)
+    if dropped:
+        raise ValueError(
+            f"conversion {type(src).__name__} -> {type(dst).__name__} "
+            f"would silently drop set parameters {dropped}; convert from "
+            "the base ELL1/DD parameterization instead")
+
+
+def convert_binary(model: TimingModel, target: str) -> TimingModel:
+    """New TimingModel with the binary re-expressed as ``target``.
+
+    ``target``: "DD" or "ELL1". Conversion is exact in the orbital
+    parameters; note the two families' *physics* differ at O(ECC^2)
+    (ELL1 truncates), so residuals agree only for small eccentricity.
+    """
+    from pint_tpu.models.binary.dd import BinaryDD
+    from pint_tpu.models.binary.ell1 import BinaryELL1
+
+    target = target.upper()
+    if target not in ("DD", "ELL1"):
+        raise ValueError(f"convert_binary target {target!r}: DD or ELL1")
+    src = next((c for c in model.components
+                if getattr(c, "binary_model_name", None)), None)
+    if src is None:
+        raise ValueError("model has no binary component")
+    if src.binary_model_name == target:
+        return model
+
+    pb_d = src.param("PB").value_f64
+    fb_source = False
+    if pb_d <= 0 and src.has_param("FB0") and src.param("FB0").value_f64:
+        pb_d = 1.0 / (src.param("FB0").value_f64 * SECS_PER_DAY)
+        fb_source = True
+
+    if target == "DD":
+        if not src.has_param("EPS1"):
+            raise ValueError(
+                f"conversion {src.binary_model_name} -> DD needs the "
+                "ELL1 parameterization (EPS1/EPS2/TASC)")
+        e1 = src.param("EPS1").value_f64
+        e2 = src.param("EPS2").value_f64
+        s1 = src.param("EPS1").uncertainty or 0.0
+        s2 = src.param("EPS2").uncertainty or 0.0
+        ecc = float(np.hypot(e1, e2))
+        om_rad = float(np.arctan2(e1, e2)) % (2.0 * np.pi)
+        dst = BinaryDD()
+        _copy_shared(src, dst)
+        dst.param("ECC").value = (ecc, 0.0)
+        dst.param("OM").value = (float(np.degrees(om_rad)), 0.0)
+        # T0 = TASC + PB * om / 2pi, exact in DD (TASC is a DD MJD)
+        from pint_tpu.ops import dd as ddm
+
+        tasc = src.param("TASC").as_dd()
+        t0 = ddm.add(tasc, pb_d * om_rad / (2.0 * np.pi))
+        dst.param("T0").value = (float(t0.hi), float(t0.lo))
+        if ecc > 0:
+            dst.param("ECC").uncertainty = float(
+                np.hypot(e1 * s1, e2 * s2) / ecc)
+            som = float(np.hypot(e2 * s1, e1 * s2) / ecc ** 2)  # rad
+            dst.param("OM").uncertainty = float(np.degrees(som))
+            stasc = src.param("TASC").uncertainty or 0.0
+            dst.param("T0").uncertainty = float(
+                np.hypot(stasc, pb_d * som / (2.0 * np.pi)))
+        for n_src, n_dst in (("EPS1", "ECC"), ("EPS2", "OM"),
+                             ("TASC", "T0")):
+            dst.param(n_dst).frozen = src.param(n_src).frozen
+        if src.has_param("EPS1DOT"):
+            d1 = src.param("EPS1DOT").value_f64
+            d2 = src.param("EPS2DOT").value_f64
+            sd1 = src.param("EPS1DOT").uncertainty or 0.0
+            sd2 = src.param("EPS2DOT").uncertainty or 0.0
+            if ecc > 0 and (d1 or d2 or sd1 or sd2):
+                dst.param("EDOT").value = (
+                    float((e1 * d1 + e2 * d2) / ecc), 0.0)
+                omdot_rad_s = (d1 * e2 - d2 * e1) / ecc ** 2
+                dst.param("OMDOT").value = (
+                    float(np.degrees(omdot_rad_s) * SEC_PER_JULIAN_YEAR),
+                    0.0)
+                dst.param("EDOT").uncertainty = float(
+                    np.hypot(e1 * sd1, e2 * sd2) / ecc)
+                dst.param("OMDOT").uncertainty = float(np.degrees(
+                    np.hypot(e2 * sd1, e1 * sd2) / ecc ** 2)
+                    * SEC_PER_JULIAN_YEAR)
+            dst.param("EDOT").frozen = src.param("EPS1DOT").frozen
+            dst.param("OMDOT").frozen = src.param("EPS2DOT").frozen
+        new_binary = "DD"
+    else:
+        if not src.has_param("ECC"):
+            raise ValueError(
+                f"conversion {src.binary_model_name} -> ELL1 needs the "
+                "DD/BT parameterization (ECC/OM/T0)")
+        ecc = src.param("ECC").value_f64
+        om_deg = src.param("OM").value_f64
+        om_rad = np.radians(om_deg) % (2.0 * np.pi)
+        if ecc > 0.01:
+            log.warning(
+                "converting ECC=%.3g to ELL1: the small-eccentricity "
+                "model drops O(e^2) terms (use utils.ELL1_check)", ecc)
+        dst = BinaryELL1()
+        _copy_shared(src, dst)
+        dst.param("EPS1").value = (float(ecc * np.sin(om_rad)), 0.0)
+        dst.param("EPS2").value = (float(ecc * np.cos(om_rad)), 0.0)
+        from pint_tpu.ops import dd as ddm
+
+        t0 = src.param("T0").as_dd()
+        tasc = ddm.sub(t0, pb_d * om_rad / (2.0 * np.pi))
+        dst.param("TASC").value = (float(tasc.hi), float(tasc.lo))
+        secc = src.param("ECC").uncertainty or 0.0
+        som_rad = np.radians(src.param("OM").uncertainty or 0.0)
+        if secc or som_rad:
+            dst.param("EPS1").uncertainty = float(np.hypot(
+                np.sin(om_rad) * secc, ecc * np.cos(om_rad) * som_rad))
+            dst.param("EPS2").uncertainty = float(np.hypot(
+                np.cos(om_rad) * secc, ecc * np.sin(om_rad) * som_rad))
+        st0 = src.param("T0").uncertainty or 0.0
+        if st0 or som_rad:
+            dst.param("TASC").uncertainty = float(np.hypot(
+                st0, pb_d * som_rad / (2.0 * np.pi)))
+        for n_src, n_dst in (("ECC", "EPS1"), ("OM", "EPS2"),
+                             ("T0", "TASC")):
+            dst.param(n_dst).frozen = src.param(n_src).frozen
+        if src.has_param("EDOT") and src.has_param("OMDOT"):
+            edot = src.param("EDOT").value_f64
+            omdot = src.param("OMDOT").value_f64
+            se = src.param("EDOT").uncertainty or 0.0
+            so = np.radians(src.param("OMDOT").uncertainty or 0.0) \
+                / SEC_PER_JULIAN_YEAR
+            if edot or omdot or se or so:
+                omdot_rad_s = np.radians(omdot) / SEC_PER_JULIAN_YEAR
+                dst.param("EPS1DOT").value = (
+                    float(edot * np.sin(om_rad)
+                          + ecc * np.cos(om_rad) * omdot_rad_s), 0.0)
+                dst.param("EPS2DOT").value = (
+                    float(edot * np.cos(om_rad)
+                          - ecc * np.sin(om_rad) * omdot_rad_s), 0.0)
+                dst.param("EPS1DOT").uncertainty = float(np.hypot(
+                    np.sin(om_rad) * se, ecc * np.cos(om_rad) * so))
+                dst.param("EPS2DOT").uncertainty = float(np.hypot(
+                    np.cos(om_rad) * se, ecc * np.sin(om_rad) * so))
+            dst.param("EPS1DOT").frozen = src.param("EDOT").frozen
+            dst.param("EPS2DOT").frozen = src.param("OMDOT").frozen
+        new_binary = "ELL1"
+
+    if fb_source and dst.param("PB").value_f64 <= 0:
+        # FB0-parameterized source (BTX): the target families carry PB
+        dst.param("PB").value = (float(pb_d), 0.0)
+        dst.param("PB").frozen = src.param("FB0").frozen
+
+    comps = [dst if c is src else c for c in model.components]
+    header = dict(model.header)
+    header["BINARY"] = new_binary
+    out = TimingModel(comps, name=model.name, header=header)
+    out.validate()
+    return out

@@ -227,3 +227,80 @@ def test_binary_phase_precision_decade():
     # simulation inverts the model to ~1e-9 s; binary phase error beyond
     # that would show up as residual scatter
     assert np.max(np.abs(np.asarray(r.time_resids))) < 5e-8
+
+
+def test_convert_binary_ell1_dd_roundtrip():
+    """ELL1 <-> DD conversion (reference: pint.binaryconvert).
+
+    Small-e orbit: converted models must predict matching residuals to
+    the families' O(e^2) physics difference, and the round trip must
+    restore the ELL1 parameters exactly.
+    """
+    from pint_tpu.models.binaryconvert import convert_binary
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = BASE + """
+BINARY ELL1
+PB 1.53 1
+A1 1.9 1
+TASC 55000.123456789 1
+EPS1 3e-6 1
+EPS2 -2e-6 1
+"""
+    m = get_model(par)
+    m["EPS1"].uncertainty = 1e-8
+    m["EPS2"].uncertainty = 2e-8
+    m["TASC"].uncertainty = 1e-9
+    toas = make_fake_toas_uniform(55000, 55100, 60, m, obs="@")
+
+    mdd = convert_binary(m, "DD")
+    assert mdd.has_component("BinaryDD")
+    assert mdd.header["BINARY"] == "DD"
+    e = np.hypot(3e-6, 2e-6)
+    np.testing.assert_allclose(mdd["ECC"].value_f64, e, rtol=1e-12)
+    assert mdd["ECC"].uncertainty > 0 and mdd["OM"].uncertainty > 0
+    assert not mdd["T0"].frozen
+
+    r0 = np.asarray(Residuals(toas, m, subtract_mean=False).time_resids)
+    r1 = np.asarray(Residuals(toas, mdd, subtract_mean=False).time_resids)
+    # physics differs at a1 * e^2 ~ 1.9 ls * 1.3e-11 = 25 ps
+    np.testing.assert_allclose(r1, r0, atol=1e-10)
+
+    back = convert_binary(mdd, "ELL1")
+    np.testing.assert_allclose(back["EPS1"].value_f64, 3e-6, rtol=1e-10)
+    np.testing.assert_allclose(back["EPS2"].value_f64, -2e-6, rtol=1e-10)
+    np.testing.assert_allclose(back["TASC"].value_f64, m["TASC"].value_f64,
+                               rtol=0, atol=1e-10)
+    assert convert_binary(m, "ELL1") is m  # no-op when already there
+
+
+def test_convert_binary_guards():
+    from pint_tpu.models.binaryconvert import convert_binary
+
+    # variant physics params must not be dropped silently
+    m = get_model(BASE + """
+BINARY ELL1H
+PB 1.5
+A1 2
+TASC 55000.1
+EPS1 1e-6
+EPS2 1e-6
+H3 5e-7
+STIG 0.7
+""")
+    with pytest.raises(ValueError, match="silently drop"):
+        convert_binary(m, "DD")
+    # FB0-parameterized source: PB filled in the target family
+    m2 = get_model(BASE + """
+BINARY BTX
+FB0 7.6e-6 1
+A1 2
+T0 55000.1
+ECC 1e-5
+OM 30
+""")
+    mell = convert_binary(m2, "ELL1")
+    np.testing.assert_allclose(mell["PB"].value_f64,
+                               1.0 / (7.6e-6 * 86400.0), rtol=1e-12)
+    assert not mell["PB"].frozen  # FB0 was free
