@@ -9,8 +9,11 @@ The closed-form maps:
 
 and their inverses; first-derivative parameters (EPS1DOT/EPS2DOT <->
 EDOT/OMDOT) and 1-sigma uncertainties transform through the exact
-Jacobians. Parameters shared by both families (PB/FB*, A1, XDOT, M2,
-SINI, PBDOT, ...) are copied by name.
+Jacobians. Variant Shapiro parameterizations map to (M2, SINI):
+orthometric H3/H4/STIG via Freire & Wex 2010, DDS SHAPMAX via
+SINI = 1 - exp(-SHAPMAX). Parameters shared by both families (PB/FB*,
+A1, XDOT, M2, SINI, PBDOT, ...) are copied by name; anything set that
+cannot be represented raises instead of vanishing.
 """
 
 from __future__ import annotations
@@ -26,7 +29,63 @@ log = logging.getLogger(__name__)
 
 # parameters consumed by the closed-form maps (not "dropped")
 _TRANSFORMED = {"EPS1", "EPS2", "TASC", "EPS1DOT", "EPS2DOT",
-                "ECC", "OM", "T0", "EDOT", "OMDOT", "FB0"}
+                "ECC", "OM", "T0", "EDOT", "OMDOT", "FB0",
+                "H3", "H4", "STIG", "SHAPMAX"}
+
+
+def _apply_shapiro_map(src, dst) -> None:
+    """Variant Shapiro parameterization -> (M2, SINI) with sigmas.
+
+    Orthometric (ELL1H/DDH, Freire & Wex 2010): with stig = STIG (or
+    H4/H3), sin i = 2 stig/(1+stig^2) and T_sun M2 = H3/stig^3.
+    DDS: SINI = 1 - exp(-SHAPMAX). Uncertainties propagate through the
+    exact partials; free/frozen state follows the source parameters.
+    """
+    from pint_tpu.constants import T_SUN_S
+
+    if src.has_param("SHAPMAX") and src.param("SHAPMAX").value_f64:
+        # DDS: only SINI is reparameterized; M2 is shared and copies over
+        sm = src.param("SHAPMAX")
+        sini = 1.0 - float(np.exp(-sm.value_f64))
+        q = dst.param("SINI")
+        q.value = (sini, 0.0)
+        q.uncertainty = float(np.exp(-sm.value_f64) * (sm.uncertainty or 0))
+        q.frozen = sm.frozen
+        log.info("mapped SHAPMAX to SINI=%.6g", sini)
+        return
+    if not (src.has_param("H3") and src.param("H3").value_f64):
+        return
+    h3p = src.param("H3")
+    h3, sh3 = h3p.value_f64, h3p.uncertainty or 0.0
+    if src.has_param("STIG") and src.param("STIG").value_f64:
+        sp = src.param("STIG")
+        stig, sstig = sp.value_f64, sp.uncertainty or 0.0
+        stig_frozen = sp.frozen
+        sm2_rel = np.hypot(sh3 / h3, 3.0 * sstig / stig)
+    elif src.has_param("H4") and src.param("H4").value_f64:
+        h4p = src.param("H4")
+        h4, sh4 = h4p.value_f64, h4p.uncertainty or 0.0
+        stig = h4 / h3
+        sstig = abs(stig) * np.hypot(sh4 / h4 if h4 else 0.0,
+                                     sh3 / h3)
+        stig_frozen = h4p.frozen
+        # M2 = H3^4 / (T_sun H4^3)
+        sm2_rel = np.hypot(4.0 * sh3 / h3, 3.0 * (sh4 / h4 if h4 else 0.0))
+    else:
+        return
+    sini = 2.0 * stig / (1.0 + stig ** 2)
+    m2 = h3 / stig ** 3 / T_SUN_S
+    q = dst.param("SINI")
+    q.value = (float(sini), 0.0)
+    q.uncertainty = float(abs(2.0 * (1.0 - stig ** 2)
+                              / (1.0 + stig ** 2) ** 2) * sstig)
+    q.frozen = stig_frozen
+    q = dst.param("M2")
+    q.value = (float(m2), 0.0)
+    q.uncertainty = float(abs(m2) * sm2_rel)
+    q.frozen = h3p.frozen and stig_frozen
+    log.info("mapped orthometric Shapiro to M2=%.6g Msun, SINI=%.6g",
+             m2, sini)
 
 
 def _copy_shared(src, dst) -> None:
@@ -82,11 +141,23 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
         pb_d = 1.0 / (src.param("FB0").value_f64 * SECS_PER_DAY)
         fb_source = True
 
+    src_is_ell1 = src.has_param("EPS1")
+
+    if target == "DD" and not src_is_ell1:
+        # within-family (DDS/DDH/BT/... -> DD): the orbit is already in
+        # ECC/OM/T0 form; only the Shapiro parameterization changes
+        dst = BinaryDD()
+        _copy_shared(src, dst)
+        _apply_shapiro_map(src, dst)
+        return _finish(model, src, dst, "DD", fb_source, pb_d)
+    if target == "ELL1" and src_is_ell1:
+        # within-family (ELL1H/ELL1k -> ELL1)
+        dst = BinaryELL1()
+        _copy_shared(src, dst)
+        _apply_shapiro_map(src, dst)
+        return _finish(model, src, dst, "ELL1", fb_source, pb_d)
+
     if target == "DD":
-        if not src.has_param("EPS1"):
-            raise ValueError(
-                f"conversion {src.binary_model_name} -> DD needs the "
-                "ELL1 parameterization (EPS1/EPS2/TASC)")
         e1 = src.param("EPS1").value_f64
         e2 = src.param("EPS2").value_f64
         s1 = src.param("EPS1").uncertainty or 0.0
@@ -95,6 +166,7 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
         om_rad = float(np.arctan2(e1, e2)) % (2.0 * np.pi)
         dst = BinaryDD()
         _copy_shared(src, dst)
+        _apply_shapiro_map(src, dst)
         dst.param("ECC").value = (ecc, 0.0)
         dst.param("OM").value = (float(np.degrees(om_rad)), 0.0)
         # T0 = TASC + PB * om / 2pi, exact in DD (TASC is a DD MJD)
@@ -115,11 +187,17 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
                              ("TASC", "T0")):
             dst.param(n_dst).frozen = src.param(n_src).frozen
         if src.has_param("EPS1DOT"):
-            d1 = src.param("EPS1DOT").value_f64
-            d2 = src.param("EPS2DOT").value_f64
-            sd1 = src.param("EPS1DOT").uncertainty or 0.0
-            sd2 = src.param("EPS2DOT").uncertainty or 0.0
-            if ecc > 0 and (d1 or d2 or sd1 or sd2):
+            p1, p2 = src.param("EPS1DOT"), src.param("EPS2DOT")
+            d1, d2 = p1.value_f64, p2.value_f64
+            sd1, sd2 = p1.uncertainty or 0.0, p2.uncertainty or 0.0
+            used = (d1 or d2 or sd1 or sd2
+                    or not p1.frozen or not p2.frozen)
+            if used and ecc == 0:
+                raise ValueError(
+                    "EPS1DOT/EPS2DOT are set/free but ECC = 0: the "
+                    "EDOT/OMDOT decomposition is undefined at zero "
+                    "eccentricity")
+            if used:
                 dst.param("EDOT").value = (
                     float((e1 * d1 + e2 * d2) / ecc), 0.0)
                 omdot_rad_s = (d1 * e2 - d2 * e1) / ecc ** 2
@@ -131,14 +209,10 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
                 dst.param("OMDOT").uncertainty = float(np.degrees(
                     np.hypot(e2 * sd1, e1 * sd2) / ecc ** 2)
                     * SEC_PER_JULIAN_YEAR)
-            dst.param("EDOT").frozen = src.param("EPS1DOT").frozen
-            dst.param("OMDOT").frozen = src.param("EPS2DOT").frozen
+                dst.param("EDOT").frozen = p1.frozen
+                dst.param("OMDOT").frozen = p2.frozen
         new_binary = "DD"
     else:
-        if not src.has_param("ECC"):
-            raise ValueError(
-                f"conversion {src.binary_model_name} -> ELL1 needs the "
-                "DD/BT parameterization (ECC/OM/T0)")
         ecc = src.param("ECC").value_f64
         om_deg = src.param("OM").value_f64
         om_rad = np.radians(om_deg) % (2.0 * np.pi)
@@ -148,6 +222,7 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
                 "model drops O(e^2) terms (use utils.ELL1_check)", ecc)
         dst = BinaryELL1()
         _copy_shared(src, dst)
+        _apply_shapiro_map(src, dst)
         dst.param("EPS1").value = (float(ecc * np.sin(om_rad)), 0.0)
         dst.param("EPS2").value = (float(ecc * np.cos(om_rad)), 0.0)
         from pint_tpu.ops import dd as ddm
@@ -170,12 +245,13 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
                              ("T0", "TASC")):
             dst.param(n_dst).frozen = src.param(n_src).frozen
         if src.has_param("EDOT") and src.has_param("OMDOT"):
-            edot = src.param("EDOT").value_f64
-            omdot = src.param("OMDOT").value_f64
-            se = src.param("EDOT").uncertainty or 0.0
-            so = np.radians(src.param("OMDOT").uncertainty or 0.0) \
-                / SEC_PER_JULIAN_YEAR
-            if edot or omdot or se or so:
+            pe, po = src.param("EDOT"), src.param("OMDOT")
+            edot, omdot = pe.value_f64, po.value_f64
+            se = pe.uncertainty or 0.0
+            so = np.radians(po.uncertainty or 0.0) / SEC_PER_JULIAN_YEAR
+            used = (edot or omdot or se or so
+                    or not pe.frozen or not po.frozen)
+            if used:
                 omdot_rad_s = np.radians(omdot) / SEC_PER_JULIAN_YEAR
                 dst.param("EPS1DOT").value = (
                     float(edot * np.sin(om_rad)
@@ -187,14 +263,23 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
                     np.sin(om_rad) * se, ecc * np.cos(om_rad) * so))
                 dst.param("EPS2DOT").uncertainty = float(np.hypot(
                     np.cos(om_rad) * se, ecc * np.sin(om_rad) * so))
-            dst.param("EPS1DOT").frozen = src.param("EDOT").frozen
-            dst.param("EPS2DOT").frozen = src.param("OMDOT").frozen
+                dst.param("EPS1DOT").frozen = pe.frozen
+                dst.param("EPS2DOT").frozen = po.frozen
         new_binary = "ELL1"
 
+    return _finish(model, src, dst, new_binary, fb_source, pb_d)
+
+
+def _finish(model, src, dst, new_binary, fb_source, pb_d) -> TimingModel:
     if fb_source and dst.param("PB").value_f64 <= 0:
-        # FB0-parameterized source (BTX): the target families carry PB
+        # FB0-parameterized source (BTX): the target families carry PB,
+        # sigma via the trivial Jacobian dPB/dFB0 = -1/(FB0^2 * 86400 s)
+        fb = src.param("FB0")
         dst.param("PB").value = (float(pb_d), 0.0)
-        dst.param("PB").frozen = src.param("FB0").frozen
+        dst.param("PB").frozen = fb.frozen
+        if fb.uncertainty:
+            dst.param("PB").uncertainty = float(
+                fb.uncertainty / (fb.value_f64 ** 2 * SECS_PER_DAY))
 
     comps = [dst if c is src else c for c in model.components]
     header = dict(model.header)

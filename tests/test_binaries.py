@@ -278,19 +278,19 @@ EPS2 -2e-6 1
 def test_convert_binary_guards():
     from pint_tpu.models.binaryconvert import convert_binary
 
-    # variant physics params must not be dropped silently
+    # unmappable variant physics must not be dropped silently
+    # (GAMMA has no ELL1 representation)
     m = get_model(BASE + """
-BINARY ELL1H
+BINARY DD
 PB 1.5
 A1 2
-TASC 55000.1
-EPS1 1e-6
-EPS2 1e-6
-H3 5e-7
-STIG 0.7
+T0 55000.1
+ECC 1e-5
+OM 30
+GAMMA 1e-6
 """)
     with pytest.raises(ValueError, match="silently drop"):
-        convert_binary(m, "DD")
+        convert_binary(m, "ELL1")
     # FB0-parameterized source: PB filled in the target family
     m2 = get_model(BASE + """
 BINARY BTX
@@ -304,3 +304,98 @@ OM 30
     np.testing.assert_allclose(mell["PB"].value_f64,
                                1.0 / (7.6e-6 * 86400.0), rtol=1e-12)
     assert not mell["PB"].frozen  # FB0 was free
+
+
+def test_convert_binary_shapiro_variants():
+    """ELL1H (orthometric) and DDS Shapiro map to M2/SINI on conversion."""
+    from pint_tpu.constants import T_SUN_S
+    from pint_tpu.models.binaryconvert import convert_binary
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    stig, m2 = 0.6, 0.25
+    h3 = T_SUN_S * m2 * stig ** 3
+    m = get_model(BASE + f"""
+BINARY ELL1H
+PB 0.8
+A1 1.2
+TASC 55000.1
+EPS1 1e-6
+EPS2 1e-6
+H3 {h3}
+STIG {stig}
+""")
+    mdd = convert_binary(m, "DD")
+    np.testing.assert_allclose(mdd["SINI"].value_f64,
+                               2 * stig / (1 + stig ** 2), rtol=1e-12)
+    np.testing.assert_allclose(mdd["M2"].value_f64, m2, rtol=1e-12)
+    toas = make_fake_toas_uniform(55000, 55020, 60, m, obs="@")
+    r0 = np.asarray(Residuals(toas, m, subtract_mean=False).time_resids)
+    r1 = np.asarray(Residuals(toas, mdd, subtract_mean=False).time_resids)
+    np.testing.assert_allclose(r1, r0, atol=5e-9)  # exact-resummed Shapiro
+
+    mdds = get_model(BASE + """
+BINARY DDS
+PB 0.8
+A1 1.2
+T0 55000.1
+ECC 1e-5
+OM 40
+M2 0.3
+SHAPMAX 2.0
+""")
+    mell = convert_binary(mdds, "ELL1")
+    np.testing.assert_allclose(mell["SINI"].value_f64,
+                               1 - np.exp(-2.0), rtol=1e-12)
+    np.testing.assert_allclose(mell["M2"].value_f64, 0.3, rtol=1e-12)
+
+
+def test_convert_binary_within_family():
+    """DDS -> DD and ELL1H -> ELL1 reparameterize Shapiro only."""
+    from pint_tpu.constants import T_SUN_S
+    from pint_tpu.models.binaryconvert import convert_binary
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    mdds = get_model(BASE + """
+BINARY DDS
+PB 0.8
+A1 1.2
+T0 55000.1
+ECC 1e-5
+OM 40
+M2 0.3
+SHAPMAX 2.0 1
+""")
+    mdds["SHAPMAX"].uncertainty = 0.05
+    mdd = convert_binary(mdds, "DD")
+    assert mdd.has_component("BinaryDD") and mdd.header["BINARY"] == "DD"
+    assert mdd["ECC"].value_f64 == 1e-5 and mdd["OM"].value_f64 == 40.0
+    np.testing.assert_allclose(mdd["SINI"].value_f64, 1 - np.exp(-2.0))
+    np.testing.assert_allclose(mdd["SINI"].uncertainty,
+                               np.exp(-2.0) * 0.05, rtol=1e-12)
+    assert not mdd["SINI"].frozen  # SHAPMAX was free
+    toas = make_fake_toas_uniform(55000, 55020, 50, mdds, obs="@")
+    r0 = np.asarray(Residuals(toas, mdds, subtract_mean=False).time_resids)
+    r1 = np.asarray(Residuals(toas, mdd, subtract_mean=False).time_resids)
+    np.testing.assert_allclose(r1, r0, atol=2e-9)
+
+    stig, m2v = 0.6, 0.25
+    mh = get_model(BASE + f"""
+BINARY ELL1H
+PB 0.8
+A1 1.2
+TASC 55000.1
+EPS1 1e-6 1
+EPS2 1e-6 1
+H3 {T_SUN_S * m2v * stig**3} 1
+STIG {stig} 1
+""")
+    mh["H3"].uncertainty = 1e-9
+    mh["STIG"].uncertainty = 0.01
+    mell = convert_binary(mh, "ELL1")
+    assert mell.has_component("BinaryELL1")
+    np.testing.assert_allclose(mell["M2"].value_f64, m2v, rtol=1e-12)
+    assert mell["M2"].uncertainty > 0 and mell["SINI"].uncertainty > 0
+    assert not mell["SINI"].frozen  # STIG was free
+    assert mell["EPS1"].value_f64 == 1e-6  # orbit untouched
