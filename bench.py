@@ -167,7 +167,8 @@ def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
             value = float(np.median(times))
             out = {"metric": metric, "value": round(value, 6), "unit": "s",
                    "vs_baseline": round(budget_s / value, 3),
-                   "backend": jax.default_backend() + pinned}
+                   "backend": jax.default_backend() + pinned,
+                   "host_cores": os.cpu_count()}
             out.update(extras())
         _emit(out)
     except Exception as e:  # noqa: BLE001
@@ -338,6 +339,7 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
         "vs_baseline": round(budget_s / value, 3),
         "backend": backend,
         "device": device,
+        "host_cores": os.cpu_count(),
         "mode": mode,
         "dd_self_check": dd_ok_cpu,  # the device DD actually runs on
         "dd_self_check_accel": dd_ok_accel,
@@ -536,6 +538,7 @@ def _main_guarded() -> None:
             "vs_baseline": round(budget_s / value, 3),
             "backend": backend,
             "device": device,
+            "host_cores": os.cpu_count(),
             "dd_self_check": dd_ok,
             "design_matrix_ms_per_toa": round(dm_ms_per_toa, 6),
             "n_ecorr_epochs": n_ecorr,

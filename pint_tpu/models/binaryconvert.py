@@ -43,7 +43,10 @@ def _apply_shapiro_map(src, dst) -> None:
     """
     from pint_tpu.constants import T_SUN_S
 
-    if src.has_param("SHAPMAX") and src.param("SHAPMAX").value_f64:
+    def _used(prm):
+        return bool(prm.value_f64 or prm.uncertainty or not prm.frozen)
+
+    if src.has_param("SHAPMAX") and _used(src.param("SHAPMAX")):
         # DDS: only SINI is reparameterized; M2 is shared and copies over
         sm = src.param("SHAPMAX")
         sini = 1.0 - float(np.exp(-sm.value_f64))
@@ -53,7 +56,7 @@ def _apply_shapiro_map(src, dst) -> None:
         q.frozen = sm.frozen
         log.info("mapped SHAPMAX to SINI=%.6g", sini)
         return
-    if not (src.has_param("H3") and src.param("H3").value_f64):
+    if not (src.has_param("H3") and _used(src.param("H3"))):
         return
     h3p = src.param("H3")
     h3, sh3 = h3p.value_f64, h3p.uncertainty or 0.0
@@ -66,11 +69,10 @@ def _apply_shapiro_map(src, dst) -> None:
         h4p = src.param("H4")
         h4, sh4 = h4p.value_f64, h4p.uncertainty or 0.0
         stig = h4 / h3
-        sstig = abs(stig) * np.hypot(sh4 / h4 if h4 else 0.0,
-                                     sh3 / h3)
+        sstig = abs(stig) * np.hypot(sh4 / h4, sh3 / h3)
         stig_frozen = h4p.frozen
         # M2 = H3^4 / (T_sun H4^3)
-        sm2_rel = np.hypot(4.0 * sh3 / h3, 3.0 * (sh4 / h4 if h4 else 0.0))
+        sm2_rel = np.hypot(4.0 * sh3 / h3, 3.0 * sh4 / h4)
     else:
         return
     sini = 2.0 * stig / (1.0 + stig ** 2)
@@ -88,14 +90,20 @@ def _apply_shapiro_map(src, dst) -> None:
              m2, sini)
 
 
-def _copy_shared(src, dst) -> None:
-    """Copy same-named params; refuse to silently drop set variant params.
+# consumed by the Shapiro map / FB0->PB fill alone (the within-family
+# paths convert nothing else, so e.g. ELL1k's OMDOT must raise there)
+_SHAPIRO_CONSUMED = {"H3", "H4", "STIG", "SHAPMAX", "FB0"}
 
-    Variant-specific physics (H3/H4/STIG, SHAPMAX, GAMMA, LNEDOT, ...)
-    has no representation on the base target class — losing a nonzero
-    one would silently change the predicted TOAs, so that is an error
-    (the reference's convert_binary maps these per-variant; converting
-    such models here requires zeroing or refitting them explicitly).
+
+def _copy_shared(src, dst, consumed: set = _TRANSFORMED) -> None:
+    """Copy same-named params; refuse to silently drop used variant params.
+
+    Variant-specific physics (GAMMA, LNEDOT, ELL1k's OMDOT, ...) with no
+    representation on the target — set, carrying an uncertainty, or left
+    free for fitting — would silently change the predicted TOAs or the
+    fit, so that is an error (the reference's convert_binary maps these
+    per-variant; converting such models here requires zeroing or
+    refitting them explicitly).
     """
     dst_names = {p.name for p in dst.params}
     dropped = []
@@ -105,14 +113,14 @@ def _copy_shared(src, dst) -> None:
             q.value = p.value
             q.uncertainty = p.uncertainty
             q.frozen = p.frozen
-        elif (p.name not in _TRANSFORMED and p.is_numeric
-              and p.value_f64 != 0.0):
+        elif (p.name not in consumed and p.is_numeric
+              and (p.value_f64 != 0.0 or p.uncertainty or not p.frozen)):
             dropped.append(p.name)
     if dropped:
         raise ValueError(
             f"conversion {type(src).__name__} -> {type(dst).__name__} "
-            f"would silently drop set parameters {dropped}; convert from "
-            "the base ELL1/DD parameterization instead")
+            f"would silently drop set/free parameters {dropped}; convert "
+            "from the base ELL1/DD parameterization instead")
 
 
 def convert_binary(model: TimingModel, target: str) -> TimingModel:
@@ -147,13 +155,13 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
         # within-family (DDS/DDH/BT/... -> DD): the orbit is already in
         # ECC/OM/T0 form; only the Shapiro parameterization changes
         dst = BinaryDD()
-        _copy_shared(src, dst)
+        _copy_shared(src, dst, consumed=_SHAPIRO_CONSUMED)
         _apply_shapiro_map(src, dst)
         return _finish(model, src, dst, "DD", fb_source, pb_d)
     if target == "ELL1" and src_is_ell1:
         # within-family (ELL1H/ELL1k -> ELL1)
         dst = BinaryELL1()
-        _copy_shared(src, dst)
+        _copy_shared(src, dst, consumed=_SHAPIRO_CONSUMED)
         _apply_shapiro_map(src, dst)
         return _finish(model, src, dst, "ELL1", fb_source, pb_d)
 

@@ -399,3 +399,34 @@ STIG {stig} 1
     assert mell["M2"].uncertainty > 0 and mell["SINI"].uncertainty > 0
     assert not mell["SINI"].frozen  # STIG was free
     assert mell["EPS1"].value_f64 == 1e-6  # orbit untouched
+
+
+def test_convert_binary_within_family_guards():
+    from pint_tpu.models.binaryconvert import convert_binary
+
+    # ELL1k's OMDOT has no base-ELL1 representation: must raise
+    mk = get_model(BASE + """
+BINARY ELL1K
+PB 0.8
+A1 1.2
+TASC 55000.1
+EPS1 1e-6
+EPS2 1e-6
+OMDOT 0.5
+""")
+    with pytest.raises(ValueError, match="drop set/free"):
+        convert_binary(mk, "ELL1")
+    # free-at-zero SHAPMAX keeps its fittable state through DDS -> DD
+    mdds = get_model(BASE + """
+BINARY DDS
+PB 0.8
+A1 1.2
+T0 55000.1
+ECC 1e-5
+OM 40
+M2 0.3
+SHAPMAX 0 1
+""")
+    mdd = convert_binary(mdds, "DD")
+    assert not mdd["SINI"].frozen
+    assert mdd["SINI"].value_f64 == 0.0
