@@ -44,14 +44,17 @@ from pint_tpu.constants import (  # noqa: F401  (re-exported)
     C_M_S, JULIAN_MILLENNIUM_DAYS, MJD_J2000, SECS_PER_DAY, TT_MINUS_TAI_S,
 )
 
-_LEAP_MJD = jnp.asarray(LEAP_MJD, jnp.float64)
-_LEAP_OFF = jnp.asarray(LEAP_TAI_MINUS_UTC, jnp.float64)
+# numpy at module scope: a jnp array here would initialize the default
+# backend at import time (observed to hang on the flaky axon tunnel);
+# jnp ops convert these to on-device constants at trace time anyway
+_LEAP_MJD = np.asarray(LEAP_MJD, np.float64)
+_LEAP_OFF = np.asarray(LEAP_TAI_MINUS_UTC, np.float64)
 
 
 def tai_minus_utc(mjd_utc_day: jax.Array) -> jax.Array:
     """TAI-UTC in seconds at the given UTC MJD (float64 day is ample)."""
     idx = jnp.clip(jnp.searchsorted(_LEAP_MJD, mjd_utc_day, side="right") - 1, 0, None)
-    return _LEAP_OFF[idx]
+    return jnp.asarray(_LEAP_OFF)[idx]
 
 
 def utc_to_tai(mjd_utc: DD) -> DD:

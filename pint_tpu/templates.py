@@ -24,7 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 
 Array = jax.Array
-_WRAPS = jnp.arange(-3.0, 4.0)  # alias sum covers widths up to ~0.3 cycles
+# numpy (not jnp): module-level device arrays initialize the backend at
+# import; converted to a constant at trace time
+_WRAPS = np.arange(-3.0, 4.0)  # alias sum covers widths up to ~0.3 cycles
 
 
 def wrapped_gaussian_pdf(phases: Array, loc: Array, width: Array) -> Array:
