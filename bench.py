@@ -131,7 +131,7 @@ def _dd_pin_ctx():
 
     The mode benches run the full DD phase pipeline on the default
     backend; that needs IEEE f64 (error-free transforms). When the
-    accelerator fails ``dd.self_check`` (TPU v5e does — measured), a
+    accelerator fails ``dd.self_check`` (TPU v5e did in a round-2 session; artifact pending), a
     valid CPU number beats NaN on-chip (the hybrid split covers the
     default gls mode only).
     """
@@ -470,7 +470,7 @@ def _main_guarded() -> None:
 
         dd_ok = bool(dd_mod.self_check())
         # DD arithmetic needs IEEE-exact f64 (error-free transforms). If
-        # the accelerator fails the self-check (TPU v5e does — measured),
+        # the accelerator fails the self-check (TPU v5e did in a round-2 session; artifact pending),
         # the valid configuration is the hybrid split: DD phase/design on
         # the CPU backend, GLS linear algebra on the chip
         # (pint_tpu.fitting.hybrid; see pint_tpu.ops.dd docstring).

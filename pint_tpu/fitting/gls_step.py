@@ -267,8 +267,9 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
                       *, mxu: bool = False) -> dict:
     """Gram reduction from pre-whitened inputs, range-safe for TPU f64.
 
-    The TPU's emulated float64 carries float32 *dynamic range* (measured:
-    ``sum(M^2 w)`` at ~1e40 overflows to inf/NaN for spin-derivative
+    The TPU's emulated float64 carries float32 *dynamic range* (observed
+    on TPU v5e in a round-2 session, artifact pending: ``sum(M^2 w)`` at
+    ~1e40 overflows to inf/NaN for spin-derivative
     design columns). This variant therefore takes the whitening done on
     the CPU — ``A_M = M sqrt(w) / ||M sqrt(w)||`` (unit columns),
     ``rw = r sqrt(w)``, ``sw = sqrt(w)`` — and keeps every on-chip

@@ -1,7 +1,8 @@
 """Hybrid GLS fit: CPU-exact DD phase -> accelerator linear algebra.
 
-Why this exists (measured, not assumed): ``dd.self_check`` is **False**
-on the TPU backend (BENCH record) — the error-free transforms
+Why this exists (observed on hardware, not assumed): ``dd.self_check``
+came back **False** on the TPU v5e backend in a round-2 session
+(committed artifact pending — see ops/dd.py) — the error-free transforms
 (TwoSum/TwoProd) underlying double-double arithmetic do not hold under
 the TPU's emulated float64, so the phase/residual pipeline computed
 there is garbage (NaN chi2). The split promised by ``pint_tpu.ops.dd``:
@@ -129,8 +130,9 @@ class HybridGLSFitter(Fitter):
             rw = r * sw
             # ONE flat output buffer: the accelerator sits behind a
             # transfer link whose per-transfer latency dominates at
-            # these sizes (measured: ~17 round trips cost ~0.7 s/iter,
-            # the on-chip compute <1 ms), so stage 1 packs everything
+            # these sizes (observed in a round-2 TPU session: ~17 round
+            # trips cost ~0.7 s/iter, the on-chip compute <1 ms;
+            # committed artifact pending), so stage 1 packs everything
             # iteration-dependent into a single array for a single
             # host->device put (t_s/inv_f2 are TOA-only: shipped once).
             return jnp.concatenate([A_M.ravel(), rw, sw, norm_M])
@@ -159,8 +161,9 @@ class HybridGLSFitter(Fitter):
         )
 
         # on a real accelerator the O(n q^2) matmuls run as double-single
-        # f32 on the MXU (emulated f64 matmul measured ~100x slower than
-        # host CPU); on a TPU the square Grams additionally go through
+        # f32 on the MXU (emulated f64 matmul observed ~100x slower than
+        # host CPU in a round-2 TPU session; artifact pending); on a TPU
+        # the square Grams additionally go through
         # the hand-tiled pallas kernel. The gradient and segment sums
         # stay exact f64. force_mxu overrides (tests exercise the ds32
         # path on CPU).

@@ -19,11 +19,33 @@ TwoProd), which require IEEE-754 correctly-rounded float64 add/sub/mul.
    records its result (``dd_self_check``) next to every timing number so
    the precision claim is auditable per hardware target.
 
-   * XLA **CPU** passes: bit-identical to numpy IEEE float64 (verified in
-     ``tests/test_dd.py``; the test suite pins this backend).
-   * XLA **TPU** emulates float64 and **fails the check on TPU v5e**
-     (measured: ``dd_self_check: false`` in BENCH_r02; DD phase evaluated
-     there yields NaN chi2). Consequence: the DD phase pipeline must stay
+   * XLA **CPU** passes for all *normal-range* float64: identical to
+     numpy IEEE arithmetic except that XLA flushes **subnormal** results
+     to zero (FTZ) where numpy keeps them (found by hypothesis in round
+     2: TwoSum(1.152e-294, 3.956e-305) has exact error term -2.14e-311,
+     which XLA returns as 0.0).  The DD contract is therefore bounded:
+     **TwoSum** is exact for inputs ``|x| > ~1e-280`` (its error term is
+     an integer multiple of ``ulp(min|x|) >= ulp(2^-930) = 2^-982 >
+     2^-1022`` and can never be subnormal); **TwoProd** additionally
+     needs the *product* in range, ``~1e-150 < |a*b| < ~1e150`` (its
+     error term lives at ``ulp(a*b)``, and the Dekker split halves at
+     ``~|x| * 2^-27`` must also stay normal — the bounds
+     ``tests/test_dd_properties.py::test_two_prod_exact_property``
+     enforces).  Scale
+     argument for why timing never leaves this domain: the smallest
+     hi-words in the pipeline are delays of ~1e-12 s and parameter
+     derivatives of ~1e-20; lo-words are bounded below (when nonzero and
+     material) by ulps of those, ~1e-36 — more than 240 orders of
+     magnitude above the subnormal threshold.  Even a worst-case flush
+     loses < 2.2e-308 absolute, ~1e250x below the 1 ns / 30 yr target.
+     (Verified in ``tests/test_dd.py``; the FTZ divergence is pinned in
+     ``tests/test_dd_properties.py::test_two_sum_subnormal_flush_documented``.)
+   * XLA **TPU** emulates float64 and **failed the check on TPU v5e**
+     (observed in a round-2 session before the TPU tunnel went down; DD
+     phase evaluated there yielded NaN chi2.  Committed artifact pending
+     — BENCH_r01/r02 are CPU-fallback runs; the standing order is to
+     commit a TPU-backend bench JSON the first session the tunnel
+     revives). Consequence: the DD phase pipeline must stay
      on the CPU backend, with only the collapsed-float64 linear algebra
      (design matrix / GLS solve — errors there multiply small parameter
      deltas) offloaded to the chip. Two implementations of that split:

@@ -1,8 +1,10 @@
 """MXU-friendly high-precision matmul: double-single float32 Gram.
 
 Why: the TPU executes float64 by software emulation at ~1/100 of host
-CPU throughput (measured — the 1e5-TOA Gram took 1.1 s emulated vs
-~10 ms of CPU f64), while its MXU runs float32 matmuls at full speed.
+CPU throughput (observed in a round-2 session on TPU v5e — the 1e5-TOA
+Gram took ~1.1 s emulated vs ~10 ms of CPU f64; committed artifact
+pending, to be recorded in a TPU-backend bench JSON the first session
+the tunnel revives), while its MXU runs float32 matmuls at full speed.
 For the GLS Gram matrix G = A^T A of a *whitened, column-normalized*
 design block (entries O(1) — see gls_gram_whitened), the right TPU
 program is the classic double-single split:
