@@ -105,10 +105,16 @@ def _init_backend() -> list:
 
 
 def build_problem(n: int):
-    """N TOAs in 4-TOA ECORR epochs (within 1 s), two frequencies."""
+    """N simulated arrivals in 4-TOA ECORR epochs (within 1 s), two freqs.
+
+    The TOAs are *simulated from the model* (fixed-point inversion +
+    Gaussian noise at the stated errors), so post-fit chi2 ~ ndof and the
+    flagship timing number doubles as a scale correctness probe — fitting
+    random MJDs would iterate on ~1e6-turn unphysical residuals.
+    """
     from pint_tpu.models import get_model
     from pint_tpu.ops.dd import DD
-    from pint_tpu.toas import build_TOAs_from_arrays
+    from pint_tpu.simulation import make_fake_toas_from_arrays
 
     model = get_model(PAR)
     rng = np.random.default_rng(0)
@@ -118,10 +124,10 @@ def build_problem(n: int):
     mjds = (centers[:, None] + offsets).ravel()[:n]
     freqs = np.where(rng.random(n) < 0.5, 1400.0, 430.0)
     errs = np.full(n, 1.0)
-    toas = build_TOAs_from_arrays(
-        DD(jnp.asarray(mjds), jnp.zeros(n)),
-        freq_mhz=freqs, error_us=errs,
-        obs_names=("gbt",), eph=model.ephem,
+    toas = make_fake_toas_from_arrays(
+        DD(jnp.asarray(mjds), jnp.zeros(n)), model,
+        freq_mhz=freqs, error_us=errs, obs="gbt",
+        add_noise=True, seed=0, niter=2,
     )
     return model, toas
 

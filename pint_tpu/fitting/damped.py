@@ -1,0 +1,60 @@
+"""Damped Gauss-Newton outer loop over a fused fit step.
+
+Host-side driver shared by the north-star fitters
+(:class:`pint_tpu.parallel.sharded_fit.ShardedWLSFitter` /
+``ShardedGLSFitter`` and :class:`pint_tpu.fitting.hybrid.HybridGLSFitter`):
+the same accept / halve / converge semantics as the dense
+``_DownhillMixin`` (reference: src/pint/fitter.py :: DownhillFitter,
+SURVEY §2.3), but expressed over a *fused step function* — one call
+evaluates the chi2 at the input parameters AND proposes a Gauss-Newton
+step, so judging a trial point costs exactly one device program instead
+of a separate residual pass.
+
+The step contract: ``iterate(deltas) -> (new_deltas, info)`` where
+``info["chi2_at_input"]`` is the (noise-marginalized, for GLS) chi2 of
+the residuals at ``deltas`` and ``new_deltas`` is the proposed full
+step from there.  The driver never needs residuals on the host.
+"""
+
+from __future__ import annotations
+
+
+def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
+                     min_chi2_decrease: float = 1e-3,
+                     max_step_halvings: int = 8):
+    """Run a damped Gauss-Newton loop; returns (deltas, info, chi2, converged).
+
+    Take the proposed step; while chi2 increases, halve it.  Stop when
+    no downhill step exists (converged at a minimum of the linearized
+    model) or the decrease falls below ``min_chi2_decrease``.  ``info``
+    is the step output evaluated *at the returned deltas* (so its
+    errors / covariance / noise coefficients are current); ``chi2`` is
+    the actual chi2 there, not the linearized prediction.
+    """
+    new_deltas, info = iterate(deltas0)
+    chi2 = float(info["chi2_at_input"])
+    deltas = deltas0
+    converged = False
+    for _ in range(max(1, maxiter)):
+        dx = {k: new_deltas[k] - deltas[k] for k in deltas}
+        lam, applied = 1.0, False
+        trial = trial_new = trial_info = None
+        for _h in range(max_step_halvings):
+            trial = {k: deltas[k] + lam * dx[k] for k in deltas}
+            trial_new, trial_info = iterate(trial)
+            trial_chi2 = float(trial_info["chi2_at_input"])
+            if trial_chi2 <= chi2 + 1e-12:
+                applied = True
+                break
+            lam *= 0.5
+        if not applied:
+            # no downhill direction left: we are at (numerical) optimum
+            converged = True
+            break
+        decrease = chi2 - trial_chi2
+        deltas, chi2 = trial, trial_chi2
+        new_deltas, info = trial_new, trial_info
+        if decrease < min_chi2_decrease:
+            converged = True
+            break
+    return deltas, info, chi2, converged

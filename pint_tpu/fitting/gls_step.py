@@ -245,6 +245,37 @@ def gls_solve_normalized(parts: dict) -> dict:
     return {"xB": xB, "Sigma": Sigma, "chi2": chi2, "x_e": x_e}
 
 
+def noise_marginal_chi2(parts: dict, p: int) -> Array:
+    """GLS chi2 of the *input* residuals: r^T C^-1 r, timing params fixed.
+
+    The dense fitters get this via a zero-column design matrix
+    (``DownhillGLSFitter._fit_chi2``); here it falls out of the Schur
+    system already built by :func:`gls_gram_seg`: restricting the
+    quadratic form to the noise columns (p:) commutes with the ECORR
+    elimination (the epoch block's Schur complement is formed
+    column-by-column), so the noise-only system is exactly
+    ``S[p:, p:] x = rhs[p:]``. One tiny extra Cholesky — this is what a
+    damped (Downhill) outer loop needs to judge a proposed step, fused
+    into the same XLA program as the step itself.
+    """
+    S, rhs = parts["S"], parts["rhs"]
+    q = S.shape[0]
+    k = q - p
+    chi2 = parts["quad0"]
+    if k > 0:
+        Sn = S[p:, p:]
+        Sn = Sn + jnp.eye(k) * (jnp.finfo(jnp.float64).eps * jnp.trace(Sn))
+        cf = jax.scipy.linalg.cho_factor(Sn, lower=True)
+        xn = jax.scipy.linalg.cho_solve(cf, rhs[p:])
+        chi2 = chi2 - parts["c_B"][p:] @ xn
+        if parts["d"].shape[0] > 0:
+            x_e = (parts["c_e"] - parts["C"][:, p:] @ xn) / parts["d"]
+            chi2 = chi2 - parts["c_e"] @ x_e
+    elif parts["d"].shape[0] > 0:
+        chi2 = chi2 - parts["c_e"] @ (parts["c_e"] / parts["d"])
+    return chi2
+
+
 def gls_finalize_seg(parts: dict, p: int) -> dict:
     """Normalized solve + un-normalization to physical parameter units.
 
@@ -395,13 +426,16 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
         M = jnp.stack(cols, axis=1)
 
         F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
-        sol = gls_solve_seg(M, r, err, F, phi_F,
-                            noise.epoch_idx, noise.ecorr_phi)
+        parts = gls_gram_seg(M, r, err, F, phi_F,
+                             noise.epoch_idx, noise.ecorr_phi)
+        sol = gls_finalize_seg(parts, M.shape[1])
         new_deltas = {k: deltas[k] + sol["x"][i + off]
                       for i, k in enumerate(names)}
         sig = jnp.sqrt(jnp.diagonal(sol["cov"]))
         errors = {k: sig[i + off] for i, k in enumerate(names)}
         return new_deltas, {"chi2": sol["chi2"], "errors": errors,
+                            "chi2_at_input":
+                                noise_marginal_chi2(parts, M.shape[1]),
                             "fourier_coeffs": sol["fourier_coeffs"],
                             "ecorr_coeffs": sol["ecorr_coeffs"]}
 

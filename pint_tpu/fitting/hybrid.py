@@ -34,7 +34,8 @@ from pint_tpu.constants import SECS_PER_DAY
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.fitting.gls_step import (PLSpec, build_noise_statics,
                                        fourier_design, gls_gram_whitened,
-                                       gls_solve_normalized, powerlaw_phi)
+                                       gls_solve_normalized,
+                                       noise_marginal_chi2, powerlaw_phi)
 
 Array = jax.Array
 
@@ -196,7 +197,10 @@ class HybridGLSFitter(Fitter):
                 sol = gls_solve_normalized(parts)
                 return jnp.concatenate([
                     sol["xB"], sol["Sigma"].ravel(), parts["norm"],
-                    jnp.reshape(sol["chi2"], (1,)), sol["x_e"],
+                    jnp.reshape(sol["chi2"], (1,)),
+                    jnp.reshape(noise_marginal_chi2(parts, n_params),
+                                (1,)),
+                    sol["x_e"],
                 ])
             return stage2
 
@@ -238,21 +242,24 @@ class HybridGLSFitter(Fitter):
         Sigma = out[o:o + q * q].reshape(q, q); o += q * q
         norm = out[o:o + q]; o += q
         chi2 = out[o]; o += 1
+        chi2_in = out[o]; o += 1
         x_e = out[o:o + ne]
         x = xB / norm
         cov = Sigma / np.outer(norm, norm)
         sol = {"x": x[:p], "cov": cov[:p, :p], "chi2": chi2,
+               "chi2_at_input": chi2_in,
                "fourier_coeffs": x[p:], "ecorr_coeffs": x_e}
         new_deltas = {k: deltas[k] + sol["x"][i + self._off]
                       for i, k in enumerate(self._names)}
         return new_deltas, sol
 
-    def fit_toas(self, maxiter: int = 2, **kw) -> float:
+    def fit_toas(self, maxiter: int = 20, **kw) -> float:
+        from pint_tpu.fitting.damped import downhill_iterate
+
         base = jax.device_put(self.model.base_dd(), self.cpu)
-        deltas = {k: jnp.zeros((), jnp.float64) for k in self._names}
-        sol = None
-        for _ in range(max(1, maxiter)):
-            deltas, sol = self._iterate(base, deltas)
+        deltas0 = {k: jnp.zeros((), jnp.float64) for k in self._names}
+        deltas, sol, chi2, converged = downhill_iterate(
+            lambda d: self._iterate(base, d), deltas0, maxiter=maxiter)
         cov = np.asarray(sol["cov"])
         errors = np.sqrt(np.diagonal(cov))
         for i, k in enumerate(self._names):
@@ -262,5 +269,5 @@ class HybridGLSFitter(Fitter):
         self.fit_params = list(self._names)
         self.parameter_covariance_matrix = cov
         self.resids = self._new_resids()
-        self.converged = True
-        return float(np.asarray(sol["chi2"]))
+        self.converged = converged
+        return chi2

@@ -89,7 +89,11 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
         if not has_phoff:
             post = post - jnp.sum(post * w) / jnp.sum(w)
         chi2 = jnp.sum(jnp.square(post / f0) * w)
-        return new_deltas, {"chi2": chi2, "errors": errors}
+        # chi2 of the residuals at the INPUT deltas — what a damped
+        # (Downhill) outer loop compares against when judging the step
+        chi2_in = jnp.sum(jnp.square(r) * w)
+        return new_deltas, {"chi2": chi2, "errors": errors,
+                            "chi2_at_input": chi2_in}
 
     if not masked:
         def step_unmasked(base, deltas, toas):

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pint_tpu.fitting.damped import downhill_iterate
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
                                        make_gls_step, pad_noise_statics)
@@ -59,10 +60,13 @@ def pad_toas(toas: TOAs, n_target: int) -> TOAs:
 
 
 def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2):
-    """Run `maxiter` sharded WLS iterations; returns (deltas, info).
+    """Damped sharded WLS; returns (deltas, info, chi2, converged).
 
     Host-side wrapper: pads the table to the mesh's TOA-shard multiple,
-    places shardings, jits the step once, and iterates.
+    places shardings, jits the step once, and runs the same
+    accept / halve / converge loop as the dense Downhill fitters
+    (:func:`pint_tpu.fitting.damped.downhill_iterate`) — each trial
+    evaluation is one sharded XLA program.
     """
     mesh = mesh or make_mesh()
     n_shards = mesh.shape["toa"]
@@ -70,12 +74,10 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2):
     toas_sh = shard_toas(padded, mesh)
     step = jax.jit(make_wls_step(model))
     base = replicate(model.base_dd(), mesh)
-    deltas = replicate(model.zero_deltas(), mesh)
-    info = None
+    deltas0 = replicate(model.zero_deltas(), mesh)
     with mesh:
-        for _ in range(max(1, maxiter)):
-            deltas, info = step(base, deltas, toas_sh)
-    return deltas, info
+        return downhill_iterate(
+            lambda d: step(base, d, toas_sh), deltas0, maxiter=maxiter)
 
 
 class ShardedWLSFitter(Fitter):
@@ -89,9 +91,9 @@ class ShardedWLSFitter(Fitter):
         super().__init__(toas, model)
         self.mesh = mesh or make_mesh()
 
-    def fit_toas(self, maxiter: int = 2) -> float:
-        deltas, info = sharded_fit(self.toas, self.model, mesh=self.mesh,
-                                   maxiter=maxiter)
+    def fit_toas(self, maxiter: int = 20) -> float:
+        deltas, info, chi2, converged = sharded_fit(
+            self.toas, self.model, mesh=self.mesh, maxiter=maxiter)
         errors = info["errors"]
         for name, d in deltas.items():
             p = self.model[name]
@@ -99,19 +101,22 @@ class ShardedWLSFitter(Fitter):
             p.uncertainty = float(np.asarray(errors[name]))
         self.fit_params = list(deltas)
         self.resids = self._new_resids()
-        self.converged = True
-        return float(np.asarray(info["chi2"]))
+        self.converged = converged
+        return chi2
 
 
 def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
-    """Run `maxiter` TOA-sharded GLS iterations; returns (deltas, info).
+    """Damped TOA-sharded GLS; returns (deltas, info, chi2, converged).
 
     The north-star configuration (SURVEY.md §5): correlated noise
     (ECORR + power-law Fourier) with every O(n) array — TOA table,
     design-matrix rows, Fourier blocks, epoch indices — sharded over the
     mesh's "toa" axis. Noise bases are built inside the jitted step
     (pint_tpu.fitting.gls_step); the host only precomputes the O(n)
-    epoch-index vector.
+    epoch-index vector. The outer loop has the dense Downhill fitters'
+    accept / halve / converge semantics (``chi2_at_input`` is computed
+    in-step via the Schur-restricted noise subsystem, so a trial point
+    costs one program).
     """
     mesh = mesh or make_mesh()
     n_shards = mesh.shape["toa"]
@@ -131,12 +136,11 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
     )
     step = jax.jit(make_gls_step(model, pl_specs=pl_specs))
     base = replicate(model.base_dd(), mesh)
-    deltas = replicate(model.zero_deltas(), mesh)
-    info = None
+    deltas0 = replicate(model.zero_deltas(), mesh)
     with mesh:
-        for _ in range(max(1, maxiter)):
-            deltas, info = step(base, deltas, toas_sh, noise_sh)
-    return deltas, info
+        return downhill_iterate(
+            lambda d: step(base, d, toas_sh, noise_sh), deltas0,
+            maxiter=maxiter)
 
 
 class ShardedGLSFitter(Fitter):
@@ -153,9 +157,9 @@ class ShardedGLSFitter(Fitter):
         self.mesh = mesh or make_mesh()
         self.noise_coeffs: np.ndarray | None = None
 
-    def fit_toas(self, maxiter: int = 2) -> float:
-        deltas, info = sharded_gls_fit(self.toas, self.model, mesh=self.mesh,
-                                       maxiter=maxiter)
+    def fit_toas(self, maxiter: int = 20) -> float:
+        deltas, info, chi2, converged = sharded_gls_fit(
+            self.toas, self.model, mesh=self.mesh, maxiter=maxiter)
         errors = info["errors"]
         for name, d in deltas.items():
             p = self.model[name]
@@ -167,5 +171,5 @@ class ShardedGLSFitter(Fitter):
             np.asarray(info["ecorr_coeffs"]),
         ])
         self.resids = self._new_resids()
-        self.converged = True
-        return float(np.asarray(info["chi2"]))
+        self.converged = converged
+        return chi2

@@ -48,11 +48,17 @@ def run_app(ctrl) -> int:
             ctrl.set_fit_flag(name, flag_vars[name].get())
         return cb
 
-    for name, free in ctrl.fit_flags().items():
-        v = tk.BooleanVar(value=free)
-        flag_vars[name] = v
-        ttk.Checkbutton(side, text=name, variable=v,
-                        command=on_flag(name)).pack(anchor="w")
+    def _refresh_flags():
+        for w in list(side.winfo_children())[1:]:
+            w.destroy()
+        flag_vars.clear()
+        for name, free in ctrl.fit_flags().items():
+            v = tk.BooleanVar(value=free)
+            flag_vars[name] = v
+            ttk.Checkbutton(side, text=name, variable=v,
+                            command=on_flag(name)).pack(anchor="w")
+
+    _refresh_flags()
 
     # ------------------------------------------------------------------ plot
     def redraw():
@@ -141,11 +147,67 @@ def run_app(ctrl) -> int:
             ctrl.write_tim(path)
             status.set(f"wrote {path}")
 
+    # ------------------------------------------------------- editor panes
+    # (reference: pint.pintk.paredit / timedit — a text editor window
+    # whose Apply round-trips through the normal par/tim load paths)
+    def _editor(title, get_text, apply_text, after_apply):
+        win = tk.Toplevel(root)
+        win.title(f"{title} — {ctrl.model.name}")
+        win.geometry("700x600")
+        txt = tk.Text(win, wrap="none", undo=True)
+        txt.insert("1.0", get_text())
+
+        def on_apply():
+            try:
+                apply_text(txt.get("1.0", "end-1c"))
+            except Exception as exc:  # invalid edit: model/TOAs untouched
+                messagebox.showerror(f"{title}: apply failed", str(exc),
+                                     parent=win)
+                return
+            after_apply()
+            status.set(f"{title} applied")
+            redraw()
+
+        def on_reload():
+            txt.delete("1.0", "end")
+            txt.insert("1.0", get_text())
+
+        def on_open():
+            path = filedialog.askopenfilename(parent=win)
+            if not path:
+                return
+            try:
+                with open(path) as f:
+                    content = f.read()
+            except (OSError, UnicodeDecodeError) as exc:
+                messagebox.showerror(f"{title}: open failed", str(exc),
+                                     parent=win)
+                return
+            txt.delete("1.0", "end")
+            txt.insert("1.0", content)
+
+        ebar = ttk.Frame(win)
+        for label, cmd in (("Apply", on_apply), ("Reload", on_reload),
+                           ("Open...", on_open)):
+            ttk.Button(ebar, text=label, command=cmd).pack(side="left",
+                                                           padx=2)
+        ebar.pack(side="top", fill="x")
+        txt.pack(side="top", fill="both", expand=True)
+
+    def do_edit_par():
+        _editor("paredit", ctrl.get_par_text, ctrl.apply_par_text,
+                _refresh_flags)
+
+    def do_edit_tim():
+        _editor("timedit", ctrl.get_tim_text, ctrl.apply_tim_text,
+                lambda: None)
+
     bar = ttk.Frame(root)
     for text, cmd in (("Fit", do_fit), ("Reset", do_reset),
                       ("Random models", do_random),
                       ("Delete selected", do_delete),
-                      ("Write par", do_write_par), ("Write tim", do_write_tim)):
+                      ("Write par", do_write_par), ("Write tim", do_write_tim),
+                      ("Edit par", do_edit_par), ("Edit tim", do_edit_tim)):
         ttk.Button(bar, text=text, command=cmd).pack(side="left", padx=2)
     ttk.Checkbutton(bar, text="Avg", variable=show_avg,
                     command=redraw).pack(side="left", padx=4)

@@ -199,6 +199,62 @@ class PintkController:
         return (avg["mjds"], avg["time_resids"] * 1e6,
                 avg["errors"] * 1e6, f"avg {which} residual (us)")
 
+    # ------------------------------------------------------------ text panes
+    # (reference: pint.pintk.paredit / timedit — in-GUI par/tim text
+    # editing round-tripping through the normal load paths)
+    def get_par_text(self) -> str:
+        """Editable par text of the current (pre-fit) model."""
+        return self.model.as_parfile()
+
+    def apply_par_text(self, text: str):
+        """Replace the working model with one parsed from edited text.
+
+        Round-trips through :func:`pint_tpu.models.get_model` — exactly
+        what loading the file would do — so invalid edits raise before
+        any state is touched.  Clears fit state (the old postfit model
+        belongs to the old parameterization) but keeps TOA selection /
+        deletion, like the reference's paredit Apply.
+        """
+        model = get_model(text)
+        self.model = model
+        self.base_model = copy.deepcopy(model)
+        self.postfit_model = None
+        self.fitter = None
+        self.random_dphase = None
+        self._invalidate()
+
+    def get_tim_text(self) -> str:
+        """Editable tempo2-format text of ALL loaded TOAs (incl. deleted)."""
+        return write_TOA_file(self.all_toas)
+
+    def apply_tim_text(self, text: str):
+        """Replace the TOA table with one parsed from edited text.
+
+        Round-trips through the normal tim pipeline (clock chain, TDB,
+        posvels via :func:`pint_tpu.toas.get_TOAs`, with the model's
+        ephemeris).  Selection and deletion reset — row identity is not
+        preserved across an arbitrary text edit.
+        """
+        import os
+        import tempfile
+
+        from pint_tpu.toas import get_TOAs
+
+        fd, path = tempfile.mkstemp(suffix=".tim")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            toas = get_TOAs(path, ephem=self.model.ephem)
+        finally:
+            os.unlink(path)
+        self.all_toas = toas
+        self.selected = np.ones(len(toas), dtype=bool)
+        self.deleted = np.zeros(len(toas), dtype=bool)
+        self.fitter = None
+        self.postfit_model = None
+        self.random_dphase = None
+        self._invalidate()
+
     # ---------------------------------------------------------------- output
     def write_par(self, path: str) -> str:
         model = self.postfit_model or self.model

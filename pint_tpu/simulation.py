@@ -70,6 +70,48 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int, model,
     return get_TOAs(tf, ephem=model.ephem, include_clock=include_clock)
 
 
+def make_fake_toas_from_arrays(mjd_dd: dd.DD, model, *, freq_mhz,
+                               error_us, obs: str = "gbt",
+                               add_noise: bool = False,
+                               seed: int | None = None, niter: int = 3,
+                               include_clock: bool = True) -> TOAs:
+    """Model-perfect arrival times at *given* epochs, no string round-trip.
+
+    Vectorized sibling of :func:`make_fake_toas_uniform` for large-N /
+    structured-epoch simulation (e.g. clustered ECORR epochs in
+    ``bench.py``): the caller supplies the local MJDs as a DD array, and
+    the same fixed-point iteration (residual shift applied in exact DD)
+    makes them arrivals the model times perfectly, skipping the per-TOA
+    string formatting/parsing of the tim-file path.  Reference
+    equivalent: pint.simulation.make_fake_toas (src/pint/simulation.py)
+    with an array-backed TOA table.
+    """
+    from pint_tpu.toas import build_TOAs_from_arrays
+
+    n = int(np.shape(np.asarray(mjd_dd.hi))[0])
+    freqs = np.resize(np.asarray(freq_mhz, np.float64), n)
+    errs = np.resize(np.asarray(error_us, np.float64), n)
+
+    def _build(m):
+        return build_TOAs_from_arrays(
+            m, freq_mhz=freqs, error_us=errs, obs_names=(obs,),
+            eph=model.ephem, include_clock=include_clock)
+
+    for _ in range(max(1, niter)):
+        toas = _build(mjd_dd)
+        r = Residuals(toas, model, subtract_mean=False,
+                      track_mode="nearest")
+        shift_day = np.asarray(r.time_resids) / SECS_PER_DAY
+        mjd_dd = dd.sub(mjd_dd, shift_day)
+
+    if add_noise:
+        rng = np.random.default_rng(seed)
+        noise_s = rng.standard_normal(n) * errs * 1e-6
+        mjd_dd = dd.add(mjd_dd, noise_s / SECS_PER_DAY)
+
+    return _build(mjd_dd)
+
+
 def make_fake_toas_fromtim(timfile: str, model, *, add_noise: bool = False,
                            seed: int | None = None, niter: int = 3) -> TOAs:
     """Replace the TOAs of an existing tim file with model-perfect ones."""

@@ -1,0 +1,108 @@
+"""End-to-end external golden: published par/tim -> fit -> published values.
+
+VERDICT round-2 task 4 asked for the NGC6440E anchor (the public
+NANOGrav/PINT tutorial dataset, ~62 GBT TOAs): load the real par/tim
+pair, fit, and compare post-fit F0/F1/DM and residual RMS against the
+PINT-published tutorial output, so any sign/convention/constant error in
+the par -> phase -> fit chain fails a test whose expected numbers were
+produced outside this repo.
+
+Status of the data: this build environment has zero network egress, the
+reference mount is empty, and no copy of NGC6440E.{par,tim} exists
+anywhere on the image (verified by filesystem search).  Fabricating TOAs
+would defeat the purpose (and is explicitly out of bounds), so the
+harness below is *data-gated*: it activates the moment a real dataset is
+placed in ``$PINT_TPU_GOLDEN_DIR`` and skips with an explanation until
+then.  The expected values are read from ``expected.json`` next to the
+data so they too come from outside this repo (copy them from the
+published tutorial output), e.g.::
+
+    {"fit": "wls", "free": ["F0", "F1", "DM", "RAJ", "DECJ"],
+     "F0": 61.48547651819495, "F0_unc": 1.6e-10,
+     "F1": -1.1813e-15, "F1_unc": 2e-18,
+     "DM": 224.114, "DM_unc": 0.03,
+     "post_rms_us": 21.3, "rms_rtol": 0.1, "unc_rtol": 0.3,
+     "value_sigma": 3.0}
+
+Tolerances are supplied with the data because they depend on which
+ephemeris/clock products the providing environment ships (SURVEY §4's
+"documented ephemeris-fallback tolerance band").
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+GOLDEN_DIR = os.environ.get("PINT_TPU_GOLDEN_DIR", "")
+_REQUIRED = ("NGC6440E.par", "NGC6440E.tim", "expected.json")
+
+
+def _golden_available() -> bool:
+    return bool(GOLDEN_DIR) and all(
+        os.path.exists(os.path.join(GOLDEN_DIR, f)) for f in _REQUIRED)
+
+
+pytestmark = pytest.mark.skipif(
+    not _golden_available(),
+    reason="external golden data absent: set PINT_TPU_GOLDEN_DIR to a "
+           "directory holding NGC6440E.par, NGC6440E.tim, expected.json "
+           "(zero-egress image ships no copy; TOAs must not be fabricated)")
+
+
+@pytest.fixture(scope="module")
+def golden_fit():
+    from pint_tpu.fitting import Fitter
+    from pint_tpu.models import get_model
+    from pint_tpu.toas import get_TOAs
+
+    with open(os.path.join(GOLDEN_DIR, "expected.json")) as f:
+        exp = json.load(f)
+    model = get_model(os.path.join(GOLDEN_DIR, "NGC6440E.par"))
+    toas = get_TOAs(os.path.join(GOLDEN_DIR, "NGC6440E.tim"),
+                    ephem=model.ephem)
+    for name in exp.get("free", []):
+        model[name].frozen = False
+    kind = exp.get("fit", "auto")
+    if kind == "auto":
+        fitter = Fitter.auto(toas, model)
+    else:
+        from pint_tpu.fitting import GLSFitter, WLSFitter
+
+        fitter = {"wls": WLSFitter, "gls": GLSFitter}[kind](toas, model)
+    fitter.fit_toas(maxiter=10)
+    return fitter, model, exp
+
+
+def test_postfit_parameters_match_published(golden_fit):
+    fitter, model, exp = golden_fit
+    sigma = float(exp.get("value_sigma", 3.0))
+    for name in ("F0", "F1", "DM"):
+        if name not in exp:
+            continue
+        p = model[name]
+        pull = (p.value_f64 - exp[name]) / exp[f"{name}_unc"]
+        assert abs(pull) < sigma, (
+            f"{name}: fit {p.value_f64!r} vs published {exp[name]!r} "
+            f"({pull:.2f} published-sigma)")
+
+
+def test_postfit_uncertainties_match_published(golden_fit):
+    _fitter, model, exp = golden_fit
+    rtol = float(exp.get("unc_rtol", 0.3))
+    for name in ("F0", "F1", "DM"):
+        if f"{name}_unc" not in exp:
+            continue
+        np.testing.assert_allclose(model[name].uncertainty,
+                                   exp[f"{name}_unc"], rtol=rtol,
+                                   err_msg=name)
+
+
+def test_postfit_rms_matches_published(golden_fit):
+    fitter, _model, exp = golden_fit
+    if "post_rms_us" not in exp:
+        pytest.skip("no published RMS in expected.json")
+    rms_us = fitter.resids.rms_weighted_s() * 1e6
+    np.testing.assert_allclose(rms_us, exp["post_rms_us"],
+                               rtol=float(exp.get("rms_rtol", 0.1)))

@@ -151,3 +151,53 @@ def test_averaged_cache_invalidated_by_fit(ctrl):
     assert "prefit" in ctrl._avg_cache
     ctrl.undelete_all()
     assert ctrl._avg_cache == {}
+
+
+def test_paredit_roundtrip(ctrl):
+    """paredit pane: text -> edit -> apply round-trips through get_model
+    (reference: pint.pintk.paredit)."""
+    text = ctrl.get_par_text()
+    assert "F0" in text and "RAJ" in text
+    # edit: change F1's value in the text
+    lines = []
+    for ln in text.splitlines():
+        if ln.split() and ln.split()[0] == "F1":
+            lines.append("F1 -1.5e-15 1")
+        else:
+            lines.append(ln)
+    ctrl.fit()  # existing fit state must be cleared by apply
+    ctrl.apply_par_text("\n".join(lines))
+    assert abs(ctrl.model["F1"].value_f64 + 1.5e-15) < 1e-25
+    assert ctrl.postfit_model is None and ctrl.fitter is None
+    # the edited model is now the reset target
+    ctrl.reset()
+    assert abs(ctrl.model["F1"].value_f64 + 1.5e-15) < 1e-25
+
+
+def test_paredit_invalid_text_leaves_state(ctrl):
+    before = ctrl.model["F0"].value_f64
+    with pytest.raises(Exception):
+        ctrl.apply_par_text("PSRJ broken\nF0 not_a_number\n")
+    assert ctrl.model["F0"].value_f64 == before
+
+
+def test_timedit_roundtrip(ctrl):
+    """timedit pane: tim text -> delete a line -> apply reloads TOAs
+    through the normal pipeline (reference: pint.pintk.timedit)."""
+    text = ctrl.get_tim_text()
+    toa_lines = [ln for ln in text.splitlines()
+                 if ln.strip() and not ln.startswith(("FORMAT", "C ", "#"))]
+    assert len(toa_lines) == 60
+    # drop the last TOA line
+    out, dropped = [], False
+    for ln in reversed(text.splitlines()):
+        if not dropped and ln.strip() and not ln.startswith(("FORMAT", "C ", "#")):
+            dropped = True
+            continue
+        out.append(ln)
+    ctrl.apply_tim_text("\n".join(reversed(out)))
+    assert len(ctrl.all_toas) == 59
+    assert ctrl.n_active == 59
+    # prefit residuals still computable on the reloaded table
+    y, e, _ = ctrl.y_data("prefit")
+    assert y.shape == (59,)
