@@ -41,6 +41,8 @@ def run_app(ctrl) -> int:
     # ---------------------------------------------------------------- params
     side = ttk.Frame(root)
     ttk.Label(side, text="Fit parameters").pack(anchor="w")
+    flags_frame = ttk.Frame(side)  # rebuilt wholesale after paredit Apply
+    flags_frame.pack(anchor="w", fill="y")
     flag_vars: dict[str, tk.BooleanVar] = {}
 
     def on_flag(name):
@@ -49,13 +51,13 @@ def run_app(ctrl) -> int:
         return cb
 
     def _refresh_flags():
-        for w in list(side.winfo_children())[1:]:
+        for w in flags_frame.winfo_children():
             w.destroy()
         flag_vars.clear()
         for name, free in ctrl.fit_flags().items():
             v = tk.BooleanVar(value=free)
             flag_vars[name] = v
-            ttk.Checkbutton(side, text=name, variable=v,
+            ttk.Checkbutton(flags_frame, text=name, variable=v,
                             command=on_flag(name)).pack(anchor="w")
 
     _refresh_flags()

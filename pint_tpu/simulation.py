@@ -34,6 +34,31 @@ def _tim_from_mjd_strings(mjd_strs, freq_mhz, error_us, obs, flags=None) -> TimF
     return TimFile(toas=toas)
 
 
+def _invert_to_model(build, mjd_dd: dd.DD, model, errs, *,
+                     add_noise: bool, seed, niter: int) -> TOAs:
+    """Shared fixed-point core of every make_fake_* flavor.
+
+    ``build(mjd_dd) -> TOAs`` rebuilds the table through whichever IO
+    path the caller uses (tim strings, raw arrays, an existing tim
+    file); this loop computes residuals under ``model``, shifts the
+    exact DD MJDs by -residual (quadratic convergence; 3 passes reach
+    < 1e-12 s), optionally folds in the Gaussian noise draw, and builds
+    the final table.
+    """
+    for _ in range(max(1, niter)):
+        toas = build(mjd_dd)
+        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+        shift_day = np.asarray(r.time_resids) / SECS_PER_DAY
+        mjd_dd = dd.sub(mjd_dd, shift_day)
+
+    if add_noise:
+        rng = np.random.default_rng(seed)
+        noise_s = rng.standard_normal(np.shape(errs)[0]) * errs * 1e-6
+        mjd_dd = dd.add(mjd_dd, noise_s / SECS_PER_DAY)
+
+    return build(mjd_dd)
+
+
 def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int, model,
                            *, obs: str = "gbt", freq_mhz: float = 1400.0,
                            error_us: float = 1.0, add_noise: bool = False,
@@ -51,23 +76,13 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int, model,
     freqs = np.resize(np.asarray(freq_mhz, np.float64), ntoas)
     errs = np.resize(np.asarray(error_us, np.float64), ntoas)
 
-    toas = None
-    for _ in range(max(1, niter)):
-        strs = [dd.to_string(mjd_dd[i], ndigits=25) for i in range(ntoas)]
+    def build(m):
+        strs = [dd.to_string(m[i], ndigits=25) for i in range(ntoas)]
         tf = _tim_from_mjd_strings(strs, freqs, errs, obs)
-        toas = get_TOAs(tf, ephem=model.ephem, include_clock=include_clock)
-        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
-        shift_day = np.asarray(r.time_resids) / SECS_PER_DAY
-        mjd_dd = dd.sub(mjd_dd, shift_day)
+        return get_TOAs(tf, ephem=model.ephem, include_clock=include_clock)
 
-    if add_noise:
-        rng = np.random.default_rng(seed)
-        noise_s = rng.standard_normal(ntoas) * errs * 1e-6
-        mjd_dd = dd.add(mjd_dd, noise_s / SECS_PER_DAY)
-
-    strs = [dd.to_string(mjd_dd[i], ndigits=25) for i in range(ntoas)]
-    tf = _tim_from_mjd_strings(strs, freqs, errs, obs)
-    return get_TOAs(tf, ephem=model.ephem, include_clock=include_clock)
+    return _invert_to_model(build, mjd_dd, model, errs,
+                            add_noise=add_noise, seed=seed, niter=niter)
 
 
 def make_fake_toas_from_arrays(mjd_dd: dd.DD, model, *, freq_mhz,
@@ -92,24 +107,13 @@ def make_fake_toas_from_arrays(mjd_dd: dd.DD, model, *, freq_mhz,
     freqs = np.resize(np.asarray(freq_mhz, np.float64), n)
     errs = np.resize(np.asarray(error_us, np.float64), n)
 
-    def _build(m):
+    def build(m):
         return build_TOAs_from_arrays(
             m, freq_mhz=freqs, error_us=errs, obs_names=(obs,),
             eph=model.ephem, include_clock=include_clock)
 
-    for _ in range(max(1, niter)):
-        toas = _build(mjd_dd)
-        r = Residuals(toas, model, subtract_mean=False,
-                      track_mode="nearest")
-        shift_day = np.asarray(r.time_resids) / SECS_PER_DAY
-        mjd_dd = dd.sub(mjd_dd, shift_day)
-
-    if add_noise:
-        rng = np.random.default_rng(seed)
-        noise_s = rng.standard_normal(n) * errs * 1e-6
-        mjd_dd = dd.add(mjd_dd, noise_s / SECS_PER_DAY)
-
-    return _build(mjd_dd)
+    return _invert_to_model(build, mjd_dd, model, errs,
+                            add_noise=add_noise, seed=seed, niter=niter)
 
 
 def make_fake_toas_fromtim(timfile: str, model, *, add_noise: bool = False,
@@ -119,28 +123,17 @@ def make_fake_toas_fromtim(timfile: str, model, *, add_noise: bool = False,
 
     tf = parse_timfile(timfile) if isinstance(timfile, str) else timfile
     raw = tf.toas
-    n = len(raw)
     mjd_dd = dd.from_strings([t.mjd_str for t in raw])
-    freqs = np.asarray([t.freq_mhz for t in raw])
     errs = np.asarray([t.error_us for t in raw])
-    obs_codes = [t.obs for t in raw]
-    flags = [t.flags for t in raw]
 
-    toas = None
-    for _ in range(max(1, niter)):
+    def build(m):
         for i, t in enumerate(raw):
-            t.mjd_str = dd.to_string(mjd_dd[i], ndigits=25)
-        toas = get_TOAs(TimFile(toas=raw, n_jump_groups=tf.n_jump_groups),
+            t.mjd_str = dd.to_string(m[i], ndigits=25)
+        return get_TOAs(TimFile(toas=raw, n_jump_groups=tf.n_jump_groups),
                         ephem=model.ephem)
-        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
-        mjd_dd = dd.sub(mjd_dd, np.asarray(r.time_resids) / SECS_PER_DAY)
 
-    if add_noise:
-        rng = np.random.default_rng(seed)
-        mjd_dd = dd.add(mjd_dd, rng.standard_normal(n) * errs * 1e-6 / SECS_PER_DAY)
-    for i, t in enumerate(raw):
-        t.mjd_str = dd.to_string(mjd_dd[i], ndigits=25)
-    return get_TOAs(TimFile(toas=raw, n_jump_groups=tf.n_jump_groups), ephem=model.ephem)
+    return _invert_to_model(build, mjd_dd, model, errs,
+                            add_noise=add_noise, seed=seed, niter=niter)
 
 
 def calculate_random_models(fitter, toas, Nmodels: int = 100, *,
