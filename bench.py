@@ -50,12 +50,12 @@ if _env_platforms and "axon" not in _env_platforms:
 import pint_tpu  # noqa: F401, E402  (enables x64)
 import jax.numpy as jnp  # noqa: E402
 
-# persistent XLA compile cache: repeat bench runs (driver, probes) skip
-# the ~5-40 s compile; same cache dir the test suite uses (.gitignored)
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NO persistent XLA compile cache: this jaxlib's XLA:CPU AOT reload is
+# unsafe on this host (machine-feature mismatch -> SIGILL/segfault; see
+# tests/conftest.py), and even accelerator runs compile CPU programs
+# (the hybrid stage-1 DD path, the dd self-check), so an env-based gate
+# would still write unsafe CPU executables. Repeat runs pay the ~5-40 s
+# compile; correctness over convenience.
 
 N_DEFAULT = 100_000
 INIT_TIMEOUT_S = int(os.environ.get("PINT_TPU_BENCH_INIT_TIMEOUT", "300"))

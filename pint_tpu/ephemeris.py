@@ -49,6 +49,11 @@ def _rot_ecl_to_eq(xyz_ecl: Array) -> Array:
     return jnp.stack([x, ce * y - se * z, se * y + ce * z], axis=-1)
 
 
+# jitted posvel programs, keyed by (include_sun_wobble, body) — shared
+# across every AnalyticEphemeris instance (the model is pure constants)
+_POSVEL_JIT_CACHE: dict = {}
+
+
 @dataclass(frozen=True)
 class _KeplerOrbit:
     """Mean J2000 heliocentric elements + linear secular rates (per century)."""
@@ -228,22 +233,90 @@ class AnalyticEphemeris:
             return self._earth_pos_ecl(T) + _moon_geocentric_ecl_au(T)
         return orbits[name].pos_ecl(T) + self._sun_pos_ecl(T)
 
-    def _posvel(self, posfn, t_tdb_mjd: Array) -> tuple[Array, Array]:
-        """(pos [lt-s], vel [lt-s/s]) via exact jvp of the position model."""
-        T = self._t_cent(t_tdb_mjd)
-        p, dp_dcent = jax.jvp(posfn, (T,), (jnp.ones_like(T),))
-        pos = _rot_ecl_to_eq(p) * AU_LIGHT_S
-        vel = _rot_ecl_to_eq(dp_dcent) * (AU_LIGHT_S / (36525.0 * DAY_S))
-        return pos, vel
+    def _posvel(self, posfn, t_tdb_mjd: Array, key: str) -> tuple[Array, Array]:
+        """(pos [lt-s], vel [lt-s/s]) via exact jvp of the position model.
+
+        Jitted through a module-level cache keyed by (wobble flag, body):
+        the Kepler chains are ~50 eager jax ops per body (the sun wobble
+        alone sums four), which made every un-jitted call cost ~0.4 s of
+        op dispatch — the dominant cost of building a TOA table.  The
+        ephemeris is pure and instance-independent given the cache key,
+        so one compiled program serves every instance and dataset.
+        """
+        cache_key = (self.include_sun_wobble, key)
+        fn = _POSVEL_JIT_CACHE.get(cache_key)
+        if fn is None:
+            def raw(t):
+                T = self._t_cent(t)
+                p, dp_dcent = jax.jvp(posfn, (T,), (jnp.ones_like(T),))
+                pos = _rot_ecl_to_eq(p) * AU_LIGHT_S
+                vel = _rot_ecl_to_eq(dp_dcent) * (AU_LIGHT_S / (36525.0 * DAY_S))
+                return pos, vel
+
+            fn = jax.jit(raw)
+            _POSVEL_JIT_CACHE[cache_key] = fn
+        return fn(t_tdb_mjd)
 
     def earth_posvel_ssb(self, t_tdb_mjd: Array) -> tuple[Array, Array]:
-        return self._posvel(self._earth_pos_ecl, t_tdb_mjd)
+        return self._posvel(self._earth_pos_ecl, t_tdb_mjd, "earth")
 
     def sun_posvel_ssb(self, t_tdb_mjd: Array) -> tuple[Array, Array]:
-        return self._posvel(self._sun_pos_ecl, t_tdb_mjd)
+        return self._posvel(self._sun_pos_ecl, t_tdb_mjd, "sun")
 
     def planet_posvel_ssb(self, name: str, t_tdb_mjd: Array) -> tuple[Array, Array]:
-        return self._posvel(lambda T: self._body_pos_ecl(name.lower(), T), t_tdb_mjd)
+        return self._posvel(lambda T: self._body_pos_ecl(name.lower(), T),
+                            t_tdb_mjd, f"planet:{name.lower()}")
+
+    def bodies_posvel_ssb(self, t_tdb_mjd: Array, names: tuple
+                          ) -> dict[str, tuple[Array, Array]]:
+        """All requested bodies in ONE jitted program (one jvp).
+
+        The per-body entry points each re-trace the solar-wobble chain
+        (every heliocentric position adds the sun's barycentric offset),
+        so building a TOA table used to cost ~9 separate traces per
+        input shape.  Here the sun/earth/moon subexpressions are traced
+        once and every body reuses them — one trace, one executable,
+        for the whole (n_bodies, n, 3) stack.
+        """
+        names = tuple(str(n).lower() for n in names)
+        cache_key = (self.include_sun_wobble, "bodies", names)
+        fn = _POSVEL_JIT_CACHE.get(cache_key)
+        if fn is None:
+            orbits = {
+                "mercury": _MERCURY, "venus": _VENUS, "mars": _MARS,
+                "jupiter": _JUPITER, "saturn": _SATURN, "uranus": _URANUS,
+                "neptune": _NEPTUNE, "emb": _EMB,
+            }
+
+            def raw(t):
+                T = self._t_cent(t)
+
+                def allpos(Tc):
+                    sun = self._sun_pos_ecl(Tc)
+                    moon_geo = _moon_geocentric_ecl_au(Tc)
+                    f = 1.0 / (1.0 + _EARTH_MOON_MASS_RATIO)
+                    earth = _EMB.pos_ecl(Tc) - f * moon_geo + sun
+                    out = []
+                    for nm in names:
+                        if nm == "sun":
+                            out.append(sun)
+                        elif nm == "earth":
+                            out.append(earth)
+                        elif nm == "moon":
+                            out.append(earth + moon_geo)
+                        else:
+                            out.append(orbits[nm].pos_ecl(Tc) + sun)
+                    return jnp.stack(out)
+
+                p, dp = jax.jvp(allpos, (T,), (jnp.ones_like(T),))
+                pos = _rot_ecl_to_eq(p) * AU_LIGHT_S
+                vel = _rot_ecl_to_eq(dp) * (AU_LIGHT_S / (36525.0 * DAY_S))
+                return pos, vel
+
+            fn = jax.jit(raw)
+            _POSVEL_JIT_CACHE[cache_key] = fn
+        pos, vel = fn(t_tdb_mjd)
+        return {nm: (pos[i], vel[i]) for i, nm in enumerate(names)}
 
 
 @dataclass(frozen=True)
@@ -294,16 +367,32 @@ class TabulatedEphemeris:
         return self._interp(name.lower(), t_tdb_mjd)
 
 
+# interned AnalyticEphemeris instances: every get_TOAs call resolves an
+# ephemeris, and downstream jit caches key on the instance — a fresh
+# object per call would recompile the astrometric pipeline every build
+_ANALYTIC_INSTANCES: dict = {}
+_SPK_INSTANCES: dict = {}
+
+
+def _analytic(**kwargs) -> "AnalyticEphemeris":
+    key = tuple(sorted(kwargs.items()))
+    inst = _ANALYTIC_INSTANCES.get(key)
+    if inst is None:
+        inst = _ANALYTIC_INSTANCES[key] = AnalyticEphemeris(**kwargs)
+    return inst
+
+
 def get_ephemeris(name: str = "builtin_analytic", **kwargs) -> Ephemeris:
     """Ephemeris factory. DE names fall back to the analytic model offline.
 
     Mirrors the reference's ephemeris-selection-by-name
     (src/pint/solar_system_ephemerides.py), where 'DE421'/'DE440' pick
     .bsp kernels. Without kernels on disk we log-and-fall-back rather
-    than fail, so par files naming an ephemeris still load.
+    than fail, so par files naming an ephemeris still load. Analytic
+    instances are interned so repeated loads share jitted programs.
     """
     if name.lower() in ("builtin_analytic", "analytic", ""):
-        return AnalyticEphemeris(**kwargs)
+        return _analytic(**kwargs)
     if name.lower().startswith("de"):
         import logging
         import os
@@ -319,7 +408,17 @@ def get_ephemeris(name: str = "builtin_analytic", **kwargs) -> Ephemeris:
             if os.path.isfile(path):
                 from pint_tpu.io.bsp import SPKEphemeris
 
-                return SPKEphemeris(path, name=name.upper())
+                # intern per resolved path (like _analytic): repeated
+                # loads must share one instance so the TOA-build
+                # pipeline cache (keyed by instance) reuses its
+                # compiled program instead of recompiling + re-holding
+                # a fresh copy of the Chebyshev tables per call
+                key = ("spk", os.path.abspath(path))
+                inst = _SPK_INSTANCES.get(key)
+                if inst is None:
+                    inst = SPKEphemeris(path, name=name.upper())
+                    _SPK_INSTANCES[key] = inst
+                return inst
         if cfg.strict_ephem:
             raise FileNotFoundError(
                 f"JPL ephemeris {name} requested but no {name.lower()}.bsp "
@@ -331,5 +430,5 @@ def get_ephemeris(name: str = "builtin_analytic", **kwargs) -> Ephemeris:
             "PINT_TPU_STRICT_EPHEM=1 to make this an error)",
             name, name.lower(),
         )
-        return AnalyticEphemeris(**kwargs)
+        return _analytic(**kwargs)
     raise ValueError(f"unknown ephemeris {name!r}")

@@ -164,6 +164,27 @@ class SPKEphemeris:
         self.et_beg = max(s.et_beg for s in segs)
         self.et_end = min(s.et_end for s in segs)
 
+    def check_coverage(self, t_tdb_mjd) -> None:
+        """Raise if any (concrete, host-side) time is outside the kernel.
+
+        The jitted TOA-build pipeline evaluates posvels on tracers, where
+        the in-evaluation coverage check in :meth:`_posvel_ls` cannot
+        run — so the TOA builder calls this on the concrete times BEFORE
+        entering jit (same behavior jplephem/PINT have: out-of-span
+        times raise instead of silently evaluating a divergent Chebyshev
+        series at |s| > 1).
+        """
+        t = np.asarray(t_tdb_mjd, np.float64)
+        if t.size == 0:
+            return
+        et_lo = (float(t.min()) - ET_J2000_MJD) * DAY_S
+        et_hi = (float(t.max()) - ET_J2000_MJD) * DAY_S
+        if et_lo < self.et_beg or et_hi > self.et_end:
+            raise ValueError(
+                f"time outside SPK kernel coverage: requested ET "
+                f"[{et_lo:.0f}, {et_hi:.0f}] s vs kernel "
+                f"[{self.et_beg:.0f}, {self.et_end:.0f}]")
+
     def _chain(self, target: int) -> list[tuple[tuple[int, int], float]]:
         """[(pair, sign), ...] composing `target` wrt SSB."""
         if (target, 0) in self._pairs:

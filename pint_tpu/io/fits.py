@@ -95,6 +95,10 @@ def _parse_tform(tform: str) -> tuple[int, np.dtype]:
         i += 1
     repeat = int(t[:i]) if i else 1
     code = t[i:i + 1]
+    if code == "A":
+        # rA = one fixed-width ASCII string of r bytes per row (FITS
+        # standard 7.3.3; found in real tooling-produced files)
+        return 1, np.dtype(f"S{repeat}")
     if code not in _TFORM_DTYPES:
         raise ValueError(f"unsupported TFORM {tform!r} (code {code!r})")
     return repeat, _TFORM_DTYPES[code]

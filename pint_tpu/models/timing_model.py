@@ -318,10 +318,58 @@ class TimingModel:
     # ------------------------------------------------------------------
     # reference-API conveniences (host entry points)
     # ------------------------------------------------------------------
+    def _fn_fingerprint(self):
+        """Hashable identity of everything the jitted host entry points
+        close over (vs. receive as traced arguments).
+
+        Numeric parameter *values* always flow through ``base_dd`` as jit
+        inputs, so only structure pins a compiled program: the component
+        stack, selectors, and frozen values (these feed closed-over state
+        like the TZR anchor table).  Same key scheme as the PTA gram
+        cache (pint_tpu.parallel.pta), which shares one executable
+        across structurally identical pulsars.
+        """
+        return (tuple(type(c).__name__ for c in self.components),
+                tuple((p.name, p.value if p.frozen else None,
+                       getattr(p, "selector", None))
+                      for p in self.params.values()))
+
+    def _cached_jit(self, key, builder):
+        """Per-instance jit cache for the eager host API.
+
+        Without it every ``Residuals``/``designmatrix`` call re-runs the
+        composed phase program op-by-op (or re-traces a fresh closure) —
+        ~seconds per call; with it, repeat calls on the same model reuse
+        one compiled executable per (key, input shape).
+        """
+        cache = self.__dict__.setdefault("_jit_fn_cache", {})
+        fp = self._fn_fingerprint()
+        ent = cache.get(key)
+        if ent is None or ent[0] != fp:
+            ent = (fp, jax.jit(builder()))
+            cache[key] = ent
+        return ent[1]
+
+    def __deepcopy__(self, memo):
+        # drop the jit cache: its closures capture this instance's
+        # components; the copy rebuilds (cheap — compiles persist in the
+        # on-disk XLA cache) rather than risk structural drift
+        import copy as _copy
+
+        new = self.__class__.__new__(self.__class__)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "_jit_fn_cache":
+                continue
+            new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
     def phase(self, toas, abs_phase: bool = True) -> phase_mod.Phase:
         """Model phase at each TOA (reference: TimingModel.phase)."""
-        fn = self.phase_fn(toas, abs_phase=abs_phase)
-        return fn(self.base_dd(), {})
+        fn = self._cached_jit(
+            ("phase", abs_phase),
+            lambda: self.phase_fn_toas(abs_phase=abs_phase))
+        return fn(self.base_dd(), {}, toas)
 
     def delay(self, toas) -> Array:
         """Total delay [s] (reference: TimingModel.delay)."""
@@ -382,29 +430,36 @@ class TimingModel:
         computed here by one ``jacfwd`` instead of the per-parameter
         analytic chain.
         """
-        names = params if params is not None else self.free_params
+        names = list(params if params is not None else self.free_params)
         # explicit PHOFF replaces the implicit offset column (its
         # derivative is exactly collinear; reference: designmatrix's
         # incoffset &= "PhaseOffset" not in components)
         incoffset = incoffset and not self.has_component("PhaseOffset")
-        base = self.base_dd()
-        fn = self.phase_fn(toas)
+        out_names = (["Offset"] if incoffset else []) + names
 
-        def total_phase(deltas: dict[str, Array]) -> Array:
-            ph = fn(base, deltas)
-            return ph.int_part + (ph.frac.hi + ph.frac.lo)
+        def build():
+            inner = self.phase_fn_toas()
 
-        J = jax.jacfwd(total_phase)(self.zero_deltas(names))
-        f0 = self.f0_f64
-        cols = []
-        out_names = []
-        if incoffset:
-            cols.append(jnp.ones(len(toas)) / f0)
-            out_names.append("Offset")
-        for k in names:
-            cols.append(-J[k] / f0)
-            out_names.append(k)
-        return jnp.stack(cols, axis=1), out_names
+            def f(base: dict[str, DD], tt) -> Array:
+                def total_phase(deltas: dict[str, Array]) -> Array:
+                    ph = inner(base, deltas, tt)
+                    return ph.int_part + (ph.frac.hi + ph.frac.lo)
+
+                J = jax.jacfwd(total_phase)(
+                    {k: jnp.zeros((), jnp.float64) for k in names})
+                f0 = base["F0"].hi + base["F0"].lo
+                cols = []
+                if incoffset:
+                    cols.append(jnp.ones_like(tt.freq_mhz) / f0)
+                for k in names:
+                    cols.append(-J[k] / f0)
+                return jnp.stack(cols, axis=1)
+
+            return f
+
+        fn = self._cached_jit(("designmatrix", tuple(names), incoffset),
+                              build)
+        return fn(self.base_dd(), toas), out_names
 
     # ------------------------------------------------------------------
     # par-file output (reference: TimingModel.as_parfile)

@@ -60,18 +60,48 @@ def ensure_exact_f64() -> None:
     placement themselves; everything a console script touches should
     just run on the exact CPU backend.
     """
+    import logging
+    import os
+    import subprocess
+    import sys
+
     import jax
 
-    if jax.default_backend() == "cpu":
+    log = logging.getLogger("pint_tpu.scripts")
+
+    platforms = str(jax.config.jax_platforms or "")
+    if not platforms or platforms.split(",")[0] == "cpu":
         return
-    from pint_tpu.ops import dd
 
-    if not dd.self_check():
-        import logging
+    # Touching a non-CPU backend (init OR first compile) can hang for
+    # minutes inside a C call when the accelerator tunnel is down — and
+    # the sandbox exports JAX_PLATFORMS=axon globally, so a console tool
+    # must not trust it blindly. A SIGALRM guard cannot interrupt the
+    # C-level init (GIL held), so probe in a CHILD process with a
+    # wall-clock timeout (the guard pattern bench.py uses), and only
+    # initialize the backend here once the child proved it responsive.
+    timeout_s = int(os.environ.get("PINT_TPU_SCRIPT_INIT_TIMEOUT", "60"))
+    code = ("import jax\n"
+            "from pint_tpu.ops import dd\n"
+            "print('EXACT' if dd.self_check() else 'INEXACT')\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        verdict = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        if proc.returncode != 0 or verdict not in ("EXACT", "INEXACT"):
+            raise RuntimeError(
+                f"probe rc={proc.returncode}: {proc.stderr[-300:]}")
+    except (subprocess.TimeoutExpired, RuntimeError) as exc:
+        jax.config.update("jax_platforms", "cpu")
+        log.warning(
+            "accelerator backend %s unreachable (%s); running on the "
+            "CPU backend", platforms, exc)
+        return
 
+    if verdict == "INEXACT":
         cpu = jax.devices("cpu")[0]
         jax.config.update("jax_default_device", cpu)
-        logging.getLogger("pint_tpu.scripts").warning(
+        log.warning(
             "backend %s fails the float64 exactness self-check; pinning "
-            "computation to %s (see pint_tpu.ops.dd)",
-            jax.default_backend(), cpu)
+            "computation to %s (see pint_tpu.ops.dd)", platforms, cpu)

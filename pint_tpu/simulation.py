@@ -43,9 +43,11 @@ def _invert_to_model(build, mjd_dd: dd.DD, model, errs, *,
     file); this loop computes residuals under ``model``, shifts the
     exact DD MJDs by -residual (quadratic convergence; 3 passes reach
     < 1e-12 s), optionally folds in the Gaussian noise draw, and builds
-    the final table.
+    the final table.  ``niter=0`` skips the inversion entirely — the
+    grid epochs are used as-is (cheap tables for tests/tools that only
+    evaluate delays, not residual statistics).
     """
-    for _ in range(max(1, niter)):
+    for _ in range(max(0, niter)):
         toas = build(mjd_dd)
         r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
         shift_day = np.asarray(r.time_resids) / SECS_PER_DAY

@@ -318,6 +318,83 @@ def build_TOAs_from_raw(
     )
 
 
+# jitted TT->TDB->posvel pipelines, keyed by (ephemeris instance,
+# planets flag, explicit-GCRS flag); the value holds a strong ref to the
+# ephemeris so the id() key can never be recycled
+_PIPELINE_JIT_CACHE: dict = {}
+
+
+def _astrometric_pipeline(eph: Ephemeris, planets: bool,
+                          explicit_gcrs: bool):
+    """One fused XLA program for the array compute of a TOA build.
+
+    utc -> TT -> (earth posvel, topocentric Einstein) -> TDB ->
+    observatory SSB posvel -> planet positions, as a single jitted
+    function instead of hundreds of op-by-op dispatches (each eager op
+    is its own tiny XLA program below the persistent-cache threshold;
+    fused, the whole build compiles once per input shape and is cached
+    on disk).  This is also the TPU-first shape of the pipeline: one
+    program the compiler can fuse and shard.
+    """
+    # AnalyticEphemeris is a frozen value type: key by value so every
+    # instance (and every get_TOAs call) shares one compiled pipeline;
+    # array-backed providers (SPK/tabulated) key by identity
+    from pint_tpu.ephemeris import AnalyticEphemeris
+
+    if isinstance(eph, AnalyticEphemeris):
+        key = (eph, planets, explicit_gcrs)
+    else:
+        key = (id(eph), planets, explicit_gcrs)
+    ent = _PIPELINE_JIT_CACHE.get(key)
+    if ent is not None and (ent[0] is eph or isinstance(eph, AnalyticEphemeris)):
+        return ent[1]
+
+    body_names = tuple(PLANET_NAMES) if planets else ("sun",)
+    bodies_fn = getattr(eph, "bodies_posvel_ssb", None)
+
+    def pipeline(utc, itrf, is_bary, is_geo, gcrs_pos_m, gcrs_vel_m_s):
+        tt = ts.utc_to_tt(utc)
+        tt_f64 = tt.hi + tt.lo
+        if explicit_gcrs:
+            obs_gcrs_pos, obs_gcrs_vel = gcrs_pos_m, gcrs_vel_m_s
+        else:
+            obs_gcrs_pos, obs_gcrs_vel = earth.itrf_to_gcrs_posvel(
+                itrf, utc.hi + utc.lo)
+        # Earth posvel for the Einstein topocentric term (at TT ~ TDB)
+        _earth_pos, earth_vel = eph.earth_posvel_ssb(tt_f64)
+        topo_corr = ts.topocentric_einstein_s(earth_vel * C_M_S,
+                                              obs_gcrs_pos)
+        topo_corr = jnp.where(is_bary | is_geo, 0.0, topo_corr)
+        tdb = ts.tt_to_tdb(tt, topo_corr)
+        # barycentric TOAs are already TDB at the SSB
+        tdb = DD(jnp.where(is_bary, utc.hi, tdb.hi),
+                 jnp.where(is_bary, utc.lo, tdb.lo))
+
+        tdb_f64 = tdb.hi + tdb.lo
+        earth_pos, earth_vel = eph.earth_posvel_ssb(tdb_f64)
+        obs_pos = earth_pos + obs_gcrs_pos / C_M_S  # GCRS m -> lt-s
+        obs_vel = earth_vel + obs_gcrs_vel / C_M_S
+        zero3 = jnp.zeros_like(obs_pos)
+        bm, gm = is_bary[:, None], is_geo[:, None]
+        obs_pos = jnp.where(bm, zero3, jnp.where(gm, earth_pos, obs_pos))
+        obs_vel = jnp.where(bm, zero3, jnp.where(gm, earth_vel, obs_vel))
+
+        if bodies_fn is not None:
+            planet_pos = {nm: p - obs_pos for nm, (p, _v)
+                          in bodies_fn(tdb_f64, body_names).items()}
+        else:
+            planet_pos = {}
+            for nm in body_names:
+                p, _ = (eph.sun_posvel_ssb(tdb_f64) if nm == "sun"
+                        else eph.planet_posvel_ssb(nm, tdb_f64))
+                planet_pos[nm] = p - obs_pos
+        return tdb, obs_pos, obs_vel, planet_pos
+
+    fn = jax.jit(pipeline)
+    _PIPELINE_JIT_CACHE[key] = (eph, fn)
+    return fn
+
+
 def build_TOAs_from_arrays(
     mjd_local: DD,
     *,
@@ -391,12 +468,10 @@ def build_TOAs_from_arrays(
             "gcrs_pos_m (from pint_tpu.event_toas.load_orbit_file) — "
             "refusing to silently treat orbit TOAs as geocentric")
 
-    tt = ts.utc_to_tt(utc)
-    tt_f64 = np.asarray(tt.hi + tt.lo)
     if gcrs_pos_m is not None:
         # explicit GCRS offsets (spacecraft orbit data) replace the
         # ITRF-rotation path wholesale; they feed the topocentric
-        # Einstein term below exactly like a ground site's position
+        # Einstein term exactly like a ground site's position
         if not all(is_spacecraft):
             raise ValueError(
                 "gcrs_pos_m overrides every TOA's observatory position; "
@@ -406,45 +481,43 @@ def build_TOAs_from_arrays(
         if gcrs_pos_m.shape != (n, 3):
             raise ValueError(
                 f"gcrs_pos_m shape {gcrs_pos_m.shape} != ({n}, 3)")
-        obs_gcrs_pos = jnp.asarray(gcrs_pos_m)
-        obs_gcrs_vel = (jnp.zeros_like(obs_gcrs_pos)
-                        if gcrs_vel_m_s is None
-                        else jnp.asarray(gcrs_vel_m_s, jnp.float64))
+        gp = jnp.asarray(gcrs_pos_m)
+        gv = (jnp.zeros_like(gp) if gcrs_vel_m_s is None
+              else jnp.asarray(gcrs_vel_m_s, jnp.float64))
     else:
-        obs_gcrs_pos, obs_gcrs_vel = earth.itrf_to_gcrs_posvel(
-            jnp.asarray(itrf), np.asarray(utc.hi + utc.lo))
+        gp = jnp.zeros((n, 3))
+        gv = jnp.zeros((n, 3))
 
-    # Earth posvel for the Einstein topocentric term (evaluated at TT ~ TDB)
-    earth_pos, earth_vel = eph.earth_posvel_ssb(jnp.asarray(tt_f64))
-    topo_corr = ts.topocentric_einstein_s(earth_vel * C_M_S, obs_gcrs_pos)
-    topo_corr = jnp.where(jnp.asarray(is_bary | is_geo), 0.0, topo_corr)
-    tdb = ts.tt_to_tdb(tt, topo_corr)
-    # Barycentric TOAs are already TDB at the SSB: undo the TT->TDB shift
-    if np.any(is_bary):
-        tdb = DD(
-            jnp.where(jnp.asarray(is_bary), utc.hi, tdb.hi),
-            jnp.where(jnp.asarray(is_bary), utc.lo, tdb.lo),
-        )
+    # coverage must be validated on CONCRETE times: inside the jitted
+    # pipeline the ephemeris sees tracers and cannot raise (SPK kernels
+    # would silently evaluate a divergent Chebyshev series out of span).
+    # UTC -> TDB differs by ~minutes; 0.01 day of margin covers it.
+    check_cov = getattr(eph, "check_coverage", None)
+    if check_cov is not None and n:
+        utc_f64 = np.asarray(utc.hi + utc.lo)
+        check_cov(np.array([utc_f64.min() - 0.01, utc_f64.max() + 0.01]))
 
-    tdb_f64 = jnp.asarray(tdb.hi + tdb.lo)
-    earth_pos, earth_vel = eph.earth_posvel_ssb(tdb_f64)
+    # bucket the TOA axis to the next power of two (pad by repeating the
+    # last row): the pipeline is elementwise over n, so padding is exact,
+    # and the whole suite / a whole session compiles ~log2(max n) fused
+    # programs instead of one per distinct TOA count
+    n_pad = max(16, 1 << (n - 1).bit_length())
 
-    obs_pos = earth_pos + obs_gcrs_pos / (C_M_S)  # GCRS meters -> light-seconds
-    obs_vel = earth_vel + obs_gcrs_vel / C_M_S
-    zero3 = jnp.zeros_like(obs_pos)
-    bary_mask = jnp.asarray(is_bary)[:, None]
-    geo_mask = jnp.asarray(is_geo)[:, None]
-    obs_pos = jnp.where(bary_mask, zero3, jnp.where(geo_mask, earth_pos, obs_pos))
-    obs_vel = jnp.where(bary_mask, zero3, jnp.where(geo_mask, earth_vel, obs_vel))
+    def _pad(x, fill=None):
+        x = jnp.asarray(x)
+        if n_pad == n:
+            return x
+        reps = jnp.repeat(x[-1:] if fill is None else fill, n_pad - n,
+                          axis=0)
+        return jnp.concatenate([x, reps], axis=0)
 
-    planet_pos = {}
-    if planets:
-        for name in PLANET_NAMES:
-            p, _ = eph.planet_posvel_ssb(name, tdb_f64)
-            planet_pos[name] = p - obs_pos
-    else:
-        p, _ = eph.sun_posvel_ssb(tdb_f64)
-        planet_pos["sun"] = p - obs_pos
+    pipeline = _astrometric_pipeline(eph, planets, gcrs_pos_m is not None)
+    tdb, obs_pos, obs_vel, planet_pos = pipeline(
+        DD(_pad(utc.hi), _pad(utc.lo)), _pad(itrf), _pad(is_bary),
+        _pad(is_geo), _pad(gp), _pad(gv))
+    tdb = DD(tdb.hi[:n], tdb.lo[:n])
+    obs_pos, obs_vel = obs_pos[:n], obs_vel[:n]
+    planet_pos = {k: v[:n] for k, v in planet_pos.items()}
 
     pulse_number = jnp.asarray(
         [float(f.get("pn", "nan")) for f in flags], jnp.float64

