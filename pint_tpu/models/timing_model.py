@@ -35,6 +35,17 @@ Array = jax.Array
 log = logging.getLogger(__name__)
 
 
+# compiled host-API programs (phase / designmatrix), shared across every
+# TimingModel instance with the same structural fingerprint — see
+# TimingModel._cached_jit. LRU-bounded: each entry pins a deepcopied
+# model (its closure state) plus executables, so unbounded growth would
+# leak in long structure-editing sessions (e.g. pintk).
+from collections import OrderedDict as _OrderedDict
+
+_JIT_PROGRAM_CACHE: "_OrderedDict" = _OrderedDict()
+_JIT_PROGRAM_CACHE_MAX = 128
+
+
 def _order_key(comp: Component) -> int:
     try:
         return DEFAULT_ORDER.index(comp.category)
@@ -322,53 +333,59 @@ class TimingModel:
         """Hashable identity of everything the jitted host entry points
         close over (vs. receive as traced arguments).
 
-        Numeric parameter *values* always flow through ``base_dd`` as jit
-        inputs, so only structure pins a compiled program: the component
-        stack, selectors, and frozen values (these feed closed-over state
-        like the TZR anchor table).  Same key scheme as the PTA gram
-        cache (pint_tpu.parallel.pta), which shares one executable
-        across structurally identical pulsars.
+        FREE numeric values flow through ``base_dd`` as jit inputs, so
+        a model and its deepcopy — or any models parsed from the same
+        par text — share one compiled program even as fits move their
+        free parameters.  Everything else is pinned conservatively,
+        because component closures DO read host-side state at trace
+        time: frozen numeric values (e.g. ``GLTD > 0`` selects the
+        glitch-decay branch; EFAC feeds ``scale_sigma``), non-numeric
+        values (``PLANET_SHAPIRO`` gates a component's delay), header
+        entries (``EPHEM`` selects the TZR anchor's barycentering),
+        selectors, and the component stack.  Sharing across models with
+        *different* values is only done where an audited input path
+        exists (the PTA gram shares across pulsars via its own key —
+        see pint_tpu.parallel.pta).
         """
+        header = getattr(self, "header", {}) or {}
         return (tuple(type(c).__name__ for c in self.components),
-                tuple((p.name, p.value if p.frozen else None,
+                tuple((p.name,
+                       p.value if (p.frozen or not p.is_numeric) else None,
                        getattr(p, "selector", None))
-                      for p in self.params.values()))
+                      for p in self.params.values()),
+                tuple((k, str(header[k])) for k in
+                      ("EPHEM", "CLK", "CLOCK", "UNITS") if k in header))
 
     def _cached_jit(self, key, builder):
-        """Per-instance jit cache for the eager host API.
+        """Module-level jit cache for the eager host API.
 
         Without it every ``Residuals``/``designmatrix`` call re-runs the
         composed phase program op-by-op (or re-traces a fresh closure) —
-        ~seconds per call; with it, repeat calls on the same model reuse
-        one compiled executable per (key, input shape).
+        ~seconds per call.  Entries are shared across *instances* with
+        the same structural fingerprint (e.g. 68 pulsars, or a model and
+        its deepcopy): the builder runs against a private deepcopy of
+        the model, so later structural mutation of any live instance
+        cannot alias the cached closures (values flow through the traced
+        ``base_dd`` argument and stay current).
         """
-        cache = self.__dict__.setdefault("_jit_fn_cache", {})
-        fp = self._fn_fingerprint()
-        ent = cache.get(key)
-        if ent is None or ent[0] != fp:
-            ent = (fp, jax.jit(builder()))
-            cache[key] = ent
-        return ent[1]
-
-    def __deepcopy__(self, memo):
-        # drop the jit cache: its closures capture this instance's
-        # components; the copy rebuilds (cheap — compiles persist in the
-        # on-disk XLA cache) rather than risk structural drift
         import copy as _copy
 
-        new = self.__class__.__new__(self.__class__)
-        memo[id(self)] = new
-        for k, v in self.__dict__.items():
-            if k == "_jit_fn_cache":
-                continue
-            new.__dict__[k] = _copy.deepcopy(v, memo)
-        return new
+        fp = (type(self).__name__, key, self._fn_fingerprint())
+        ent = _JIT_PROGRAM_CACHE.get(fp)
+        if ent is None:
+            owner = _copy.deepcopy(self)
+            ent = _JIT_PROGRAM_CACHE[fp] = jax.jit(builder(owner))
+            while len(_JIT_PROGRAM_CACHE) > _JIT_PROGRAM_CACHE_MAX:
+                _JIT_PROGRAM_CACHE.popitem(last=False)
+        else:
+            _JIT_PROGRAM_CACHE.move_to_end(fp)
+        return ent
 
     def phase(self, toas, abs_phase: bool = True) -> phase_mod.Phase:
         """Model phase at each TOA (reference: TimingModel.phase)."""
         fn = self._cached_jit(
             ("phase", abs_phase),
-            lambda: self.phase_fn_toas(abs_phase=abs_phase))
+            lambda owner: owner.phase_fn_toas(abs_phase=abs_phase))
         return fn(self.base_dd(), {}, toas)
 
     def delay(self, toas) -> Array:
@@ -437,8 +454,8 @@ class TimingModel:
         incoffset = incoffset and not self.has_component("PhaseOffset")
         out_names = (["Offset"] if incoffset else []) + names
 
-        def build():
-            inner = self.phase_fn_toas()
+        def build(owner):
+            inner = owner.phase_fn_toas()
 
             def f(base: dict[str, DD], tt) -> Array:
                 def total_phase(deltas: dict[str, Array]) -> Array:

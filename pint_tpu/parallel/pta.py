@@ -249,13 +249,28 @@ class PTAGLSFitter:
                 )
                 base = replicate(base, self.mesh)
                 deltas = replicate(deltas, self.mesh)
-            # one executable per model *structure*: free values flow through
-            # the traced `base`, but frozen values, selectors, and the TZR
-            # anchor are closed over host-side, so they pin the cache key
+            # one executable per model *structure*: FREE values flow
+            # through the traced `base` and PL hyperparameters through
+            # `noise.pl_params`, but component closures read other
+            # host state at trace time (frozen EFAC/EQUAD values in
+            # scale_sigma, bool flags like PLANET_SHAPIRO, the EPHEM
+            # header via the TZR anchor), so frozen/non-numeric values
+            # and the header pin the key. Same-structure pulsars with
+            # identical frozen values (the 68-pulsar scale_proof
+            # config) share ONE compiled gram; per-pulsar TNREDAMP
+            # could safely share too (it is a traced input) but is
+            # keyed conservatively with the rest.
+            header = getattr(model, "header", {}) or {}
             key = (tuple(model.free_params), pl_specs,
                    tuple(type(c).__name__ for c in model.components),
-                   tuple((p.name, p.value if p.frozen else None, p.selector)
+                   tuple((p.name,
+                          p.value if (p.frozen or not p.is_numeric)
+                          else None,
+                          p.selector)
                          for p in model.params.values()),
+                   tuple((k, str(header[k])) for k in
+                         ("EPHEM", "CLK", "CLOCK", "UNITS")
+                         if k in header),
                    len(toas))
             if key not in cache:
                 cache[key] = jax.jit(make_pta_gram(model, self.gw, pl_specs))

@@ -39,7 +39,7 @@ UNITS          TDB
 TZRMJD  53801.0
 TZRFRQ  1400.0
 TZRSITE gbt
-EFAC -f fake 1.1
+EFAC -f fake {efac}
 ECORR -f fake 0.9
 TNREDAMP {redamp}
 TNREDGAM 3.1
@@ -53,9 +53,13 @@ GW_AMP, GW_GAM, GW_NHARM = -13.8, 4.33, 3
 
 
 def _mkpar(i):
+    # per-pulsar EFAC: frozen white-noise values are BAKED into compiled
+    # grams (scale_sigma reads them at trace time), so heterogeneous
+    # EFACs here make the dense-parity test fail if the gram cache ever
+    # shares programs across different frozen values
     return PAR_TMPL.format(i=i, raj=SKY[i][0], decj=SKY[i][1],
                            f0=300.0 + 13.0 * i, dm=20.0 + 5.0 * i,
-                           redamp=-13.6 - 0.2 * i)
+                           redamp=-13.6 - 0.2 * i, efac=1.1 + 0.15 * i)
 
 
 @pytest.fixture(scope="module")
@@ -63,7 +67,11 @@ def pta_problems():
     problems = []
     for i in range(4):
         model = get_model(_mkpar(i))
-        t0 = make_fake_toas_uniform(53000 + 50 * i, 56000, 25 + 3 * i, model,
+        # same TOA count per pulsar: heterogeneity under test is in the
+        # sky positions / spin / per-pulsar red-noise amplitudes;
+        # distinct counts would only fragment XLA programs by shape
+        # (per-pulsar epochs/spans still differ)
+        t0 = make_fake_toas_uniform(53000 + 50 * i, 56000, 28, model,
                                     obs="gbt", freq_mhz=np.array([1400.0, 430.0]),
                                     error_us=1.0, add_noise=True, seed=20 + i)
         toas = merge_TOAs([t0, t0])  # 2-TOA ECORR epochs
