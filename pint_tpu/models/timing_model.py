@@ -374,6 +374,11 @@ class TimingModel:
         ent = _JIT_PROGRAM_CACHE.get(fp)
         if ent is None:
             owner = _copy.deepcopy(self)
+            # the content-keyed eager-noise cache can hold O(n x k)
+            # dense bases (hundreds of MB at scale); the phase/design
+            # closures never read it — do not pin it in the LRU
+            owner.__dict__.pop("_noise_basis_key", None)
+            owner.__dict__.pop("_noise_basis_val", None)
             ent = _JIT_PROGRAM_CACHE[fp] = jax.jit(builder(owner))
             while len(_JIT_PROGRAM_CACHE) > _JIT_PROGRAM_CACHE_MAX:
                 _JIT_PROGRAM_CACHE.popitem(last=False)
