@@ -16,11 +16,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 # float64 and the multi-device mesh tests need the virtual CPU platform.
 # The axon sitecustomize overrides the env var via jax.config, so the
 # config entry (which wins) must be forced too, before any backend init.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# PINT_TPU_RUN_TPU_TESTS=1 keeps the accelerator platform visible so the
+# opt-in on-hardware tests (tests/test_pallas.py) can reach the chip —
+# only use it with a live tunnel and a targeted test selection.
+_want_tpu = os.environ.get("PINT_TPU_RUN_TPU_TESTS") == "1"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _want_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 # NO persistent XLA compilation cache on the CPU backend: this jaxlib's

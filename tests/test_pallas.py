@@ -34,3 +34,37 @@ def test_pallas_gram_agrees_with_xla_ds32():
     G_ds = np.asarray(ds32_gram(A, block=128))
     scale = np.max(np.abs(G_ds))
     assert np.max(np.abs(G_pl - G_ds)) / scale < 1e-6
+
+
+# ---------------------------------------------------------------- hardware
+# Opt-in (PINT_TPU_RUN_TPU_TESTS=1): the sandbox's axon tunnel hangs at
+# backend init for whole sessions, so the gate must NOT touch the TPU
+# backend during collection — an env flag keeps the default suite safe
+# on the CPU mesh while giving the first live-tunnel session a one-line
+# way to produce the on-hardware pallas evidence (VERDICT round-2 task
+# 1: non-interpret compile + accuracy vs f64 on the real chip).
+import os
+
+_RUN_TPU = os.environ.get("PINT_TPU_RUN_TPU_TESTS") == "1"
+
+
+@pytest.mark.skipif(not _RUN_TPU,
+                    reason="set PINT_TPU_RUN_TPU_TESTS=1 with a live TPU "
+                           "backend to run the on-hardware pallas check")
+def test_pallas_gram_on_tpu_hardware():
+    import jax
+
+    tpus = [d for d in jax.devices() if d.platform == "tpu"]
+    assert tpus, "PINT_TPU_RUN_TPU_TESTS=1 but no TPU backend"
+    rng = np.random.default_rng(2)
+    n, q, block = 4096, 24, 512
+    # full-precision f64 input: the ds32 split's low part a2 must be
+    # nonzero or the test can't catch a kernel dropping the cross terms
+    A_host = rng.standard_normal((n, q)) / np.sqrt(n)
+    A = jax.device_put(jnp.asarray(A_host, jnp.float64), tpus[0])
+    # non-interpret: the kernel must actually lower + compile on the chip
+    G = np.asarray(ds32_gram_pallas(A, interpret=False, block=block))
+    G_ref = A_host.T @ A_host
+    scale = np.max(np.abs(G_ref))
+    assert np.isfinite(G).all()
+    assert np.max(np.abs(G - G_ref)) / scale < 10 * gram_error_bound(n, block)
