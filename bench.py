@@ -105,7 +105,7 @@ def _init_backend() -> list:
 
 
 def build_problem(n: int):
-    """N simulated arrivals in 4-TOA ECORR epochs (within 1 s), two freqs.
+    """N simulated arrivals in 4-TOA ECORR epochs (within 0.5 s), two freqs.
 
     The TOAs are *simulated from the model* (fixed-point inversion +
     Gaussian noise at the stated errors), so post-fit chi2 ~ ndof and the
@@ -113,23 +113,10 @@ def build_problem(n: int):
     random MJDs would iterate on ~1e6-turn unphysical residuals.
     """
     from pint_tpu.models import get_model
-    from pint_tpu.ops.dd import DD
-    from pint_tpu.simulation import make_fake_toas_from_arrays
 
     model = get_model(PAR)
-    rng = np.random.default_rng(0)
-    n_epochs = max(1, (n + 3) // 4)
-    centers = np.sort(rng.uniform(50000.0, 58000.0, size=n_epochs))
-    offsets = rng.uniform(0.0, 0.5 / 86400.0, size=(n_epochs, 4))
-    mjds = (centers[:, None] + offsets).ravel()[:n]
-    freqs = np.where(rng.random(n) < 0.5, 1400.0, 430.0)
-    errs = np.full(n, 1.0)
-    toas = make_fake_toas_from_arrays(
-        DD(jnp.asarray(mjds), jnp.zeros(n)), model,
-        freq_mhz=freqs, error_us=errs, obs="gbt",
-        add_noise=True, seed=0, niter=2,
-    )
-    return model, toas
+    return model, _sim_toas(model, n, np.random.default_rng(0),
+                            epochs4=True)
 
 
 def _dd_pin_ctx():
@@ -182,9 +169,12 @@ def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
-def _random_toas(model, n: int, rng, *, epochs4: bool = False):
+def _sim_toas(model, n: int, rng, *, epochs4: bool = False):
+    """Simulated-from-model arrivals (chi2 ~ ndof, like build_problem):
+    every mode bench doubles as a scale correctness probe rather than
+    iterating on unphysical ~1e6-turn residuals."""
     from pint_tpu.ops.dd import DD
-    from pint_tpu.toas import build_TOAs_from_arrays
+    from pint_tpu.simulation import make_fake_toas_from_arrays
 
     if epochs4:  # 4-TOA ECORR epochs within 0.5 s
         n_ep = max(1, (n + 3) // 4)
@@ -193,10 +183,11 @@ def _random_toas(model, n: int, rng, *, epochs4: bool = False):
                 + rng.uniform(0, 0.5 / 86400.0, (n_ep, 4))).ravel()[:n]
     else:
         mjds = np.sort(rng.uniform(50000.0, 58000.0, size=n))
-    return build_TOAs_from_arrays(
-        DD(jnp.asarray(mjds), jnp.zeros(n)),
+    return make_fake_toas_from_arrays(
+        DD(np.asarray(mjds), np.zeros(n)), model,
         freq_mhz=np.where(rng.random(n) < 0.5, 1400.0, 430.0),
-        error_us=np.full(n, 1.0), obs_names=("gbt",), eph=model.ephem)
+        error_us=1.0, obs="gbt", add_noise=True,
+        seed=int(rng.integers(2 ** 31)), niter=2)
 
 
 def _strip_par_lines(par: str, names: tuple[str, ...]) -> str:
@@ -223,7 +214,7 @@ def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
             par = PAR.replace("17:48:52.75", f"{(i * 7) % 24:02d}:48:52.75")
             par = par.replace("61.485476554", f"{61.485476554 + 0.7 * i:.9f}")
             model = get_model(par)
-            problems.append((_random_toas(model, toas_per_psr, rng,
+            problems.append((_sim_toas(model, toas_per_psr, rng,
                                           epochs4=True), model))
         fitter = PTAGLSFitter(problems, gw_log10_amp=-14.0,
                               gw_gamma=4.33, gw_nharm=20)
@@ -253,7 +244,7 @@ def bench_wideband(n: int, reps: int) -> None:
         par = _strip_par_lines(PAR, ("ECORR", "TNREDAMP", "TNREDGAM",
                                      "TNREDC"))
         model = get_model(par)
-        toas = _random_toas(model, n, np.random.default_rng(2))
+        toas = _sim_toas(model, n, np.random.default_rng(2))
         dm_true = np.asarray(model.total_dm(toas))
         flags = Flags(dict(d, pp_dm=str(float(m)), pp_dme="1e-4")
                       for d, m in zip(toas.flags, dm_true))
@@ -286,7 +277,7 @@ def bench_batch(n_psr: int, toas_per_psr: int, reps: int) -> None:
                                    f"{(i * 5) % 24:02d}:48:52.75")
             par = par.replace("61.485476554", f"{61.485476554 + 0.3 * i:.9f}")
             model = get_model(par)
-            problems.append((_random_toas(model, toas_per_psr, rng), model))
+            problems.append((_sim_toas(model, toas_per_psr, rng), model))
         f = BatchedPulsarFitter(problems)
         return (lambda: f.fit_toas(maxiter=1)), dict
 

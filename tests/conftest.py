@@ -41,8 +41,17 @@ if os.environ.get("PINT_TPU_JAX_CACHE") == "1":
                                    ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-_cpus = jax.devices("cpu")
-jax.config.update("jax_default_device", _cpus[0])
+# under PINT_TPU_RUN_TPU_TESTS=1 the accelerator platform owns the
+# config and "cpu" may not be a registered backend at all — the opt-in
+# hardware tests manage device placement themselves
+if _want_tpu:
+    try:
+        _cpus = jax.devices("cpu")
+    except RuntimeError:
+        _cpus = []
+else:
+    _cpus = jax.devices("cpu")
+    jax.config.update("jax_default_device", _cpus[0])
 
 import pytest  # noqa: E402
 
