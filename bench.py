@@ -215,7 +215,7 @@ def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
             par = par.replace("61.485476554", f"{61.485476554 + 0.7 * i:.9f}")
             model = get_model(par)
             problems.append((_sim_toas(model, toas_per_psr, rng,
-                                          epochs4=True), model))
+                                       epochs4=True), model))
         fitter = PTAGLSFitter(problems, gw_log10_amp=-14.0,
                               gw_gamma=4.33, gw_nharm=20)
         return (fitter.fit_toas,

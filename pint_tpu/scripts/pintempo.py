@@ -19,8 +19,11 @@ def main(argv=None) -> int:
     parser.add_argument("--outfile", default=None,
                         help="write the post-fit par file here")
     parser.add_argument("--fitter", default="auto",
-                        choices=["auto", "wls", "gls", "downhill", "sharded"],
-                        help="fitter selection (auto follows the model's noise)")
+                        choices=["auto", "wls", "gls", "downhill", "sharded",
+                                 "hybrid"],
+                        help="fitter selection (auto follows the model's "
+                             "noise; hybrid = CPU DD stage + accelerator "
+                             "GLS solve)")
     parser.add_argument("--maxiter", type=int, default=10)
     parser.add_argument("--allow-tcb", action="store_true",
                         help="auto-convert a TCB par file to TDB")
@@ -57,6 +60,10 @@ def main(argv=None) -> int:
         cls = (ShardedGLSFitter if model.has_correlated_errors
                else ShardedWLSFitter)
         fitter = cls(toas, model)
+    elif args.fitter == "hybrid":
+        from pint_tpu.fitting.hybrid import HybridGLSFitter
+
+        fitter = HybridGLSFitter(toas, model)
     else:
         fitter = Fitter.auto(toas, model, downhill=True)
     fitter.fit_toas(maxiter=args.maxiter)

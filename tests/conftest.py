@@ -44,21 +44,10 @@ if os.environ.get("PINT_TPU_JAX_CACHE") == "1":
 # under PINT_TPU_RUN_TPU_TESTS=1 the accelerator platform owns the
 # config and "cpu" may not be a registered backend at all — the opt-in
 # hardware tests manage device placement themselves
-if _want_tpu:
-    try:
-        _cpus = jax.devices("cpu")
-    except RuntimeError:
-        _cpus = []
-else:
-    _cpus = jax.devices("cpu")
-    jax.config.update("jax_default_device", _cpus[0])
+if not _want_tpu:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 import pytest  # noqa: E402
-
-
-@pytest.fixture(scope="session")
-def cpu_devices():
-    return _cpus
 
 
 @pytest.fixture(autouse=True)

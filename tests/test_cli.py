@@ -85,6 +85,20 @@ def test_pintempo_sharded_fitter(par_tim, tmp_path, capsys):
     assert "chi2" in capsys.readouterr().out
 
 
+def test_pintempo_hybrid_fitter(par_tim, tmp_path, capsys):
+    """--fitter hybrid: CPU DD stage -> accelerator-style GLS solve
+    (both CPU here), through the real console entry point."""
+    par, tim, _ = par_tim
+    pert = tmp_path / "pert.par"
+    pert.write_text(PAR.replace("61.485476554", "61.485476555")
+                    + "EFAC 1.1\nECORR 1.2\nTNREDAMP -13.5\n"
+                      "TNREDGAM 3.5\nTNREDC 5\n")
+    rc = pintempo.main([str(pert), tim, "--fitter", "hybrid",
+                        "--maxiter", "3"])
+    assert rc == 0
+    assert "chi2" in capsys.readouterr().out
+
+
 def test_zima_roundtrip(par_tim, tmp_path, capsys):
     par, _, _ = par_tim
     out = tmp_path / "sim.tim"
