@@ -298,16 +298,75 @@ class BatchedPulsarFitter:
                           params=self.free_params),
             in_axes=(0, 0, 0, 0)))
 
-    def fit_toas(self, maxiter: int = 2) -> np.ndarray:
-        """Run the batched fit; updates every model. Returns per-pulsar chi2."""
+    def fit_toas(self, maxiter: int = 20,
+                 min_chi2_decrease: float = 1e-3,
+                 max_step_halvings: int = 8) -> np.ndarray:
+        """Run the damped batched fit; updates every model.
+
+        The dense fitters' accept/halve/converge loop, vectorized over
+        the pulsar axis: each pulsar carries its own step damping
+        ``lam`` and convergence flag, and every trial evaluation is the
+        ONE vmapped XLA program (a halving for one pulsar re-evaluates
+        all — the batch is a single program, so partial evaluation
+        would not be cheaper). Returns per-pulsar chi2;
+        ``self.converged`` is the per-pulsar (B,) truth array.
+        """
         B = len(self.models)
         deltas = {k: jnp.zeros(B) for k in self.free_params}
         base = replicate(self.base, self.mesh)
         mask = replicate(self.param_mask, self.mesh)
-        info = None
+
+        def run(d):
+            return self.step(base, d, self.toas, mask)
+
         with self.mesh:
+            new_deltas, info = run(deltas)
+            chi2 = np.asarray(info["chi2_at_input"]).copy()
+            converged = np.zeros(B, dtype=bool)
             for _ in range(max(1, maxiter)):
-                deltas, info = self.step(base, deltas, self.toas, mask)
+                dx = {k: new_deltas[k] - deltas[k] for k in deltas}
+                lam = np.ones(B)
+                active = ~converged
+                accepted = np.zeros(B, dtype=bool)
+                trial_new = trial_info = None
+                for _h in range(max_step_halvings):
+                    lam_j = jnp.asarray(np.where(active & ~accepted,
+                                                 lam, 0.0))
+                    trial = {k: deltas[k] + lam_j * dx[k] for k in deltas}
+                    trial_new, trial_info = run(trial)
+                    trial_chi2 = np.asarray(trial_info["chi2_at_input"])
+                    better = trial_chi2 <= chi2 + 1e-12
+                    newly = active & ~accepted & better
+                    # keep the accepted pulsars' trial state
+                    keep = jnp.asarray(newly)
+                    deltas = {k: jnp.where(keep, trial[k], deltas[k])
+                              for k in deltas}
+                    new_deltas = {k: jnp.where(keep, trial_new[k],
+                                               new_deltas[k])
+                                  for k in deltas}
+                    decrease = chi2 - trial_chi2
+                    chi2 = np.where(newly, trial_chi2, chi2)
+                    converged |= newly & (decrease < min_chi2_decrease)
+                    accepted |= newly
+                    if (accepted | ~active).all():
+                        break
+                    lam = np.where(active & ~accepted, lam * 0.5, lam)
+                # pulsars with no downhill step left are at their optimum
+                converged |= active & ~accepted
+                # when the inner loop drained every active pulsar, the
+                # last trial evaluated each pulsar exactly at its kept
+                # deltas (accepted ones at their trial, the rest at
+                # lam=0); only a rejected-final-trial exit needs a fresh
+                # evaluation at the kept points
+                last_eval_at_kept = bool((accepted | ~active).all())
+                if converged.all():
+                    break
+            if last_eval_at_kept and trial_info is not None:
+                info = trial_info
+            else:
+                _, info = run(deltas)
+            info = dict(info, chi2=info["chi2_at_input"])
+        self.converged = converged
         for i, m in enumerate(self.models):
             for k in self.free_params:
                 if float(np.asarray(self.param_mask[k][i])) == 0.0:

@@ -226,3 +226,24 @@ def test_batched_rejects_mismatched_dmx_windows():
         problems.append((toas, get_model(PAR + lines)))
     with pytest.raises(ValueError, match="non-parameter state"):
         BatchedPulsarFitter(problems)
+
+
+def test_batched_damped_convergence_flags():
+    """The batched fitter's damped loop reports per-pulsar convergence
+    truthfully (round-2 VERDICT: north-star fitters must not claim
+    success unconditionally)."""
+    problems = []
+    for i in range(3):
+        model, toas = _problem(seed=70 + i, ntoas=60)
+        pert = get_model(PAR)
+        pert["F0"].add_delta(3e-10)
+        problems.append((toas, pert))
+    bf = BatchedPulsarFitter(problems, mesh=make_mesh(8, psr_axis=1))
+    chi2 = bf.fit_toas(maxiter=15)
+    assert chi2.shape == (3,)
+    assert np.all(np.isfinite(chi2))
+    assert bf.converged.shape == (3,)
+    assert bf.converged.all()
+    # statistically clean: damped loop reached the optimum, not a cap
+    n = 60
+    assert np.all(chi2 / (n - 4) < 1.8)
