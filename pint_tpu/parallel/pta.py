@@ -111,6 +111,9 @@ def _eliminate_block(A: Array, B: Array, ct: Array):
             jax.scipy.linalg.cho_solve(cf, jnp.eye(m)))
 
 
+_eliminate_blocks = jax.jit(jax.vmap(_eliminate_block))
+
+
 def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
     """Build ``gram(base, deltas, toas, noise) -> dict`` for one pulsar.
 
@@ -316,8 +319,8 @@ class PTAGLSFitter:
         chi2_base = 0.0
         norms, gw_norms = [], []
         # per-pulsar elimination: A_i^{-1} B_i, A_i^{-1} c_i^t, and the
-        # k x k contribution to the GW core (jitted; P small host loop)
-        Ys, zs, Ks, gs, Ainvs, ct_list = [], [], [], [], [], []
+        # k x k contribution to the GW core
+        As, Bs, Ds, cts, cgs = [], [], [], [], []
         for g in grams:
             S = np.asarray(g["S"])
             rhs = np.asarray(g["rhs"])
@@ -326,18 +329,31 @@ class PTAGLSFitter:
             norms.append(norm)
             gw_norms.append(norm[-k:])
             m = S.shape[0] - k
-            A, B, D = S[:m, :m], S[:m, m:], S[m:, m:]
-            ct, cg = rhs[:m], rhs[m:]
-            sol = _eliminate_block(jnp.asarray(A), jnp.asarray(B),
-                                   jnp.asarray(ct))
-            Y, z, Ainv = (np.asarray(sol[0]), np.asarray(sol[1]),
-                          np.asarray(sol[2]))
-            Ys.append(Y)
-            zs.append(z)
-            Ainvs.append(Ainv)
-            ct_list.append(ct)
-            Ks.append(D - B.T @ Y)
-            gs.append(cg - B.T @ z)
+            As.append(S[:m, :m])
+            Bs.append(S[:m, m:])
+            Ds.append(S[m:, m:])
+            cts.append(rhs[:m])
+            cgs.append(rhs[m:])
+
+        if len({a.shape for a in As}) == 1:
+            # uniform structure (the 68-pulsar north-star case): ONE
+            # vmapped program for all P factorizations — on a real
+            # accelerator this is one dispatch instead of P
+            sols = _eliminate_blocks(jnp.asarray(np.stack(As)),
+                                     jnp.asarray(np.stack(Bs)),
+                                     jnp.asarray(np.stack(cts)))
+            Ys, zs, Ainvs = (np.asarray(sols[0]), np.asarray(sols[1]),
+                             np.asarray(sols[2]))
+        else:
+            out = [_eliminate_block(jnp.asarray(A), jnp.asarray(B),
+                                    jnp.asarray(ct))
+                   for A, B, ct in zip(As, Bs, cts)]
+            Ys = [np.asarray(s[0]) for s in out]
+            zs = [np.asarray(s[1]) for s in out]
+            Ainvs = [np.asarray(s[2]) for s in out]
+        ct_list = cts
+        Ks = [D - B.T @ Y for D, B, Y in zip(Ds, Bs, Ys)]
+        gs = [cg - B.T @ z for cg, B, z in zip(cgs, Bs, zs)]
 
         # GW-only core: dense k x k diagonal blocks + DIAGONAL HD
         # coupling (Gamma^-1[a,b]/(phi na nb)) on every pair

@@ -207,3 +207,32 @@ def test_pta_gls_sharded_mesh(pta_problems):
         for name in m_a.free_params:
             np.testing.assert_allclose(m_b[name].value_f64, m_a[name].value_f64,
                                        rtol=0, atol=1e-3 * m_a[name].uncertainty)
+
+
+def test_pta_heterogeneous_structures():
+    """Different per-pulsar model structure (here: red-noise harmonic
+    counts, TNREDC 4 vs 6) gives non-uniform reduced-block shapes, which
+    cannot vmap — the per-pulsar elimination fallback must produce a
+    finite fit. (TOA counts do NOT vary block shape: the gram is already
+    reduced to (p + k_pl + k_gw).)"""
+    problems = []
+    for i, nredc in enumerate((4, 6)):
+        par = _mkpar(i).replace("TNREDC 4", f"TNREDC {nredc}")
+        model = get_model(par)
+        t0 = make_fake_toas_uniform(53000, 56000, 24, model, obs="gbt",
+                                    freq_mhz=np.array([1400.0, 430.0]),
+                                    error_us=1.0, add_noise=True,
+                                    seed=60 + i)
+        toas = merge_TOAs([t0, t0])
+        toas = dataclasses.replace(
+            toas, flags=Flags(dict(d, f="fake") for d in toas.flags))
+        m = get_model(par)
+        m["F0"].add_delta(2e-10)
+        problems.append((toas, m))
+    f = PTAGLSFitter(problems, gw_log10_amp=GW_AMP, gw_gamma=GW_GAM,
+                     gw_nharm=GW_NHARM)
+    chi2 = f.fit_toas(maxiter=1)
+    assert np.isfinite(chi2)
+    for _, m in problems:
+        assert np.isfinite(m["F0"].uncertainty)
+        assert m["F0"].uncertainty > 0
