@@ -1,0 +1,70 @@
+"""Unit tests for the damped Gauss-Newton driver (fitting/damped.py).
+
+Synthetic step functions isolate the accept/halve/converge logic from
+any timing model: the driver must accept good steps, halve overshooting
+ones, stop at stagnation, and report `converged` truthfully.
+"""
+
+import numpy as np
+
+from pint_tpu.fitting.damped import downhill_iterate
+
+
+def _quadratic_step(scale=1.0):
+    """Gauss-Newton on chi2(x) = (x-3)^2 with a step-length distortion:
+    proposes x + scale*(3-x), so scale=1 is exact Newton and scale>2
+    overshoots into a chi2 increase that must be halved away."""
+
+    def iterate(deltas):
+        x = float(deltas["x"])
+        chi2 = (x - 3.0) ** 2
+        new = {"x": x + scale * (3.0 - x)}
+        return new, {"chi2_at_input": chi2, "x_at": x}
+
+    return iterate
+
+
+def test_accepts_exact_newton_and_converges():
+    deltas, info, chi2, converged = downhill_iterate(
+        _quadratic_step(1.0), {"x": 0.0}, maxiter=10)
+    assert converged
+    assert abs(deltas["x"] - 3.0) < 1e-12
+    assert chi2 < 1e-20
+    # info corresponds to the returned point
+    assert info["x_at"] == deltas["x"]
+
+
+def test_halves_overshooting_step():
+    # scale 3.2: full step flips x across the minimum and RAISES chi2
+    # (|1 - 3.2| > 1), so acceptance requires halving; the loop must
+    # still converge to the minimum
+    deltas, _info, chi2, converged = downhill_iterate(
+        _quadratic_step(3.2), {"x": 0.0}, maxiter=50,
+        min_chi2_decrease=1e-10)
+    assert converged
+    assert abs(deltas["x"] - 3.0) < 1e-3
+    assert chi2 < 1e-5
+
+
+def test_no_downhill_step_reports_converged_at_start():
+    # pathological proposal that always increases chi2 beyond rescue:
+    # jumps to x + 1000 regardless; from the MINIMUM no halving helps
+    def iterate(deltas):
+        x = float(deltas["x"])
+        return {"x": x + 1000.0}, {"chi2_at_input": (x - 3.0) ** 2}
+
+    deltas, _info, chi2, converged = downhill_iterate(
+        iterate, {"x": 3.0}, maxiter=5)
+    assert converged           # at the optimum: no downhill step exists
+    assert deltas["x"] == 3.0  # never moved
+    assert chi2 == 0.0
+
+
+def test_maxiter_exhaustion_reports_not_converged():
+    # tiny steps (scale 1e-3) with a strict decrease threshold: progress
+    # every iteration but never "done" -> converged must be False
+    deltas, _info, _chi2, converged = downhill_iterate(
+        _quadratic_step(1e-3), {"x": 0.0}, maxiter=3,
+        min_chi2_decrease=1e-30)
+    assert not converged
+    assert 0.0 < deltas["x"] < 0.1
