@@ -39,15 +39,10 @@ import time
 
 import numpy as np
 
-# Honor an explicit JAX_PLATFORMS request (the axon sitecustomize
-# force-selects its TPU platform via jax.config, overriding the env var).
-import jax
-
-_env_platforms = os.environ.get("JAX_PLATFORMS", "")
-if _env_platforms and "axon" not in _env_platforms:
-    jax.config.update("jax_platforms", _env_platforms)
-
-import pint_tpu  # noqa: F401, E402  (enables x64)
+# importing pint_tpu honors an explicit JAX_PLATFORMS request despite
+# the axon sitecustomize's jax.config override (pint_tpu.setup_platform)
+import pint_tpu  # noqa: F401  (enables x64)
+import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 # NO persistent XLA compile cache: this jaxlib's XLA:CPU AOT reload is
@@ -138,6 +133,91 @@ def _dd_pin_ctx():
 
     return (jax.default_device(cpu_device()),
             " (pinned to cpu: accelerator fails dd self-check)")
+
+
+def _cpu_info() -> tuple[str, float]:
+    """(model name, MHz) from /proc/cpuinfo; empty/0 when unavailable."""
+    model, mhz = "", 0.0
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("model name") and not model:
+                    model = line.split(":", 1)[1].strip()
+                elif line.startswith("cpu MHz") and not mhz:
+                    mhz = float(line.split(":", 1)[1])
+    except OSError:
+        pass
+    return model, mhz
+
+
+def _xla_flops(compiled) -> float:
+    """FLOPs of an AOT-compiled program per XLA's cost analysis (-1 if
+    n/a) — XLA's own static count of the whole fused program, design
+    matrix included, which no hand formula for the linear algebra
+    captures. Takes the ALREADY-compiled executable the timing loop
+    runs (the bench compiles once via lower().compile() and reuses it),
+    so accounting adds zero compile time.
+    """
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", -1.0))
+    except Exception:  # noqa: BLE001 — accounting must never fail the bench
+        return -1.0
+
+
+def _analytic_gls_flops(n: int, p: int, k: int, ne: int) -> dict:
+    """Hand-counted FLOPs of one GLS iteration's linear algebra.
+
+    q = p + k extended columns over n TOAs with ne ECORR epochs:
+    weighted Gram B^T W B (2nq^2), rhs + chi2 (~6nq), segment-summed
+    epoch blocks + diagonal Schur complement (3nq + 2*ne*q^2), core
+    Cholesky + solves (q^3/3 + ~4q^2). Excludes the jacfwd design
+    matrix (transcendental-heavy; counted only by the XLA number).
+    """
+    q = p + k
+    return {
+        "gram": 2.0 * n * q * q,
+        "rhs_chi2": 6.0 * n * q,
+        "epoch_schur": 3.0 * n * q + 2.0 * ne * q * q,
+        "core_cholesky": q ** 3 / 3.0 + 4.0 * q * q,
+    }
+
+
+# documented peaks for MFU (BASELINE.md primary metric; VERDICT r3 #4).
+# TPU v5e: 197 TFLOP/s bf16 per chip (public datasheet); f32 through the
+# MXU at ~1/4 bf16. CPU: cores x GHz x 16 f64 FLOP/cycle (2x 256-bit FMA
+# ports) — an upper bound for the sandbox's single core.
+def _peak_gflops(backend: str) -> tuple[float, str]:
+    if backend.startswith("cpu"):
+        model, mhz = _cpu_info()
+        ghz = (mhz / 1e3) or 2.0
+        cores = os.cpu_count() or 1
+        return (cores * ghz * 16.0,
+                f"cpu peak = {cores} core x {ghz:.2f} GHz x 16 f64 "
+                f"FLOP/cycle (AVX2 2xFMA) [{model or 'unknown cpu'}]")
+    return (49_000.0,
+            "tpu v5e f32 peak ~49.2 TFLOP/s (datasheet 197 TFLOP/s bf16 / 4)")
+
+
+def _flop_fields(flops: float, analytic: dict, value_s: float,
+                 backend: str) -> dict:
+    """Derived accounting fields shared by the gls/hybrid emitters."""
+    peak, peak_model = _peak_gflops(backend)
+    out = {
+        "flops_analytic": {k: round(v) for k, v in analytic.items()},
+        "flops_analytic_total": round(sum(analytic.values())),
+        "cpu_model": _cpu_info()[0],
+        "load1": round(os.getloadavg()[0], 2),
+        "peak_gflops": round(peak, 1),
+        "peak_model": peak_model,
+    }
+    if flops > 0:
+        out["flops_per_iter"] = round(flops)
+        out["gflops_s"] = round(flops / value_s / 1e9, 3)
+        out["mfu_pct"] = round(100.0 * flops / value_s / 1e9 / peak, 3)
+    return out
 
 
 def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
@@ -353,7 +433,7 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
     chi2 = float(np.asarray(sol["chi2"]))
     stage1_s = float(np.median(s1_times))
 
-    _emit({
+    out_fields = {
         "metric": metric,
         "value": round(value, 6),
         "unit": "s",
@@ -371,7 +451,16 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
         "n_rednoise_harmonics": 30,
         "compile_s": round(compile_s, 3),
         "chi2": round(chi2, 3),
-    })
+    }
+    # accelerator-stage accounting: the analytic linear-algebra count is
+    # what stage 2 executes on the chip; MFU computed against the
+    # ACCELERATOR peak over the stage-2 wall clock
+    analytic = _analytic_gls_flops(n, len(f._names) + 1, 2 * 30,
+                                   int(np.asarray(f.noise.ecorr_phi).size))
+    stage2_s = max(value - stage1_s, 1e-9)
+    out_fields.update(_flop_fields(sum(analytic.values()), analytic,
+                                   stage2_s, backend))
+    _emit(out_fields)
 
 
 def main() -> None:
@@ -506,11 +595,14 @@ def _main_guarded() -> None:
         model, toas = build_problem(n)
         noise, pl_specs = build_noise_statics(model, toas)
         n_ecorr = int(np.asarray(noise.ecorr_phi).size)
-        step = jax.jit(make_gls_step(model, pl_specs=pl_specs))
+        step_jit = jax.jit(make_gls_step(model, pl_specs=pl_specs))
         base = model.base_dd()
         deltas = model.zero_deltas()
 
+        # ONE explicit lower+compile; the AOT executable serves both the
+        # timing loop and the FLOP cost analysis (no second compile)
         t0 = time.perf_counter()
+        step = step_jit.lower(base, deltas, toas, noise).compile()
         out = step(base, deltas, toas, noise)
         jax.block_until_ready(out)
         compile_s = time.perf_counter() - t0
@@ -552,7 +644,7 @@ def _main_guarded() -> None:
             dm_times.append(time.perf_counter() - t0)
         dm_ms_per_toa = float(np.median(dm_times)) * 1e3 / n
 
-        _emit({
+        out_fields = {
             "metric": metric,
             "value": round(value, 6),
             "unit": "s",
@@ -566,7 +658,13 @@ def _main_guarded() -> None:
             "n_rednoise_harmonics": 30,
             "compile_s": round(compile_s, 3),
             "chi2": round(chi2, 3),
-        })
+        }
+        p_cols = len(model.free_params) + 1  # + implicit offset column
+        out_fields.update(_flop_fields(
+            _xla_flops(step),
+            _analytic_gls_flops(n, p_cols, 2 * 30, n_ecorr),
+            value, backend))
+        _emit(out_fields)
     except Exception as e:  # noqa: BLE001
         _emit({"metric": metric, "value": -1.0, "unit": "s",
                "vs_baseline": 0.0, "backend": backend, "device": device,

@@ -26,6 +26,43 @@ import jax as _jax
 # The whole framework assumes 64-bit floats; enable before anything traces.
 _jax.config.update("jax_enable_x64", True)
 
+
+def setup_platform(platform: str | None = None) -> None:
+    """Make an explicit JAX platform request actually stick.
+
+    Some accelerator plugins (the sandbox's axon tunnel among them)
+    force-select their platform via ``jax.config`` in ``sitecustomize``,
+    which silently overrides a user's ``JAX_PLATFORMS`` environment
+    variable — a plain script run with ``JAX_PLATFORMS=cpu`` then hangs
+    at backend initialization when the accelerator is unreachable.
+
+    This is the ONE place that workaround lives (round-3 weak #4):
+    ``import pint_tpu`` calls it with no argument, re-applying the
+    ``JAX_PLATFORMS`` env var to ``jax.config`` when the var is set and
+    the config disagrees; entry points that must run on a specific
+    backend call it explicitly, e.g. ``pint_tpu.setup_platform("cpu")``,
+    before any jax computation. With no argument and no env var it does
+    nothing (an auto-detected accelerator stays selected). No-op with a
+    warning if the backend is already initialized (too late to switch).
+    """
+    import os
+
+    want = platform or os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    try:
+        if str(_jax.config.jax_platforms or "") != want:
+            _jax.config.update("jax_platforms", want)
+    except RuntimeError as exc:  # backends already initialized
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "setup_platform(%r) too late — jax backends already "
+            "initialized (%s)", want, exc)
+
+
+setup_platform()
+
 __version__ = "0.1.0"
 
 from pint_tpu.ops import dd  # noqa: E402,F401

@@ -50,8 +50,14 @@ def _rot_ecl_to_eq(xyz_ecl: Array) -> Array:
 
 
 # jitted posvel programs, keyed by (include_sun_wobble, body) — shared
-# across every AnalyticEphemeris instance (the model is pure constants)
-_POSVEL_JIT_CACHE: dict = {}
+# across every AnalyticEphemeris instance (the model is pure constants).
+# LRU-bounded: ad-hoc body-set combinations would otherwise accumulate
+# executables without limit in long sessions.
+from pint_tpu.utils.cache import LRUCache
+
+_POSVEL_JIT_CACHE = LRUCache(64)
+_posvel_cache_get = _POSVEL_JIT_CACHE.get_lru
+_posvel_cache_put = _POSVEL_JIT_CACHE.put_lru
 
 
 @dataclass(frozen=True)
@@ -244,7 +250,7 @@ class AnalyticEphemeris:
         so one compiled program serves every instance and dataset.
         """
         cache_key = (self.include_sun_wobble, key)
-        fn = _POSVEL_JIT_CACHE.get(cache_key)
+        fn = _posvel_cache_get(cache_key)
         if fn is None:
             def raw(t):
                 T = self._t_cent(t)
@@ -254,7 +260,7 @@ class AnalyticEphemeris:
                 return pos, vel
 
             fn = jax.jit(raw)
-            _POSVEL_JIT_CACHE[cache_key] = fn
+            _posvel_cache_put(cache_key, fn)
         return fn(t_tdb_mjd)
 
     def earth_posvel_ssb(self, t_tdb_mjd: Array) -> tuple[Array, Array]:
@@ -280,7 +286,7 @@ class AnalyticEphemeris:
         """
         names = tuple(str(n).lower() for n in names)
         cache_key = (self.include_sun_wobble, "bodies", names)
-        fn = _POSVEL_JIT_CACHE.get(cache_key)
+        fn = _posvel_cache_get(cache_key)
         if fn is None:
             orbits = {
                 "mercury": _MERCURY, "venus": _VENUS, "mars": _MARS,
@@ -314,7 +320,7 @@ class AnalyticEphemeris:
                 return pos, vel
 
             fn = jax.jit(raw)
-            _POSVEL_JIT_CACHE[cache_key] = fn
+            _posvel_cache_put(cache_key, fn)
         pos, vel = fn(t_tdb_mjd)
         return {nm: (pos[i], vel[i]) for i, nm in enumerate(names)}
 

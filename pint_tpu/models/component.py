@@ -111,6 +111,18 @@ class Component:
     def validate(self) -> None:  # pragma: no cover - overridden where needed
         pass
 
+    def trace_facts(self) -> tuple:
+        """Hashable host-side facts the traced closure branches on.
+
+        Anything a component reads from the *host* object at trace time
+        beyond frozen/non-fittable parameter values (those are already
+        pinned by ``TimingModel._fn_fingerprint``) must be reported
+        here, or two models differing only in such state could alias one
+        cached compiled program. Example: Glitch pins its per-glitch
+        ``GLTD > 0`` decay-branch selections.
+        """
+        return ()
+
     # -- class-level par-file matching ---------------------------------
     @classmethod
     def applicable(cls, pf) -> bool:

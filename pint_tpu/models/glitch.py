@@ -68,6 +68,12 @@ class Glitch(Component):
                     and self.param(f"GLTD_{i}").value_f64 <= 0.0):
                 raise ValueError(f"GLF0D_{i} set but GLTD_{i} not positive")
 
+    def trace_facts(self) -> tuple:
+        # phase() pins the decay branch per glitch from the HOST value of
+        # GLTD (a fittable param that may be free) at trace time
+        return tuple(self.param(f"GLTD_{i}").value_f64 > 0
+                     for i in self.indices)
+
     def phase(self, p: dict[str, DD], toas, delay: Array, aux: dict) -> phase_mod.Phase:
         total = jnp.zeros(len(toas))
         for i in self.indices:

@@ -47,6 +47,14 @@ class NoiseComponent(Component):
     is_noise_scale = False  # rescales white-noise sigmas
     is_noise_basis = False  # contributes (basis, weight) to GLS
 
+    def trace_facts(self) -> tuple:
+        # noise hyperparameters (EFAC/EQUAD/ECORR/TN*) feed traced
+        # closures via HOST .value_f64 reads regardless of frozen state;
+        # frozen ones are pinned by the main fingerprint — pin the
+        # unfrozen remainder here
+        return tuple((p.name, p.value) for p in self.params
+                     if p.is_numeric and not p.frozen)
+
     def scale_sigma(self, sigma: Array, toas) -> Array:  # pragma: no cover
         raise NotImplementedError
 

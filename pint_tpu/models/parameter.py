@@ -67,6 +67,39 @@ class Param:
     par_scale: float = 1.0
 
     # ------------------------------------------------------------------
+    def __setattr__(self, name: str, val) -> None:
+        # coerce at SET time: a bare scalar assigned to a numeric
+        # parameter's .value used to be stored as-is and crash mid-fit
+        # ("'float' object is not subscriptable" from .hi)
+        if name == "value":
+            val = self._coerce_value(val)
+        object.__setattr__(self, name, val)
+
+    def _coerce_value(self, val):
+        """Numeric kinds store an exact (hi, lo) float64 pair.
+
+        A bare float / int / numpy real scalar coerces exactly (a
+        float64 is its own exact DD; an int splits into hi + exact
+        remainder); anything else non-pair raises immediately instead
+        of deferring the failure into the compute path.
+        """
+        if val is None or not self.is_numeric:
+            return val
+        if isinstance(val, (tuple, list)) and len(val) == 2:
+            return (float(val[0]), float(val[1]))
+        if isinstance(val, bool):
+            pass  # bool is an int subclass but never a numeric value
+        elif isinstance(val, (int, np.integer)):
+            hi = float(int(val))
+            return (hi, float(int(val) - int(hi)))
+        elif isinstance(val, (float, np.floating)):
+            return (float(val), 0.0)
+        raise TypeError(
+            f"{self.name}.value must be an exact (hi, lo) float64 pair "
+            f"or a real scalar (internal units); got "
+            f"{type(val).__name__!s} — par-file strings go through "
+            "set_from_par()")
+
     @property
     def is_numeric(self) -> bool:
         return self.kind in (FLOAT, DDFLOAT, MJD, ANGLE_RA, ANGLE_DEC)
@@ -86,8 +119,13 @@ class Param:
         return self.value[1]
 
     def as_dd(self) -> DD:
-        """Value as a scalar DD of jnp arrays (for the compute path)."""
-        return DD(jnp.asarray(self.hi, jnp.float64), jnp.asarray(self.lo, jnp.float64))
+        """Value as a scalar DD (numpy f64 — converted at jit entry).
+
+        Building ~40 of these per ``base_dd()`` call used to dispatch
+        ~80 eager XLA scalar ops per phase/fit evaluation; numpy
+        scalars are free and identical once traced.
+        """
+        return DD(np.float64(self.hi), np.float64(self.lo))
 
     @property
     def value_f64(self) -> float:

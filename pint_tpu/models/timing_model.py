@@ -40,10 +40,25 @@ log = logging.getLogger(__name__)
 # TimingModel._cached_jit. LRU-bounded: each entry pins a deepcopied
 # model (its closure state) plus executables, so unbounded growth would
 # leak in long structure-editing sessions (e.g. pintk).
-from collections import OrderedDict as _OrderedDict
+from pint_tpu.utils.cache import LRUCache
 
-_JIT_PROGRAM_CACHE: "_OrderedDict" = _OrderedDict()
-_JIT_PROGRAM_CACHE_MAX = 128
+_JIT_PROGRAM_CACHE = LRUCache(128)
+
+
+def _nan_safe(v):
+    """Replace NaN floats in a nested fingerprint tuple with a sentinel.
+
+    Unset parameters pin ``(nan, 0.0)`` values, and ``nan != nan`` made
+    every fingerprint compare unequal ACROSS instances (while hashing
+    equal), so the program caches missed for every new model — each of
+    68 same-structure pulsars was silently recompiling every program
+    (round-3 weak #2: the 199 s PTA "one-time" compile was 68 of them).
+    """
+    if isinstance(v, tuple):
+        return tuple(_nan_safe(x) for x in v)
+    if isinstance(v, float) and v != v:
+        return "__nan__"
+    return v
 
 
 def _order_key(comp: Component) -> int:
@@ -348,13 +363,21 @@ class TimingModel:
         see pint_tpu.parallel.pta).
         """
         header = getattr(self, "header", {}) or {}
-        return (tuple(type(c).__name__ for c in self.components),
-                tuple((p.name,
-                       p.value if (p.frozen or not p.is_numeric) else None,
-                       getattr(p, "selector", None))
-                      for p in self.params.values()),
-                tuple((k, str(header[k])) for k in
-                      ("EPHEM", "CLK", "CLOCK", "UNITS") if k in header))
+        # pin values unless the param is a FREE FITTABLE one (those flow
+        # through the traced base_dd): an unfrozen-but-unfittable param
+        # (e.g. an MJD epoch the par parser unfroze via a fit flag) is
+        # still read host-side at trace time. Per-component trace-time
+        # branch facts (glitch decay selection, unfrozen noise
+        # hyperparameters) come from the trace_facts hook.
+        return _nan_safe(
+            (tuple((type(c).__name__, c.trace_facts())
+                   for c in self.components),
+             tuple((p.name,
+                    p.value if (p.frozen or not p.fittable) else None,
+                    getattr(p, "selector", None))
+                   for p in self.params.values()),
+             tuple((k, str(header[k])) for k in
+                   ("EPHEM", "CLK", "CLOCK", "UNITS") if k in header)))
 
     def _cached_jit(self, key, builder):
         """Module-level jit cache for the eager host API.
@@ -371,7 +394,7 @@ class TimingModel:
         import copy as _copy
 
         fp = (type(self).__name__, key, self._fn_fingerprint())
-        ent = _JIT_PROGRAM_CACHE.get(fp)
+        ent = _JIT_PROGRAM_CACHE.get_lru(fp)
         if ent is None:
             owner = _copy.deepcopy(self)
             # the content-keyed eager-noise cache can hold O(n x k)
@@ -379,11 +402,7 @@ class TimingModel:
             # closures never read it — do not pin it in the LRU
             owner.__dict__.pop("_noise_basis_key", None)
             owner.__dict__.pop("_noise_basis_val", None)
-            ent = _JIT_PROGRAM_CACHE[fp] = jax.jit(builder(owner))
-            while len(_JIT_PROGRAM_CACHE) > _JIT_PROGRAM_CACHE_MAX:
-                _JIT_PROGRAM_CACHE.popitem(last=False)
-        else:
-            _JIT_PROGRAM_CACHE.move_to_end(fp)
+            ent = _JIT_PROGRAM_CACHE.put_lru(fp, jax.jit(builder(owner)))
         return ent
 
     def phase(self, toas, abs_phase: bool = True) -> phase_mod.Phase:

@@ -204,7 +204,9 @@ def from_string(s: str) -> DD:
     value into hi = round(x), lo = round(x - hi) via Fraction arithmetic.
     """
     hi, lo = _split_decimal(s)
-    return DD(jnp.asarray(hi, jnp.float64), jnp.asarray(lo, jnp.float64))
+    # numpy scalars, not device arrays: parsing is host bookkeeping and
+    # must not dispatch XLA ops (jit boundaries convert on entry)
+    return DD(np.float64(hi), np.float64(lo))
 
 
 def _split_decimal(s: str) -> tuple[float, float]:
@@ -224,7 +226,7 @@ def from_strings(strings) -> DD:
     los = np.empty(len(strings), dtype=np.float64)
     for i, s in enumerate(strings):
         his[i], los[i] = _split_decimal(s)
-    return DD(jnp.asarray(his), jnp.asarray(los))
+    return DD(his, los)
 
 
 def to_string(x: DD, ndigits: int = 25) -> str:

@@ -294,7 +294,6 @@ class PTAGLSFitter:
         cache = self._gram_cache
         for toas, model in zip(self.toas_list, self.models):
             noise, pl_specs = build_noise_statics(model, toas)
-            base = model.base_dd()
             # one executable per model *structure*: FREE values flow
             # through the traced `base` and PL hyperparameters through
             # `noise.pl_params`; everything a compiled closure pins is
@@ -322,10 +321,9 @@ class PTAGLSFitter:
                     jax.device_put(noise.ecorr_phi, rep),
                     jax.device_put(noise.pl_params, rep),
                 )
-                base = replicate(base, self.mesh)
             if key not in cache:
                 cache[key] = jax.jit(make_pta_gram(model, self.gw, pl_specs))
-            prepared.append((cache[key], base, toas, noise, model))
+            prepared.append((cache[key], toas, noise, model))
         self._prepared = prepared
         return prepared
 
@@ -337,7 +335,12 @@ class PTAGLSFitter:
         evaluation); ``None`` means zeros.
         """
         out = []
-        for i, (gram, base, toas, noise, model) in enumerate(self._prepare()):
+        for i, (gram, toas, noise, model) in enumerate(self._prepare()):
+            # base is rebuilt per call (cheap numpy scalars), NOT cached
+            # in _prepare: fit_toas mutates the models' values, and a
+            # stale cached linearization point would silently
+            # double-apply deltas on a second fit
+            base = model.base_dd()
             deltas = model.zero_deltas()
             if deltas_list is not None:
                 deltas = {k: jnp.asarray(deltas_list[i][k], jnp.float64)
@@ -345,6 +348,7 @@ class PTAGLSFitter:
             if self.mesh is not None:
                 from pint_tpu.parallel.mesh import replicate
 
+                base = replicate(base, self.mesh)
                 deltas = replicate(deltas, self.mesh)
                 with self.mesh:
                     out.append(gram(base, deltas, toas, noise))

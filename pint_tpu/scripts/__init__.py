@@ -38,14 +38,15 @@ def _pin_platform() -> None:
     for minutes when the tunnel is busy (round-1 bench failure mode).
     Console tools are single-dataset workflows that must run on an
     IEEE-exact-f64 backend anyway, so default them to CPU outright; an
-    explicit ``JAX_PLATFORMS`` naming an accelerator still wins.
+    explicit ``JAX_PLATFORMS`` naming an accelerator still wins. The
+    mechanism is the library-level :func:`pint_tpu.setup_platform`
+    guard — this wrapper only supplies the console-script default.
     """
     import os
 
-    import jax
+    import pint_tpu
 
-    env = os.environ.get("JAX_PLATFORMS", "")
-    jax.config.update("jax_platforms", env if env else "cpu")
+    pint_tpu.setup_platform(os.environ.get("JAX_PLATFORMS") or "cpu")
 
 
 def ensure_exact_f64() -> None:
@@ -70,8 +71,12 @@ def ensure_exact_f64() -> None:
     log = logging.getLogger("pint_tpu.scripts")
 
     platforms = str(jax.config.jax_platforms or "")
-    if not platforms or platforms.split(",")[0] == "cpu":
+    if platforms and platforms.split(",")[0] == "cpu":
         return
+    # NOTE: an EMPTY platforms config is NOT safe to skip — on a host
+    # with an accelerator plugin installed (libtpu etc.), jax
+    # auto-detects it, so the resolved default backend must be probed
+    # exactly like an explicitly-requested one.
 
     # Touching a non-CPU backend (init OR first compile) can hang for
     # minutes inside a C call when the accelerator tunnel is down — and
@@ -83,12 +88,15 @@ def ensure_exact_f64() -> None:
     timeout_s = int(os.environ.get("PINT_TPU_SCRIPT_INIT_TIMEOUT", "60"))
     code = ("import jax\n"
             "from pint_tpu.ops import dd\n"
-            "print('EXACT' if dd.self_check() else 'INEXACT')\n")
+            "b = jax.default_backend()\n"
+            "ok = b == 'cpu' or dd.self_check()\n"
+            "print(b + ':' + ('EXACT' if ok else 'INEXACT'))\n")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               capture_output=True, text=True,
                               timeout=timeout_s)
-        verdict = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        out = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        backend, _, verdict = out.partition(":")
         if proc.returncode != 0 or verdict not in ("EXACT", "INEXACT"):
             raise RuntimeError(
                 f"probe rc={proc.returncode}: {proc.stderr[-300:]}")
@@ -96,12 +104,14 @@ def ensure_exact_f64() -> None:
         jax.config.update("jax_platforms", "cpu")
         log.warning(
             "accelerator backend %s unreachable (%s); running on the "
-            "CPU backend", platforms, exc)
+            "CPU backend", platforms or "<auto>", exc)
         return
 
+    if backend == "cpu":
+        return  # auto-detection resolved to CPU: nothing to pin
     if verdict == "INEXACT":
         cpu = jax.devices("cpu")[0]
         jax.config.update("jax_default_device", cpu)
         log.warning(
             "backend %s fails the float64 exactness self-check; pinning "
-            "computation to %s (see pint_tpu.ops.dd)", platforms, cpu)
+            "computation to %s (see pint_tpu.ops.dd)", backend, cpu)

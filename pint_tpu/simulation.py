@@ -47,11 +47,18 @@ def _invert_to_model(build, mjd_dd: dd.DD, model, errs, *,
     grid epochs are used as-is (cheap tables for tests/tools that only
     evaluate delays, not residual statistics).
     """
+    toas = None
     for _ in range(max(0, niter)):
-        toas = build(mjd_dd)
+        # full clock/TDB/posvel build once; subsequent iterations shift
+        # the EXISTING table to first order (_shift_toas) — the shifts
+        # are sub-phase-period (<~10 ms), where the first-order update
+        # is exact far below noise, and the final build below is a full
+        # one anyway
+        toas = build(mjd_dd) if toas is None else _shift_toas(toas, shift)
         r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
         shift_day = np.asarray(r.time_resids) / SECS_PER_DAY
         mjd_dd = dd.sub(mjd_dd, shift_day)
+        shift = -shift_day
 
     if add_noise:
         rng = np.random.default_rng(seed)
@@ -59,6 +66,27 @@ def _invert_to_model(build, mjd_dd: dd.DD, model, errs, *,
         mjd_dd = dd.add(mjd_dd, noise_s / SECS_PER_DAY)
 
     return build(mjd_dd)
+
+
+def _shift_toas(toas: TOAs, delta_day) -> TOAs:
+    """Advance a built table's arrival times by ``delta_day`` (f64 days).
+
+    First-order update for the inversion loop: times shift exactly (DD
+    add), the observatory SSB position advances by v*dt (quadratic
+    remainder a*dt^2/2 < 1e-7 m for dt < 10 ms), and planet positions
+    are left in place (planetary Shapiro delays vary by < 1e-12 s over
+    such shifts). NOT a substitute for a full rebuild over large deltas
+    — clock chains and TDB-TT drift are frozen across the shift.
+    """
+    import dataclasses
+
+    dt_s = np.asarray(delta_day) * SECS_PER_DAY
+    return dataclasses.replace(
+        toas,
+        utc=dd.add(toas.utc, delta_day),
+        tdb=dd.add(toas.tdb, delta_day),
+        obs_pos_ls=toas.obs_pos_ls + toas.obs_vel_c * dt_s[:, None],
+    )
 
 
 def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int, model,

@@ -37,7 +37,7 @@ from pint_tpu.ops.dd import DD
 
 Array = jax.Array
 
-from pint_tpu.constants import C_M_S
+from pint_tpu.constants import C_M_S, SECS_PER_DAY
 PLANET_NAMES = ("sun", "venus", "jupiter", "saturn", "uranus", "neptune")
 
 
@@ -320,8 +320,12 @@ def build_TOAs_from_raw(
 
 # jitted TT->TDB->posvel pipelines, keyed by (ephemeris instance,
 # planets flag, explicit-GCRS flag); the value holds a strong ref to the
-# ephemeris so the id() key can never be recycled
-_PIPELINE_JIT_CACHE: dict = {}
+# ephemeris so the id() key can never be recycled. LRU-bounded:
+# id()-keyed providers (SPK/tabulated) would otherwise pin ephemerides +
+# executables forever in long sessions.
+from pint_tpu.utils.cache import LRUCache
+
+_PIPELINE_JIT_CACHE = LRUCache(32)
 
 
 def _astrometric_pipeline(eph: Ephemeris, planets: bool,
@@ -345,7 +349,7 @@ def _astrometric_pipeline(eph: Ephemeris, planets: bool,
         key = (eph, planets, explicit_gcrs)
     else:
         key = (id(eph), planets, explicit_gcrs)
-    ent = _PIPELINE_JIT_CACHE.get(key)
+    ent = _PIPELINE_JIT_CACHE.get_lru(key)
     if ent is not None and (ent[0] is eph or isinstance(eph, AnalyticEphemeris)):
         return ent[1]
 
@@ -360,38 +364,58 @@ def _astrometric_pipeline(eph: Ephemeris, planets: bool,
         else:
             obs_gcrs_pos, obs_gcrs_vel = earth.itrf_to_gcrs_posvel(
                 itrf, utc.hi + utc.lo)
-        # Earth posvel for the Einstein topocentric term (at TT ~ TDB)
-        _earth_pos, earth_vel = eph.earth_posvel_ssb(tt_f64)
-        topo_corr = ts.topocentric_einstein_s(earth_vel * C_M_S,
-                                              obs_gcrs_pos)
-        topo_corr = jnp.where(is_bary | is_geo, 0.0, topo_corr)
-        tdb = ts.tt_to_tdb(tt, topo_corr)
-        # barycentric TOAs are already TDB at the SSB
-        tdb = DD(jnp.where(is_bary, utc.hi, tdb.hi),
-                 jnp.where(is_bary, utc.lo, tdb.lo))
 
-        tdb_f64 = tdb.hi + tdb.lo
-        earth_pos, earth_vel = eph.earth_posvel_ssb(tdb_f64)
+        if bodies_fn is not None:
+            # ONE shared-subexpression posvel evaluation at TT for every
+            # body INCLUDING the geocenter (the transcendental-heavy
+            # Kepler/wobble chains dominated the whole TOA build when
+            # run once for the Einstein term, again at TDB, and again
+            # for the planets). Positions are then advanced to TDB to
+            # first order, pos + v*(TDB-TT): |TDB-TT| < 2 ms and the
+            # largest acceleration (geocenter, 6e-3 m/s^2) makes the
+            # quadratic remainder < 1e-8 m — twelve decades below the
+            # ~0.3 m that matters for ns timing.
+            pv = bodies_fn(tt_f64, ("earth",) + body_names)
+            earth_pos_tt, earth_vel = pv["earth"]
+            topo_corr = ts.topocentric_einstein_s(earth_vel * C_M_S,
+                                                  obs_gcrs_pos)
+            topo_corr = jnp.where(is_bary | is_geo, 0.0, topo_corr)
+            corr_s = ts.tdb_minus_tt(tt) + topo_corr
+            tdb = dd.add(tt, corr_s / SECS_PER_DAY)
+            tdb = DD(jnp.where(is_bary, utc.hi, tdb.hi),
+                     jnp.where(is_bary, utc.lo, tdb.lo))
+            earth_pos = earth_pos_tt + earth_vel * corr_s[:, None]
+            planet_pv = {nm: (pv[nm][0] + pv[nm][1] * corr_s[:, None])
+                         for nm in body_names}
+        else:
+            # generic provider without the batched hook: evaluate the
+            # protocol methods at each timescale (reference structure)
+            _earth_pos, earth_vel = eph.earth_posvel_ssb(tt_f64)
+            topo_corr = ts.topocentric_einstein_s(earth_vel * C_M_S,
+                                                  obs_gcrs_pos)
+            topo_corr = jnp.where(is_bary | is_geo, 0.0, topo_corr)
+            tdb = ts.tt_to_tdb(tt, topo_corr)
+            tdb = DD(jnp.where(is_bary, utc.hi, tdb.hi),
+                     jnp.where(is_bary, utc.lo, tdb.lo))
+            tdb_f64 = tdb.hi + tdb.lo
+            earth_pos, earth_vel = eph.earth_posvel_ssb(tdb_f64)
+            planet_pv = {}
+            for nm in body_names:
+                p, _ = (eph.sun_posvel_ssb(tdb_f64) if nm == "sun"
+                        else eph.planet_posvel_ssb(nm, tdb_f64))
+                planet_pv[nm] = p
+
         obs_pos = earth_pos + obs_gcrs_pos / C_M_S  # GCRS m -> lt-s
         obs_vel = earth_vel + obs_gcrs_vel / C_M_S
         zero3 = jnp.zeros_like(obs_pos)
         bm, gm = is_bary[:, None], is_geo[:, None]
         obs_pos = jnp.where(bm, zero3, jnp.where(gm, earth_pos, obs_pos))
         obs_vel = jnp.where(bm, zero3, jnp.where(gm, earth_vel, obs_vel))
-
-        if bodies_fn is not None:
-            planet_pos = {nm: p - obs_pos for nm, (p, _v)
-                          in bodies_fn(tdb_f64, body_names).items()}
-        else:
-            planet_pos = {}
-            for nm in body_names:
-                p, _ = (eph.sun_posvel_ssb(tdb_f64) if nm == "sun"
-                        else eph.planet_posvel_ssb(nm, tdb_f64))
-                planet_pos[nm] = p - obs_pos
+        planet_pos = {nm: p - obs_pos for nm, p in planet_pv.items()}
         return tdb, obs_pos, obs_vel, planet_pos
 
     fn = jax.jit(pipeline)
-    _PIPELINE_JIT_CACHE[key] = (eph, fn)
+    _PIPELINE_JIT_CACHE.put_lru(key, (eph, fn))
     return fn
 
 
@@ -420,6 +444,13 @@ def build_TOAs_from_arrays(
     """
     eph = get_ephemeris(eph) if isinstance(eph, str) else eph
     n = int(np.shape(np.asarray(mjd_local.hi))[0])
+    if n == 0:
+        # the power-of-two padding below repeats the LAST row, which
+        # does not exist: x[-1:] on an empty array stays empty, so the
+        # pipeline would silently compile a shape-0 program instead of
+        # the intended bucket (and array-backed providers would see
+        # empty inputs)
+        raise ValueError("cannot build an empty TOA table (0 TOAs)")
     site_names = list(obs_names)
     obs_index = (np.zeros(n, dtype=np.int32) if obs_index is None
                  else np.asarray(obs_index, dtype=np.int32))
@@ -497,11 +528,18 @@ def build_TOAs_from_arrays(
         utc_f64 = np.asarray(utc.hi + utc.lo)
         check_cov(np.array([utc_f64.min() - 0.01, utc_f64.max() + 0.01]))
 
-    # bucket the TOA axis to the next power of two (pad by repeating the
-    # last row): the pipeline is elementwise over n, so padding is exact,
-    # and the whole suite / a whole session compiles ~log2(max n) fused
-    # programs instead of one per distinct TOA count
-    n_pad = max(16, 1 << (n - 1).bit_length())
+    # bucket the TOA axis (pad by repeating the last row): the pipeline
+    # is elementwise over n, so padding is exact, and the whole suite /
+    # a whole session compiles a bounded number of fused programs
+    # instead of one per distinct TOA count. Small n: next power of two
+    # (~log2 programs). Large n: next multiple of 1024 — a power-of-two
+    # bucket would waste up to 2x pipeline compute (e.g. 8824 -> 16384),
+    # which dominates big-N builds, while multiples of 1024 waste < 12%
+    # and real sessions use few distinct large sizes.
+    if n <= 8192:
+        n_pad = max(16, 1 << (n - 1).bit_length())
+    else:
+        n_pad = (n + 1023) & ~1023
 
     def _pad(x, fill=None):
         x = jnp.asarray(x)

@@ -1,0 +1,34 @@
+"""Bounded LRU mapping shared by the framework's jit-program caches.
+
+Three module-level caches hold compiled XLA programs keyed by host
+state (``timing_model._JIT_PROGRAM_CACHE``, ``toas._PIPELINE_JIT_CACHE``,
+``ephemeris._POSVEL_JIT_CACHE``); each must be bounded or id()-keyed
+entries pin executables (and the objects they close over) forever in
+long sessions. One implementation, one eviction policy.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class LRUCache(OrderedDict):
+    """OrderedDict with get-refreshes-recency and size-capped insertion."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self.maxsize = int(maxsize)
+
+    def get_lru(self, key):
+        """Value for ``key`` (refreshing its recency) or None."""
+        val = self.get(key)
+        if val is not None:
+            self.move_to_end(key)
+        return val
+
+    def put_lru(self, key, val):
+        """Insert and evict least-recently-used entries over the cap."""
+        self[key] = val
+        while len(self) > self.maxsize:
+            self.popitem(last=False)
+        return val

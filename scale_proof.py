@@ -33,11 +33,14 @@ import subprocess
 import sys
 import time
 
-import jax
+import pint_tpu
 
-# the axon sitecustomize force-selects the TPU platform; this proof is
-# a CPU-scaling measurement (see bench.py for the accelerator path)
-jax.config.update("jax_platforms", "cpu")
+# this proof is a CPU-scaling measurement (see bench.py for the
+# accelerator path); the library-level guard makes the pin stick
+# despite the axon sitecustomize's platform override
+pint_tpu.setup_platform("cpu")
+
+import jax  # noqa: E402
 # no persistent compile cache: XLA:CPU AOT reload is unsafe on this host
 # (machine-feature mismatch -> SIGILL; see tests/conftest.py)
 
@@ -252,7 +255,7 @@ def main() -> int:
     out = {"north_star": "68 psr / 6e5 TOAs full GLS iter < 30 s on v5e-8",
            "host": "single-core CPU (sandbox)", "results": results}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "SCALE_r03.json")
+                        "SCALE_r04.json")
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(json.dumps(out))

@@ -14,19 +14,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Force CPU: the sandbox env pins JAX_PLATFORMS=axon (single-TPU tunnel),
 # which must never be the test backend — DD arithmetic requires IEEE-exact
 # float64 and the multi-device mesh tests need the virtual CPU platform.
-# The axon sitecustomize overrides the env var via jax.config, so the
-# config entry (which wins) must be forced too, before any backend init.
+# The axon sitecustomize overrides the env var via jax.config; importing
+# pint_tpu re-applies the env var (pint_tpu.setup_platform — the one
+# library-level home of that workaround) before any backend init.
 # PINT_TPU_RUN_TPU_TESTS=1 keeps the accelerator platform visible so the
 # opt-in on-hardware tests (tests/test_pallas.py) can reach the chip —
 # only use it with a live tunnel and a targeted test selection.
 _want_tpu = os.environ.get("PINT_TPU_RUN_TPU_TESTS") == "1"
-
-import jax  # noqa: E402
-
 if not _want_tpu:
     os.environ["JAX_PLATFORMS"] = "cpu"
-    jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+
+import pint_tpu  # noqa: E402,F401  (applies JAX_PLATFORMS, enables x64)
+import jax  # noqa: E402
 
 # NO persistent XLA compilation cache on the CPU backend: this jaxlib's
 # XLA:CPU AOT deserialization is broken on this host (reloading a cached

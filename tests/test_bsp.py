@@ -132,7 +132,8 @@ _REAL_BSP = [os.path.join(_EPHEM_DIR, f) for f in
 
 @pytest.mark.skipif(not _REAL_BSP,
                     reason="PINT_TPU_EPHEM_DIR has no .bsp: no real JPL "
-                           "kernel on this zero-egress image")
+                           "kernel on this zero-egress image — see README 'To validate "
+                           "externally'")
 def test_real_jpl_kernel_physical_invariants():
     """Activates when a real JPL DE kernel is provided (VERDICT round-2
     task 7): the reader must recover physically correct orbits from real

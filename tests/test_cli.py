@@ -6,6 +6,7 @@ compare_parfiles reports parameter shifts; write_TOA_file round-trips.
 """
 
 import json
+import os
 import subprocess
 import sys
 
@@ -201,3 +202,20 @@ def test_value_with_unc_notation():
     assert value_with_unc(123.0, 9.99) == "123(10)"
     assert value_with_unc(123.0, 99.5) == "123(100)"
     assert value_with_unc(0.5, 0.0999) == "0.50(10)"
+
+
+def test_env_platform_honored_in_plain_script():
+    """Round-3 weak #4 repro: a plain user script run with
+    JAX_PLATFORMS=cpu must execute on the CPU backend instead of
+    hanging at accelerator init — `import pint_tpu` re-applies the env
+    var to jax.config (setup_platform), defeating any sitecustomize
+    platform override."""
+    code = ("import pint_tpu\n"
+            "import jax.numpy as jnp\n"
+            "x = jnp.arange(8.0)\n"
+            "print(x.sum().devices().pop().platform)\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert proc.stdout.strip().splitlines()[-1] == "cpu"

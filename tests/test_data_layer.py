@@ -315,7 +315,8 @@ _CLOCK_DIR = os.environ.get("PINT_TPU_CLOCK_DIR", "")
 
 @pytest.mark.skipif(not _CLOCK_DIR or not os.path.isdir(_CLOCK_DIR),
                     reason="PINT_TPU_CLOCK_DIR not set: no real clock "
-                           "products on this zero-egress image")
+                           "products on this zero-egress image — see README 'To "
+                           "validate externally'")
 def test_clock_real_products_parse_and_evaluate():
     """Activates when real IPTA clock products are provided: every file
     in the directory must parse to a monotone table that evaluates

@@ -48,7 +48,8 @@ pytestmark = pytest.mark.skipif(
     not _golden_available(),
     reason="external golden data absent: set PINT_TPU_GOLDEN_DIR to a "
            "directory holding NGC6440E.par, NGC6440E.tim, expected.json "
-           "(zero-egress image ships no copy; TOAs must not be fabricated)")
+           "(zero-egress image ships no copy; TOAs must not be fabricated) — "
+           "see README 'To validate externally'")
 
 
 @pytest.fixture(scope="module")

@@ -147,7 +147,7 @@ def _dense_reference(problems, models, gw):
     return x, cov, chi2, names_all, poff, C, off
 
 
-def _dense_chi2_at(problems, models, C, off):
+def _dense_chi2_at(problems, models, C):
     """Actual noise-marginalized chi2 r^T C^-1 r at the models' current
     values, with the gram's residual convention (scaled-weight mean
     subtraction, no offset profiling)."""
@@ -182,7 +182,7 @@ def test_pta_gls_matches_dense(pta_problems):
     chi2 = fitter.fit_toas(maxiter=1)
     assert np.isfinite(chi2)
 
-    x, cov, chi2_lin, names_all, poff, C, off = _dense_reference(
+    x, cov, chi2_lin, names_all, poff, C, _off = _dense_reference(
         pta_problems, models_b, fitter.gw)
     # the damped fitter reports the ACTUAL noise-marginalized chi2 at
     # the accepted point, not the linearized prediction: step the dense
@@ -193,7 +193,7 @@ def test_pta_gls_matches_dense(pta_problems):
         for j, name in enumerate(names_all[i]):
             if name != "Offset":
                 m[name].add_delta(float(x[poff[i] + j]))
-    chi2_ref = _dense_chi2_at(pta_problems, models_stepped, C, off)
+    chi2_ref = _dense_chi2_at(pta_problems, models_stepped, C)
     np.testing.assert_allclose(chi2, chi2_ref, rtol=1e-6)
 
     for i, m_b in enumerate(models_b):
@@ -227,8 +227,14 @@ def test_pta_damped_convergence(pta_problems):
     chi2_1 = f.fit_toas(maxiter=1)
     assert chi2_1 < chi2_start      # the single step went downhill...
     assert f.converged is False     # ...but the cap stopped the loop
+    f0_after_1 = [m["F0"].value_f64 for m in f.models]
     chi2_final = f.fit_toas(maxiter=10)
     assert f.converged is True
+    # the continuation must linearize around the CURRENT values, not a
+    # stale cached base (which would re-apply the first step on top of
+    # the already-updated parameters)
+    for m, f0_1 in zip(f.models, f0_after_1):
+        assert abs(m["F0"].value_f64 - f0_1) < 5 * m["F0"].uncertainty
     # the merit never increases across damped continuation
     assert chi2_final <= chi2_1 + 1e-9 * abs(chi2_1)
     for _, m in zip(pta_problems, f.models):
