@@ -50,6 +50,14 @@ def setup_platform(platform: str | None = None) -> None:
     want = platform or os.environ.get("JAX_PLATFORMS", "")
     if not want:
         return
+    # the env var is the pin accelerator-plugin stacks actually honor:
+    # a config-only update can still be raced by a plugin's lazy
+    # backend hook (observed round 4: jax.config.update("jax_platforms",
+    # "cpu") before any jax use still initialized the tunnel client at
+    # the first device_put, while JAX_PLATFORMS=cpu did not) — so an
+    # EXPLICIT platform request sets both.
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
     try:
         if str(_jax.config.jax_platforms or "") != want:
             _jax.config.update("jax_platforms", want)

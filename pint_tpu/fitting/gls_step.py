@@ -440,3 +440,18 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
                             "ecorr_coeffs": sol["ecorr_coeffs"]}
 
     return step
+
+
+def jitted_gls_step(model, *, pl_specs: tuple[PLSpec, ...] = ()):
+    """Jitted :func:`make_gls_step`, shared across fitter instances.
+
+    Same rationale as :func:`pint_tpu.fitting.step.jitted_wls_step`:
+    ``jax.jit(make_gls_step(model, ...))`` compiles per closure object,
+    so every new sharded/hybrid fitter over the same model structure
+    repays the full XLA compile. Routed through
+    ``TimingModel._cached_jit`` instead — one program per (structure
+    fingerprint, pl_specs); values flow through the traced ``base``.
+    """
+    return model._cached_jit(
+        ("gls_step", pl_specs),
+        lambda owner: make_gls_step(owner, pl_specs=pl_specs))

@@ -57,6 +57,119 @@ def accelerator_device():
     return cpu_device()
 
 
+def accel_mxu_mode(dev):
+    """Gram-arithmetic policy for an accelerator device (one place):
+    ``False`` = exact f64 (CPU devices — fastest there, and the
+    split-plumbing tests want exactness), ``"pallas"`` = hand-tiled
+    double-single kernel (real TPUs), ``True`` = XLA ds32 (any other
+    accelerator). Shared by ``HybridGLSFitter`` and ``PTAGLSFitter``.
+    """
+    if dev is None or dev.platform == "cpu":
+        return False
+    return "pallas" if dev.platform == "tpu" else True
+
+
+def run_stage2_with_fallback(owner, key, run):
+    """Shared pallas->ds32 fallback contract for the hybrid fitters.
+
+    ``run(mode)`` executes the stage-2 program under gram mode ``mode``;
+    ``owner`` holds the current mode in ``_mxu_mode`` and per-program
+    success keys in ``_stage2_ok_keys`` (a set). A failure under
+    ``"pallas"`` *before the first success of this program key* is
+    treated as a Mosaic lowering/compile failure: the owner is switched
+    to XLA ds32 (re-keying every later stage-2 build) and the call
+    retried. A failure after that key has succeeded is a real runtime
+    error and propagates. Keys give per-structure granularity: one
+    pulsar's successful pallas compile must not disable the fallback
+    for a differently-shaped pulsar (PTA heterogeneous structures).
+    """
+    mode = owner._mxu_mode
+    try:
+        out = run(mode)
+    except Exception:  # noqa: BLE001 — lowering failure only (see above)
+        if mode != "pallas" or key in owner._stage2_ok_keys:
+            raise
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pallas gram kernel failed to compile; "
+            "falling back to XLA ds32")
+        owner._mxu_mode = True
+        out = run(True)
+    owner._stage2_ok_keys.add(key)
+    return out
+
+
+def ship_stage2_statics(toas, noise, dev):
+    """Device-resident iteration-independent stage-2 inputs, shipped
+    once: ``(epoch_idx, ecorr_phi, pl_params, t_s, inv_f2)`` — the
+    positional argument contract of both hybrid stage-2 programs
+    (``HybridGLSFitter`` and :func:`pint_tpu.parallel.pta
+    .make_pta_stage2`). One definition so the argument order and the
+    ``inv_f2`` convention cannot drift between the two consumers.
+    """
+    from pint_tpu.models.noise import DM_FREF_MHZ
+
+    t_s = np.asarray(toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+    inv_f2 = np.square(DM_FREF_MHZ / np.asarray(toas.freq_mhz))
+    return tuple(jax.device_put(x, dev) for x in (
+        noise.epoch_idx, noise.ecorr_phi, noise.pl_params,
+        jnp.asarray(t_s), jnp.asarray(inv_f2)))
+
+
+def make_whiten_stage1(model, tzr=None):
+    """CPU stage-1 builder shared by the hybrid fitters: DD phase ->
+    whitened, column-normalized design, packed flat.
+
+    Everything DD-graded for one dataset — composed phase, residual
+    wrap, weighted-mean subtraction, jacfwd design matrix (one primal
+    pass serves both via ``has_aux``), whitening and unit column
+    normalization — packed into a single flat f64 buffer
+    ``[A_M.ravel() | rw | sw | norm_M]`` for one host->device transfer.
+    ``toas`` is a traced argument, so all same-structure datasets (the
+    68 PTA pulsars; repeated fitter constructions) share one compiled
+    program via ``TimingModel._cached_jit``. Consumed by
+    ``HybridGLSFitter`` and ``PTAGLSFitter``'s stage 2 — the packing
+    offsets are a contract between the two stages.
+    """
+    if tzr is None:
+        tzr = model.get_tzr_toas()
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=tzr is not None)
+    names = model.free_params
+    has_phoff = model.has_component("PhaseOffset")
+
+    def stage1(base, deltas, toas):
+        f0 = base["F0"].hi + base["F0"].lo
+
+        def total_phase(d):
+            ph = phase_fn(base, d, toas)
+            # aux carries the wrapped fractional phase from the SAME
+            # primal evaluation — one DD pipeline pass serves both
+            # the residual and the jacobian
+            return (ph.int_part + (ph.frac.hi + ph.frac.lo),
+                    ph.frac.hi + ph.frac.lo)
+
+        err = model.scaled_toa_uncertainty(toas)
+        w = 1.0 / jnp.square(err)
+        sw = jnp.sqrt(w)
+        J, resid = jax.jacfwd(total_phase, has_aux=True)(deltas)
+        if not has_phoff:
+            resid = resid - jnp.sum(resid * w) / jnp.sum(w)
+        r = resid / f0
+        cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
+            + [-J[k] / f0 for k in names]
+        M = jnp.stack(cols, axis=1)
+        # whiten + unit-normalize columns HERE: the accelerator's
+        # emulated f64 has f32 dynamic range, and sum(M^2 w) on raw
+        # spin-derivative columns overflows it (see gls_gram_whitened)
+        Mw = M * sw[:, None]
+        norm_M = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
+        norm_M = jnp.where(norm_M == 0.0, 1.0, norm_M)
+        return jnp.concatenate([(Mw / norm_M).ravel(), r * sw, sw, norm_M])
+
+    return stage1
+
+
 def _accel_pl_bases(t_s, inv_f2, specs: tuple[PLSpec, ...], pl_params):
     """pl_bases rebuilt from plain arrays (accelerator side)."""
     if not specs:
@@ -96,47 +209,22 @@ class HybridGLSFitter(Fitter):
         # subtraction (see TimingModel.designmatrix)
         has_phoff = model.has_component("PhaseOffset")
         self._off = 0 if has_phoff else 1
-        tzr = model.get_tzr_toas()
-        phase_fn = model.phase_fn_toas(tzr=tzr)
         toas_cpu = jax.device_put(toas, self.cpu)
+        # ONE flat stage-1 output buffer: the accelerator sits behind a
+        # transfer link whose per-transfer latency dominates at these
+        # sizes (observed in a round-2 TPU session: ~17 round trips cost
+        # ~0.7 s/iter, the on-chip compute <1 ms; committed artifact
+        # pending), so stage 1 packs everything iteration-dependent into
+        # a single array for a single host->device put (t_s/inv_f2 are
+        # TOA-only: shipped once). The builder is shared with the PTA
+        # hybrid and cached per model structure (make_whiten_stage1).
+        stage1_fn = model._cached_jit(
+            ("whiten_stage1",), lambda owner: make_whiten_stage1(owner))
 
         def stage1(base, deltas):
-            f0 = base["F0"].hi + base["F0"].lo
-
-            def total_phase(d):
-                ph = phase_fn(base, d, toas_cpu)
-                # aux carries the wrapped fractional phase from the SAME
-                # primal evaluation — one DD pipeline pass serves both
-                # the residual and the jacobian (has_aux below)
-                return (ph.int_part + (ph.frac.hi + ph.frac.lo),
-                        ph.frac.hi + ph.frac.lo)
-
-            err = model.scaled_toa_uncertainty(toas_cpu)
-            w = 1.0 / jnp.square(err)
-            sw = jnp.sqrt(w)
-            J, resid = jax.jacfwd(total_phase, has_aux=True)(deltas)
-            if not has_phoff:
-                resid = resid - jnp.sum(resid * w) / jnp.sum(w)
-            r = resid / f0
-            cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
-                + [-J[k] / f0 for k in names]
-            M = jnp.stack(cols, axis=1)
-            # whiten + unit-normalize columns HERE: the accelerator's
-            # emulated f64 has f32 dynamic range, and sum(M^2 w) on raw
-            # spin-derivative columns overflows it (see gls_gram_whitened)
-            Mw = M * sw[:, None]
-            norm_M = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
-            norm_M = jnp.where(norm_M == 0.0, 1.0, norm_M)
-            A_M = Mw / norm_M
-            rw = r * sw
-            # ONE flat output buffer: the accelerator sits behind a
-            # transfer link whose per-transfer latency dominates at
-            # these sizes (observed in a round-2 TPU session: ~17 round
-            # trips cost ~0.7 s/iter, the on-chip compute <1 ms;
-            # committed artifact pending), so stage 1 packs everything
-            # iteration-dependent into a single array for a single
-            # host->device put (t_s/inv_f2 are TOA-only: shipped once).
-            return jnp.concatenate([A_M.ravel(), rw, sw, norm_M])
+            with jax.default_device(self.cpu):
+                return stage1_fn(base, jax.device_put(deltas, self.cpu),
+                                 toas_cpu)
 
         pl_specs = self.pl_specs
         n_params = len(names) + (0 if has_phoff else 1)  # + offset column
@@ -148,18 +236,10 @@ class HybridGLSFitter(Fitter):
         self._q, self._ne = q, ne
 
         # noise statics and TOA-only arrays never change across
-        # iterations: ship them once
-        from pint_tpu.models.noise import DM_FREF_MHZ
-
-        t_s_host = np.asarray(toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
-        inv_f2_host = np.square(DM_FREF_MHZ / np.asarray(toas.freq_mhz))
-        self._noise_dev = (
-            jax.device_put(self.noise.epoch_idx, self.accel),
-            jax.device_put(self.noise.ecorr_phi, self.accel),
-            jax.device_put(self.noise.pl_params, self.accel),
-            jax.device_put(jnp.asarray(t_s_host), self.accel),
-            jax.device_put(jnp.asarray(inv_f2_host), self.accel),
-        )
+        # iterations: ship them once (shared argument contract —
+        # see ship_stage2_statics)
+        self._noise_dev = ship_stage2_statics(toas, self.noise,
+                                              self.accel)
 
         # on a real accelerator the O(n q^2) matmuls run as double-single
         # f32 on the MXU (emulated f64 matmul observed ~100x slower than
@@ -168,12 +248,8 @@ class HybridGLSFitter(Fitter):
         # the hand-tiled pallas kernel. The gradient and segment sums
         # stay exact f64. force_mxu overrides (tests exercise the ds32
         # path on CPU).
-        if self._force_mxu is not None:
-            use_mxu = self._force_mxu
-        elif self.accel.platform == "tpu":
-            use_mxu = "pallas"
-        else:
-            use_mxu = self.accel.platform != "cpu"
+        use_mxu = (self._force_mxu if self._force_mxu is not None
+                   else accel_mxu_mode(self.accel))
 
         def make_stage2(mxu_mode):
             def stage2(packed, epoch_idx, ecorr_phi, pl_params,
@@ -204,31 +280,22 @@ class HybridGLSFitter(Fitter):
                 ])
             return stage2
 
-        self._stage1 = jax.jit(stage1)
+        self._stage1 = stage1  # stage1_fn already jitted via _cached_jit
         self._make_stage2 = make_stage2
-        self._use_mxu = use_mxu
+        self._mxu_mode = use_mxu
         self._stage2 = jax.jit(make_stage2(use_mxu))
-        self._stage2_ok = False
+        self._stage2_mode = use_mxu
+        self._stage2_ok_keys: set = set()
 
     def _run_stage2(self, packed_dev):
-        try:
-            out = self._stage2(packed_dev, *self._noise_dev)
-        except Exception:  # noqa: BLE001
-            # fall back ONLY on the first call (i.e. a pallas lowering/
-            # compile failure on this backend); a runtime error after a
-            # successful compile is a real error and must propagate
-            if self._use_mxu != "pallas" or self._stage2_ok:
-                raise
-            import logging
+        def run(mode):
+            if mode != self._stage2_mode:
+                self._stage2 = jax.jit(self._make_stage2(mode))
+                self._stage2_mode = mode
+            return self._stage2(packed_dev, *self._noise_dev)
 
-            logging.getLogger(__name__).warning(
-                "pallas gram kernel failed to compile on %s; "
-                "falling back to XLA ds32", self.accel)
-            self._use_mxu = True
-            self._stage2 = jax.jit(self._make_stage2(True))
-            out = self._stage2(packed_dev, *self._noise_dev)
-        self._stage2_ok = True
-        return out
+        # single model structure -> one program key
+        return run_stage2_with_fallback(self, "stage2", run)
 
     def _iterate(self, base, deltas) -> tuple[dict, dict]:
         packed = self._stage1(base, deltas)

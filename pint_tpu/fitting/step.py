@@ -101,3 +101,27 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
 
         return step_unmasked
     return step
+
+
+def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
+                    params: list[str] | None = None, vmapped: bool = False):
+    """Jitted :func:`make_wls_step`, shared across fitter instances.
+
+    ``jax.jit(make_wls_step(model))`` compiles a fresh program per
+    *closure object*, so two fitters over the same model structure —
+    or repeated fits in a pintk/gridutils session — each pay the full
+    XLA compile. This routes the step through the same model-level
+    program cache as the host API (`TimingModel._cached_jit`): one
+    compiled step per (structure fingerprint, step config), with free
+    values flowing through the traced ``base``. ``vmapped`` builds the
+    batched (pulsar-axis) masked variant used by BatchedPulsarFitter.
+    """
+    key = ("wls_step", abs_phase, masked,
+           tuple(params) if params is not None else None, vmapped)
+
+    def build(owner):
+        fn = make_wls_step(owner, abs_phase=abs_phase, masked=masked,
+                           params=params)
+        return jax.vmap(fn, in_axes=(0, 0, 0, 0)) if vmapped else fn
+
+    return model._cached_jit(key, build)

@@ -72,8 +72,12 @@ def ds32_gram(A: Array, B: Array | None = None, *, block: int = 32768,
     b2 = b2.reshape(nb, block, q)
 
     def mm(x, y):  # (nb, B, p) x (nb, B, q) -> (nb, p, q), f32 on the MXU
+        # HIGHEST is load-bearing: at default precision the TPU MXU
+        # demotes f32 operands to bf16 (~2^-11 per product — observed
+        # on TPU v5e, round 4), which swamps the double-single split.
         return jax.lax.dot_general(
             x, y, (((1,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)
 
     g = (mm(a1, b1).astype(jnp.float64)

@@ -40,6 +40,9 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+_I32_ZERO = np.int32(0)
+
+
 def _gram_kernel(a1_ref, a2_ref, hi_ref, lo_ref):
     """One n-block: ds32 partial product + compensated accumulation."""
     import jax.experimental.pallas as pl
@@ -48,8 +51,12 @@ def _gram_kernel(a1_ref, a2_ref, hi_ref, lo_ref):
     a2 = a2_ref[:]
 
     def xtx(x, y):  # x^T y on the MXU, f32 accumulate
+        # HIGHEST is load-bearing: at default precision the TPU MXU
+        # demotes f32 operands to bf16 (~2^-11 per product — observed
+        # on TPU v5e, round 4), which swamps the double-single split.
         return jax.lax.dot_general(
             x, y, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)
 
     p = xtx(a1, a1) + (xtx(a1, a2) + xtx(a2, a1))
@@ -99,12 +106,16 @@ def ds32_gram_pallas(A: Array, *, block: int = 1024,
         _gram_kernel,
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((bn, qp), lambda i: (i, 0)),
-            pl.BlockSpec((bn, qp), lambda i: (i, 0)),
+            # index maps avoid python-int literals: under enable_x64 a
+            # literal 0 traces as i64 next to the i32 program id, and
+            # Mosaic rejects the (i32, i64) index tuple (observed on
+            # TPU v5e, round 4)
+            pl.BlockSpec((bn, qp), lambda i: (i, _I32_ZERO)),
+            pl.BlockSpec((bn, qp), lambda i: (i, _I32_ZERO)),
         ],
         out_specs=[
-            pl.BlockSpec((qp, qp), lambda i: (0, 0)),
-            pl.BlockSpec((qp, qp), lambda i: (0, 0)),
+            pl.BlockSpec((qp, qp), lambda i: (_I32_ZERO, _I32_ZERO)),
+            pl.BlockSpec((qp, qp), lambda i: (_I32_ZERO, _I32_ZERO)),
         ],
         out_shape=[out_shape, out_shape],
         interpret=interpret,

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.fitting.step import make_wls_step
+from pint_tpu.fitting.step import jitted_wls_step
 from pint_tpu.models.jump import PhaseJump
 from pint_tpu.models.noise import ScaleToaError
 from pint_tpu.models.parameter import materialize_selector_masks
@@ -293,10 +293,9 @@ class BatchedPulsarFitter:
         # params= is the fitter's free-param union — a parameter frozen in
         # the model that contributed the union component may still be free
         # in another pulsar (its column is masked per pulsar).
-        self.step = jax.jit(jax.vmap(
-            make_wls_step(self.union, abs_phase=False, masked=True,
-                          params=self.free_params),
-            in_axes=(0, 0, 0, 0)))
+        self.step = jitted_wls_step(self.union, abs_phase=False,
+                                    masked=True, params=self.free_params,
+                                    vmapped=True)
 
     def fit_toas(self, maxiter: int = 20,
                  min_chi2_decrease: float = 1e-3,

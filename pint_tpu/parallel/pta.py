@@ -42,6 +42,13 @@ from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
 
 Array = jax.Array
 
+# compiled stage-2 programs are model-free (see _stage2_prog): bounded
+# module-level cache keyed (gw, pl_specs, p, mode), shared across
+# fitters and pulsars
+from pint_tpu.utils.cache import LRUCache  # noqa: E402
+
+_STAGE2_CACHE = LRUCache(32)
+
 
 def hellings_downs(cos_theta) -> Array:
     """HD overlap-reduction coefficient for angular separation theta.
@@ -228,6 +235,55 @@ def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
     return gram
 
 
+def make_pta_stage2(gw: GWSpec, pl_specs, p: int, mxu):
+    """Accelerator stage of the hybrid PTA gram: bases + ds32 reduction.
+
+    Consumes stage 1's packed buffer (the CPU whitening stage shared
+    with ``HybridGLSFitter`` — :func:`pint_tpu.fitting.hybrid
+    .make_whiten_stage1`, whose ``[A_M.ravel() | rw | sw | norm_M]``
+    packing is the contract here), rebuilds the per-pulsar PL and
+    common-grid GW Fourier blocks ON DEVICE from ``t_s`` (never shipped
+    per iteration), and runs the whitened Gram reduction with ECORR
+    Schur elimination (:func:`pint_tpu.fitting.gls_step
+    .gls_gram_whitened`) — the O(n q^2) FLOPs of the joint PTA fit, on
+    the MXU as double-single f32 when ``mxu`` is set. GW columns carry
+    no per-pulsar prior (the HD-coupled prior is added globally):
+    ``phi = inf`` makes their prior diagonal exactly zero. Output is one
+    packed buffer ``[S.ravel() | rhs | norm | chi2_base]`` for a single
+    device->host fetch.
+    """
+    from pint_tpu.fitting.gls_step import gls_gram_whitened
+    from pint_tpu.fitting.hybrid import _accel_pl_bases
+
+    def stage2(packed, epoch_idx, ecorr_phi, pl_params, t_s, inv_f2):
+        n = t_s.shape[0]
+        o = n * p
+        A_M = packed[:o].reshape(n, p)
+        rw = packed[o:o + n]; o += n
+        sw = packed[o:o + n]; o += n
+        norm_M = packed[o:o + p]
+        F_pl, phi_pl = _accel_pl_bases(t_s, inv_f2, pl_specs, pl_params)
+        F_gw, _, _ = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
+                                    tspan=gw.tspan_s)
+        phi_inf = jnp.full(2 * gw.nharm, jnp.inf)
+        if F_pl is not None:
+            F = jnp.concatenate([F_pl, F_gw], axis=1)
+            phi_F = jnp.concatenate([phi_pl, phi_inf])
+        else:
+            F, phi_F = F_gw, phi_inf
+        parts = gls_gram_whitened(A_M, rw, sw, norm_M, F, phi_F,
+                                  epoch_idx, ecorr_phi, mxu=mxu)
+        chi2_base = parts["quad0"]
+        if parts["d"].shape[0] > 0:
+            chi2_base = chi2_base - jnp.sum(jnp.square(parts["c_e"])
+                                            / parts["d"])
+        return jnp.concatenate([parts["S"].ravel(), parts["rhs"],
+                                parts["norm"],
+                                jnp.reshape(chi2_base, (1,))])
+
+    return stage2
+
+
 class PTAGLSFitter:
     """Joint GLS over a pulsar array with an HD-correlated GW background.
 
@@ -240,12 +296,42 @@ class PTAGLSFitter:
     """
 
     def __init__(self, problems, *, gw_log10_amp: float, gw_gamma: float,
-                 gw_nharm: int = 20, mesh=None):
+                 gw_nharm: int = 20, mesh=None, accel=None):
         if not problems:
             raise ValueError("no problems given")
         self.toas_list = [t for t, _ in problems]
         self.models = [m for _, m in problems]
         self.mesh = mesh
+        # hybrid CPU-DD -> accelerator-gram split (same architecture as
+        # fitting.hybrid.HybridGLSFitter): auto-enabled when the default
+        # backend is an accelerator (whose emulated f64 cannot run the
+        # DD pipeline — pint_tpu.ops.dd) and no CPU mesh is requested.
+        # ``accel``: None = auto, False = off, True = force (error when
+        # unsatisfiable), or an explicit device.
+        from pint_tpu.fitting import hybrid as _hybrid
+
+        if accel not in (None, False) and mesh is not None:
+            raise ValueError("accel= and mesh= are mutually exclusive: "
+                             "the hybrid split places stage 1 on the "
+                             "host CPU, the CPU mesh shards it")
+        if accel is False or mesh is not None:
+            self.accel_dev = None
+        elif accel is None or accel is True:
+            dev = _hybrid.accelerator_device()
+            if accel is True and dev.platform == "cpu":
+                raise ValueError("accel=True but no accelerator device "
+                                 "is attached (pass an explicit device "
+                                 "to run the split plumbing on CPU)")
+            auto_on = accel is True or jax.default_backend() != "cpu"
+            self.accel_dev = dev if (dev.platform != "cpu" and auto_on) \
+                else None
+        else:
+            self.accel_dev = accel
+        # gram-arithmetic mode + pallas fallback state: shared policy
+        # with HybridGLSFitter (fitting.hybrid.accel_mxu_mode /
+        # run_stage2_with_fallback)
+        self._mxu_mode = _hybrid.accel_mxu_mode(self.accel_dev)
+        self._stage2_ok_keys: set = set()
 
         t_all = [np.asarray(t.tdb.hi + t.tdb.lo) * SECS_PER_DAY
                  for t in self.toas_list]
@@ -271,7 +357,6 @@ class PTAGLSFitter:
         self.chi2: float | None = None
         self.converged: bool = False
         self.gw_coeffs: np.ndarray | None = None
-        self._gram_cache: dict = {}  # model structure -> jitted gram program
         self._prepared = None        # delta-independent per-pulsar state
         # common GW per-frequency prior phi_gw (f on the shared grid)
         f = np.arange(1, self.gw.nharm + 1) / self.gw.tspan_s
@@ -291,19 +376,30 @@ class PTAGLSFitter:
         if self._prepared is not None:
             return self._prepared
         prepared = []
-        cache = self._gram_cache
+        cpu = (None if self.accel_dev is None
+               else jax.devices("cpu")[0])
         for toas, model in zip(self.toas_list, self.models):
             noise, pl_specs = build_noise_statics(model, toas)
-            # one executable per model *structure*: FREE values flow
-            # through the traced `base` and PL hyperparameters through
-            # `noise.pl_params`; everything a compiled closure pins is
-            # captured by the SAME fingerprint the TimingModel program
-            # cache uses (frozen/non-numeric values, selectors, header
-            # — one policy, one place). Same-structure pulsars with
-            # identical frozen values (the 68-pulsar scale_proof
-            # config) share ONE compiled gram.
-            key = (model._fn_fingerprint(), tuple(model.free_params),
-                   pl_specs, len(toas))
+            if self.accel_dev is not None:
+                from pint_tpu.fitting.hybrid import (make_whiten_stage1,
+                                                     ship_stage2_statics)
+
+                p = (len(model.free_params)
+                     + (0 if model.has_component("PhaseOffset") else 1))
+                k_pl = int(sum(2 * s.nharm for s in pl_specs))
+                stage1 = model._cached_jit(
+                    ("whiten_stage1",),
+                    lambda owner: make_whiten_stage1(owner))
+                dev_args = ship_stage2_statics(toas, noise, self.accel_dev)
+                # stage2 is NOT pinned here: _run_hybrid resolves it per
+                # call through the bounded program cache, so a pallas->
+                # ds32 fallback (self._mxu_mode switch) propagates to
+                # every pulsar and iteration instead of leaving stale
+                # pallas programs in the prepared state
+                prepared.append(("hybrid", (stage1, model, pl_specs,
+                                            p, k_pl),
+                                 jax.device_put(toas, cpu), dev_args))
+                continue
             if self.mesh is not None:
                 from pint_tpu.fitting.gls_step import pad_noise_statics
                 from pint_tpu.parallel.mesh import (pad_to_multiple,
@@ -321,11 +417,62 @@ class PTAGLSFitter:
                     jax.device_put(noise.ecorr_phi, rep),
                     jax.device_put(noise.pl_params, rep),
                 )
-            if key not in cache:
-                cache[key] = jax.jit(make_pta_gram(model, self.gw, pl_specs))
-            prepared.append((cache[key], toas, noise, model))
+            # one executable per model *structure*, shared through the
+            # SAME model-level program cache as the host API
+            # (`TimingModel._cached_jit`): FREE values flow through the
+            # traced `base`, PL hyperparameters through
+            # `noise.pl_params`, and everything a compiled closure pins
+            # is captured by the model fingerprint. Same-structure
+            # pulsars (the 68-pulsar scale_proof config) — and
+            # same-structure fitters across a session — share ONE
+            # compiled gram; jit respecializes per TOA count/sharding.
+            gram = model._cached_jit(
+                ("pta_gram", self.gw, pl_specs),
+                lambda owner, _pl=pl_specs: make_pta_gram(owner, self.gw,
+                                                          _pl))
+            prepared.append(("plain", gram, toas, noise, model))
         self._prepared = prepared
         return prepared
+
+    def _stage2_prog(self, pl_specs, p: int, mode):
+        # stage2 never reads the model (everything model-shaped arrived
+        # via stage 1's packed buffer), so the cache is module-level and
+        # model-free: 68 pulsars with distinct frozen values but equal
+        # (gw, pl_specs, p, mode) share ONE compiled program per shape
+        key = (self.gw, pl_specs, p, mode)
+        prog = _STAGE2_CACHE.get_lru(key)
+        if prog is None:
+            prog = _STAGE2_CACHE.put_lru(
+                key, jax.jit(make_pta_stage2(self.gw, pl_specs, p, mode)))
+        return prog
+
+    def _run_hybrid(self, meta, toas_cpu, dev_args, base, deltas):
+        """stage1 on the CPU, one upload, stage2 on the chip, one fetch."""
+        stage1, model, pl_specs, p, k_pl = meta
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            packed = stage1(jax.device_put(base, cpu),
+                            jax.device_put(deltas, cpu), toas_cpu)
+        packed_dev = jax.device_put(packed, self.accel_dev)
+        # shared pallas->ds32 fallback (fitting.hybrid): the mode is
+        # threaded explicitly so a fallback retry cannot silently rerun
+        # the failing program; the ok-key is per *compiled shape* —
+        # (pl_specs, p, n) — since pallas lowering failures can depend
+        # on any of them, and one pulsar's success must not disable the
+        # fallback for a differently shaped one.
+        from pint_tpu.fitting.hybrid import run_stage2_with_fallback
+
+        n = int(dev_args[3].shape[0])  # t_s
+        out = run_stage2_with_fallback(
+            self, (pl_specs, p, n),
+            lambda mode: self._stage2_prog(pl_specs, p, mode)(
+                packed_dev, *dev_args))
+        out = np.asarray(out)  # ONE device->host fetch
+        q = k_pl + 2 * self.gw.nharm + p
+        o = q * q
+        return {"S": out[:o].reshape(q, q), "rhs": out[o:o + q],
+                "norm": out[o + q:o + 2 * q], "chi2_base": out[-1],
+                "p": p, "k_pl": k_pl}
 
     def _grams(self, deltas_list=None):
         """Run the per-pulsar Gram program for every pulsar.
@@ -335,11 +482,22 @@ class PTAGLSFitter:
         evaluation); ``None`` means zeros.
         """
         out = []
-        for i, (gram, toas, noise, model) in enumerate(self._prepare()):
+        for i, entry in enumerate(self._prepare()):
             # base is rebuilt per call (cheap numpy scalars), NOT cached
             # in _prepare: fit_toas mutates the models' values, and a
             # stale cached linearization point would silently
             # double-apply deltas on a second fit
+            if entry[0] == "hybrid":
+                _, meta, toas_cpu, dev_args = entry
+                model = meta[1]
+                deltas = model.zero_deltas()
+                if deltas_list is not None:
+                    deltas = {k: jnp.asarray(deltas_list[i][k], jnp.float64)
+                              for k in deltas}
+                out.append(self._run_hybrid(meta, toas_cpu, dev_args,
+                                            model.base_dd(), deltas))
+                continue
+            _, gram, toas, noise, model = entry
             base = model.base_dd()
             deltas = model.zero_deltas()
             if deltas_list is not None:

@@ -21,8 +21,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pint_tpu.fitting.damped import downhill_iterate
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
-                                       make_gls_step, pad_noise_statics)
-from pint_tpu.fitting.step import make_wls_step
+                                       jitted_gls_step, pad_noise_statics)
+from pint_tpu.fitting.step import jitted_wls_step
 from pint_tpu.parallel.mesh import (make_mesh, pad_to_multiple, replicate,
                                     shard_toas)
 from pint_tpu.toas import Flags, TOAs
@@ -72,7 +72,7 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2):
     n_shards = mesh.shape["toa"]
     padded = pad_toas(toas, pad_to_multiple(len(toas), n_shards))
     toas_sh = shard_toas(padded, mesh)
-    step = jax.jit(make_wls_step(model))
+    step = jitted_wls_step(model)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
     with mesh:
@@ -134,7 +134,7 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
         ecorr_phi=jax.device_put(noise.ecorr_phi, rep),
         pl_params=jax.device_put(noise.pl_params, rep),
     )
-    step = jax.jit(make_gls_step(model, pl_specs=pl_specs))
+    step = jitted_gls_step(model, pl_specs=pl_specs)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
     with mesh:

@@ -16,9 +16,12 @@ from pint_tpu.ops.pallas_gram import ds32_gram_pallas, gram_error_bound
 @pytest.mark.parametrize("n,q,block", [(640, 20, 128), (137, 5, 64)])
 def test_pallas_gram_matches_f64(n, q, block):
     rng = np.random.default_rng(0)
-    A = jnp.asarray(rng.standard_normal((n, q)) / np.sqrt(n))
+    A_host = rng.standard_normal((n, q)) / np.sqrt(n)
+    A = jnp.asarray(A_host)
     G = np.asarray(ds32_gram_pallas(A, interpret=True, block=block))
-    G_ref = np.asarray(A.T @ A)
+    # reference on the HOST: on an accelerator backend A.T @ A would run
+    # in emulated f64 whose own accuracy is the thing under test
+    G_ref = A_host.T @ A_host
     scale = np.max(np.abs(G_ref))
     assert np.max(np.abs(G - G_ref)) / scale < 10 * gram_error_bound(n, block)
     # symmetric by construction

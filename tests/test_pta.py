@@ -52,21 +52,24 @@ SKY = [("04:37:15.9", "-47:15:09.1"), ("17:13:49.5", "07:47:37.5"),
 GW_AMP, GW_GAM, GW_NHARM = -13.8, 4.33, 3
 
 
-def _mkpar(i):
+def _mkpar(i, *, homog: bool = False):
     # per-pulsar EFAC: frozen white-noise values are BAKED into compiled
     # grams (scale_sigma reads them at trace time), so heterogeneous
     # EFACs here make the dense-parity test fail if the gram cache ever
-    # shares programs across different frozen values
+    # shares programs across different frozen values. ``homog`` pins
+    # EFAC/TNREDAMP uniform (sky/spin/DM stay distinct but FREE, so
+    # they flow through the traced base): the non-parity tests use it
+    # so all four pulsars share ONE compiled gram structure.
     return PAR_TMPL.format(i=i, raj=SKY[i][0], decj=SKY[i][1],
                            f0=300.0 + 13.0 * i, dm=20.0 + 5.0 * i,
-                           redamp=-13.6 - 0.2 * i, efac=1.1 + 0.15 * i)
+                           redamp=-13.6 if homog else -13.6 - 0.2 * i,
+                           efac=1.1 if homog else 1.1 + 0.15 * i)
 
 
-@pytest.fixture(scope="module")
-def pta_problems():
+def _build_problems(*, homog: bool):
     problems = []
     for i in range(4):
-        model = get_model(_mkpar(i))
+        model = get_model(_mkpar(i, homog=homog))
         # same TOA count per pulsar: heterogeneity under test is in the
         # sky positions / spin / per-pulsar red-noise amplitudes;
         # distinct counts would only fragment XLA programs by shape
@@ -81,10 +84,24 @@ def pta_problems():
     return problems
 
 
-def _perturbed_models():
+@pytest.fixture(scope="module")
+def pta_problems():
+    return _build_problems(homog=False)
+
+
+@pytest.fixture(scope="module")
+def pta_problems_homog():
+    """Structure-identical pulsars: the damped/sharded tests exercise
+    loop semantics and sharding parity, not frozen-value heterogeneity
+    (test_pta_gls_matches_dense covers that), so they share ONE
+    compiled gram across all four pulsars and both fitter instances."""
+    return _build_problems(homog=True)
+
+
+def _perturbed_models(*, homog: bool = False):
     models = []
     for i in range(4):
-        m = get_model(_mkpar(i))
+        m = get_model(_mkpar(i, homog=homog))
         m["F0"].add_delta(2e-10)
         models.append(m)
     return models
@@ -213,15 +230,15 @@ def test_pta_gls_matches_dense(pta_problems):
     assert fitter.gw_coeffs.shape == (4, 2 * GW_NHARM)
 
 
-def test_pta_damped_convergence(pta_problems):
+def test_pta_damped_convergence(pta_problems_homog):
     """Damped contract (round-3 task 2): from a deliberately bad start
     the loop only accepts downhill steps, and ``converged`` reports
     truthfully — False when the iteration cap stops a still-improving
     fit, True once no meaningful decrease remains."""
-    models = _perturbed_models()
+    models = _perturbed_models(homog=True)
     for m in models:
         m["F0"].add_delta(5e-10)  # far outside the noise (no phase wrap)
-    f = PTAGLSFitter([(t, m) for (t, _), m in zip(pta_problems, models)],
+    f = PTAGLSFitter([(t, m) for (t, _), m in zip(pta_problems_homog, models)],
                      gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM)
     chi2_start = f.step(f.zero_flat())[1]["chi2_at_input"]
     chi2_1 = f.fit_toas(maxiter=1)
@@ -237,19 +254,21 @@ def test_pta_damped_convergence(pta_problems):
         assert abs(m["F0"].value_f64 - f0_1) < 5 * m["F0"].uncertainty
     # the merit never increases across damped continuation
     assert chi2_final <= chi2_1 + 1e-9 * abs(chi2_1)
-    for _, m in zip(pta_problems, f.models):
+    for _, m in zip(pta_problems_homog, f.models):
         assert np.isfinite(m["F0"].uncertainty) and m["F0"].uncertainty > 0
 
 
-def test_pta_gls_sharded_mesh(pta_problems):
+def test_pta_gls_sharded_mesh(pta_problems_homog):
     """Same joint fit with every pulsar's TOA axis sharded over 8 devices."""
-    models_a = _perturbed_models()
-    models_b = _perturbed_models()
-    f1 = PTAGLSFitter([(t, m) for (t, _), m in zip(pta_problems, models_a)],
+    models_a = _perturbed_models(homog=True)
+    models_b = _perturbed_models(homog=True)
+    f1 = PTAGLSFitter([(t, m) for (t, _), m
+                       in zip(pta_problems_homog, models_a)],
                       gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM)
     c1 = f1.fit_toas(maxiter=2)
     mesh = make_mesh(8, psr_axis=1)
-    f2 = PTAGLSFitter([(t, m) for (t, _), m in zip(pta_problems, models_b)],
+    f2 = PTAGLSFitter([(t, m) for (t, _), m
+                       in zip(pta_problems_homog, models_b)],
                       gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM,
                       mesh=mesh)
     c2 = f2.fit_toas(maxiter=2)
@@ -258,6 +277,38 @@ def test_pta_gls_sharded_mesh(pta_problems):
         for name in m_a.free_params:
             np.testing.assert_allclose(m_b[name].value_f64, m_a[name].value_f64,
                                        rtol=0, atol=1e-3 * m_a[name].uncertainty)
+
+
+def test_pta_hybrid_split_matches_plain(pta_problems_homog):
+    """The hybrid CPU-stage1/accel-stage2 split (run here with an
+    explicit CPU 'accelerator': exact f64, so parity is tight) must
+    reproduce the plain in-one-program gram path bit-for-bit at the
+    fit level — the split is a layout, not an algorithm change."""
+    import jax
+
+    models_a = _perturbed_models(homog=True)
+    models_b = _perturbed_models(homog=True)
+    f_plain = PTAGLSFitter(
+        [(t, m) for (t, _), m in zip(pta_problems_homog, models_a)],
+        gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM)
+    assert f_plain.accel_dev is None  # auto stays off on a CPU backend
+    c_plain = f_plain.fit_toas(maxiter=2)
+    f_hyb = PTAGLSFitter(
+        [(t, m) for (t, _), m in zip(pta_problems_homog, models_b)],
+        gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM,
+        accel=jax.devices("cpu")[0])
+    assert f_hyb.accel_dev is not None
+    c_hyb = f_hyb.fit_toas(maxiter=2)
+    np.testing.assert_allclose(c_hyb, c_plain, rtol=1e-9)
+    for m_a, m_b in zip(models_a, models_b):
+        for name in m_a.free_params:
+            np.testing.assert_allclose(
+                m_b[name].value_f64, m_a[name].value_f64, rtol=0,
+                atol=1e-6 * max(m_a[name].uncertainty, 1e-30),
+                err_msg=name)
+            np.testing.assert_allclose(m_b[name].uncertainty,
+                                       m_a[name].uncertainty, rtol=1e-6,
+                                       err_msg=name)
 
 
 def test_pta_heterogeneous_structures():

@@ -1,0 +1,227 @@
+"""On-hardware evidence capture → TPU_EVIDENCE_r04.json (incremental).
+
+Four rounds of VERDICTs have demanded a committed artifact measured on
+the chip in this project's name; the axon tunnel is alive only in
+unpredictable windows and hangs without warning (observed rounds 1-4).
+This script therefore records evidence *incrementally*: every step
+rewrites the JSON before moving on, so a mid-run hang still leaves the
+steps that completed on disk. Run under an external `timeout`; rerun
+freely (steps are independent).
+
+Steps (each bounded, each try/except):
+1. backend/device identity
+2. DD self-check on-chip (error-free transforms under emulated f64 —
+   the fact behind the hybrid CPU-DD/TPU-solve design, pint_tpu.ops.dd)
+3. emulated-f64 matmul accuracy at default vs HIGHEST precision
+   (documents why on-device f64 references are untrustworthy)
+4. XLA double-single Gram (ops/mxu.ds32_gram): accuracy vs host f64 +
+   wall-clock vs the chip's emulated-f64 matmul (the ~100x claim)
+5. pallas kernel (ops/pallas_gram): interpret-mode accuracy on the
+   chip, then the real Mosaic-lowered kernel — compile, accuracy,
+   wall-clock
+6. hybrid GLS iteration (fitting/hybrid): end-to-end wall + stage split
+   at PINT_TPU_EVIDENCE_N TOAs (default 100k)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import pint_tpu  # noqa: F401  (x64 + platform guard)
+import jax
+import jax.numpy as jnp
+
+OUT = os.environ.get("PINT_TPU_EVIDENCE_OUT", "TPU_EVIDENCE_r04.json")
+N_HYBRID = int(os.environ.get("PINT_TPU_EVIDENCE_N", "100000"))
+
+results: dict = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "steps_completed": []}
+
+
+def _save() -> None:
+    with open(OUT, "w") as fh:
+        json.dump(results, fh, indent=1)
+        fh.write("\n")
+
+
+# a hang at backend init is itself evidence: record the attempt before
+# touching the backend, so a killed run leaves a diagnostic on disk
+results["note"] = ("incomplete => the axon tunnel hung before the first "
+                   "step finished (steps_completed lists what ran)")
+_save()
+
+
+def step(name: str):
+    def deco(fn):
+        t0 = time.perf_counter()
+        try:
+            out = fn()
+            out = dict(out or {})
+            out["elapsed_s"] = round(time.perf_counter() - t0, 3)
+            results[name] = out
+            results["steps_completed"].append(name)
+            print(f"[ok] {name}: {out}", flush=True)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:500],
+                             "elapsed_s": round(time.perf_counter() - t0, 3)}
+            print(f"[FAIL] {name}: {results[name]['error']}", flush=True)
+        _save()
+        return fn
+    return deco
+
+
+@step("backend")
+def _backend():
+    devs = jax.devices()
+    return {"backend": jax.default_backend(),
+            "devices": [str(d) for d in devs],
+            "platform": devs[0].platform}
+
+
+@step("dd_self_check")
+def _dd():
+    from pint_tpu.ops import dd as dd_mod
+
+    return {"on_chip": bool(dd_mod.self_check()),
+            "note": "False => emulated f64 breaks error-free transforms; "
+                    "DD phase pipeline must run on host CPU (hybrid split)"}
+
+
+def _timeit(fn, reps=5):
+    fn()  # warm/compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+@step("emulated_f64_matmul_accuracy")
+def _emulated():
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((4096, 24)) / 64.0
+    G_host = A.T @ A
+    scale = np.max(np.abs(G_host))
+    Ad = jnp.asarray(A)
+
+    def rel(prec):
+        f = jax.jit(lambda x: jax.lax.dot_general(
+            x, x, (((0,), (0,)), ((), ())), precision=prec))
+        return float(np.max(np.abs(np.asarray(f(Ad)) - G_host)) / scale)
+
+    return {"rel_err_default": rel(jax.lax.Precision.DEFAULT),
+            "rel_err_highest": rel(jax.lax.Precision.HIGHEST),
+            "f64_eps": 2.2e-16, "f32_eps": 1.2e-7,
+            "note": "on-device f64 matmul error at each precision vs "
+                    "exact host f64 (n=4096, q=24, O(1) entries)"}
+
+
+@step("ds32_gram_xla")
+def _mxu():
+    from pint_tpu.ops.mxu import ds32_gram, ds32_gram_error_bound
+
+    rng = np.random.default_rng(1)
+    n, q = 100_000, 72
+    A = rng.standard_normal((n, q)) / np.sqrt(n)
+    G_host = A.T @ A
+    scale = np.max(np.abs(G_host))
+    Ad = jnp.asarray(A)
+
+    G = np.asarray(ds32_gram(Ad))
+    t_ds32 = _timeit(lambda: ds32_gram(Ad))
+    mm = jax.jit(lambda x: jax.lax.dot_general(
+        x, x, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST))
+    t_f64 = _timeit(lambda: mm(Ad))
+    return {"n": n, "q": q,
+            "rel_err": float(np.max(np.abs(G - G_host)) / scale),
+            "error_bound": ds32_gram_error_bound(n),
+            "wall_s_ds32": round(t_ds32, 6),
+            "wall_s_emulated_f64": round(t_f64, 6),
+            "speedup_vs_emulated_f64": round(t_f64 / t_ds32, 2)}
+
+
+@step("pallas_gram_interpret")
+def _pallas_interp():
+    from pint_tpu.ops.pallas_gram import ds32_gram_pallas, gram_error_bound
+
+    rng = np.random.default_rng(2)
+    n, q, block = 640, 20, 128
+    A = rng.standard_normal((n, q)) / np.sqrt(n)
+    G = np.asarray(ds32_gram_pallas(jnp.asarray(A), interpret=True,
+                                    block=block))
+    G_host = A.T @ A
+    scale = np.max(np.abs(G_host))
+    return {"rel_err": float(np.max(np.abs(G - G_host)) / scale),
+            "error_bound": gram_error_bound(n, block)}
+
+
+@step("pallas_gram_hardware")
+def _pallas_hw():
+    from pint_tpu.ops.pallas_gram import ds32_gram_pallas, gram_error_bound
+
+    rng = np.random.default_rng(3)
+    n, q, block = 4096, 24, 512
+    A = rng.standard_normal((n, q)) / np.sqrt(n)
+    Ad = jnp.asarray(A)
+    t0 = time.perf_counter()
+    G = np.asarray(ds32_gram_pallas(Ad, interpret=False, block=block))
+    compile_s = time.perf_counter() - t0
+    t = _timeit(lambda: ds32_gram_pallas(Ad, interpret=False, block=block))
+    G_host = A.T @ A
+    scale = np.max(np.abs(G_host))
+    return {"n": n, "q": q, "block": block,
+            "rel_err": float(np.max(np.abs(G - G_host)) / scale),
+            "error_bound": gram_error_bound(n, block),
+            "finite": bool(np.isfinite(G).all()),
+            "compile_s": round(compile_s, 3),
+            "wall_s": round(t, 6)}
+
+
+@step("hybrid_gls_iteration")
+def _hybrid():
+    from bench import build_problem
+    from pint_tpu.fitting.hybrid import HybridGLSFitter
+
+    model, toas = build_problem(N_HYBRID)
+    f = HybridGLSFitter(toas, model)
+    base = jax.device_put(model.base_dd(), f.cpu)
+    deltas = {k: jnp.zeros((), jnp.float64) for k in f._names}
+
+    t0 = time.perf_counter()
+    _, sol = f._iterate(base, deltas)
+    jax.block_until_ready(sol["chi2"])
+    compile_s = time.perf_counter() - t0
+
+    times, s1_times = [], []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        s1 = f._stage1(base, deltas)
+        jax.block_until_ready(s1)
+        s1_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _, sol = f._iterate(base, deltas)
+        jax.block_until_ready(sol["chi2"])
+        times.append(time.perf_counter() - t0)
+    value = float(np.median(times))
+    s1 = float(np.median(s1_times))
+    return {"n_toas": N_HYBRID,
+            "wall_s": round(value, 6),
+            "stage1_cpu_s": round(s1, 6),
+            "stage2_accel_s": round(max(value - s1, 0.0), 6),
+            "compile_s": round(compile_s, 3),
+            "chi2": round(float(np.asarray(sol["chi2"])), 3),
+            "vs_baseline_budget": round(30.0 * (N_HYBRID / 6e5) / value, 3)}
+
+
+results["note"] = (f"{len(results['steps_completed'])}/6 steps ran to "
+                   "completion (per-step 'error' keys mark failures)")
+_save()
+
+if __name__ == "__main__":
+    print(json.dumps(results))
