@@ -358,6 +358,7 @@ class PTAGLSFitter:
         self.converged: bool = False
         self.gw_coeffs: np.ndarray | None = None
         self._prepared = None        # delta-independent per-pulsar state
+        self._batched = None         # stacked hybrid state (uniform shapes)
         # common GW per-frequency prior phi_gw (f on the shared grid)
         f = np.arange(1, self.gw.nharm + 1) / self.gw.tspan_s
         self._phi_gw = np.repeat(np.asarray(powerlaw_phi(
@@ -432,27 +433,100 @@ class PTAGLSFitter:
                                                           _pl))
             prepared.append(("plain", gram, toas, noise, model))
         self._prepared = prepared
+        self._prepare_batched(prepared)
         return prepared
 
-    def _stage2_prog(self, pl_specs, p: int, mode):
+    def _prepare_batched(self, prepared):
+        """Stack the hybrid per-pulsar state when shapes are uniform.
+
+        The north-star config (68 same-structure pulsars) then runs ONE
+        vmapped stage-2 dispatch per joint evaluation — one stacked
+        host->device upload and one device->host fetch instead of P of
+        each (the tunnel's per-transfer latency dominates at these
+        sizes; see fitting.hybrid). Heterogeneous shapes keep the
+        per-pulsar path.
+        """
+        self._batched = None
+        if self.accel_dev is None or len(prepared) < 2:
+            return
+        if not all(e[0] == "hybrid" for e in prepared):
+            return
+        metas = [e[1] for e in prepared]
+        shapes = {(m[2], m[3], m[4]) for m in metas}  # (pl_specs, p, k_pl)
+        arg_shapes = {tuple(a.shape for a in e[3]) for e in prepared}
+        if len(shapes) > 1 or len(arg_shapes) > 1:
+            return
+        self._batched = tuple(
+            jnp.stack([e[3][j] for e in prepared])
+            for j in range(len(prepared[0][3])))
+
+    def _grams_batched(self, prepared, deltas_list):
+        """One vmapped stage-2 evaluation over all (uniform) pulsars."""
+        from pint_tpu.fitting.hybrid import run_stage2_with_fallback
+
+        cpu = jax.devices("cpu")[0]
+        packs = []
+        for i, (_, meta, toas_cpu, _da) in enumerate(prepared):
+            stage1, model = meta[0], meta[1]
+            packs.append(self._stage1_pack(
+                stage1, model, self._deltas_for(model, deltas_list, i),
+                toas_cpu))
+        _, _, pl_specs, p, k_pl = prepared[0][1]
+        with jax.default_device(cpu):
+            stacked = jnp.stack(packs)
+        stacked_dev = jax.device_put(stacked, self.accel_dev)
+        n = int(self._batched[3].shape[1])  # t_s is (P, n)
+
+        def run(mode):
+            return self._stage2_prog(pl_specs, p, mode,
+                                     vmapped=True)(stacked_dev,
+                                                   *self._batched)
+
+        out = np.asarray(run_stage2_with_fallback(
+            self, (pl_specs, p, n, "vmapped"), run)
+        )  # ONE device->host fetch for the whole array
+        q = k_pl + 2 * self.gw.nharm + p
+        o = q * q
+        return [{"S": row[:o].reshape(q, q), "rhs": row[o:o + q],
+                 "norm": row[o + q:o + 2 * q], "chi2_base": row[-1],
+                 "p": p, "k_pl": k_pl} for row in out]
+
+    def _stage2_prog(self, pl_specs, p: int, mode, *,
+                     vmapped: bool = False):
         # stage2 never reads the model (everything model-shaped arrived
         # via stage 1's packed buffer), so the cache is module-level and
         # model-free: 68 pulsars with distinct frozen values but equal
-        # (gw, pl_specs, p, mode) share ONE compiled program per shape
-        key = (self.gw, pl_specs, p, mode)
+        # (gw, pl_specs, p, mode) share ONE compiled program per shape.
+        # ONE key convention for both the per-pulsar and vmapped paths.
+        key = (self.gw, pl_specs, p, mode, vmapped)
         prog = _STAGE2_CACHE.get_lru(key)
         if prog is None:
+            fn = make_pta_stage2(self.gw, pl_specs, p, mode)
             prog = _STAGE2_CACHE.put_lru(
-                key, jax.jit(make_pta_stage2(self.gw, pl_specs, p, mode)))
+                key, jax.jit(jax.vmap(fn) if vmapped else fn))
         return prog
 
-    def _run_hybrid(self, meta, toas_cpu, dev_args, base, deltas):
-        """stage1 on the CPU, one upload, stage2 on the chip, one fetch."""
-        stage1, model, pl_specs, p, k_pl = meta
+    @staticmethod
+    def _deltas_for(model, deltas_list, i):
+        """Per-pulsar f64 delta dict at the loop's linearization point."""
+        deltas = model.zero_deltas()
+        if deltas_list is not None:
+            deltas = {k: jnp.asarray(deltas_list[i][k], jnp.float64)
+                      for k in deltas}
+        return deltas
+
+    @staticmethod
+    def _stage1_pack(stage1, model, deltas, toas_cpu):
+        """Run the CPU whitening stage pinned to the host device."""
         cpu = jax.devices("cpu")[0]
         with jax.default_device(cpu):
-            packed = stage1(jax.device_put(base, cpu),
-                            jax.device_put(deltas, cpu), toas_cpu)
+            return stage1(jax.device_put(model.base_dd(), cpu),
+                          jax.device_put(deltas, cpu), toas_cpu)
+
+    def _run_hybrid(self, meta, toas_cpu, dev_args, deltas):
+        """stage1 on the CPU, one upload, stage2 on the chip, one fetch."""
+        stage1, model, pl_specs, p, k_pl = meta
+        packed = self._stage1_pack(stage1, model, deltas, toas_cpu)
         packed_dev = jax.device_put(packed, self.accel_dev)
         # shared pallas->ds32 fallback (fitting.hybrid): the mode is
         # threaded explicitly so a fallback retry cannot silently rerun
@@ -481,8 +555,11 @@ class PTAGLSFitter:
         models' current values (the linearization point of this
         evaluation); ``None`` means zeros.
         """
+        prepared = self._prepare()
+        if self._batched is not None:
+            return self._grams_batched(prepared, deltas_list)
         out = []
-        for i, entry in enumerate(self._prepare()):
+        for i, entry in enumerate(prepared):
             # base is rebuilt per call (cheap numpy scalars), NOT cached
             # in _prepare: fit_toas mutates the models' values, and a
             # stale cached linearization point would silently
@@ -490,19 +567,13 @@ class PTAGLSFitter:
             if entry[0] == "hybrid":
                 _, meta, toas_cpu, dev_args = entry
                 model = meta[1]
-                deltas = model.zero_deltas()
-                if deltas_list is not None:
-                    deltas = {k: jnp.asarray(deltas_list[i][k], jnp.float64)
-                              for k in deltas}
-                out.append(self._run_hybrid(meta, toas_cpu, dev_args,
-                                            model.base_dd(), deltas))
+                out.append(self._run_hybrid(
+                    meta, toas_cpu, dev_args,
+                    self._deltas_for(model, deltas_list, i)))
                 continue
             _, gram, toas, noise, model = entry
             base = model.base_dd()
-            deltas = model.zero_deltas()
-            if deltas_list is not None:
-                deltas = {k: jnp.asarray(deltas_list[i][k], jnp.float64)
-                          for k in deltas}
+            deltas = self._deltas_for(model, deltas_list, i)
             if self.mesh is not None:
                 from pint_tpu.parallel.mesh import replicate
 

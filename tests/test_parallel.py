@@ -232,18 +232,23 @@ def test_batched_damped_convergence_flags():
     """The batched fitter's damped loop reports per-pulsar convergence
     truthfully (round-2 VERDICT: north-star fitters must not claim
     success unconditionally)."""
+    # same pulsar count / per-pulsar TOA counts / mesh layout as
+    # test_batched_pulsar_fitter, so BOTH tests run the ONE compiled
+    # vmapped step (the damped semantics under test are orthogonal to
+    # the batch geometry)
     problems = []
-    for i in range(3):
-        model, toas = _problem(seed=70 + i, ntoas=60)
+    ns = []
+    for i in range(4):
+        model, toas = _problem(seed=70 + i, ntoas=60 + 7 * i)
+        ns.append(len(toas))
         pert = get_model(PAR)
         pert["F0"].add_delta(3e-10)
         problems.append((toas, pert))
-    bf = BatchedPulsarFitter(problems, mesh=make_mesh(8, psr_axis=1))
+    bf = BatchedPulsarFitter(problems, mesh=make_mesh(8, psr_axis=4))
     chi2 = bf.fit_toas(maxiter=15)
-    assert chi2.shape == (3,)
+    assert chi2.shape == (4,)
     assert np.all(np.isfinite(chi2))
-    assert bf.converged.shape == (3,)
+    assert bf.converged.shape == (4,)
     assert bf.converged.all()
     # statistically clean: damped loop reached the optimum, not a cap
-    n = 60
-    assert np.all(chi2 / (n - 4) < 1.8)
+    assert np.all(chi2 / (np.array(ns) - 4) < 1.8)

@@ -56,14 +56,16 @@ def _mkpar(i, *, homog: bool = False):
     # per-pulsar EFAC: frozen white-noise values are BAKED into compiled
     # grams (scale_sigma reads them at trace time), so heterogeneous
     # EFACs here make the dense-parity test fail if the gram cache ever
-    # shares programs across different frozen values. ``homog`` pins
+    # shares programs across different frozen values — two distinct
+    # frozen-value structures (i mod 2) prove that property while the
+    # other two pulsars share their compiles. ``homog`` pins
     # EFAC/TNREDAMP uniform (sky/spin/DM stay distinct but FREE, so
     # they flow through the traced base): the non-parity tests use it
     # so all four pulsars share ONE compiled gram structure.
     return PAR_TMPL.format(i=i, raj=SKY[i][0], decj=SKY[i][1],
                            f0=300.0 + 13.0 * i, dm=20.0 + 5.0 * i,
-                           redamp=-13.6 if homog else -13.6 - 0.2 * i,
-                           efac=1.1 if homog else 1.1 + 0.15 * i)
+                           redamp=-13.6 if homog else -13.6 - 0.2 * (i % 2),
+                           efac=1.1 if homog else 1.1 + 0.15 * (i % 2))
 
 
 def _build_problems(*, homog: bool):
@@ -299,6 +301,8 @@ def test_pta_hybrid_split_matches_plain(pta_problems_homog):
         accel=jax.devices("cpu")[0])
     assert f_hyb.accel_dev is not None
     c_hyb = f_hyb.fit_toas(maxiter=2)
+    # uniform shapes -> the ONE-dispatch vmapped stage-2 path engaged
+    assert f_hyb._batched is not None
     np.testing.assert_allclose(c_hyb, c_plain, rtol=1e-9)
     for m_a, m_b in zip(models_a, models_b):
         for name in m_a.free_params:
@@ -309,6 +313,16 @@ def test_pta_hybrid_split_matches_plain(pta_problems_homog):
             np.testing.assert_allclose(m_b[name].uncertainty,
                                        m_a[name].uncertainty, rtol=1e-6,
                                        err_msg=name)
+    # the per-pulsar (non-batched) hybrid path must agree too: force it
+    models_c = _perturbed_models(homog=True)
+    f_pp = PTAGLSFitter(
+        [(t, m) for (t, _), m in zip(pta_problems_homog, models_c)],
+        gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM,
+        accel=jax.devices("cpu")[0])
+    f_pp._prepare()
+    f_pp._batched = None
+    c_pp = f_pp.fit_toas(maxiter=2)
+    np.testing.assert_allclose(c_pp, c_plain, rtol=1e-9)
 
 
 def test_pta_heterogeneous_structures():
@@ -319,7 +333,10 @@ def test_pta_heterogeneous_structures():
     reduced to (p + k_pl + k_gw).)"""
     problems = []
     for i, nredc in enumerate((4, 6)):
-        par = _mkpar(i).replace("TNREDC 4", f"TNREDC {nredc}")
+        # homog base: the structural heterogeneity under test is the
+        # harmonic count (TNREDC) alone, so pulsar 0 reuses the homog
+        # gram other tests already compiled
+        par = _mkpar(i, homog=True).replace("TNREDC 4", f"TNREDC {nredc}")
         model = get_model(par)
         t0 = make_fake_toas_uniform(53000, 56000, 24, model, obs="gbt",
                                     freq_mhz=np.array([1400.0, 430.0]),

@@ -92,7 +92,8 @@ def _dd():
 
 
 def _timeit(fn, reps=5):
-    fn()  # warm/compile
+    jax.block_until_ready(fn())  # warm/compile; async dispatch must
+    #                              drain before the first timed rep
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
