@@ -225,7 +225,8 @@ def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
 
     ``setup()`` runs under the DD-validity pin and returns
     ``(fit, extras)`` — ``fit()`` performs one full iteration;
-    ``extras()`` contributes additional JSON fields after timing.
+    ``extras(value_s)`` contributes additional JSON fields after
+    timing, given the measured median wall clock.
     """
     try:
         ctx, pinned = _dd_pin_ctx()
@@ -242,7 +243,7 @@ def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
                    "vs_baseline": round(budget_s / value, 3),
                    "backend": jax.default_backend() + pinned,
                    "host_cores": os.cpu_count()}
-            out.update(extras())
+            out.update(extras(value))
         _emit(out)
     except Exception as e:  # noqa: BLE001
         _emit({"metric": metric, "value": -1.0, "unit": "s",
@@ -309,7 +310,27 @@ def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
             _, info = fitter.step(deltas0)
             state["chi2"] = info["chi2_at_input"]
 
-        return one_step, lambda: {"chi2": round(float(state["chi2"]), 3)}
+        def extras(value_s):
+            # analytic joint-step FLOPs: P per-pulsar extended Grams
+            # (the O(n q^2) hot op, on the accelerator in hybrid mode)
+            # + the (P k_gw)^3/3 GW-only core Cholesky
+            m0 = problems[0][1]
+            p = len(m0.free_params) + 1
+            k = 2 * 30 + 2 * fitter.gw.nharm  # per-pulsar PL + GW cols
+            n1 = toas_per_psr
+            per = _analytic_gls_flops(n1, p, k, max(1, n1 // 4))
+            core = (n_psr * 2 * fitter.gw.nharm) ** 3 / 3.0
+            analytic = {f"per_psr_{kk}": v * n_psr
+                        for kk, v in per.items()}
+            analytic["gw_core_cholesky"] = core
+            out = {"chi2": round(float(state["chi2"]), 3),
+                   "hybrid_accel": fitter.accel_dev is not None,
+                   "batched_stage2": fitter._batched is not None}
+            out.update(_flop_fields(sum(analytic.values()), analytic,
+                                    value_s, jax.default_backend()))
+            return out
+
+        return one_step, extras
 
     _run_timed(metric, 30.0 * (n_psr * toas_per_psr / 6e5), reps, setup)
 
@@ -340,7 +361,7 @@ def bench_wideband(n: int, reps: int) -> None:
                       for d, m in zip(toas.flags, dm_true))
         toas = dataclasses.replace(toas, flags=flags)
         f = WidebandTOAFitter(toas, model)
-        return (lambda: f.fit_toas(maxiter=1)), dict
+        return (lambda: f.fit_toas(maxiter=1)), lambda _v: {}
 
     _run_timed(metric, 30.0 * (n / 6e5), reps, setup)
 
@@ -383,7 +404,7 @@ def bench_batch(n_psr: int, toas_per_psr: int, reps: int) -> None:
                 _, info = f.step(base, deltas, f.toas, mask)
             jax.block_until_ready(info["chi2"])
 
-        return one_step, dict
+        return one_step, lambda _v: {}
 
     _run_timed(metric, 30.0 * (n_psr * toas_per_psr / 6e5), reps, setup)
 

@@ -293,10 +293,17 @@ class PTAGLSFitter:
     returns the joint GLS chi2. Per-pulsar Gram programs are compiled
     once per model *structure* (identical structures share one
     executable); pass ``mesh`` to shard each pulsar's TOA axis.
+
+    On an accelerator backend the per-pulsar grams run as the hybrid
+    CPU-DD -> chip split (``accel``; see fitting.hybrid), and with
+    uniform per-pulsar shapes the stage-2 programs batch into ONE
+    vmapped dispatch per joint evaluation (``accel_batched=False``
+    keeps the per-pulsar dispatch path).
     """
 
     def __init__(self, problems, *, gw_log10_amp: float, gw_gamma: float,
-                 gw_nharm: int = 20, mesh=None, accel=None):
+                 gw_nharm: int = 20, mesh=None, accel=None,
+                 accel_batched: bool = True):
         if not problems:
             raise ValueError("no problems given")
         self.toas_list = [t for t, _ in problems]
@@ -359,6 +366,7 @@ class PTAGLSFitter:
         self.gw_coeffs: np.ndarray | None = None
         self._prepared = None        # delta-independent per-pulsar state
         self._batched = None         # stacked hybrid state (uniform shapes)
+        self._accel_batched = bool(accel_batched)
         # common GW per-frequency prior phi_gw (f on the shared grid)
         f = np.arange(1, self.gw.nharm + 1) / self.gw.tspan_s
         self._phi_gw = np.repeat(np.asarray(powerlaw_phi(
@@ -447,7 +455,8 @@ class PTAGLSFitter:
         per-pulsar path.
         """
         self._batched = None
-        if self.accel_dev is None or len(prepared) < 2:
+        if (not self._accel_batched or self.accel_dev is None
+                or len(prepared) < 2):
             return
         if not all(e[0] == "hybrid" for e in prepared):
             return
@@ -459,6 +468,10 @@ class PTAGLSFitter:
         self._batched = tuple(
             jnp.stack([e[3][j] for e in prepared])
             for j in range(len(prepared[0][3])))
+        # the stacked copy replaces the per-pulsar device statics — drop
+        # them so the fitter does not hold 2x the stage-2 HBM footprint
+        for i, e in enumerate(prepared):
+            prepared[i] = (e[0], e[1], e[2], None)
 
     def _grams_batched(self, prepared, deltas_list):
         """One vmapped stage-2 evaluation over all (uniform) pulsars."""
@@ -485,11 +498,7 @@ class PTAGLSFitter:
         out = np.asarray(run_stage2_with_fallback(
             self, (pl_specs, p, n, "vmapped"), run)
         )  # ONE device->host fetch for the whole array
-        q = k_pl + 2 * self.gw.nharm + p
-        o = q * q
-        return [{"S": row[:o].reshape(q, q), "rhs": row[o:o + q],
-                 "norm": row[o + q:o + 2 * q], "chi2_base": row[-1],
-                 "p": p, "k_pl": k_pl} for row in out]
+        return [self._unpack_gram(row, p, k_pl) for row in out]
 
     def _stage2_prog(self, pl_specs, p: int, mode, *,
                      vmapped: bool = False):
@@ -505,6 +514,16 @@ class PTAGLSFitter:
             prog = _STAGE2_CACHE.put_lru(
                 key, jax.jit(jax.vmap(fn) if vmapped else fn))
         return prog
+
+    def _unpack_gram(self, row, p: int, k_pl: int) -> dict:
+        """Decode one stage-2 packed row ``[S | rhs | norm | chi2_base]``
+        (the make_pta_stage2 output contract, one place for both the
+        per-pulsar and vmapped paths)."""
+        q = k_pl + 2 * self.gw.nharm + p
+        o = q * q
+        return {"S": row[:o].reshape(q, q), "rhs": row[o:o + q],
+                "norm": row[o + q:o + 2 * q], "chi2_base": row[-1],
+                "p": p, "k_pl": k_pl}
 
     @staticmethod
     def _deltas_for(model, deltas_list, i):
@@ -541,12 +560,7 @@ class PTAGLSFitter:
             self, (pl_specs, p, n),
             lambda mode: self._stage2_prog(pl_specs, p, mode)(
                 packed_dev, *dev_args))
-        out = np.asarray(out)  # ONE device->host fetch
-        q = k_pl + 2 * self.gw.nharm + p
-        o = q * q
-        return {"S": out[:o].reshape(q, q), "rhs": out[o:o + q],
-                "norm": out[o + q:o + 2 * q], "chi2_base": out[-1],
-                "p": p, "k_pl": k_pl}
+        return self._unpack_gram(np.asarray(out), p, k_pl)
 
     def _grams(self, deltas_list=None):
         """Run the per-pulsar Gram program for every pulsar.

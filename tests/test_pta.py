@@ -313,15 +313,14 @@ def test_pta_hybrid_split_matches_plain(pta_problems_homog):
             np.testing.assert_allclose(m_b[name].uncertainty,
                                        m_a[name].uncertainty, rtol=1e-6,
                                        err_msg=name)
-    # the per-pulsar (non-batched) hybrid path must agree too: force it
+    # the per-pulsar (non-batched) hybrid path must agree too
     models_c = _perturbed_models(homog=True)
     f_pp = PTAGLSFitter(
         [(t, m) for (t, _), m in zip(pta_problems_homog, models_c)],
         gw_log10_amp=GW_AMP, gw_gamma=GW_GAM, gw_nharm=GW_NHARM,
-        accel=jax.devices("cpu")[0])
-    f_pp._prepare()
-    f_pp._batched = None
+        accel=jax.devices("cpu")[0], accel_batched=False)
     c_pp = f_pp.fit_toas(maxiter=2)
+    assert f_pp._batched is None
     np.testing.assert_allclose(c_pp, c_plain, rtol=1e-9)
 
 
