@@ -312,17 +312,30 @@ def bench_pta(n_psr: int, toas_per_psr: int, reps: int) -> None:
 
         def extras(value_s):
             # analytic joint-step FLOPs: P per-pulsar extended Grams
-            # (the O(n q^2) hot op, on the accelerator in hybrid mode)
-            # + the (P k_gw)^3/3 GW-only core Cholesky
-            m0 = problems[0][1]
-            p = len(m0.free_params) + 1
-            k = 2 * 30 + 2 * fitter.gw.nharm  # per-pulsar PL + GW cols
+            # (the O(n q^2) hot op, on the accelerator in hybrid mode),
+            # the TWO per-pulsar elimination passes (full timing+PL
+            # block and the noise-only merit restriction), and the TWO
+            # (P k_gw)-dim GW-core Choleskys the step actually runs
+            # (Gauss-Newton solve + noise-marginalized chi2 at input).
+            # Column counts come from the model, not hardcoded.
+            from pint_tpu.fitting.gls_step import build_noise_statics
+
+            t0, m0 = problems[0]
+            p = (len(m0.free_params)
+                 + (0 if m0.has_component("PhaseOffset") else 1))
+            k_pl = int(sum(2 * s.nharm
+                           for s in build_noise_statics(m0, t0)[1]))
+            k_gw = 2 * fitter.gw.nharm
+            k = k_pl + k_gw
             n1 = toas_per_psr
+            m = p + k_pl  # eliminated block size
             per = _analytic_gls_flops(n1, p, k, max(1, n1 // 4))
-            core = (n_psr * 2 * fitter.gw.nharm) ** 3 / 3.0
+            per.pop("core_cholesky")  # replaced by the true terms below
             analytic = {f"per_psr_{kk}": v * n_psr
                         for kk, v in per.items()}
-            analytic["gw_core_cholesky"] = core
+            analytic["per_psr_eliminations"] = n_psr * (
+                m ** 3 / 3.0 + k_pl ** 3 / 3.0 + 2.0 * m * m * k_gw)
+            analytic["gw_core_cholesky_x2"] = 2 * (n_psr * k_gw) ** 3 / 3.0
             out = {"chi2": round(float(state["chi2"]), 3),
                    "hybrid_accel": fitter.accel_dev is not None,
                    "batched_stage2": fitter._batched is not None}
