@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from pint_tpu.constants import DM_CONST
-from pint_tpu.models.component import Component, f64
+from pint_tpu.models.component import (Component, check_contiguous_series, f64, has_series_term)
 from pint_tpu.models.parameter import float_param, mjd_param
 from pint_tpu.models.wave import WaveX
 from pint_tpu.ops import dd
@@ -66,14 +66,17 @@ class ChromaticCM(Component):
     @classmethod
     def applicable(cls, pf) -> bool:
         # TNCHROMIDX alone is NOT enough: CMWaveX carries its own copy
-        # and must not drag this component in
-        return pf.get("CM") is not None or bool(pf.get_all("CMX_"))
+        # and must not drag this component in. Any CM<k> counts so a
+        # gapped series reaches from_parfile's contiguity error.
+        return (pf.get("CM") is not None or bool(pf.get_all("CMX_"))
+                or has_series_term(pf, "CM"))
 
     @classmethod
     def from_parfile(cls, pf) -> "ChromaticCM":
         n = 1
         while pf.get(f"CM{n}") is not None:
             n += 1
+        check_contiguous_series(pf, "CM", n)
         idx = sorted(int(l.name.split("_")[1]) for l in pf.get_all("CMX_"))
         self = cls(num_terms=n, indices=idx)
         self.setup_from_parfile(pf)

@@ -21,6 +21,8 @@ the reference's cross-component ``ssb_to_psb_xyz`` calls.
 
 from __future__ import annotations
 
+import re as _re
+
 from typing import Optional
 
 import jax
@@ -135,6 +137,51 @@ class Component:
 
     def phase(self, p: dict[str, DD], toas, delay: Array, aux: dict):
         raise NotImplementedError
+
+
+def check_contiguous_series(pf, prefix: str, n_found: int, *,
+                            base: int = 0, first_index: int = 1) -> None:
+    """Reject indexed-series gaps (e.g. F2 with no F1, DM2 with no DM1).
+
+    ``n_found`` is the count of contiguous series terms a
+    ``from_parfile`` discovered starting at index ``base`` (0 for
+    F/DM/CM whose zeroth term exists, 1 for FD/WAVE); ``first_index``
+    is the smallest LEGAL ``{prefix}<int>`` par name (0 for F whose
+    zeroth term is literally ``F0``; 1 for DM/CM whose zeroth term is
+    the bare prefix, and for the 1-based FD/WAVE series — so a stray
+    ``DM0``/``FD0`` line is an error, not a silent drop). Any
+    ``{prefix}<int>`` line outside [first_index, base + n_found) would
+    otherwise be SILENTLY dropped by the builder's unknown-parameter
+    warning — a wrong timing model with no hard failure. (ref:
+    src/pint/models/spindown.py :: Spindown.validate; found by
+    tools/soak.py randomized composition.)
+    """
+    hi = base + n_found
+    pat = _re.compile(_re.escape(prefix) + r"(\d+)")
+    for line in pf.get_all(prefix):
+        m = pat.fullmatch(line.name)
+        if not m:
+            continue
+        idx = int(m.group(1))
+        if idx < first_index:
+            raise ValueError(
+                f"unexpected series term {line.name}: indices below "
+                f"{prefix}{first_index} do not exist "
+                f"(the zeroth term is named '{prefix}')")
+        if idx >= hi:
+            raise ValueError(
+                f"non-contiguous series term {line.name}: "
+                f"{prefix}{idx - 1} is missing from the par file")
+
+
+def has_series_term(pf, prefix: str) -> bool:
+    """True when any ``{prefix}<int>`` line exists — used by
+    ``applicable()`` so a gapped series (e.g. FD2 with no FD1) still
+    constructs the component, whose ``from_parfile`` then raises the
+    contiguity error instead of the builder silently dropping the line.
+    """
+    pat = _re.compile(_re.escape(prefix) + r"\d+")
+    return any(pat.fullmatch(line.name) for line in pf.get_all(prefix))
 
 
 def f64(p: dict[str, DD], name: str) -> Array:

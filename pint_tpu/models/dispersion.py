@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.models.component import Component, f64
+from pint_tpu.models.component import (Component, check_contiguous_series, f64, has_series_term)
 from pint_tpu.models.parameter import Param, float_param, mjd_param, toa_mask
 from pint_tpu.ops.dd import DD
 
@@ -39,13 +39,16 @@ class DispersionDM(Component):
 
     @classmethod
     def applicable(cls, pf) -> bool:
-        return pf.get("DM") is not None
+        # any DM<k> too: a gapped series (DM2, no DM/DM1) must reach
+        # from_parfile's contiguity error, not be silently dropped
+        return pf.get("DM") is not None or has_series_term(pf, "DM")
 
     @classmethod
     def from_parfile(cls, pf) -> "DispersionDM":
         nd = 1
         while pf.get(f"DM{nd}") is not None:
             nd += 1
+        check_contiguous_series(pf, "DM", nd)
         self = cls(num_dm_terms=nd)
         self.setup_from_parfile(pf)
         if self.param("DMEPOCH").value_f64 == 0.0:

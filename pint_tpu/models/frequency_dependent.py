@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.models.component import Component, f64
+from pint_tpu.models.component import (Component, check_contiguous_series, f64, has_series_term)
 from pint_tpu.models.parameter import float_param
 from pint_tpu.ops.dd import DD
 
@@ -34,13 +34,16 @@ class FD(Component):
 
     @classmethod
     def applicable(cls, pf) -> bool:
-        return pf.get("FD1") is not None
+        # any FD<k> (not just FD1): a gapped series must reach
+        # from_parfile's contiguity error, not be silently dropped
+        return has_series_term(pf, "FD")
 
     @classmethod
     def from_parfile(cls, pf) -> "FD":
         n = 0
         while pf.get(f"FD{n + 1}") is not None:
             n += 1
+        check_contiguous_series(pf, "FD", n, base=1)
         self = cls(num_terms=n)
         self.setup_from_parfile(pf)
         return self

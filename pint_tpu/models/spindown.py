@@ -18,7 +18,9 @@ import math
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.models.component import Component, f64
+from pint_tpu.models.component import (Component,
+                                       check_contiguous_series,
+                                       f64)
 from pint_tpu.models.parameter import DDFLOAT, float_param, mjd_param
 from pint_tpu.ops import dd, phase as phase_mod, timescales as ts
 from pint_tpu.ops.dd import DD
@@ -51,6 +53,7 @@ class Spindown(Component):
         nf = 1
         while pf.get(f"F{nf}") is not None:
             nf += 1
+        check_contiguous_series(pf, "F", nf, first_index=0)
         self = cls(num_freq_terms=nf)
         self.setup_from_parfile(pf)
         return self

@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.models.component import Component, f64
+from pint_tpu.models.component import (Component, check_contiguous_series, f64)
 from pint_tpu.models.parameter import float_param, mjd_param
 from pint_tpu.ops import dd
 from pint_tpu.ops.dd import DD
@@ -54,6 +54,7 @@ class Wave(Component):
         n = 0
         while pf.get(f"WAVE{n + 1}") is not None:
             n += 1
+        check_contiguous_series(pf, "WAVE", n, base=1)
         self = cls(num_waves=n)
         self.setup_from_parfile(pf)
         # WAVEk lines hold "A B" pairs: value=A, rest/uncertainty column=B

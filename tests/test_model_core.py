@@ -125,6 +125,23 @@ def test_phase_frac_is_wrapped(model, toas):
     assert np.all(ints == np.round(ints))
 
 
+BASE_MIN_PAR = ("PSRJ FAKE\nF0 100.0 1\nPEPOCH 53750\nDM 10.0\n"
+                "RAJ 04:37:15.9\nDECJ -47:15:09.1\n"
+                "EPHEM DE421\nUNITS TDB\nTZRMJD 53801.0\nTZRFRQ 1400.0\n"
+                "TZRSITE gbt\n")
+
+
+@pytest.mark.parametrize("gap_line", [
+    "F2 1e-25",           # F2 without F1 (0-based series)
+    "DM2 1e-4",           # DM2 without DM1
+    "FD2 1e-4",           # FD2 without FD1 (1-based series)
+])
+def test_noncontiguous_series_rejected(gap_line):
+    """Series gaps must raise, not be silently dropped (soak find)."""
+    with pytest.raises(ValueError, match="non-contiguous"):
+        get_model(BASE_MIN_PAR + gap_line + "\n")
+
+
 def test_design_matrix_vs_finite_difference(model, toas):
     """jacfwd design matrix vs central finite differences of the phase."""
     M, names = model.designmatrix(toas)

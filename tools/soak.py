@@ -1,0 +1,187 @@
+"""Randomized model-composition soak test (bug-hunting tool, not CI).
+
+Hand-written tests cover components mostly in isolation or in a few
+curated combinations. This tool samples RANDOM par files across the
+component space — spindown order x astrometry frame x dispersion
+terms x binary model x glitch/jump/FD/wave x noise stack — and pushes
+each through the full pipeline:
+
+    par text -> get_model -> simulate TOAs -> perturb -> Fitter.auto
+    -> convergence / recovery / chi2 sanity
+    -> as_parfile round-trip -> phase parity at every TOA
+
+Failures print the full par text + seed so any hit is reproducible
+with ``python tools/soak.py --seed N --trials 1``.
+
+Run: JAX_PLATFORMS=cpu python tools/soak.py [--trials 50] [--seed 0]
+Exit code = number of failing trials (0 = clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import traceback
+
+import numpy as np
+
+import pint_tpu  # noqa: F401
+from pint_tpu.fitting.fitter import Fitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+
+def random_par(rng: np.random.Generator) -> str:
+    lines = ["PSRJ FAKE_SOAK"]
+    f0 = rng.uniform(1.0, 700.0)
+    lines.append(f"F0 {f0:.9f} 1")
+    if rng.random() < 0.8:
+        lines.append(f"F1 {-10 ** rng.uniform(-16, -13):.4e} 1")
+        if rng.random() < 0.25:  # contiguous only: F2 requires F1
+            lines.append(f"F2 {10 ** rng.uniform(-26, -24):.4e}")
+    lines.append("PEPOCH 53750")
+
+    if rng.random() < 0.5:  # equatorial
+        lines.append(f"RAJ {rng.integers(0, 24):02d}:"
+                     f"{rng.integers(0, 60):02d}:{rng.uniform(0, 60):.4f} 1")
+        lines.append(f"DECJ {rng.choice(['-', ''])}"
+                     f"{rng.integers(0, 70):02d}:"
+                     f"{rng.integers(0, 60):02d}:{rng.uniform(0, 60):.3f} 1")
+        if rng.random() < 0.4:
+            lines.append(f"PMRA {rng.normal(0, 20):.3f} 1")
+            lines.append(f"PMDEC {rng.normal(0, 20):.3f} 1")
+    else:  # ecliptic
+        lines.append(f"ELONG {rng.uniform(0, 360):.6f} 1")
+        lines.append(f"ELAT {rng.uniform(-80, 80):.6f} 1")
+        if rng.random() < 0.4:
+            lines.append(f"PMELONG {rng.normal(0, 20):.3f} 1")
+            lines.append(f"PMELAT {rng.normal(0, 20):.3f} 1")
+    if rng.random() < 0.3:
+        lines.append(f"PX {rng.uniform(0.1, 3.0):.3f} 1")
+    lines.append("POSEPOCH 53750")
+
+    lines.append(f"DM {rng.uniform(2.0, 300.0):.4f} 1")
+    if rng.random() < 0.3:
+        lines.append(f"DM1 {rng.normal(0, 1e-3):.2e} 1")
+    if rng.random() < 0.2:
+        lines.append("NE_SW 6.0 1")
+
+    binary = rng.choice(["none", "ELL1", "DD", "BT"],
+                        p=[0.5, 0.25, 0.15, 0.1])
+    if binary != "none":
+        pb = rng.uniform(0.3, 50.0)
+        a1 = rng.uniform(0.5, 30.0)
+        lines.append(f"BINARY {binary}")
+        lines.append(f"PB {pb:.8f} 1")
+        lines.append(f"A1 {a1:.6f} 1")
+        if binary == "ELL1":
+            lines.append("TASC 53740.0")
+            lines.append(f"EPS1 {rng.normal(0, 1e-4):.3e} 1")
+            lines.append(f"EPS2 {rng.normal(0, 1e-4):.3e} 1")
+        else:
+            lines.append("T0 53740.0")
+            lines.append(f"ECC {rng.uniform(1e-5, 0.6):.6f} 1")
+            lines.append(f"OM {rng.uniform(0, 360):.4f} 1")
+
+    if rng.random() < 0.15:
+        lines.append("GLEP_1 54500")
+        lines.append(f"GLPH_1 {rng.normal(0, 0.1):.4f} 1")
+        lines.append(f"GLF0_1 {rng.normal(0, 1e-8):.3e} 1")
+    if rng.random() < 0.2:
+        lines.append(f"FD1 {rng.normal(0, 1e-4):.3e} 1")
+    if rng.random() < 0.2:
+        lines.append(f"JUMP -fe L-wide {rng.normal(0, 1e-4):.3e} 1")
+
+    if rng.random() < 0.4:
+        lines.append(f"EFAC -fe L-wide {rng.uniform(0.8, 2.0):.3f}")
+    if rng.random() < 0.3:
+        lines.append(f"EQUAD -fe L-wide {rng.uniform(0.01, 2.0):.3f}")
+    noise_gls = rng.random() < 0.35
+    if noise_gls:
+        lines.append(f"ECORR -fe L-wide {rng.uniform(0.1, 2.0):.3f}")
+        if rng.random() < 0.5:
+            lines.append(f"TNREDAMP {rng.uniform(-15.0, -13.0):.2f}")
+            lines.append(f"TNREDGAM {rng.uniform(1.5, 5.0):.2f}")
+            lines.append("TNREDC 5")
+    if rng.random() < 0.2:
+        lines.append("PHOFF 0.0 1")
+
+    lines += ["EPHEM DE421", "UNITS TDB", "TZRMJD 53801.0",
+              "TZRFRQ 1400.0", "TZRSITE gbt"]
+    return "\n".join(lines) + "\n"
+
+
+def one_trial(seed: int) -> tuple[bool, str]:
+    rng = np.random.default_rng(seed)
+    par = random_par(rng)
+    try:
+        truth = get_model(par)
+        n = int(rng.integers(80, 240))
+        toas = make_fake_toas_uniform(
+            53000, 56000, n, truth, obs="gbt",
+            freq_mhz=np.array([1400.0, 430.0]), error_us=1.0,
+            add_noise=True, seed=int(rng.integers(2 ** 31)))
+        # flag half the TOAs into the selector group the mask params use
+        import dataclasses
+
+        from pint_tpu.toas import Flags
+
+        flags = Flags(dict(d, fe="L-wide" if i % 2 else "430")
+                      for i, d in enumerate(toas.flags))
+        toas = dataclasses.replace(toas, flags=flags)
+
+        model = get_model(par)
+        # perturb F0 within ~5 sigma of a typical fit; wrap-safe
+        model["F0"].add_delta(rng.uniform(-1, 1) * 2e-10)
+        pre_chi2 = Residuals(toas, model).chi2
+        f = Fitter.auto(toas, model)
+        chi2 = f.fit_toas(maxiter=12)
+        assert np.isfinite(chi2), f"chi2 not finite: {chi2}"
+        assert chi2 <= pre_chi2 * 1.01 + 1e-6, (
+            f"fit went uphill: {pre_chi2} -> {chi2}")
+        red = chi2 / max(1, len(toas) - len(model.free_params))
+        assert red < 5.0, f"reduced chi2 {red} implausible"
+        for name in model.free_params:
+            p = model[name]
+            assert np.isfinite(p.value_f64), f"{name} value not finite"
+            assert p.uncertainty is None or np.isfinite(p.uncertainty), (
+                f"{name} uncertainty not finite")
+
+        # checkpoint contract: par round-trip preserves the phase model
+        par2 = model.as_parfile()
+        model2 = get_model(par2)
+        r1 = np.asarray(Residuals(toas, model,
+                                  subtract_mean=False).time_resids)
+        r2 = np.asarray(Residuals(toas, model2,
+                                  subtract_mean=False).time_resids)
+        assert np.max(np.abs(r1 - r2)) < 2e-9, (
+            f"par round-trip phase drift {np.max(np.abs(r1 - r2))} s")
+        return True, ""
+    except Exception:  # noqa: BLE001
+        return False, f"--- seed {seed} ---\n{par}\n{traceback.format_exc()}"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    fails = 0
+    t0 = time.time()
+    for i in range(args.trials):
+        seed = args.seed + i
+        ok, msg = one_trial(seed)
+        if not ok:
+            fails += 1
+            print(msg, flush=True)
+        print(f"[{i + 1}/{args.trials}] seed {seed}: "
+              f"{'ok' if ok else 'FAIL'} ({time.time() - t0:.0f}s)",
+              flush=True)
+    print(f"soak: {args.trials - fails}/{args.trials} passed")
+    return min(fails, 255)  # raw count would wrap mod 256 (256 -> "clean")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
