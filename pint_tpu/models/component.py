@@ -113,6 +113,17 @@ class Component:
     def validate(self) -> None:  # pragma: no cover - overridden where needed
         pass
 
+    def par_line_overrides(self) -> dict:
+        """Map param name -> replacement par line (or None to emit
+        nothing) for parameters whose internal representation differs
+        from their par-file syntax. Wave splits each tempo ``WAVEk A B``
+        pair line into WAVEkA/WAVEkB params; without this hook
+        ``as_parfile`` would write those internal names, which no
+        parser reads back — a round-trip that silently drops the
+        component's content (found by tools/soak.py seed 500).
+        """
+        return {}
+
     def trace_facts(self) -> tuple:
         """Hashable host-side facts the traced closure branches on.
 
@@ -164,10 +175,11 @@ def check_contiguous_series(pf, prefix: str, n_found: int, *,
             continue
         idx = int(m.group(1))
         if idx < first_index:
+            hint = (f" (the zeroth term is named '{prefix}')"
+                    if base == 0 and first_index == 1 else "")
             raise ValueError(
                 f"unexpected series term {line.name}: indices below "
-                f"{prefix}{first_index} do not exist "
-                f"(the zeroth term is named '{prefix}')")
+                f"{prefix}{first_index} do not exist{hint}")
         if idx >= hi:
             raise ValueError(
                 f"non-contiguous series term {line.name}: "

@@ -534,7 +534,12 @@ class TimingModel:
                 lines.append("# NB: the JUMP lines below were a DelayJump "
                              "(delay-chain); par syntax re-loads them as "
                              "PhaseJump")
+            overrides = c.par_line_overrides()
             for p in c.params:
+                if p.name in overrides:
+                    if overrides[p.name]:
+                        lines.append(overrides[p.name])
+                    continue
                 if p.kind == "bool":
                     if p.value:
                         lines.append(f"{p.name:<15} Y")

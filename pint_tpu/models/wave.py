@@ -47,7 +47,11 @@ class Wave(Component):
 
     @classmethod
     def applicable(cls, pf) -> bool:
-        return pf.get("WAVE_OM") is not None
+        from pint_tpu.models.component import has_series_term
+
+        # any WAVE<k> too: harmonic lines without WAVE_OM must reach
+        # validate's hard error, not be silently dropped
+        return pf.get("WAVE_OM") is not None or has_series_term(pf, "WAVE")
 
     @classmethod
     def from_parfile(cls, pf) -> "Wave":
@@ -69,7 +73,19 @@ class Wave(Component):
 
     def validate(self) -> None:
         if self.num_waves and self.param("WAVE_OM").value_f64 <= 0:
-            raise ValueError("WAVE_OM must be positive")
+            raise ValueError(
+                "WAVE harmonics require a positive WAVE_OM "
+                "(missing or non-positive in the par file)")
+
+    def par_line_overrides(self) -> dict:
+        # serialize back to the tempo pair syntax the parser reads
+        out: dict = {}
+        for k in range(1, self.num_waves + 1):
+            a = self.param(f"WAVE{k}A").value_f64
+            b = self.param(f"WAVE{k}B").value_f64
+            out[f"WAVE{k}A"] = f"{f'WAVE{k}':<15} {a!r} {b!r}"
+            out[f"WAVE{k}B"] = None
+        return out
 
     def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
         dt_dd = dd.sub(toas.tdb, p["WAVEEPOCH"])

@@ -91,6 +91,20 @@ WAVE2 3e-6 0
     np.testing.assert_allclose(d0, -2e-5 + 0.0, atol=1e-8)
 
 
+def test_wave_par_roundtrip():
+    """as_parfile must write tempo 'WAVEk A B' pair lines the parser
+    reads back — the internal WAVEkA/WAVEkB split must not leak
+    (tools/soak.py seed-500 find: round-trip silently dropped every
+    harmonic)."""
+    par = BASE + "WAVEEPOCH 55000\nWAVE_OM 0.01\nWAVE1 1e-5 -2e-5\nWAVE2 3e-6 -4e-6\n"
+    m = get_model(par)
+    m2 = get_model(m.as_parfile())
+    for name in ("WAVE1A", "WAVE1B", "WAVE2A", "WAVE2B", "WAVE_OM"):
+        np.testing.assert_allclose(m2[name].value_f64, m[name].value_f64,
+                                   rtol=0, atol=0, err_msg=name)
+    assert m2.get_component("Wave").num_waves == 2
+
+
 def test_ifunc_interpolation():
     m = get_model(BASE + """
 SIFUNC 2

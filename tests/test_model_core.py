@@ -132,14 +132,26 @@ BASE_MIN_PAR = ("PSRJ FAKE\nF0 100.0 1\nPEPOCH 53750\nDM 10.0\n"
 
 
 @pytest.mark.parametrize("gap_line", [
-    "F2 1e-25",           # F2 without F1 (0-based series)
-    "DM2 1e-4",           # DM2 without DM1
-    "FD2 1e-4",           # FD2 without FD1 (1-based series)
+    "F2 1e-25",               # F2 without F1 (0-based series)
+    "DM2 1e-4",               # DM2 without DM1 (bare zeroth term)
+    "FD2 1e-4",               # FD2 without FD1 (1-based series)
+    "CM2 1e-4",               # CM2 without CM/CM1
+    "WAVE_OM 0.01\nWAVE2 1e-6 0",   # WAVE2 without WAVE1
 ])
 def test_noncontiguous_series_rejected(gap_line):
     """Series gaps must raise, not be silently dropped (soak find)."""
     with pytest.raises(ValueError, match="non-contiguous"):
         get_model(BASE_MIN_PAR + gap_line + "\n")
+
+
+def test_wave_harmonics_without_wave_om_rejected():
+    with pytest.raises(ValueError, match="WAVE_OM"):
+        get_model(BASE_MIN_PAR + "WAVE1 1e-5 2e-5\n")
+
+
+def test_below_range_series_index_rejected():
+    with pytest.raises(ValueError, match="unexpected series term DM0"):
+        get_model(BASE_MIN_PAR + "DM0 5.0\n")
 
 
 def test_design_matrix_vs_finite_difference(model, toas):

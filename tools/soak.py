@@ -68,22 +68,40 @@ def random_par(rng: np.random.Generator) -> str:
     if rng.random() < 0.2:
         lines.append("NE_SW 6.0 1")
 
-    binary = rng.choice(["none", "ELL1", "DD", "BT"],
-                        p=[0.5, 0.25, 0.15, 0.1])
+    if rng.random() < 0.15:  # two DMX windows over the span halves
+        lines.append("DMX_0001 0.0 1")
+        lines.append("DMXR1_0001 53000")
+        lines.append("DMXR2_0001 54500")
+        lines.append("DMX_0002 0.0 1")
+        lines.append("DMXR1_0002 54500")
+        lines.append("DMXR2_0002 56001")
+
+    binary = rng.choice(["none", "ELL1", "ELL1H", "DD", "DDS", "BT"],
+                        p=[0.45, 0.2, 0.08, 0.12, 0.05, 0.1])
     if binary != "none":
         pb = rng.uniform(0.3, 50.0)
         a1 = rng.uniform(0.5, 30.0)
         lines.append(f"BINARY {binary}")
         lines.append(f"PB {pb:.8f} 1")
         lines.append(f"A1 {a1:.6f} 1")
-        if binary == "ELL1":
+        if binary.startswith("ELL1"):
             lines.append("TASC 53740.0")
             lines.append(f"EPS1 {rng.normal(0, 1e-4):.3e} 1")
             lines.append(f"EPS2 {rng.normal(0, 1e-4):.3e} 1")
+            if binary == "ELL1H":
+                lines.append(f"H3 {rng.uniform(1e-8, 3e-7):.3e} 1")
         else:
             lines.append("T0 53740.0")
             lines.append(f"ECC {rng.uniform(1e-5, 0.6):.6f} 1")
             lines.append(f"OM {rng.uniform(0, 360):.4f} 1")
+            if binary == "DDS":
+                lines.append(f"M2 {rng.uniform(0.1, 1.0):.4f}")
+                lines.append(f"SHAPMAX {rng.uniform(1.0, 8.0):.3f}")
+
+    if rng.random() < 0.15:  # tempo WAVE absorber, 2 harmonics
+        lines.append("WAVE_OM 0.006")
+        lines.append(f"WAVE1 {rng.normal(0, 1e-5):.3e} {rng.normal(0, 1e-5):.3e}")
+        lines.append(f"WAVE2 {rng.normal(0, 1e-5):.3e} {rng.normal(0, 1e-5):.3e}")
 
     if rng.random() < 0.15:
         lines.append("GLEP_1 54500")
@@ -133,8 +151,17 @@ def one_trial(seed: int) -> tuple[bool, str]:
         toas = dataclasses.replace(toas, flags=flags)
 
         model = get_model(par)
-        # perturb F0 within ~5 sigma of a typical fit; wrap-safe
-        model["F0"].add_delta(rng.uniform(-1, 1) * 2e-10)
+        # perturb a random subset of free params at roughly-fittable
+        # scales (wrap-safe for F0); always include F0
+        scales = {"F0": 2e-10, "F1": 1e-18, "DM": 1e-4, "PB": 1e-9,
+                  "A1": 1e-6, "EPS1": 1e-6, "EPS2": 1e-6}
+        perturbed = {}
+        for name, s in scales.items():
+            if name in model.free_params and (name == "F0"
+                                              or rng.random() < 0.5):
+                d = rng.uniform(-1, 1) * s
+                model[name].add_delta(d)
+                perturbed[name] = d
         pre_chi2 = Residuals(toas, model).chi2
         f = Fitter.auto(toas, model)
         chi2 = f.fit_toas(maxiter=12)
@@ -148,6 +175,23 @@ def one_trial(seed: int) -> tuple[bool, str]:
             assert np.isfinite(p.value_f64), f"{name} value not finite"
             assert p.uncertainty is None or np.isfinite(p.uncertainty), (
                 f"{name} uncertainty not finite")
+
+        # hybrid-fitter parity on a fraction of GLS-shaped trials: the
+        # CPU/accelerator split must reach the same fit as the dense path
+        if (rng.random() < 0.25 and any(
+                getattr(c, "is_noise_basis", False)
+                for c in model.components)):
+            from pint_tpu.fitting.hybrid import HybridGLSFitter
+
+            m_h = get_model(par)  # same perturbed start as the auto fit
+            for name, d in perturbed.items():
+                m_h[name].add_delta(d)
+            fh = HybridGLSFitter(toas, m_h)
+            chi2_h = fh.fit_toas(maxiter=12)
+            assert np.isfinite(chi2_h), "hybrid chi2 not finite"
+            rel = abs(chi2_h - chi2) / max(abs(chi2), 1e-12)
+            assert rel < 1e-3, (
+                f"hybrid/auto chi2 mismatch: {chi2_h} vs {chi2}")
 
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
