@@ -84,7 +84,14 @@ class BinaryELL1H(BinaryELL1):
     """Orthometric Shapiro parameterization (Freire & Wex 2010).
 
     With STIG given: s = 2 STIG/(1+STIG^2), r = H3/STIG^3 (the exact
-    resummation). With H3/H4 only: STIG = H4/H3.
+    resummation). With H3/H4 only: STIG = H4/H3. With H3 ALONE (the
+    low-inclination regime where only the third harmonic is
+    measurable): the Shapiro delay is its third Fourier harmonic,
+    ``-(4/3) H3 sin(3 Phi)`` — with the convention ``H3 = r sigma^3``
+    used throughout (the exact delay's sin(3 Phi) Fourier coefficient
+    is exactly (4/3) r sigma^3; verified numerically in
+    tests/test_binaries.py). Reference: pint.models
+    .stand_alone_psr_binaries.ELL1H_model (H3-only NHARM=3 mode).
     """
 
     binary_model_name = "ELL1H"
@@ -102,10 +109,34 @@ class BinaryELL1H(BinaryELL1):
         super().validate()
         if self.param("H3").value_f64 == 0.0:
             raise ValueError("ELL1H requires H3")
-        if self.param("STIG").value_f64 == 0.0 and self.param("H4").value_f64 == 0.0:
-            raise ValueError(
-                "ELL1H needs STIG or H4 alongside H3 (the H3-only truncated-"
-                "harmonic mode is not implemented; s would silently be 0)")
+        for nm in ("H4", "STIG"):
+            p = self.param(nm)
+            if not p.frozen and p.value_f64 == 0.0:
+                # mode selection is by value: a free-but-zero H4/STIG
+                # would silently select the H3-only mode where its
+                # design column is identically zero (and the exact
+                # orthometric resummation is singular at stig = 0) —
+                # an unfittable request, so reject it loudly
+                raise ValueError(
+                    f"ELL1H: {nm} is free but zero — the orthometric "
+                    f"mode needs a nonzero starting value (or freeze "
+                    f"{nm} at 0 for the H3-only third-harmonic mode)")
+
+    def _h3_only(self) -> bool:
+        """Mode selection is static (host-side, like the reference's):
+        neither H4 nor STIG set at construction -> third-harmonic-only."""
+        return (self.param("H4").value_f64 == 0.0
+                and self.param("STIG").value_f64 == 0.0)
+
+    def trace_facts(self) -> tuple:
+        # the mode is a trace-time branch: two models differing only in
+        # whether H4/STIG are set must not alias one compiled program
+        return super().trace_facts() + (("ell1h_h3_only", self._h3_only()),)
+
+    def shapiro_delay(self, p: dict[str, DD], Phi: Array) -> Array:
+        if self._h3_only():
+            return -(4.0 / 3.0) * f64(p, "H3") * jnp.sin(3.0 * Phi)
+        return super().shapiro_delay(p, Phi)
 
     def shapiro_rs(self, p: dict[str, DD]) -> tuple[Array, Array]:
         h3 = f64(p, "H3")

@@ -146,9 +146,12 @@ def get_model(parfile: str | ParFile, *, allow_tcb: bool = False) -> TimingModel
             extra_res.append(pat)
     for line in pf.lines:
         nm = line.name
-        if nm in recognized or nm == "JUMP" or nm.startswith(
-            ("DMXR1_", "DMXR2_", "DMX_", "JUMP")
-        ) or any(p.match(nm) for p in extra_res):
+        # DMX/CMX window lines are claimed by their components'
+        # extra_par_names — no hardcoded prefix whitelist, so an orphan
+        # DMXR1_0007 with no matching DMX_0007 window WARNS instead of
+        # being silently swallowed
+        if nm in recognized or nm == "JUMP" or nm.startswith("JUMP") \
+                or any(p.match(nm) for p in extra_res):
             continue
         log.warning("par parameter %s not recognized by any component; ignored", nm)
     return model

@@ -89,6 +89,22 @@ class ChromaticCM(Component):
             self.param("CMEPOCH").set_from_par(pf.get("PEPOCH").value)
         return self
 
+    def par_line_overrides(self) -> dict:
+        # CMX window bounds live in self.ranges (see DispersionDMX)
+        return self._ranged_window_overrides("CMX")
+
+    @property
+    def extra_par_names(self) -> tuple[str, ...]:
+        # CMXR1_/CMXR2_ bound lines are consumed by from_parfile but
+        # are not params: claim them so the builder does not log a
+        # false "ignored" warning for every window on load
+        return tuple(f"CMXR{j}_{i:04d}" for i in self.indices
+                     for j in (1, 2))
+
+    def trace_facts(self) -> tuple:
+        # window bounds are trace-time host state (see DispersionDMX)
+        return (("cmx_ranges", tuple(sorted(self.ranges.items()))),)
+
     def cm_value(self, p: dict[str, DD], toas) -> Array:
         """CM(t) [pc/cm^3 at the 1400 MHz reference]."""
         dt_dd = dd.sub(toas.tdb, p["CMEPOCH"])

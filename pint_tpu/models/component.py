@@ -114,15 +114,31 @@ class Component:
         pass
 
     def par_line_overrides(self) -> dict:
-        """Map param name -> replacement par line (or None to emit
+        """Map param name -> replacement par text (or None to emit
         nothing) for parameters whose internal representation differs
-        from their par-file syntax. Wave splits each tempo ``WAVEk A B``
-        pair line into WAVEkA/WAVEkB params; without this hook
-        ``as_parfile`` would write those internal names, which no
-        parser reads back — a round-trip that silently drops the
-        component's content (found by tools/soak.py seed 500).
+        from their par-file syntax; a value may contain newlines to
+        emit companion lines (DMX/CMX range bounds). Wave splits each
+        tempo ``WAVEk A B`` pair line into WAVEkA/WAVEkB params; DMX/
+        CMX windows keep their bounds in ``self.ranges``; IFunc node
+        MJDs live outside the params. Without this hook ``as_parfile``
+        writes internal names/values no parser reads back — a
+        round-trip that silently corrupts the component (found by
+        tools/soak.py seed 500).
         """
         return {}
+
+    def _ranged_window_overrides(self, prefix: str) -> dict:
+        """Shared DMX/CMX serialization: the per-window value param plus
+        its R1/R2 bound companion lines (bounds live in ``self.ranges``,
+        not params — see :meth:`par_line_overrides`)."""
+        out: dict = {}
+        for i in self.indices:
+            p = self.param(f"{prefix}_{i:04d}")
+            lo, hi = self.ranges[i]
+            out[p.name] = (p.as_parfile_line()
+                           + f"\n{f'{prefix}R1_{i:04d}':<15} {float(lo)!r}"
+                           + f"\n{f'{prefix}R2_{i:04d}':<15} {float(hi)!r}")
+        return out
 
     def trace_facts(self) -> tuple:
         """Hashable host-side facts the traced closure branches on.

@@ -111,6 +111,26 @@ class DispersionDMX(Component):
             )
         return self
 
+    def par_line_overrides(self) -> dict:
+        # the window bounds live in self.ranges, not params: without
+        # these lines a par round-trip collapses every window to
+        # (0, 1e9) — overlapping and degenerate (soak-class find, same
+        # as the Wave pair-line bug)
+        return self._ranged_window_overrides("DMX")
+
+    @property
+    def extra_par_names(self) -> tuple[str, ...]:
+        # DMXR1_/DMXR2_ bound lines are consumed by from_parfile but
+        # are not params (see ChromaticCM.extra_par_names)
+        return tuple(f"DMXR{j}_{i:04d}" for i in self.indices
+                     for j in (1, 2))
+
+    def trace_facts(self) -> tuple:
+        # window bounds are trace-time host state baked into the masks:
+        # two models differing only in DMXR1/DMXR2 must not alias one
+        # compiled program (review-confirmed aliasing without this)
+        return (("dmx_ranges", tuple(sorted(self.ranges.items()))),)
+
     def dm_value(self, p: dict[str, DD], toas) -> Array:
         # trace-safe: window masks from the (possibly traced) float64 MJDs
         mjds = toas.tdb.hi + toas.tdb.lo

@@ -64,6 +64,23 @@ class IFunc(Component):
             self.param(f"IFUNC{k + 1}").set_value_dd(off)
         return self
 
+    def trace_facts(self) -> tuple:
+        # node MJDs and the interpolation kind are trace-time host
+        # state baked into the compiled interpolant (see DispersionDMX)
+        return (("ifunc_nodes", tuple(float(t) for t in self.node_mjds),
+                 self.sifunc),)
+
+    def par_line_overrides(self) -> dict:
+        # par syntax is "IFUNCk MJD OFFSET flag": node MJDs live in
+        # self.node_mjds, the params hold only offsets — writing the
+        # bare param line would re-parse the offset AS an MJD
+        out: dict = {}
+        for k in range(len(self.node_mjds)):
+            p = self.param(f"IFUNC{k + 1}")
+            out[p.name] = (f"{p.name:<15} {float(self.node_mjds[k])!r} "
+                           f"{float(p.value_f64)!r} 0")
+        return out
+
     def validate(self) -> None:
         if len(self.node_mjds) and not np.all(np.diff(self.node_mjds) > 0):
             raise ValueError("IFUNC node MJDs must be strictly increasing")

@@ -16,7 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.models.component import (Component, check_contiguous_series, f64)
+from pint_tpu.models.component import (Component, check_contiguous_series,
+                                       f64, has_series_term)
 from pint_tpu.models.parameter import float_param, mjd_param
 from pint_tpu.ops import dd
 from pint_tpu.ops.dd import DD
@@ -47,8 +48,6 @@ class Wave(Component):
 
     @classmethod
     def applicable(cls, pf) -> bool:
-        from pint_tpu.models.component import has_series_term
-
         # any WAVE<k> too: harmonic lines without WAVE_OM must reach
         # validate's hard error, not be silently dropped
         return pf.get("WAVE_OM") is not None or has_series_term(pf, "WAVE")

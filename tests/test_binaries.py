@@ -162,14 +162,42 @@ MTOT           2.828378
 
 
 def test_orthometric_validation():
-    with pytest.raises(ValueError, match="STIG or H4"):
-        get_model(BASE + ELL1_LINES.replace("BINARY         ELL1",
-                                            "BINARY         ELL1H")
-                  + "H3 1e-7\n")
     with pytest.raises(ValueError, match="DDH requires STIG"):
         get_model(BASE + DD_LINES.replace("BINARY         DD",
                                           "BINARY         DDH")
                   + "H3 1e-7\n")
+
+
+def test_ell1h_h3_only_third_harmonic():
+    """H3-only ELL1H (low inclination, FW2010): the Shapiro delay is
+    the exact delay's third Fourier harmonic, -(4/3) H3 sin(3 Phi)
+    with H3 = r sigma^3 — pinned against the numerical projection of
+    the exact -2r ln(1 - s sin Phi) form."""
+    sig = 0.2
+    r = 1.5e-6  # seconds
+    h3 = r * sig ** 3
+    ell1h = BASE + ELL1_LINES.replace("BINARY         ELL1",
+                                      "BINARY         ELL1H")
+    m_h3 = get_model(ell1h + f"H3 {h3!r}\n")
+    comp = m_h3.get_component("BinaryELL1H")
+    assert comp._h3_only()
+    phi = np.linspace(0.0, 2 * np.pi, 4096, endpoint=False)
+    d = np.asarray(comp.shapiro_delay(m_h3.base_dd(), jnp.asarray(phi)))
+    np.testing.assert_allclose(d, -(4.0 / 3.0) * h3 * np.sin(3 * phi),
+                               rtol=1e-12, atol=1e-20)
+    # third-harmonic projection of the EXACT delay with the same (r, s)
+    s = 2 * sig / (1 + sig ** 2)
+    d_exact = -2 * r * np.log(1 - s * np.sin(phi))
+    c3 = 2 * np.mean(d_exact * np.sin(3 * phi))
+    np.testing.assert_allclose(np.max(np.abs(d)), abs(c3), rtol=5e-3)
+    # the STIG-given exact mode is untouched
+    m_stig = get_model(ell1h + f"H3 {h3!r}\nSTIG {sig}\n")
+    assert not m_stig.get_component("BinaryELL1H")._h3_only()
+    # and the models compile/evaluate end-to-end
+    toas = make_fake_toas_uniform(54995, 55005, 64, m_h3, obs="@")
+    z = jnp.zeros(len(toas))
+    dh = np.asarray(comp.delay(m_h3.base_dd(), toas, z, {}))
+    assert np.all(np.isfinite(dh))
 
 
 def test_btx_matches_bt():

@@ -105,6 +105,43 @@ def test_wave_par_roundtrip():
     assert m2.get_component("Wave").num_waves == 2
 
 
+def test_dmx_ranges_fingerprinted():
+    """Two models differing ONLY in DMXR1/DMXR2 bounds must NOT alias
+    one cached compiled program (review-confirmed: without ranges in
+    trace_facts the second model silently reused the first's windows)."""
+    tmpl = BASE + "DMX_0001 0.005 1\nDMXR1_0001 {lo}\nDMXR2_0001 {hi}\n"
+    m1 = get_model(tmpl.format(lo=55000, hi=55400))
+    m2 = get_model(tmpl.format(lo=55600, hi=56000))
+    toas = make_fake_toas_uniform(55000, 56000, 60, m1, obs="@",
+                                  freq_mhz=1400.0, niter=0)
+    r1 = np.asarray(Residuals(toas, m1, subtract_mean=False).time_resids)
+    r2 = np.asarray(Residuals(toas, m2, subtract_mean=False).time_resids)
+    assert np.max(np.abs(r1 - r2)) > 1e-9  # different windows, different model
+
+
+def test_dmx_and_ifunc_par_roundtrip():
+    """Window bounds (self.ranges) and IFUNC node MJDs are not params:
+    as_parfile must serialize them explicitly or a round-trip collapses
+    every DMX window to (0, 1e9) and re-parses IFUNC offsets as MJDs
+    (same serialization-asymmetry class as the WAVE pair-line bug)."""
+    par = BASE + (
+        "DMX_0001 0.003 1\nDMXR1_0001 53000\nDMXR2_0001 54500\n"
+        "DMX_0002 0.001 1\nDMXR1_0002 54500\nDMXR2_0002 56001\n"
+        "CM 0.5 1\nCMX_0001 0.01 1\nCMXR1_0001 53000\nCMXR2_0001 54500\n"
+        "SIFUNC 2 0\nIFUNC1 53100.0 1e-5 0\nIFUNC2 55900.0 -2e-5 0\n")
+    m = get_model(par)
+    m2 = get_model(m.as_parfile())
+    dmx = m2.get_component("DispersionDMX")
+    assert dmx.ranges == {1: (53000.0, 54500.0), 2: (54500.0, 56001.0)}
+    cm = m2.get_component("ChromaticCM")
+    assert cm.ranges == {1: (53000.0, 54500.0)}
+    ifu = m2.get_component("IFunc")
+    np.testing.assert_allclose(ifu.node_mjds, [53100.0, 55900.0])
+    np.testing.assert_allclose(
+        [ifu.param("IFUNC1").value_f64, ifu.param("IFUNC2").value_f64],
+        [1e-5, -2e-5])
+
+
 def test_ifunc_interpolation():
     m = get_model(BASE + """
 SIFUNC 2
