@@ -150,7 +150,7 @@ def get_model(parfile: str | ParFile, *, allow_tcb: bool = False) -> TimingModel
         # extra_par_names — no hardcoded prefix whitelist, so an orphan
         # DMXR1_0007 with no matching DMX_0007 window WARNS instead of
         # being silently swallowed
-        if nm in recognized or nm == "JUMP" or nm.startswith("JUMP") \
+        if nm in recognized or nm.startswith("JUMP") \
                 or any(p.match(nm) for p in extra_res):
             continue
         log.warning("par parameter %s not recognized by any component; ignored", nm)

@@ -127,6 +127,15 @@ class Component:
         """
         return {}
 
+    def extra_par_lines(self) -> list[str]:
+        """Par lines this component must emit that correspond to NO
+        param it owns (e.g. PLChromNoise consumes TNCHROMIDX but the
+        param belongs to ChromaticCM/CMWaveX when those exist).
+        ``as_parfile`` appends these, skipping any whose name another
+        emitted line already carries — so shared lines are written
+        exactly once."""
+        return []
+
     def _ranged_window_overrides(self, prefix: str) -> dict:
         """Shared DMX/CMX serialization: the per-window value param plus
         its R1/R2 bound companion lines (bounds live in ``self.ranges``,

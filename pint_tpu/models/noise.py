@@ -479,6 +479,17 @@ class PLChromNoise(_PLNoiseBase):
     def basis_alpha(self) -> float:
         return self._alpha
 
+    def extra_par_lines(self) -> list[str]:
+        # TNCHROMIDX is consumed here but owned (as a param) by
+        # ChromaticCM/CMWaveX when present; standalone PLChromNoise
+        # must still round-trip it (soak-audit find: alpha silently
+        # reset to 4.0 through as_parfile)
+        return [f"{'TNCHROMIDX':<15} {float(self._alpha)!r}"]
+
+    def trace_facts(self) -> tuple:
+        return super().trace_facts() + (("chrom_alpha",
+                                         float(self._alpha)),)
+
     def refresh_from_model(self, model) -> None:
         """Track the model's live TNCHROMIDX (owned by ChromaticCM/
         CMWaveX when present) so the noise basis and the deterministic

@@ -553,6 +553,16 @@ class TimingModel:
                 if p.kind == "float" and not np.isfinite(p.value_f64):
                     continue
                 lines.append(p.as_parfile_line())
+        # component lines owned by no param (see extra_par_lines):
+        # emitted once per NAME across the whole file
+        emitted = {ln.split()[0] for ln in lines if ln and not
+                   ln.startswith("#")}
+        for c in self.components:
+            for extra in c.extra_par_lines():
+                name = extra.split()[0]
+                if name not in emitted:
+                    emitted.add(name)
+                    lines.append(extra)
         return "\n".join(lines) + "\n"
 
     def compare(self, other: "TimingModel") -> str:

@@ -162,6 +162,14 @@ MTOT           2.828378
 
 
 def test_orthometric_validation():
+    ell1h = BASE + ELL1_LINES.replace("BINARY         ELL1",
+                                      "BINARY         ELL1H")
+    # free-but-zero H4/STIG: design column identically zero and the
+    # exact resummation singular at stig = 0 — must be rejected loudly
+    with pytest.raises(ValueError, match="free but zero"):
+        get_model(ell1h + "H3 1e-7 1\nH4 0 1\n")
+    with pytest.raises(ValueError, match="free but zero"):
+        get_model(ell1h + "H3 1e-7 1\nSTIG 0 1\n")
     with pytest.raises(ValueError, match="DDH requires STIG"):
         get_model(BASE + DD_LINES.replace("BINARY         DD",
                                           "BINARY         DDH")

@@ -226,3 +226,21 @@ def test_delay_jump_matches_phase_jump():
     np.testing.assert_allclose(r1[sel] - r0[sel], -J * f0,
                                rtol=0, atol=1e-6)
     np.testing.assert_allclose(r1[~sel], r0[~sel], rtol=0, atol=1e-12)
+
+
+def test_plchrom_alpha_par_roundtrip():
+    """Standalone PLChromNoise must round-trip TNCHROMIDX (it consumes
+    the line but the param belongs to ChromaticCM when present — the
+    extra_par_lines hook writes it exactly once either way)."""
+    par = BASE + ("TNCHROMAMP -13.5\nTNCHROMGAM 3.0\nTNCHROMC 5\n"
+                  "TNCHROMIDX 3.5\n")
+    m = get_model(par)
+    m2 = get_model(m.as_parfile())
+    assert m2.get_component("PLChromNoise").basis_alpha() == 3.5
+    # with ChromaticCM owning the param: one line, same value
+    m3 = get_model(BASE + "CM 0.5 1\nTNCHROMIDX 3.5\nTNCHROMAMP -13.5\n"
+                   "TNCHROMGAM 3.0\nTNCHROMC 5\n")
+    out = m3.as_parfile()
+    assert sum(1 for l in out.splitlines()
+               if l.startswith("TNCHROMIDX")) == 1
+    assert get_model(out).get_component("PLChromNoise").basis_alpha() == 3.5
