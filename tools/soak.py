@@ -107,6 +107,18 @@ def random_par(rng: np.random.Generator) -> str:
         lines.append("GLEP_1 54500")
         lines.append(f"GLPH_1 {rng.normal(0, 0.1):.4f} 1")
         lines.append(f"GLF0_1 {rng.normal(0, 1e-8):.3e} 1")
+        if rng.random() < 0.5:  # recovering component (decay branch)
+            lines.append(f"GLF0D_1 {rng.normal(0, 1e-9):.3e} 1")
+            lines.append(f"GLTD_1 {rng.uniform(50, 300):.1f}")
+    if rng.random() < 0.1:  # piecewise spindown segment
+        lines.append("PWEP_1 54200")
+        lines.append("PWSTART_1 54000")
+        lines.append("PWSTOP_1 54400")
+        lines.append(f"PWF0_1 {rng.normal(0, 1e-9):.3e} 1")
+    if rng.random() < 0.1:  # IFunc nodes spanning the TOAs
+        lines.append("SIFUNC 2 0")
+        for j, mjd in enumerate((52990.0, 54500.0, 56010.0)):
+            lines.append(f"IFUNC{j + 1} {mjd} {rng.normal(0, 1e-5):.3e} 0")
     if rng.random() < 0.2:
         lines.append(f"FD1 {rng.normal(0, 1e-4):.3e} 1")
     if rng.random() < 0.2:
@@ -175,6 +187,27 @@ def one_trial(seed: int) -> tuple[bool, str]:
             assert np.isfinite(p.value_f64), f"{name} value not finite"
             assert p.uncertainty is None or np.isfinite(p.uncertainty), (
                 f"{name} uncertainty not finite")
+
+        # wideband fit on a fraction of trials: attach -pp_dm/-pp_dme
+        # flags derived from the model's own DM(t) and run the stacked
+        # TOA+DM fitter (random models exercise the wideband design
+        # matrix across component combinations)
+        if rng.random() < 0.2:
+            from pint_tpu.fitting.wideband import WidebandTOAFitter
+
+            m_wb = get_model(par)
+            dm_true = np.asarray(m_wb.total_dm(toas))
+            wb_flags = Flags(dict(d, pp_dm=str(float(v) +
+                                               float(rng.normal(0, 1e-4))),
+                                  pp_dme="1e-4")
+                             for d, v in zip(toas.flags, dm_true))
+            toas_wb = dataclasses.replace(toas, flags=wb_flags)
+            fwb = WidebandTOAFitter(toas_wb, m_wb)
+            chi2_wb = fwb.fit_toas(maxiter=6)
+            assert np.isfinite(chi2_wb), "wideband chi2 not finite"
+            ndof_wb = 2 * len(toas) - len(m_wb.free_params)
+            assert chi2_wb / max(1, ndof_wb) < 5.0, (
+                f"wideband reduced chi2 {chi2_wb / ndof_wb} implausible")
 
         # hybrid-fitter parity on a fraction of GLS-shaped trials: the
         # CPU/accelerator split must reach the same fit as the dense path
