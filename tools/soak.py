@@ -138,7 +138,10 @@ def random_par(rng: np.random.Generator) -> str:
     if rng.random() < 0.2:
         lines.append("PHOFF 0.0 1")
 
-    lines += ["EPHEM DE421", "UNITS TDB", "TZRMJD 53801.0",
+    # occasionally a TCB par file: the TCB->TDB auto-conversion rescales
+    # F/DM/epoch parameters before any of the pipeline runs
+    units = "TCB" if rng.random() < 0.1 else "TDB"
+    lines += ["EPHEM DE421", f"UNITS {units}", "TZRMJD 53801.0",
               "TZRFRQ 1400.0", "TZRSITE gbt"]
     return "\n".join(lines) + "\n"
 
@@ -147,7 +150,7 @@ def one_trial(seed: int) -> tuple[bool, str]:
     rng = np.random.default_rng(seed)
     par = random_par(rng)
     try:
-        truth = get_model(par)
+        truth = get_model(par, allow_tcb=True)
         n = int(rng.integers(80, 240))
         toas = make_fake_toas_uniform(
             53000, 56000, n, truth, obs="gbt",
@@ -162,7 +165,7 @@ def one_trial(seed: int) -> tuple[bool, str]:
                       for i, d in enumerate(toas.flags))
         toas = dataclasses.replace(toas, flags=flags)
 
-        model = get_model(par)
+        model = get_model(par, allow_tcb=True)
         # perturb a random subset of free params at roughly-fittable
         # scales (wrap-safe for F0); always include F0
         scales = {"F0": 2e-10, "F1": 1e-18, "DM": 1e-4, "PB": 1e-9,
@@ -195,7 +198,7 @@ def one_trial(seed: int) -> tuple[bool, str]:
         if rng.random() < 0.2:
             from pint_tpu.fitting.wideband import WidebandTOAFitter
 
-            m_wb = get_model(par)
+            m_wb = get_model(par, allow_tcb=True)
             dm_true = np.asarray(m_wb.total_dm(toas))
             wb_flags = Flags(dict(d, pp_dm=str(float(v) +
                                                float(rng.normal(0, 1e-4))),
@@ -216,7 +219,7 @@ def one_trial(seed: int) -> tuple[bool, str]:
                 for c in model.components)):
             from pint_tpu.fitting.hybrid import HybridGLSFitter
 
-            m_h = get_model(par)  # same perturbed start as the auto fit
+            m_h = get_model(par, allow_tcb=True)  # same perturbed start as the auto fit
             for name, d in perturbed.items():
                 m_h[name].add_delta(d)
             fh = HybridGLSFitter(toas, m_h)
