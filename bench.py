@@ -119,7 +119,7 @@ def _dd_pin_ctx():
 
     The mode benches run the full DD phase pipeline on the default
     backend; that needs IEEE f64 (error-free transforms). When the
-    accelerator fails ``dd.self_check`` (TPU v5e did in a round-2 session; artifact pending), a
+    accelerator fails ``dd.self_check`` (TPU v5e did, rounds 2 and 4; committed artifact pending), a
     valid CPU number beats NaN on-chip (the hybrid split covers the
     default gls mode only).
     """
@@ -614,7 +614,7 @@ def _main_guarded() -> None:
 
         dd_ok = bool(dd_mod.self_check())
         # DD arithmetic needs IEEE-exact f64 (error-free transforms). If
-        # the accelerator fails the self-check (TPU v5e did in a round-2 session; artifact pending),
+        # the accelerator fails the self-check (TPU v5e did, rounds 2 and 4),
         # the valid configuration is the hybrid split: DD phase/design on
         # the CPU backend, GLS linear algebra on the chip
         # (pint_tpu.fitting.hybrid; see pint_tpu.ops.dd docstring).

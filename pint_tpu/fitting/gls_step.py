@@ -299,7 +299,7 @@ def gls_gram_whitened(A_M: Array, rw: Array, sw: Array, norm_M: Array,
     """Gram reduction from pre-whitened inputs, range-safe for TPU f64.
 
     The TPU's emulated float64 carries float32 *dynamic range* (observed
-    on TPU v5e in a round-2 session, artifact pending: ``sum(M^2 w)`` at
+    on TPU v5e round 2, artifact pending: ``sum(M^2 w)`` at
     ~1e40 overflows to inf/NaN for spin-derivative
     design columns). This variant therefore takes the whitening done on
     the CPU — ``A_M = M sqrt(w) / ||M sqrt(w)||`` (unit columns),

@@ -1,7 +1,8 @@
 """Hybrid GLS fit: CPU-exact DD phase -> accelerator linear algebra.
 
 Why this exists (observed on hardware, not assumed): ``dd.self_check``
-came back **False** on the TPU v5e backend in a round-2 session
+came back **False** on the TPU v5e backend in a round-2 session,
+re-confirmed on hardware in round 4's brief live-tunnel window
 (committed artifact pending — see ops/dd.py) — the error-free transforms
 (TwoSum/TwoProd) underlying double-double arithmetic do not hold under
 the TPU's emulated float64, so the phase/residual pipeline computed

@@ -41,11 +41,14 @@ TwoProd), which require IEEE-754 correctly-rounded float64 add/sub/mul.
      (Verified in ``tests/test_dd.py``; the FTZ divergence is pinned in
      ``tests/test_dd_properties.py::test_two_sum_subnormal_flush_documented``.)
    * XLA **TPU** emulates float64 and **failed the check on TPU v5e**
-     (observed in a round-2 session before the TPU tunnel went down; DD
-     phase evaluated there yielded NaN chi2.  Committed artifact pending
-     — BENCH_r01/r02 are CPU-fallback runs; the standing order is to
-     commit a TPU-backend bench JSON the first session the tunnel
-     revives). Consequence: the DD phase pipeline must stay
+     (observed in a round-2 session; **re-confirmed round 4** in a
+     ~2-minute live-tunnel window: ``self_check()`` returned False on
+     "TPU v5 lite" moments before the tunnel died again — the same
+     window also exposed the MXU bf16 demotion fixed in ops/mxu.py.
+     DD phase evaluated on-chip yields NaN chi2. A committed
+     TPU-backend bench JSON is still pending — every BENCH_r* so far
+     is a CPU fallback; tpu_evidence.py captures the full bundle the
+     next live window). Consequence: the DD phase pipeline must stay
      on the CPU backend, with only the collapsed-float64 linear algebra
      (design matrix / GLS solve — errors there multiply small parameter
      deltas) offloaded to the chip. Two implementations of that split:

@@ -11,8 +11,9 @@ a hand-tiled TPU kernel. Rationale over the XLA formulation in
   and the cross-block reduction is carried in a **compensated (hi, lo)
   f32 pair** via the TwoSum error-free transform, which *is* exact in
   hardware f32 (unlike the chip's emulated f64, whose error-free
-  transforms fail — observed on TPU v5e in a round-2 session, committed
-  artifact pending; the fact behind the whole hybrid design, see
+  transforms fail — observed on TPU v5e round 2, re-confirmed on
+  hardware round 4; committed artifact pending, see tpu_evidence.py;
+  the fact behind the whole hybrid design, see
   ``pint_tpu.ops.dd``). Net precision matches
   :func:`pint_tpu.ops.mxu.ds32_gram`'s f64 block accumulation
   (~2⁻⁴⁸ representation + ~√B·2⁻²⁴ per-block MXU floor).

@@ -55,7 +55,8 @@ def ensure_exact_f64() -> None:
 
     The interactive tools are single-dataset workflows whose DD phase
     arithmetic silently produces garbage on a backend with emulated
-    f64 (observed on TPU v5e, artifact pending — see pint_tpu.ops.dd).
+    f64 (observed on TPU v5e rounds 2 and 4, committed artifact
+    pending — see pint_tpu.ops.dd).
     The big-N TPU
     paths go through the hybrid/sharded fitters, which manage device
     placement themselves; everything a console script touches should
