@@ -144,8 +144,14 @@ def _coerce(x: DDLike) -> DD:
 
 
 def two_sum(a: Array, b: Array) -> tuple[Array, Array]:
-    """Knuth TwoSum: s + err == a + b exactly (6 flops, branch-free)."""
-    s = a + b
+    """Knuth TwoSum: s + err == a + b exactly (6 flops, branch-free).
+
+    The pivot sum is guarded too: backend FMA only fuses a MULTIPLY
+    into an add, but HLO-level rewrites in large programs can still
+    reassociate constant-chained adds, and the barrier half of _exact
+    blocks those until codegen (see _exact).
+    """
+    s = _exact(a + b)
     bb = s - a
     err = (a - (s - bb)) + (b - bb)
     return s, err
@@ -153,14 +159,52 @@ def two_sum(a: Array, b: Array) -> tuple[Array, Array]:
 
 def quick_two_sum(a: Array, b: Array) -> tuple[Array, Array]:
     """Fast TwoSum requiring |a| >= |b| (or a == 0)."""
-    s = a + b
+    s = _exact(a + b)  # see two_sum
     err = b - (s - a)
     return s, err
 
 
+def _exact(x: Array) -> Array:
+    """Pin a product's IEEE rounding against backend FMA contraction.
+
+    XLA:CPU's JIT builds its TargetMachine with FP-op fusion enabled,
+    so LLVM instruction selection contracts an ``fmul`` feeding an
+    ``fadd`` into one fma EVEN THOUGH the emitted IR carries no
+    fast-math flags (round-4 find: the dumped optimized HLO/LLVM-IR of
+    a jitted ``dd.mul`` is faithful Dekker arithmetic, yet the dumped
+    OBJECT CODE contains ``vfmadd213pd`` and the executed result is
+    off by ~1 ulp of the product — ~1e-6 relative on the pair, vs the
+    ~1e-32 DD contract; eager per-op execution is exact, which is why
+    ``self_check`` and the unit tests never caught it). HLO
+    ``optimization_barrier`` does NOT survive to codegen on CPU and
+    cannot prevent this.
+
+    The guard is two layers. A select whose condition is runtime data
+    (``x == x`` — true except NaN, where the DD pipeline is already
+    meaningless): ISel cannot pattern-match fmul->fadd THROUGH a
+    select, and no compiler pass can fold a data-dependent one. Plus an
+    ``optimization_barrier``, which holds HLO-level rewrites off the
+    pivot value for the passes it does survive. Applied where the EFT
+    proofs need an intermediate rounding pinned: the Dekker splitter
+    product, TwoProd's high product, and the TwoSum pivot sums — with
+    all guards in place the spindown-scale composed phase is BITWISE
+    identical jit-vs-eager (tests/test_model_core.py pins the composed
+    program at < 1e-12 turns; tests/test_dd.py pins dd.mul bitwise).
+    Cost, measured on the 2e4-TOA CPU GLS bench: iteration 0.078 ->
+    0.114 s (+46%) and design-matrix build ~2.3x — all in the DD phase
+    stage. Accepted deliberately: the alternative is a timing code
+    whose compiled phase silently differs from IEEE evaluation by tens
+    of ns for fast pulsars on decade baselines.
+    """
+    return jax.lax.optimization_barrier(
+        jnp.where(x == x, x, jnp.zeros_like(x)))
+
+
 def split(a: Array) -> tuple[Array, Array]:
     """Dekker split: a == hi + lo with hi, lo having <= 26/27-bit significands."""
-    t = _SPLITTER * a
+    # the barrier stops `t - a` contracting into fma(SPLITTER, a, -a),
+    # which skips t's rounding and breaks the split (see _exact)
+    t = _exact(_SPLITTER * a)
     hi = t - (t - a)
     lo = a - hi
     return hi, lo
@@ -168,7 +212,11 @@ def split(a: Array) -> tuple[Array, Array]:
 
 def two_prod(a: Array, b: Array) -> tuple[Array, Array]:
     """Dekker TwoProd: p + err == a * b exactly (IEEE multiply required)."""
-    p = a * b
+    # the barrier keeps every consumer of p (the err expansion here,
+    # two_sum chains in callers) reading the SAME rounded product —
+    # without it LLVM contracts one use into an fma and the pair no
+    # longer sums to a*b (see _exact)
+    p = _exact(a * b)
     ahi, alo = split(a)
     bhi, blo = split(b)
     err = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo

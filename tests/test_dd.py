@@ -170,3 +170,43 @@ def test_operator_sugar():
     assert float((a * b).hi) == 6.0
     assert float((a / b * b).hi) == 2.0
     assert float((2.0 + a).hi) == 4.0
+
+
+def test_eft_exact_inside_large_fused_jit():
+    """Round-4 regression: XLA:CPU's backend contracts fmul+fadd into
+    FMA at instruction selection (proven by vfmadd213pd in dumped
+    object code while the dumped IR was clean), silently breaking
+    Dekker TwoProd inside LARGE fused programs — small programs and
+    eager per-op execution are exact, so self_check alone cannot see
+    it. The _exact guards must make a spindown-scale jitted dd.mul
+    BITWISE-identical to the (decimal-verified-exact) eager result."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    hi = jnp.asarray(rng.uniform(1e7, 2.6e8, 2048))
+    lo = jnp.asarray(rng.uniform(-1e-9, 1e-9, 2048))
+    f0 = dd.DD(jnp.float64(478.41687741), jnp.float64(1.3e-15))
+
+    def f(h, l):
+        p = dd.mul(dd.DD(h, l), f0)
+        q = dd.add(p, dd.DD(jnp.float64(0.125), jnp.float64(0.0)))
+        return q.hi, q.lo
+
+    he, le = f(hi, lo)
+    hj, lj = jax.jit(f)(hi, lo)
+    # hi words bitwise (the ulp(product)-scale breakage this guards);
+    # lo words may differ below the DD floor (the error-term cross
+    # products are allowed to contract: their own rounding sits at
+    # ~2^-106 relative, verified < 1e-21 absolute here)
+    np.testing.assert_array_equal(np.asarray(he), np.asarray(hj))
+    assert float(np.max(np.abs(np.asarray(le) - np.asarray(lj)))) < 1e-20
+    # exactness of the eager reference on a few elements via Decimal
+    import decimal
+
+    decimal.getcontext().prec = 60
+    f0d = decimal.Decimal(478.41687741) + decimal.Decimal(1.3e-15)
+    for i in range(0, 2048, 512):
+        ref = ((decimal.Decimal(float(hi[i])) + decimal.Decimal(float(lo[i])))
+               * f0d + decimal.Decimal(0.125))
+        got = decimal.Decimal(float(he[i])) + decimal.Decimal(float(le[i]))
+        assert abs(float(got - ref)) < 1e-18

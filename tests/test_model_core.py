@@ -154,6 +154,36 @@ def test_below_range_series_index_rejected():
         get_model(BASE_MIN_PAR + "DM0 5.0\n")
 
 
+def test_composed_phase_jit_matches_eager():
+    """Round-4 regression (backend FMA contraction, see
+    tests/test_dd.py::test_eft_exact_inside_large_fused_jit): the FULL
+    composed phase program — spindown + astrometry + dispersion + TZR
+    anchor, the exact shape whose fused compilation exposed the bug —
+    must agree with eager evaluation to ~f64-delay round-off. The bug's
+    signature was ~1 ulp of the TOTAL phase (~1e-6 turns = tens of ns);
+    the bound here is three orders tighter (1e-9 turns ~ 2e-12 s; the
+    residual jit-vs-eager difference is ~1 ulp of the ~500 s Roemer
+    delay in PLAIN f64 — contraction of the components' f64 delay
+    math, which is harmless and permitted)."""
+    import jax
+
+    par = (BASE_MIN_PAR.replace("RAJ 04:37:15.9", "RAJ 04:37:15.9 1")
+           .replace("DECJ -47:15:09.1", "DECJ -47:15:09.1 1")
+           .replace("F0 100.0 1", "F0 478.416877410 1"))
+    m = get_model(par)
+    toas = make_fake_toas_uniform(53000, 56000, 64, m, obs="gbt",
+                                  freq_mhz=1400.0, niter=0)
+    pf = m.phase_fn_toas(tzr=m.get_tzr_toas(), abs_phase=True)
+    b, z = m.base_dd(), m.zero_deltas()
+
+    def frac(d):
+        ph = pf(b, d, toas)
+        return ph.frac.hi + ph.frac.lo
+
+    d = np.asarray(jax.jit(frac)(z)) - np.asarray(frac(z))
+    assert float(np.max(np.abs(d))) < 1e-9, np.max(np.abs(d))
+
+
 def test_design_matrix_vs_finite_difference(model, toas):
     """jacfwd design matrix vs central finite differences of the phase."""
     M, names = model.designmatrix(toas)

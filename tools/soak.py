@@ -13,8 +13,11 @@ each through the full pipeline:
 Failures print the full par text + seed so any hit is reproducible
 with ``python tools/soak.py --seed N --trials 1``.
 
-Run: JAX_PLATFORMS=cpu python tools/soak.py [--trials 50] [--seed 0]
-Exit code = number of failing trials (0 = clean).
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python tools/soak.py [--trials 50] [--seed 0]
+(the 8-device flag arms the sharded-fitter parity checks; without it
+those trials skip the mesh comparison). Exit code = number of failing
+trials (0 = clean).
 """
 
 from __future__ import annotations
@@ -191,17 +194,41 @@ def one_trial(seed: int) -> tuple[bool, str]:
             assert p.uncertainty is None or np.isfinite(p.uncertainty), (
                 f"{name} uncertainty not finite")
 
+        # optional extra harnesses draw from an INDEPENDENT stream so
+        # adding/removing one never shifts the main trial's rng — a
+        # recorded failing seed stays reproducible across soak versions
+        gates = np.random.default_rng((seed, 1))
+
+        def _parity_fit(make_fitter, label):
+            """Re-fit from the SAME perturbed start with another fitter
+            and require chi2 + parameter agreement with the auto fit."""
+            m_p = get_model(par, allow_tcb=True)
+            for name, d in perturbed.items():
+                m_p[name].add_delta(d)
+            f_p = make_fitter(m_p)
+            chi2_p = f_p.fit_toas(maxiter=12)
+            assert np.isfinite(chi2_p), f"{label} chi2 not finite"
+            rel = abs(chi2_p - chi2) / max(abs(chi2), 1e-12)
+            assert rel < 1e-3, (
+                f"{label}/auto chi2 mismatch: {chi2_p} vs {chi2}")
+            for name in model.free_params:
+                tol = max(5e-2 * (model[name].uncertainty or 0.0),
+                          1e-12 * max(1.0, abs(model[name].value_f64)))
+                assert abs(m_p[name].value_f64
+                           - model[name].value_f64) < tol, (
+                    f"{label}/auto {name} mismatch")
+
         # wideband fit on a fraction of trials: attach -pp_dm/-pp_dme
         # flags derived from the model's own DM(t) and run the stacked
         # TOA+DM fitter (random models exercise the wideband design
         # matrix across component combinations)
-        if rng.random() < 0.2:
+        if gates.random() < 0.2:
             from pint_tpu.fitting.wideband import WidebandTOAFitter
 
             m_wb = get_model(par, allow_tcb=True)
             dm_true = np.asarray(m_wb.total_dm(toas))
             wb_flags = Flags(dict(d, pp_dm=str(float(v) +
-                                               float(rng.normal(0, 1e-4))),
+                                               float(gates.normal(0, 1e-4))),
                                   pp_dme="1e-4")
                              for d, v in zip(toas.flags, dm_true))
             toas_wb = dataclasses.replace(toas, flags=wb_flags)
@@ -212,22 +239,28 @@ def one_trial(seed: int) -> tuple[bool, str]:
             assert chi2_wb / max(1, ndof_wb) < 5.0, (
                 f"wideband reduced chi2 {chi2_wb / ndof_wb} implausible")
 
+        # sharded-fitter parity on a fraction of trials: the mesh path
+        # (TOA axis sharded over the virtual 8-device CPU mesh) must
+        # reach the same fit as the dense fitter on RANDOM models —
+        # sharding is a layout, not an algorithm change
+        import jax
+
+        has_basis = any(getattr(c, "is_noise_basis", False)
+                        for c in model.components)
+        if gates.random() < 0.15 and len(jax.devices()) >= 8:
+            from pint_tpu.parallel import (ShardedGLSFitter,
+                                           ShardedWLSFitter, make_mesh)
+
+            cls = ShardedGLSFitter if has_basis else ShardedWLSFitter
+            _parity_fit(lambda m: cls(toas, m, mesh=make_mesh(8, psr_axis=1)),
+                        "sharded")
+
         # hybrid-fitter parity on a fraction of GLS-shaped trials: the
         # CPU/accelerator split must reach the same fit as the dense path
-        if (rng.random() < 0.25 and any(
-                getattr(c, "is_noise_basis", False)
-                for c in model.components)):
+        if gates.random() < 0.25 and has_basis:
             from pint_tpu.fitting.hybrid import HybridGLSFitter
 
-            m_h = get_model(par, allow_tcb=True)  # same perturbed start as the auto fit
-            for name, d in perturbed.items():
-                m_h[name].add_delta(d)
-            fh = HybridGLSFitter(toas, m_h)
-            chi2_h = fh.fit_toas(maxiter=12)
-            assert np.isfinite(chi2_h), "hybrid chi2 not finite"
-            rel = abs(chi2_h - chi2) / max(abs(chi2), 1e-12)
-            assert rel < 1e-3, (
-                f"hybrid/auto chi2 mismatch: {chi2_h} vs {chi2}")
+            _parity_fit(lambda m: HybridGLSFitter(toas, m), "hybrid")
 
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
