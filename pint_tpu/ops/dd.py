@@ -147,9 +147,9 @@ def two_sum(a: Array, b: Array) -> tuple[Array, Array]:
     """Knuth TwoSum: s + err == a + b exactly (6 flops, branch-free).
 
     The pivot sum is guarded too: backend FMA only fuses a MULTIPLY
-    into an add, but HLO-level rewrites in large programs can still
-    reassociate constant-chained adds, and the barrier half of _exact
-    blocks those until codegen (see _exact).
+    into an add, but the observed breakage also reached sums through
+    rematerialized products in sibling fusions — guarding the pivot
+    keeps every consumer on one rounded value (see _exact).
     """
     s = _exact(a + b)
     bb = s - a
@@ -179,30 +179,32 @@ def _exact(x: Array) -> Array:
     ``optimization_barrier`` does NOT survive to codegen on CPU and
     cannot prevent this.
 
-    The guard is two layers. A select whose condition is runtime data
-    (``x == x`` — true except NaN, where the DD pipeline is already
-    meaningless): ISel cannot pattern-match fmul->fadd THROUGH a
-    select, and no compiler pass can fold a data-dependent one. Plus an
-    ``optimization_barrier``, which holds HLO-level rewrites off the
-    pivot value for the passes it does survive. Applied where the EFT
-    proofs need an intermediate rounding pinned: the Dekker splitter
-    product, TwoProd's high product, and the TwoSum pivot sums — with
-    all guards in place the spindown-scale composed phase is BITWISE
-    identical jit-vs-eager (tests/test_model_core.py pins the composed
-    program at < 1e-12 turns; tests/test_dd.py pins dd.mul bitwise).
-    Cost, measured on the 2e4-TOA CPU GLS bench: iteration 0.078 ->
-    0.114 s (+46%) and design-matrix build ~2.3x — all in the DD phase
-    stage. Accepted deliberately: the alternative is a timing code
-    whose compiled phase silently differs from IEEE evaluation by tens
-    of ns for fast pulsars on decade baselines.
+    The guard: a select whose condition is runtime data (``x == x`` —
+    true except NaN, where the DD pipeline is already meaningless).
+    ISel cannot pattern-match fmul->fadd THROUGH a select, and no
+    compiler pass can fold a data-dependent one.
+    Applied where the EFT proofs need an intermediate rounding pinned:
+    the Dekker splitter product, TwoProd's high product, and the
+    TwoSum pivot sums. With the guards, a spindown-scale jitted
+    ``dd.mul`` is BITWISE identical to eager (tests/test_dd.py) and
+    the fully composed phase program agrees with eager to < 1e-9
+    turns (~1 ulp of the plain-f64 Roemer delay — harmless;
+    tests/test_model_core.py pins it). An ``optimization_barrier``
+    variant achieves bitwise parity for the composed program too, but
+    fragments every DD kernel (+5 min suite compile, +8% runtime) for
+    precision 5 orders below the ns contract — not worth it. Cost of
+    the select guard, measured on the 2e4-TOA CPU GLS bench:
+    iteration 0.078 -> 0.107 s and design-matrix build ~2.3x — all in
+    the DD phase stage. Accepted deliberately: the alternative is a
+    timing code whose compiled phase silently differs from IEEE
+    evaluation by tens of ns for fast pulsars on decade baselines.
     """
-    return jax.lax.optimization_barrier(
-        jnp.where(x == x, x, jnp.zeros_like(x)))
+    return jnp.where(x == x, x, jnp.zeros_like(x))
 
 
 def split(a: Array) -> tuple[Array, Array]:
     """Dekker split: a == hi + lo with hi, lo having <= 26/27-bit significands."""
-    # the barrier stops `t - a` contracting into fma(SPLITTER, a, -a),
+    # the guard stops `t - a` contracting into fma(SPLITTER, a, -a),
     # which skips t's rounding and breaks the split (see _exact)
     t = _exact(_SPLITTER * a)
     hi = t - (t - a)
@@ -212,7 +214,7 @@ def split(a: Array) -> tuple[Array, Array]:
 
 def two_prod(a: Array, b: Array) -> tuple[Array, Array]:
     """Dekker TwoProd: p + err == a * b exactly (IEEE multiply required)."""
-    # the barrier keeps every consumer of p (the err expansion here,
+    # the guard keeps every consumer of p (the err expansion here,
     # two_sum chains in callers) reading the SAME rounded product —
     # without it LLVM contracts one use into an fma and the pair no
     # longer sums to a*b (see _exact)
