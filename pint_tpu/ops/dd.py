@@ -486,11 +486,14 @@ def cos2pi(x: DD) -> Array:
 def self_check(device=None) -> bool:
     """Verify error-free-transform invariants hold on `device`.
 
-    Returns True iff TwoSum and TwoProd are exact under jit on the target
-    backend (compared against numpy IEEE float64). This is the evidence
-    gate for running the DD phase pipeline on an accelerator — bench.py
-    records it per run; see the module docstring for the fallback split
-    when a backend fails.
+    Returns True iff (a) TwoSum and TwoProd are exact under jit on the
+    target backend (compared against numpy IEEE float64) AND (b) a
+    whole-program fusion probe — a spindown-scale ``dd.mul`` returning
+    both words — gives the same results jitted as op-by-op (the round-4
+    FMA-contraction class, invisible to per-op checks). This is the
+    evidence gate for running the DD phase pipeline on an accelerator —
+    bench.py records it per run; see the module docstring for the
+    fallback split when a backend fails either way.
     """
     rng = np.random.default_rng(1234)
     a = rng.uniform(-1e9, 1e9, 4096)
@@ -518,4 +521,35 @@ def self_check(device=None) -> bool:
     ld = np.longdouble
     exact = ld(a) * ld(b * 1e6) - ld(p)
     ok_prod = bool(np.max(np.abs(ld(f) - exact)) < 1e-18 * np.max(np.abs(p)))
-    return bool(ok_sum and ok_prod)
+
+    # fusion probe: the round-4 FMA-contraction bug was INVISIBLE to
+    # the per-op checks above — small programs compile exactly, large
+    # fusions contract fmul+fadd at instruction selection (see _exact).
+    # A composite spindown-scale chain must give the SAME hi words
+    # under whole-program jit as op-by-op (eager) execution on the
+    # same device; divergence means compilation-dependent rounding.
+    def chain(h, l):
+        # exactly the shape that reproduced the contraction: one DD
+        # multiply of a spindown-scale pair by a DD scalar, BOTH words
+        # out (the two-output program is what splits the computation
+        # across fusions and exposes the rematerialized-product
+        # inconsistency)
+        x = mul(DD(h, l), DD(jnp.float64(478.41687741),
+                             jnp.float64(1.3e-15)))
+        return x.hi, x.lo
+
+    h = rng.uniform(1e7, 2.6e8, 4096)
+    low = rng.uniform(-1e-9, 1e-9, 4096)
+    if device is not None:
+        h = jax.device_put(h, device)
+        low = jax.device_put(low, device)
+    hi_jit, lo_jit = jax.jit(chain)(h, low)
+    hi_eager, lo_eager = chain(jnp.asarray(h), jnp.asarray(low))
+    # hi bitwise; lo words directly (a float64 collapse would round the
+    # lo contribution away entirely at these magnitudes) — divergence
+    # below 1e-20 absolute is the harmless error-term cross-product
+    # contraction, anything larger is compilation-dependent rounding
+    ok_fused = (np.array_equal(np.asarray(hi_jit), np.asarray(hi_eager))
+                and bool(np.max(np.abs(np.asarray(lo_jit)
+                                       - np.asarray(lo_eager))) < 1e-20))
+    return bool(ok_sum and ok_prod and ok_fused)
