@@ -1,0 +1,76 @@
+#!/bin/bash
+# Persistent on-TPU evidence capture loop (in-repo so it survives sandbox
+# resets and is auditable — round-4 VERDICT task 1).
+#
+# The axon TPU tunnel is alive only in short unpredictable windows and a
+# dead tunnel HANGS backend init, so: bounded probe first, then the
+# incremental evidence bundle (tpu_evidence.py saves after every step).
+# Policy change per round-4 VERDICT: commit TPU_EVIDENCE_r05.json after
+# ANY completed step, not only a full bundle.  `git commit -- <path>`
+# commits only that path, so the loop can never sweep up unrelated
+# work-in-progress from the main session.
+cd /root/repo || exit 1
+LOG=${TPU_RETRY_LOG:-/tmp/tpu_retry.log}
+EVID=TPU_EVIDENCE_r05.json
+
+steps_done() {
+    python - "$EVID" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+    print(len(d.get("steps_completed", [])))
+except Exception:
+    print(0)
+EOF
+}
+
+commit_evidence() {
+    # commit only when the artifact gained steps since the last commit
+    local n="$1"
+    local prev
+    prev=$(git show HEAD:"$EVID" 2>/dev/null | python -c "
+import json, sys
+try: print(len(json.load(sys.stdin).get('steps_completed', [])))
+except Exception: print(-1)" 2>/dev/null || echo -1)
+    if [ "$n" -gt "${prev:--1}" ]; then
+        git add "$EVID"
+        git commit -m "On-TPU evidence: $n/7 steps captured live" -- "$EVID" \
+            >> "$LOG" 2>&1
+    fi
+}
+
+echo "retry loop start $(date -u +%H:%M:%S)" >> "$LOG"
+for i in $(seq 1 400); do
+    # quick probe: 60s to list devices; skip the heavy run if dead
+    if ! timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "attempt $i $(date -u +%H:%M:%S): probe dead" >> "$LOG"
+        sleep 180
+        continue
+    fi
+    echo "attempt $i $(date -u +%H:%M:%S): probe ALIVE, capturing" >> "$LOG"
+    timeout 540 python tpu_evidence.py >> "$LOG" 2>&1
+    n=$(steps_done)
+    echo "attempt $i: $n/7 steps" >> "$LOG"
+    commit_evidence "$n"
+    if [ "$n" -ge 7 ]; then
+        echo "evidence complete; pallas hw tests + bench" >> "$LOG"
+        PINT_TPU_RUN_TPU_TESTS=1 timeout 540 python -m pytest \
+            tests/test_pallas.py -q >> "$LOG" 2>&1
+        timeout 1250 python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
+        echo "bench rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
+        cat /tmp/bench_tpu.json >> "$LOG"
+        if python -c "
+import json; d=json.load(open('/tmp/bench_tpu.json'))
+raise SystemExit(0 if d.get('backend') not in (None, 'cpu') else 1)" \
+                2>/dev/null; then
+            cp /tmp/bench_tpu.json BENCH_TPU_r05.json
+            git add BENCH_TPU_r05.json
+            git commit -m "On-TPU bench artifact captured live" \
+                -- BENCH_TPU_r05.json >> "$LOG" 2>&1
+        fi
+        touch /tmp/tpu_retry.DONE
+        exit 0
+    fi
+    sleep 120
+done
+echo "retry loop exhausted $(date -u +%H:%M:%S)" >> "$LOG"

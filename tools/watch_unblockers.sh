@@ -1,0 +1,85 @@
+#!/bin/bash
+# Watch for the three operator-supplied validation bundles (README top
+# block) and run the gated test families the moment they appear —
+# round-4 VERDICT task 6.  The operator may drop files mid-round in any
+# shell, so env vars set elsewhere are invisible here; this watcher
+# therefore polls both its own env vars AND a filesystem scan for the
+# bundles' signature files:
+#   golden : NGC6440E.par + NGC6440E.tim + expected.json  (PINT_TPU_GOLDEN_DIR)
+#   ephem  : any *.bsp JPL kernel                         (PINT_TPU_EPHEM_DIR)
+#   clock  : gps2utc.clk / time_*.dat IPTA products       (PINT_TPU_CLOCK_DIR)
+# On detection it runs the matching gated tests and commits the pytest
+# report as UNBLOCKED_r05_<bundle>.txt (path-scoped commit; can't sweep
+# up unrelated work).
+cd /root/repo || exit 1
+LOG=${WATCH_UNBLOCKERS_LOG:-/tmp/watch_unblockers.log}
+SCAN_ROOTS="/root /srv /data /mnt /media /tmp/operator"
+
+find_dirs_with() {  # find_dirs_with <glob> -> ALL directories containing it
+    for root in $SCAN_ROOTS; do
+        [ -d "$root" ] || continue
+        find "$root" -maxdepth 4 -name "$1" -not -path "*/repo/*" \
+            -not -path "*/.git/*" 2>/dev/null
+    done | xargs -r -n1 dirname | sort -u
+}
+
+first_dir_with() {  # first_dir_with <glob> [required-companion ...]
+    local glob="$1"; shift
+    local d f ok
+    for d in $(find_dirs_with "$glob"); do
+        ok=1
+        for f in "$@"; do [ -f "$d/$f" ] || { ok=""; break; }; done
+        [ -n "$ok" ] && { echo "$d"; return 0; }
+    done
+    return 1
+}
+
+run_bundle() {  # run_bundle <name> <envvar> <dir> <pytest-target>
+    local name="$1" envvar="$2" dir="$3" target="$4"
+    local out="UNBLOCKED_r05_${name}.txt"
+    echo "$(date -u +%H:%M:%S) $name bundle found at $dir" >> "$LOG"
+    { echo "# $name bundle detected at $dir ($(date -u +%FT%TZ))";
+      env "$envvar=$dir" timeout 900 python -m pytest "$target" -v 2>&1;
+    } > "$out"
+    git add "$out"
+    git commit -m "External $name bundle appeared: gated tests executed" \
+        -- "$out" >> "$LOG" 2>&1
+}
+
+echo "watcher start $(date -u +%H:%M:%S)" >> "$LOG"
+done_golden=""; done_ephem=""; done_clock=""
+for i in $(seq 1 300); do
+    if [ -z "$done_golden" ]; then
+        # a complete bundle anywhere wins; a stray partial par file must
+        # not shadow it
+        d="${PINT_TPU_GOLDEN_DIR:-$(first_dir_with 'NGC6440E.par' \
+            NGC6440E.tim expected.json)}"
+        if [ -n "$d" ] && [ -f "$d/NGC6440E.tim" ] && \
+           [ -f "$d/expected.json" ]; then
+            run_bundle golden PINT_TPU_GOLDEN_DIR "$d" \
+                tests/test_external_golden.py
+            done_golden=1
+        fi
+    fi
+    if [ -z "$done_ephem" ]; then
+        d="${PINT_TPU_EPHEM_DIR:-$(first_dir_with '*.bsp')}"
+        if [ -n "$d" ]; then
+            run_bundle ephem PINT_TPU_EPHEM_DIR "$d" tests/test_bsp.py
+            done_ephem=1
+        fi
+    fi
+    if [ -z "$done_clock" ]; then
+        # any IPTA-style product counts: *.clk or time_*.dat, matching
+        # what tests/test_data_layer.py globs for
+        d="${PINT_TPU_CLOCK_DIR:-$(first_dir_with '*.clk')}"
+        [ -n "$d" ] || d="$(first_dir_with 'time_*.dat')"
+        if [ -n "$d" ]; then
+            run_bundle clock PINT_TPU_CLOCK_DIR "$d" tests/test_data_layer.py
+            done_clock=1
+        fi
+    fi
+    [ -n "$done_golden" ] && [ -n "$done_ephem" ] && [ -n "$done_clock" ] && {
+        echo "all bundles captured $(date -u +%H:%M:%S)" >> "$LOG"; exit 0; }
+    sleep 120
+done
+echo "watcher exhausted $(date -u +%H:%M:%S)" >> "$LOG"

@@ -1,4 +1,4 @@
-"""On-hardware evidence capture → TPU_EVIDENCE_r04.json (incremental).
+"""On-hardware evidence capture → TPU_EVIDENCE_r05.json (incremental).
 
 Four rounds of VERDICTs have demanded a committed artifact measured on
 the chip in this project's name; the axon tunnel is alive only in
@@ -35,17 +35,25 @@ import pint_tpu  # noqa: F401  (x64 + platform guard)
 import jax
 import jax.numpy as jnp
 
-OUT = os.environ.get("PINT_TPU_EVIDENCE_OUT", "TPU_EVIDENCE_r04.json")
+OUT = os.environ.get("PINT_TPU_EVIDENCE_OUT", "TPU_EVIDENCE_r05.json")
 N_HYBRID = int(os.environ.get("PINT_TPU_EVIDENCE_N", "100000"))
+# @step functions below: backend, dd_self_check, emulated_f64_matmul_accuracy,
+# ds32_gram_xla, pallas_gram_interpret, pallas_gram_hardware,
+# hybrid_gls_iteration (docstring item 5 covers the two pallas steps)
+N_STEPS = 7
 
 results: dict = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                  "steps_completed": []}
 
 
 def _save() -> None:
-    with open(OUT, "w") as fh:
+    # atomic: a tunnel kill mid-write must not corrupt the artifact this
+    # script exists to preserve
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(results, fh, indent=1)
         fh.write("\n")
+    os.replace(tmp, OUT)
 
 
 # a hang at backend init is itself evidence: record the attempt before
@@ -220,8 +228,8 @@ def _hybrid():
             "vs_baseline_budget": round(30.0 * (N_HYBRID / 6e5) / value, 3)}
 
 
-results["note"] = (f"{len(results['steps_completed'])}/6 steps ran to "
-                   "completion (per-step 'error' keys mark failures)")
+results["note"] = (f"{len(results['steps_completed'])}/{N_STEPS} steps ran "
+                   "to completion (per-step 'error' keys mark failures)")
 _save()
 
 if __name__ == "__main__":
