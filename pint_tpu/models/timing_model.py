@@ -396,6 +396,17 @@ class TimingModel:
         fp = (type(self).__name__, key, self._fn_fingerprint())
         ent = _JIT_PROGRAM_CACHE.get_lru(fp)
         if ent is None:
+            # once-per-process (cached per backend) EFT gate: a
+            # toolchain whose codegen defeats the select guard must
+            # warn in plain library use, not only at bench time
+            # (round-4 advisor; see ops/dd.ensure_backend_guard).
+            # Honor an active jax.default_device override — the hybrid
+            # fitters build their DD programs under a CPU pin, and the
+            # guard must validate the backend the program will RUN on,
+            # not the process default.
+            from pint_tpu.ops.dd import ensure_backend_guard
+
+            ensure_backend_guard(jax.config.jax_default_device)
             owner = _copy.deepcopy(self)
             # the content-keyed eager-noise cache can hold O(n x k)
             # dense bases (hundreds of MB at scale); the phase/design
@@ -554,14 +565,24 @@ class TimingModel:
                     continue
                 lines.append(p.as_parfile_line())
         # component lines owned by no param (see extra_par_lines):
-        # emitted once per NAME across the whole file
-        emitted = {ln.split()[0] for ln in lines if ln and not
-                   ln.startswith("#")}
+        # emitted once per NAME across the whole file. Entries may be
+        # multi-line strings (a DMX value plus its DMXR1_/DMXR2_
+        # companions), so every PHYSICAL line's first token counts —
+        # registering only the first token of the string would let a
+        # later component silently duplicate a companion name.
+        def _line_names(s: str) -> set[str]:
+            return {pl.split()[0] for pl in s.splitlines()
+                    if pl.strip() and not pl.lstrip().startswith("#")}
+
+        emitted: set[str] = set()
+        for ln in lines:
+            if ln:
+                emitted |= _line_names(ln)
         for c in self.components:
             for extra in c.extra_par_lines():
-                name = extra.split()[0]
-                if name not in emitted:
-                    emitted.add(name)
+                names = _line_names(extra)
+                if not (names & emitted):
+                    emitted |= names
                     lines.append(extra)
         return "\n".join(lines) + "\n"
 

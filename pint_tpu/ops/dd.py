@@ -164,6 +164,7 @@ def quick_two_sum(a: Array, b: Array) -> tuple[Array, Array]:
     return s, err
 
 
+@jax.custom_jvp
 def _exact(x: Array) -> Array:
     """Pin a product's IEEE rounding against backend FMA contraction.
 
@@ -198,8 +199,33 @@ def _exact(x: Array) -> Array:
     the DD phase stage. Accepted deliberately: the alternative is a
     timing code whose compiled phase silently differs from IEEE
     evaluation by tens of ns for fast pulsars on decade baselines.
+
+    **Tangents pass through unguarded** (custom_jvp below): the guard
+    exists to pin the *value* chain — the DD residual that must agree
+    with IEEE evaluation to the lo word. Derivative columns only ever
+    need plain-f64 accuracy (they are collapsed via ``astype_f64`` and
+    multiply small parameter deltas in Gauss-Newton; a contracted fma
+    in a tangent product shifts a design-matrix entry by ~1 ulp
+    relative, ~1e-16), so threading selects through the jacfwd tangent
+    graph costs the design-matrix build ~2.3x for nothing. The primal
+    inside ``jacfwd(..., has_aux=True)`` keeps its selects, so the
+    residual extracted from the same evaluation keeps bitwise parity
+    (round-5 clawback of the round-4 regression; pinned by
+    tests/test_dd.py::test_jacfwd_primal_keeps_guard).
+
+    NaN handling: the else-branch is NaN (not 0.0), so a NaN entering
+    an EFT poisons the hi word too — a consumer reading only hi
+    (int_part extraction, masks) sees NaN, not finite garbage
+    (round-4 advisor finding). Still a data-dependent select: neither
+    branch is foldable and ISel cannot contract through it.
     """
-    return jnp.where(x == x, x, jnp.zeros_like(x))
+    return jnp.where(x == x, x, jnp.full_like(x, jnp.nan))
+
+
+@_exact.defjvp
+def _exact_jvp(primals, tangents):
+    (x,), (dx,) = primals, tangents
+    return _exact(x), dx
 
 
 def split(a: Array) -> tuple[Array, Array]:
@@ -481,6 +507,44 @@ def cos2pi(x: DD) -> Array:
 # ---------------------------------------------------------------------------
 # Backend validation
 # ---------------------------------------------------------------------------
+
+_BACKEND_GUARD_OK: dict = {}
+
+
+def ensure_backend_guard(device=None) -> bool:
+    """Once-per-process EFT gate for plain library use (cached per backend).
+
+    The round-4 FMA-contraction find means the select guard's validity
+    is a property of the *toolchain*, not the source: a jaxlib/LLVM
+    upgrade whose instruction selection learns to pattern-match through
+    a data-dependent select would silently reintroduce ulp-scale phase
+    errors in ordinary ``Fitter``/``Residuals`` use, with only
+    bench-time ``self_check`` calls standing guard. This runs the full
+    :func:`self_check` (per-op EFTs + the whole-program fusion probe)
+    the first time a DD phase program is built on each backend
+    (``TimingModel._cached_jit`` calls it) and warns loudly on failure
+    instead of relying on bench/CI toolchain parity. It deliberately
+    warns rather than raises: a failing backend is exactly what the
+    hybrid CPU-DD/accelerator-solve split exists to work around, and
+    the TPU backend is *expected* to fail (TPU_OBSERVATIONS.json).
+    """
+    key = device.platform if device is not None else jax.default_backend()
+    ok = _BACKEND_GUARD_OK.get(key)
+    if ok is None:
+        ok = self_check(device)
+        _BACKEND_GUARD_OK[key] = ok
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                f"double-double error-free transforms do NOT hold on "
+                f"backend {key!r} (per-op or whole-program fusion probe "
+                f"failed): DD phase/residual results computed there are "
+                f"untrustworthy. Keep DD work on an IEEE float64 CPU "
+                f"backend (pint_tpu.fitting.hybrid) — see "
+                f"pint_tpu.ops.dd docstring and TPU_OBSERVATIONS.json.",
+                RuntimeWarning, stacklevel=2)
+    return ok
 
 
 def self_check(device=None) -> bool:

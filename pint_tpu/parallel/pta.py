@@ -396,9 +396,12 @@ class PTAGLSFitter:
                 p = (len(model.free_params)
                      + (0 if model.has_component("PhaseOffset") else 1))
                 k_pl = int(sum(2 * s.nharm for s in pl_specs))
-                stage1 = model._cached_jit(
-                    ("whiten_stage1",),
-                    lambda owner: make_whiten_stage1(owner))
+                # build under the CPU pin so the EFT backend gate in
+                # _cached_jit validates the device the DD stage runs on
+                with jax.default_device(cpu):
+                    stage1 = model._cached_jit(
+                        ("whiten_stage1",),
+                        lambda owner: make_whiten_stage1(owner))
                 dev_args = ship_stage2_statics(toas, noise, self.accel_dev)
                 # stage2 is NOT pinned here: _run_hybrid resolves it per
                 # call through the bounded program cache, so a pallas->

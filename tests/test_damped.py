@@ -68,3 +68,35 @@ def test_maxiter_exhaustion_reports_not_converged():
         min_chi2_decrease=1e-30)
     assert not converged
     assert 0.0 < deltas["x"] < 0.1
+
+
+def test_chi2_probe_used_for_halved_trials():
+    """With chi2_at provided, halved trials are judged by the cheap
+    probe (no full step); a probe-accepted point is re-evaluated once
+    with the full step; and the trajectory matches the no-probe driver
+    (round-4 verdict task 2a)."""
+    calls = {"full": 0, "probe": 0}
+
+    def iterate(deltas):
+        calls["full"] += 1
+        x = float(deltas["x"])
+        return {"x": x + 3.2 * (3.0 - x)}, {"chi2_at_input": (x - 3.0) ** 2}
+
+    def chi2_at(deltas):
+        calls["probe"] += 1
+        return (float(deltas["x"]) - 3.0) ** 2
+
+    d1, _i, c1, conv = downhill_iterate(
+        iterate, {"x": 0.0}, maxiter=50, min_chi2_decrease=1e-10,
+        chi2_at=chi2_at)
+    assert conv and abs(d1["x"] - 3.0) < 1e-3
+    assert calls["probe"] > 0          # halvings went through the probe
+
+    calls_probe_full = calls["full"]
+    calls.update(full=0, probe=0)
+    d2, _i2, c2, conv2 = downhill_iterate(
+        iterate, {"x": 0.0}, maxiter=50, min_chi2_decrease=1e-10)
+    assert conv2
+    assert abs(d1["x"] - d2["x"]) < 1e-12 and abs(c1 - c2) < 1e-15
+    # the probe path must not cost MORE full steps than the plain path
+    assert calls_probe_full <= calls["full"]

@@ -210,3 +210,46 @@ def test_eft_exact_inside_large_fused_jit():
                * f0d + decimal.Decimal(0.125))
         got = decimal.Decimal(float(he[i])) + decimal.Decimal(float(le[i]))
         assert abs(float(got - ref)) < 1e-18
+
+
+def test_jacfwd_primal_keeps_guard():
+    """Round-5: _exact passes TANGENTS through unguarded (custom_jvp)
+    so the design-matrix jacfwd pays no select tax, but the PRIMAL
+    inside jacfwd(..., has_aux=True) must keep its selects — the
+    residual extracted from the same evaluation carries the bitwise
+    contract of test_eft_exact_inside_large_fused_jit."""
+    import jax
+
+    rng = np.random.default_rng(7)
+    hi = jnp.asarray(rng.uniform(1e7, 2.6e8, 2048))
+    lo = jnp.asarray(rng.uniform(-1e-9, 1e-9, 2048))
+
+    def f(delta):
+        f0 = dd.add(dd.DD(jnp.float64(478.41687741), jnp.float64(1.3e-15)),
+                    delta)
+        p = dd.mul(dd.DD(hi, lo), f0)
+        return p.hi + p.lo, (p.hi, p.lo)  # collapsed column + DD words
+
+    J, (ph, pl) = jax.jit(
+        lambda d: jax.jacfwd(f, has_aux=True)(d))(jnp.float64(0.0))
+    _, (eh, el) = f(jnp.float64(0.0))  # eager guarded reference
+    np.testing.assert_array_equal(np.asarray(ph), np.asarray(eh))
+    assert float(np.max(np.abs(np.asarray(pl) - np.asarray(el)))) < 1e-20
+    # tangent: d((hi+lo)*f0)/d(delta added to f0) = hi+lo, to plain-f64
+    x = np.asarray(hi) + np.asarray(lo)
+    assert float(np.max(np.abs((np.asarray(J) - x) / x))) < 1e-13
+
+
+def test_nan_poisons_hi_word():
+    """Round-4 advisor: a NaN entering an EFT must surface in the HI
+    word (the guard's else-branch is NaN, not 0), so consumers reading
+    only hi see the poison, preserving the broken-backend signal."""
+    import jax
+
+    nan = jnp.float64(np.nan)
+    s, _e = jax.jit(dd.two_sum)(nan, jnp.float64(1.0))
+    assert np.isnan(np.asarray(s))
+    p, _f = jax.jit(dd.two_prod)(nan, jnp.float64(2.0))
+    assert np.isnan(np.asarray(p))
+    m = jax.jit(lambda: dd.mul(dd.DD(nan, jnp.float64(0.0)), 3.0))()
+    assert np.isnan(np.asarray(m.hi))

@@ -297,3 +297,23 @@ def test_hybrid_downhill_semantics(noise_problem):
     for name in ("F0", "F1", "DM"):
         a, b = pert_a[name], pert_b[name]
         assert abs(a.value_f64 - b.value_f64) < 0.05 * a.uncertainty, name
+
+
+def test_hybrid_chi2_probe_matches_full(noise_problem):
+    """The O(n·k) chi2 probe (_chi2_at: residual-only stage 1 + cached
+    noise-block Cholesky) must reproduce the full fused step's
+    chi2_at_input at an arbitrary trial point — same algebra, different
+    program (round-4 verdict task 2a)."""
+    import jax
+
+    from pint_tpu.fitting.hybrid import HybridGLSFitter
+
+    model, toas = noise_problem
+    f = HybridGLSFitter(toas, model)
+    base = jax.device_put(model.base_dd(), f.cpu)
+    deltas = {k: jnp.zeros((), jnp.float64) for k in f._names}
+    trial = dict(deltas, F0=jnp.float64(2e-10))
+    _, sol = f._iterate(base, trial)
+    probe = f._chi2_at(base, trial)
+    np.testing.assert_allclose(probe, float(sol["chi2_at_input"]),
+                               rtol=1e-9)

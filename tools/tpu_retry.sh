@@ -54,22 +54,29 @@ for i in $(seq 1 400); do
     commit_evidence "$n"
     if [ "$n" -ge 7 ]; then
         echo "evidence complete; pallas hw tests + bench" >> "$LOG"
-        PINT_TPU_RUN_TPU_TESTS=1 timeout 540 python -m pytest \
-            tests/test_pallas.py -q >> "$LOG" 2>&1
+        if [ ! -f /tmp/tpu_retry.pallas_done ]; then
+            PINT_TPU_RUN_TPU_TESTS=1 timeout 540 python -m pytest \
+                tests/test_pallas.py -q >> "$LOG" 2>&1 \
+                && touch /tmp/tpu_retry.pallas_done
+        fi
         timeout 1250 python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
         echo "bench rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
         cat /tmp/bench_tpu.json >> "$LOG"
+        # exit ONLY once a genuinely on-TPU bench record is committed;
+        # a CPU-fallback record (tunnel died mid-bench) means the next
+        # live window should try again, not give up
         if python -c "
 import json; d=json.load(open('/tmp/bench_tpu.json'))
-raise SystemExit(0 if d.get('backend') not in (None, 'cpu') else 1)" \
-                2>/dev/null; then
+raise SystemExit(0 if str(d.get('backend', 'cpu')) not in ('cpu', 'None')
+                 and d.get('value', -1) > 0 else 1)" 2>/dev/null; then
             cp /tmp/bench_tpu.json BENCH_TPU_r05.json
             git add BENCH_TPU_r05.json
             git commit -m "On-TPU bench artifact captured live" \
                 -- BENCH_TPU_r05.json >> "$LOG" 2>&1
+            touch /tmp/tpu_retry.DONE
+            exit 0
         fi
-        touch /tmp/tpu_retry.DONE
-        exit 0
+        echo "bench not on-TPU; retrying at next live window" >> "$LOG"
     fi
     sleep 120
 done
