@@ -624,6 +624,17 @@ def main() -> None:
                        PINT_TPU_BENCH_PSRS=os.environ.get(
                            "PINT_TPU_BENCH_PSRS", "8"))
         pta_res, pta_fail = run_child(pta_env, remaining - 20.0)
+        if pta_res is not None:
+            # the tunnel can die between children: a PTA record whose
+            # backend differs from the primary's must say so, or an
+            # "on-TPU" artifact would silently embed a CPU number
+            pb = str(pta_res.get("backend", ""))
+            mb = str(primary.get("backend", ""))
+            if pb.split()[0:1] != mb.split()[0:1]:
+                pta_res["fallback_reason"] = (
+                    f"pta child ran on backend {pb!r} while the primary "
+                    f"record is {mb!r} (tunnel state changed between "
+                    f"children)")
         primary["pta"] = (pta_res if pta_res is not None
                           else {"error": pta_fail})
 

@@ -409,18 +409,21 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
 
         def total_phase(d):
             ph = phase_fn(base, d, toas)
-            return ph.int_part + (ph.frac.hi + ph.frac.lo)
+            # one DD pipeline trace serves residual + jacobian via
+            # has_aux (guarded primal keeps the residual bitwise — see
+            # make_whiten_stage1); a separate residual evaluation
+            # doubled the program's op count and compile time
+            return (ph.int_part + (ph.frac.hi + ph.frac.lo),
+                    ph.frac.hi + ph.frac.lo)
 
         err = model.scaled_toa_uncertainty(toas)
         w = 1.0 / jnp.square(err)
 
-        ph = phase_fn(base, deltas, toas)
-        resid_turns = ph.frac.hi + ph.frac.lo
+        J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
         if not has_phoff:
             resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
 
-        J = jax.jacfwd(total_phase)(deltas)
         cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
             + [-J[k] / f0 for k in names]
         M = jnp.stack(cols, axis=1)

@@ -403,22 +403,39 @@ class HybridGLSFitter(Fitter):
         is a function of the TOA table only), so the whitened noise
         block ``A_F``, its ECORR cross/diagonal blocks and the Cholesky
         factor of the noise-only Schur system are all
-        iteration-independent — built once here (on the accelerator,
-        from the last full iteration's packed buffer) and reused by
-        every probe. The algebra mirrors
+        iteration-independent — built once here on the CPU device and
+        reused by every probe. The algebra mirrors
         :func:`pint_tpu.fitting.gls_step.gls_gram_whitened` restricted
         to the noise columns + :func:`noise_marginal_chi2` (which is
         independent of the timing columns), so probe values track the
         full program's ``chi2_at_input`` to XLA-reordering roundoff.
         """
+        # The probe runs ENTIRELY on the CPU device: it is O(n·k) exact
+        # f64 linear algebra — the op class measured ~100x slower as the
+        # accelerator's emulated f64, and unlike stage 2 it is not
+        # normalized/double-single, so routing it through the chip would
+        # need the whole mxu/fallback machinery for no win. rw is
+        # already CPU-resident (residual-only stage 1).
         # sw is a pure function of the TOA table (same expression as
         # make_whiten_stage1) — computed directly so the probe has no
-        # ordering dependency on a prior full _iterate
+        # ordering dependency on a prior full _iterate.
         with jax.default_device(self.cpu):
             err = self.model.scaled_toa_uncertainty(self._toas_cpu)
-            sw_host = 1.0 / jnp.asarray(err)
-        sw = jax.device_put(sw_host, self.accel)
+            sw = 1.0 / jnp.asarray(err)
         ne, pl_specs = self._ne, self.pl_specs
+        # CPU copies of the shipped statics + the Fourier block (the
+        # one-time O(n·k) build mirrors _pl_static, on the host)
+        noise_cpu = tuple(jax.device_put(x, self.cpu)
+                          for x in self._noise_dev)
+        if pl_specs:
+            with jax.default_device(self.cpu):
+                F_cpu, fs_cpu = jax.jit(
+                    lambda t, i: _accel_pl_basis_arrays(t, i, pl_specs))(
+                        noise_cpu[3], noise_cpu[4])
+            pl_static = (F_cpu,) + tuple(fs_cpu)
+        else:
+            pl_static = ()
+        self._probe_epoch_idx_cpu = noise_cpu[0]
 
         def build(sw, epoch_idx, ecorr_phi, pl_params, t_s, inv_f2,
                   *pl_static):
@@ -456,7 +473,8 @@ class HybridGLSFitter(Fitter):
                 cho = jnp.zeros((0, 0))
             return A_F, C, d, cho, sw
 
-        consts = jax.jit(build)(sw, *self._noise_dev, *self._pl_static)
+        with jax.default_device(self.cpu):
+            consts = jax.jit(build)(sw, *noise_cpu, *pl_static)
         k = int(consts[0].shape[1])
 
         def chi2_fn(rw, epoch_idx, A_F, C, d, cho, sw):
@@ -493,8 +511,8 @@ class HybridGLSFitter(Fitter):
         if self._chi2_probe is None:
             self._chi2_probe = self._build_chi2_probe()
         consts, prog = self._chi2_probe
-        out = prog(jax.device_put(rw, self.accel), self._noise_dev[0],
-                   *consts)
+        with jax.default_device(self.cpu):
+            out = prog(rw, self._probe_epoch_idx_cpu, *consts)
         return float(np.asarray(out))
 
     def fit_toas(self, maxiter: int = 20, **kw) -> float:

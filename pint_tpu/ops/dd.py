@@ -528,10 +528,17 @@ def ensure_backend_guard(device=None) -> bool:
     hybrid CPU-DD/accelerator-solve split exists to work around, and
     the TPU backend is *expected* to fail (TPU_OBSERVATIONS.json).
     """
-    key = device.platform if device is not None else jax.default_backend()
+    # accept a Device, a platform string (jax.default_device allows
+    # 'cpu'/'gpu'/'tpu'), or None (process default backend)
+    if device is None:
+        key, dev = jax.default_backend(), None
+    elif isinstance(device, str):
+        key, dev = device, jax.devices(device)[0]
+    else:
+        key, dev = device.platform, device
     ok = _BACKEND_GUARD_OK.get(key)
     if ok is None:
-        ok = self_check(device)
+        ok = self_check(dev)
         _BACKEND_GUARD_OK[key] = ok
         if not ok:
             import warnings

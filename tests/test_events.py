@@ -148,7 +148,7 @@ def test_fit_template_recovers():
 def test_event_fitter_recovers_f0(tmp_path):
     rng = np.random.default_rng(6)
     p = tmp_path / "fit.fits"
-    _write_events(p, rng, n=600)
+    _write_events(p, rng, n=400)
     toas = load_nicer_TOAs(str(p))
     model = get_model(PAR.replace(f"F0             {F0}",
                                   f"F0             {F0}  1"))
@@ -158,7 +158,7 @@ def test_event_fitter_recovers_f0(tmp_path):
 
     f = EventFitter(toas, model, TEMPLATE,
                     priors={"F0": UniformPrior(F0 - 2e-6, F0 + 2e-6)})
-    best = f.fit_toas(nsteps=400, seed=2)
+    best = f.fit_toas(nsteps=250, seed=2)
     assert np.isfinite(best)
     # the true F0 maximizes the template likelihood
     assert abs(model["F0"].value_f64 - F0) < 5e-8

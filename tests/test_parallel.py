@@ -36,7 +36,8 @@ TZRSITE 1
 """
 
 
-def _problem(seed=1, ntoas=100, f0_extra=0.0):
+def _problem(seed=1, ntoas=96, f0_extra=0.0):  # 96 = the 2d-mesh size:
+    # one simulate/fit shape per structure instead of two
     par = PAR
     if f0_extra:
         par = par.replace("61.485476554", f"{61.485476554 + f0_extra:.9f}")
@@ -157,9 +158,11 @@ def test_batched_heterogeneous_matches_individual():
     for i, par in enumerate(pars):
         truth = get_model(par)
         # three bands: a JUMP on one band must not be degenerate with
-        # DM + offset (with two bands it is, and the fit diverges)
+        # DM + offset (with two bands it is, and the fit diverges).
+        # 57 TOAs (19/band) is the tolerance floor for the 5%-sigma
+        # parity below; the full-size case runs in scale_proof.py
         toas = make_fake_toas_uniform(
-            53478, 54187, 81, truth, obs="gbt",
+            53478, 54187, 57, truth, obs="gbt",
             freq_mhz=np.array([1400.0, 800.0, 430.0]), error_us=2.0,
             add_noise=True, seed=40 + i)
         pert_i = get_model(par)
@@ -167,14 +170,14 @@ def test_batched_heterogeneous_matches_individual():
         pert_b = get_model(par)
         pert_b["F0"].add_delta(2e-10)
         f = WLSFitter(toas, pert_i)
-        f.fit_toas(maxiter=3)
+        f.fit_toas(maxiter=2)
         individuals.append(pert_i)
         problems.append((toas, pert_b))
 
     bf = BatchedPulsarFitter(problems)  # default mesh: psr=gcd(3,8)=1, toa=8
     assert "PB" in bf.free_params and any(
         k.startswith("JUMP") for k in bf.free_params)
-    chi2 = bf.fit_toas(maxiter=3)
+    chi2 = bf.fit_toas(maxiter=2)
     assert chi2.shape == (3,)
     for ind, (toas, bat) in zip(individuals, problems):
         for name in ind.free_params:
