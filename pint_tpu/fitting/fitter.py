@@ -248,6 +248,11 @@ class Fitter:
                 continue
             if nodmx and name.startswith("DMX"):
                 continue
+            if p.frozen and p.kind == "float" and not np.isfinite(p.value_f64):
+                # unset alternate-convention params (e.g. RNAMP when the
+                # model uses TNRED*): as_parfile skips them; so does the
+                # summary table
+                continue
             flag = "" if p.frozen else "*"
             out.append(
                 f"{name + flag:<12}{p.format_value():>24}"

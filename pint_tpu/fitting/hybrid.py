@@ -200,14 +200,6 @@ def make_resid_stage1(model, tzr=None):
     return stage1r
 
 
-def _accel_pl_bases(t_s, inv_f2, specs: tuple[PLSpec, ...], pl_params):
-    """pl_bases rebuilt from plain arrays (accelerator side)."""
-    if not specs:
-        return None, None
-    F, fs = _accel_pl_basis_arrays(t_s, inv_f2, specs)
-    return F, _accel_pl_phi(fs, specs, pl_params)
-
-
 def _accel_pl_basis_arrays(t_s, inv_f2, specs: tuple[PLSpec, ...]):
     """The iteration-INDEPENDENT part of the noise bases: the stacked
     Fourier block (n, k_F) with chromatic scaling applied, plus the

@@ -37,7 +37,7 @@ import numpy as np
 
 from pint_tpu.constants import SECS_PER_DAY
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
-                                       fourier_design, pl_bases,
+                                       fourier_design,
                                        powerlaw_phi)
 
 Array = jax.Array
@@ -153,7 +153,12 @@ def _eliminate_all(As, Bs, cts):
 
 
 def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
-    """Build ``gram(base, deltas, toas, noise) -> dict`` for one pulsar.
+    """Build ``gram(base, deltas, toas, noise, *pl_static) -> dict``.
+
+    ``pl_static`` is REQUIRED: the iteration-independent ``(F, *fs)``
+    noise block from :func:`pta_basis_prog` (built once per pulsar at
+    prepare time; rebuilding O(n·k) transcendentals per call was the
+    dominant per-iteration cost after the gram itself).
 
     One jitted call produces everything the global PTA solve needs from
     this pulsar: the reduced extended Gram S (q, q) with ECORR epochs
@@ -173,39 +178,43 @@ def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
     # subtraction (see TimingModel.designmatrix)
     has_phoff = model.has_component("PhaseOffset")
 
-    def gram(base, deltas, toas, noise: NoiseStatics):
+    def gram(base, deltas, toas, noise: NoiseStatics, *pl_static):
         f0 = base["F0"].hi + base["F0"].lo
 
         def total_phase(d):
             ph = phase_fn(base, d, toas)
-            return ph.int_part + (ph.frac.hi + ph.frac.lo)
+            # one DD trace serves residual + jacobian (has_aux; guarded
+            # primal keeps the residual bitwise — see make_whiten_stage1)
+            return (ph.int_part + (ph.frac.hi + ph.frac.lo),
+                    ph.frac.hi + ph.frac.lo)
 
         err = model.scaled_toa_uncertainty(toas)
         w = 1.0 / jnp.square(err)
 
-        ph = phase_fn(base, deltas, toas)
-        resid_turns = ph.frac.hi + ph.frac.lo
+        J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
         if not has_phoff:
             resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
 
-        J = jax.jacfwd(total_phase)(deltas)
         cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
             + [-J[k] / f0 for k in names]
         M = jnp.stack(cols, axis=1)
         p = M.shape[1]
 
-        F_pl, phi_pl = pl_bases(toas, pl_specs, noise.pl_params)
-        t_s = (toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
-        F_gw, f_gw, _ = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
-                                       tspan=gw.tspan_s)
-        blocks = [M] + ([F_pl] if F_pl is not None else []) + [F_gw]
-        B = jnp.concatenate(blocks, axis=1)
+        # iteration-independent [PL | GW] block built once per fitter
+        # (pta_basis_prog); only the O(k) phi depends on the traced
+        # hyperparameters
+        from pint_tpu.fitting.hybrid import _accel_pl_phi
+
+        F_noise = pl_static[0]
+        k_pl = F_noise.shape[1] - 2 * gw.nharm
+        phi_pl = (_accel_pl_phi(pl_static[1:], pl_specs, noise.pl_params)
+                  if pl_specs else None)
+        B = jnp.concatenate([M, F_noise], axis=1)
         q = B.shape[1]
-        k_pl = 0 if F_pl is None else F_pl.shape[1]
         phiinv = jnp.concatenate([
             jnp.zeros(p),
-            1.0 / phi_pl if F_pl is not None else jnp.zeros(0),
+            1.0 / phi_pl if phi_pl is not None else jnp.zeros(0),
             jnp.zeros(2 * gw.nharm),    # GW prior is global, added later
         ])
 
@@ -235,15 +244,74 @@ def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
     return gram
 
 
+def make_pta_basis_arrays_fn(gw: GWSpec, pl_specs):
+    """``build(t_s, inv_f2) -> (F, *fs)``: the iteration-independent
+    noise block for one pulsar — stacked [per-pulsar PL | common-grid
+    GW] Fourier columns (chromatic scaling applied) plus the per-spec
+    PL frequency grids the in-program phi evaluation needs. Pure
+    function of the TOA table: :class:`PTAGLSFitter` builds it once per
+    pulsar at prepare time (on the stage-2 device for the hybrid split;
+    sharded inputs give sharded outputs under a mesh) instead of
+    re-evaluating O(n·k) transcendentals in every gram/stage-2 call.
+    """
+    def build(t_s, inv_f2):
+        from pint_tpu.fitting.hybrid import _accel_pl_basis_arrays
+
+        if pl_specs:
+            F_pl, fs = _accel_pl_basis_arrays(t_s, inv_f2, pl_specs)
+        else:
+            F_pl, fs = None, ()
+        F_gw, _, _ = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
+                                    tspan=gw.tspan_s)
+        F = (jnp.concatenate([F_pl, F_gw], axis=1)
+             if F_pl is not None else F_gw)
+        return (F,) + tuple(fs)
+
+    return build
+
+
+def make_pta_basis_fn(gw: GWSpec, pl_specs):
+    """TOA-table flavor of :func:`make_pta_basis_arrays_fn`."""
+    arrays_fn = make_pta_basis_arrays_fn(gw, pl_specs)
+
+    def basis(toas):
+        from pint_tpu.models.noise import DM_FREF_MHZ
+
+        t_s = (toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+        inv_f2 = jnp.square(DM_FREF_MHZ / toas.freq_mhz)
+        return arrays_fn(t_s, inv_f2)
+
+    return basis
+
+
+def pta_basis_prog(gw: GWSpec, pl_specs, *, from_toas: bool):
+    """Module-level-cached jitted basis builder.
+
+    The basis is model-free (a pure function of the TOA table and the
+    static specs), so the cache key is ``(gw, pl_specs, flavor)`` — 68
+    same-structure pulsars share ONE executable instead of compiling a
+    fresh per-pulsar jit closure (jit caching is per-wrapper).
+    """
+    key = ("basis", gw, pl_specs, from_toas)
+    prog = _STAGE2_CACHE.get_lru(key)
+    if prog is None:
+        fn = (make_pta_basis_fn(gw, pl_specs) if from_toas
+              else make_pta_basis_arrays_fn(gw, pl_specs))
+        prog = _STAGE2_CACHE.put_lru(key, jax.jit(fn))
+    return prog
+
+
 def make_pta_stage2(gw: GWSpec, pl_specs, p: int, mxu):
     """Accelerator stage of the hybrid PTA gram: bases + ds32 reduction.
 
     Consumes stage 1's packed buffer (the CPU whitening stage shared
     with ``HybridGLSFitter`` — :func:`pint_tpu.fitting.hybrid
     .make_whiten_stage1`, whose ``[A_M.ravel() | rw | sw | norm_M]``
-    packing is the contract here), rebuilds the per-pulsar PL and
-    common-grid GW Fourier blocks ON DEVICE from ``t_s`` (never shipped
-    per iteration), and runs the whitened Gram reduction with ECORR
+    packing is the contract here), takes the device-resident hoisted
+    ``*pl_static`` [PL | GW] block (REQUIRED trailing args — from
+    :func:`pta_basis_prog`, built once at prepare, never shipped or
+    rebuilt per iteration), and runs the whitened Gram reduction with
+    ECORR
     Schur elimination (:func:`pint_tpu.fitting.gls_step
     .gls_gram_whitened`) — the O(n q^2) FLOPs of the joint PTA fit, on
     the MXU as double-single f32 when ``mxu`` is set. GW columns carry
@@ -253,24 +321,24 @@ def make_pta_stage2(gw: GWSpec, pl_specs, p: int, mxu):
     device->host fetch.
     """
     from pint_tpu.fitting.gls_step import gls_gram_whitened
-    from pint_tpu.fitting.hybrid import _accel_pl_bases
 
-    def stage2(packed, epoch_idx, ecorr_phi, pl_params, t_s, inv_f2):
+    def stage2(packed, epoch_idx, ecorr_phi, pl_params, t_s, inv_f2,
+               *pl_static):
         n = t_s.shape[0]
         o = n * p
         A_M = packed[:o].reshape(n, p)
         rw = packed[o:o + n]; o += n
         sw = packed[o:o + n]; o += n
         norm_M = packed[o:o + p]
-        F_pl, phi_pl = _accel_pl_bases(t_s, inv_f2, pl_specs, pl_params)
-        F_gw, _, _ = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
-                                    tspan=gw.tspan_s)
+        # hoisted [PL | GW] block (pta_basis_prog): only the O(k) phi
+        # evaluation stays in the per-iteration program
+        from pint_tpu.fitting.hybrid import _accel_pl_phi
+
         phi_inf = jnp.full(2 * gw.nharm, jnp.inf)
-        if F_pl is not None:
-            F = jnp.concatenate([F_pl, F_gw], axis=1)
-            phi_F = jnp.concatenate([phi_pl, phi_inf])
-        else:
-            F, phi_F = F_gw, phi_inf
+        F = pl_static[0]
+        phi_F = (jnp.concatenate([
+            _accel_pl_phi(pl_static[1:], pl_specs, pl_params),
+            phi_inf]) if pl_specs else phi_inf)
         parts = gls_gram_whitened(A_M, rw, sw, norm_M, F, phi_F,
                                   epoch_idx, ecorr_phi, mxu=mxu)
         chi2_base = parts["quad0"]
@@ -403,6 +471,12 @@ class PTAGLSFitter:
                         ("whiten_stage1",),
                         lambda owner: make_whiten_stage1(owner))
                 dev_args = ship_stage2_statics(toas, noise, self.accel_dev)
+                # iteration-independent [PL | GW] block, built once on
+                # the stage-2 device (operands are device-resident);
+                # same-structure pulsars share one compiled builder
+                basis = pta_basis_prog(self.gw, pl_specs,
+                                       from_toas=False)(
+                    dev_args[3], dev_args[4])
                 # stage2 is NOT pinned here: _run_hybrid resolves it per
                 # call through the bounded program cache, so a pallas->
                 # ds32 fallback (self._mxu_mode switch) propagates to
@@ -410,7 +484,8 @@ class PTAGLSFitter:
                 # pallas programs in the prepared state
                 prepared.append(("hybrid", (stage1, model, pl_specs,
                                             p, k_pl),
-                                 jax.device_put(toas, cpu), dev_args))
+                                 jax.device_put(toas, cpu), dev_args,
+                                 basis))
                 continue
             if self.mesh is not None:
                 from pint_tpu.fitting.gls_step import pad_noise_statics
@@ -442,7 +517,13 @@ class PTAGLSFitter:
                 ("pta_gram", self.gw, pl_specs),
                 lambda owner, _pl=pl_specs: make_pta_gram(owner, self.gw,
                                                           _pl))
-            prepared.append(("plain", gram, toas, noise, model))
+            basis_fn = pta_basis_prog(self.gw, pl_specs, from_toas=True)
+            if self.mesh is not None:
+                with self.mesh:
+                    basis = basis_fn(toas)
+            else:
+                basis = basis_fn(toas)
+            prepared.append(("plain", gram, toas, noise, model, basis))
         self._prepared = prepared
         self._prepare_batched(prepared)
         return prepared
@@ -468,13 +549,17 @@ class PTAGLSFitter:
         arg_shapes = {tuple(a.shape for a in e[3]) for e in prepared}
         if len(shapes) > 1 or len(arg_shapes) > 1:
             return
+        # stack the shipped statics AND the hoisted basis arrays: the
+        # vmapped stage2 maps over both in one argument list
         self._batched = tuple(
             jnp.stack([e[3][j] for e in prepared])
-            for j in range(len(prepared[0][3])))
+            for j in range(len(prepared[0][3]))) + tuple(
+            jnp.stack([e[4][j] for e in prepared])
+            for j in range(len(prepared[0][4])))
         # the stacked copy replaces the per-pulsar device statics — drop
         # them so the fitter does not hold 2x the stage-2 HBM footprint
         for i, e in enumerate(prepared):
-            prepared[i] = (e[0], e[1], e[2], None)
+            prepared[i] = (e[0], e[1], e[2], None, None)
 
     def _grams_batched(self, prepared, deltas_list):
         """One vmapped stage-2 evaluation over all (uniform) pulsars."""
@@ -482,7 +567,7 @@ class PTAGLSFitter:
 
         cpu = jax.devices("cpu")[0]
         packs = []
-        for i, (_, meta, toas_cpu, _da) in enumerate(prepared):
+        for i, (_, meta, toas_cpu, _da, _basis) in enumerate(prepared):
             stage1, model = meta[0], meta[1]
             packs.append(self._stage1_pack(
                 stage1, model, self._deltas_for(model, deltas_list, i),
@@ -550,7 +635,7 @@ class PTAGLSFitter:
             return stage1(jax.device_put(model.base_dd(), cpu),
                           jax.device_put(deltas, cpu), toas_cpu)
 
-    def _run_hybrid(self, meta, toas_cpu, dev_args, deltas):
+    def _run_hybrid(self, meta, toas_cpu, dev_args, basis, deltas):
         """stage1 on the CPU, one upload, stage2 on the chip, one fetch."""
         stage1, model, pl_specs, p, k_pl = meta
         packed = self._stage1_pack(stage1, model, deltas, toas_cpu)
@@ -567,7 +652,7 @@ class PTAGLSFitter:
         out = run_stage2_with_fallback(
             self, (pl_specs, p, n),
             lambda mode: self._stage2_prog(pl_specs, p, mode)(
-                packed_dev, *dev_args))
+                packed_dev, *dev_args, *basis))
         return self._unpack_gram(np.asarray(out), p, k_pl)
 
     def _grams(self, deltas_list=None):
@@ -587,13 +672,13 @@ class PTAGLSFitter:
             # stale cached linearization point would silently
             # double-apply deltas on a second fit
             if entry[0] == "hybrid":
-                _, meta, toas_cpu, dev_args = entry
+                _, meta, toas_cpu, dev_args, basis = entry
                 model = meta[1]
                 out.append(self._run_hybrid(
-                    meta, toas_cpu, dev_args,
+                    meta, toas_cpu, dev_args, basis,
                     self._deltas_for(model, deltas_list, i)))
                 continue
-            _, gram, toas, noise, model = entry
+            _, gram, toas, noise, model, basis = entry
             base = model.base_dd()
             deltas = self._deltas_for(model, deltas_list, i)
             if self.mesh is not None:
@@ -602,9 +687,9 @@ class PTAGLSFitter:
                 base = replicate(base, self.mesh)
                 deltas = replicate(deltas, self.mesh)
                 with self.mesh:
-                    out.append(gram(base, deltas, toas, noise))
+                    out.append(gram(base, deltas, toas, noise, *basis))
             else:
-                out.append(gram(base, deltas, toas, noise))
+                out.append(gram(base, deltas, toas, noise, *basis))
         return out
 
     def fit_toas(self, maxiter: int = 10) -> float:
