@@ -225,3 +225,18 @@ def f64(p: dict[str, DD], name: str) -> Array:
     """Resolved parameter as float64 (collapses DD; gradient flows)."""
     v = p[name]
     return v.hi + v.lo
+
+
+def safe_log_nu(toas) -> tuple[Array, Array]:
+    """``(valid, log(nu/1GHz))`` with non-finite/zero frequencies masked.
+
+    Infinite-frequency (barycentered photon) TOAs must see ZERO
+    profile-evolution delay, not ``log(inf)`` poisoning the phase
+    (found by the round-5 soak's spacecraft-event gate); the inner
+    ``where`` keeps the log finite so gradients stay finite too, and
+    callers zero their term with the outer mask. Shared by FD and
+    FDJump.
+    """
+    valid = jnp.isfinite(toas.freq_mhz) & (toas.freq_mhz > 0.0)
+    log_nu = jnp.log(jnp.where(valid, toas.freq_mhz, 1000.0) / 1000.0)
+    return valid, log_nu

@@ -79,10 +79,12 @@ class FDJump(Component):
         return self
 
     def delay(self, p, toas, acc_delay: Array, aux: dict) -> Array:
-        log_nu = jnp.log(toas.freq_mhz / 1000.0)
+        from pint_tpu.models.component import safe_log_nu
+
+        valid, log_nu = safe_log_nu(toas)
         total = jnp.zeros(len(toas))
         for name, order in self.fdjump_orders.items():
             param = self.param(name)
             mask = jnp.asarray(toa_mask(param.selector, toas), jnp.float64)
             total = total + mask * f64(p, name) * log_nu ** order
-        return total
+        return jnp.where(valid, total, 0.0)

@@ -49,9 +49,11 @@ class FD(Component):
         return self
 
     def delay(self, p: dict[str, DD], toas, acc_delay: Array, aux: dict) -> Array:
-        log_nu = jnp.log(toas.freq_mhz / 1000.0)
+        from pint_tpu.models.component import safe_log_nu
+
+        valid, log_nu = safe_log_nu(toas)
         # Horner over FD_n..FD_1 with zero constant term
         acc = jnp.zeros(len(toas))
         for i in reversed(range(1, self.num_terms + 1)):
             acc = (acc + f64(p, f"FD{i}")) * log_nu
-        return acc
+        return jnp.where(valid, acc, 0.0)

@@ -244,3 +244,34 @@ def test_plchrom_alpha_par_roundtrip():
     assert sum(1 for l in out.splitlines()
                if l.startswith("TNCHROMIDX")) == 1
     assert get_model(out).get_component("PLChromNoise").basis_alpha() == 3.5
+
+
+def test_fd_zero_at_infinite_frequency():
+    """Barycentered photon TOAs carry freq = inf; FD/FDJUMP profile-
+    evolution terms must vanish there instead of poisoning the phase
+    with log(inf) (found by the round-5 soak's spacecraft-event gate,
+    seed 10017)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = (BASE + "FD1 -7.9e-05 1\nFD2 1.2e-05 1\n"
+           + "FD1JUMP -freq 300 500 3e-5 1\n")
+    m = get_model(par)
+    toas = make_fake_toas_uniform(55000, 55200, 24, m, obs="@",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  niter=0)
+    inf_toas = dataclasses.replace(
+        toas, freq_mhz=jnp.full(len(toas), jnp.inf))
+    base = m.base_dd()
+    z = jnp.zeros(len(toas))
+    d_fd = m.get_component("FD").delay(base, inf_toas, z, {})
+    np.testing.assert_array_equal(np.asarray(d_fd), 0.0)
+    fdj = m.get_component("FDJump")
+    d_fdj = fdj.delay(base, inf_toas, z, {})
+    np.testing.assert_array_equal(np.asarray(d_fdj), 0.0)
+    ph = m.phase(inf_toas)
+    assert np.all(np.isfinite(np.asarray(ph.frac.hi)))
