@@ -192,7 +192,13 @@ class _DownhillMixin:
         """chi2 of current residuals under this fitter's noise treatment."""
         raise NotImplementedError
 
-    def fit_toas(self, maxiter: int = 20, **kw) -> float:
+    def fit_toas(self, maxiter: int = 20,
+                 min_chi2_decrease: float | None = None, **kw) -> float:
+        # same convergence-floor knob as the hybrid/sharded fitters
+        # (None = the class attribute), so callers can tighten any
+        # north-star fitter uniformly
+        if min_chi2_decrease is not None:
+            self.min_chi2_decrease = min_chi2_decrease
         self.converged = False
         chi2 = self._chi2_now()
         for _ in range(max(1, maxiter)):

@@ -507,13 +507,15 @@ class HybridGLSFitter(Fitter):
             out = prog(rw, self._probe_epoch_idx_cpu, *consts)
         return float(np.asarray(out))
 
-    def fit_toas(self, maxiter: int = 20, **kw) -> float:
+    def fit_toas(self, maxiter: int = 20,
+                 min_chi2_decrease: float = 1e-3, **kw) -> float:
         from pint_tpu.fitting.damped import downhill_iterate
 
         base = jax.device_put(self.model.base_dd(), self.cpu)
         deltas0 = {k: jnp.zeros((), jnp.float64) for k in self._names}
         deltas, sol, chi2, converged = downhill_iterate(
             lambda d: self._iterate(base, d), deltas0, maxiter=maxiter,
+            min_chi2_decrease=min_chi2_decrease,
             chi2_at=lambda d: self._chi2_at(base, d))
         cov = np.asarray(sol["cov"])
         errors = np.sqrt(np.diagonal(cov))

@@ -59,7 +59,8 @@ def pad_toas(toas: TOAs, n_target: int) -> TOAs:
     )
 
 
-def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2):
+def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
+                min_chi2_decrease: float = 1e-3):
     """Damped sharded WLS; returns (deltas, info, chi2, converged).
 
     Host-side wrapper: pads the table to the mesh's TOA-shard multiple,
@@ -77,7 +78,8 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2):
     deltas0 = replicate(model.zero_deltas(), mesh)
     with mesh:
         return downhill_iterate(
-            lambda d: step(base, d, toas_sh), deltas0, maxiter=maxiter)
+            lambda d: step(base, d, toas_sh), deltas0, maxiter=maxiter,
+            min_chi2_decrease=min_chi2_decrease)
 
 
 class ShardedWLSFitter(Fitter):
@@ -91,9 +93,11 @@ class ShardedWLSFitter(Fitter):
         super().__init__(toas, model)
         self.mesh = mesh or make_mesh()
 
-    def fit_toas(self, maxiter: int = 20) -> float:
+    def fit_toas(self, maxiter: int = 20,
+                 min_chi2_decrease: float = 1e-3) -> float:
         deltas, info, chi2, converged = sharded_fit(
-            self.toas, self.model, mesh=self.mesh, maxiter=maxiter)
+            self.toas, self.model, mesh=self.mesh, maxiter=maxiter,
+            min_chi2_decrease=min_chi2_decrease)
         errors = info["errors"]
         for name, d in deltas.items():
             p = self.model[name]
@@ -105,7 +109,8 @@ class ShardedWLSFitter(Fitter):
         return chi2
 
 
-def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
+def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
+                    min_chi2_decrease: float = 1e-3):
     """Damped TOA-sharded GLS; returns (deltas, info, chi2, converged).
 
     The north-star configuration (SURVEY.md §5): correlated noise
@@ -140,7 +145,7 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2):
     with mesh:
         return downhill_iterate(
             lambda d: step(base, d, toas_sh, noise_sh), deltas0,
-            maxiter=maxiter)
+            maxiter=maxiter, min_chi2_decrease=min_chi2_decrease)
 
 
 class ShardedGLSFitter(Fitter):
@@ -157,9 +162,11 @@ class ShardedGLSFitter(Fitter):
         self.mesh = mesh or make_mesh()
         self.noise_coeffs: np.ndarray | None = None
 
-    def fit_toas(self, maxiter: int = 20) -> float:
+    def fit_toas(self, maxiter: int = 20,
+                 min_chi2_decrease: float = 1e-3) -> float:
         deltas, info, chi2, converged = sharded_gls_fit(
-            self.toas, self.model, mesh=self.mesh, maxiter=maxiter)
+            self.toas, self.model, mesh=self.mesh, maxiter=maxiter,
+            min_chi2_decrease=min_chi2_decrease)
         errors = info["errors"]
         for name, d in deltas.items():
             p = self.model[name]

@@ -66,7 +66,15 @@ class Residuals:
             raise ValueError(f"unknown track_mode {self.track_mode!r}")
         if self.subtract_mean:
             if self.use_weighted_mean:
-                err = self.toas.get_errors_s()
+                # NOISE-SCALED uncertainties (get_errors_s), not the raw
+                # per-TOA errors: the reference weights the mean by
+                # get_data_error (EFAC/EQUAD applied), and every fitter
+                # subtracts the mean with the same scaled weights — raw
+                # weights left a constant offset in the residuals of any
+                # model with heterogeneous EFAC/EQUAD groups (~36 ns on
+                # soak seed 20021), skewing r^T C^-1 r merit values
+                # between fitters by ~0.1%
+                err = self.get_errors_s()
                 w = jnp.where(err > 0, 1.0 / jnp.square(err), 0.0)
                 mean = jnp.sum(resid * w) / jnp.sum(w)
             else:

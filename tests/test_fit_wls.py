@@ -132,3 +132,32 @@ def test_make_fake_toas_from_arrays_matches_model():
     assert np.max(np.abs(np.asarray(r.time_resids))) < 1e-9
     # epochs preserved to within the applied shift (< 1 s)
     assert np.max(np.abs(np.asarray(toas.utc.hi) - mjds)) < 2.0 / 86400.0
+
+
+def test_weighted_mean_uses_scaled_errors():
+    """Mean subtraction must weight by the NOISE-SCALED uncertainties
+    (reference: get_data_error), not raw TOA errors — raw weights left
+    a ~36 ns constant offset in any model with heterogeneous
+    EFAC/EQUAD groups and skewed r^T C^-1 r merit values between
+    fitters by ~0.1% (round-5 soak seed 20021)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toas import Flags
+
+    m = get_model(PAR + "EQUAD -fe L-wide 5.0\nEFAC -fe L-wide 1.7\n")
+    toas = make_fake_toas_uniform(53100, 53800, 80, m, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=3)
+    rng = np.random.default_rng(9)
+    flags = Flags(dict(d, fe="L-wide" if rng.random() < 0.5 else "430")
+                  for d in toas.flags)
+    toas = dataclasses.replace(toas, flags=flags)
+    r = Residuals(toas, m)
+    err = np.asarray(m.scaled_toa_uncertainty(toas))
+    w = 1.0 / err ** 2
+    resid = np.asarray(r.time_resids)
+    wmean = np.sum(resid * w) / np.sum(w)
+    assert abs(wmean) < 1e-12, f"scaled-weight mean not removed: {wmean}"

@@ -249,24 +249,44 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
         # recorded failing seed stays reproducible across soak versions
         gates = np.random.default_rng((seed, 1))
 
+        # parity fits compare CONVERGED minima, so both sides run with
+        # a tight decrease floor: at the default min_chi2_decrease=1e-3
+        # two correct solvers legitimately stop at different depths of
+        # a shallow marginal-likelihood valley (seed 20021: 0.145% chi2
+        # apart with both reporting converged — red-noise/spin ridge)
+        tight: dict = {}
+
+        def _tight_ref():
+            if not tight:
+                m_t = get_model(par, allow_tcb=True)
+                for name, d in perturbed.items():
+                    m_t[name].add_delta(d)
+                f_t = Fitter.auto(toas, m_t)
+                tight["chi2"] = f_t.fit_toas(maxiter=30,
+                                             min_chi2_decrease=1e-7)
+                tight["model"] = m_t
+            return tight["chi2"], tight["model"]
+
         def _parity_fit(make_fitter, label):
             """Re-fit from the SAME perturbed start with another fitter
-            and require chi2 + parameter agreement with the auto fit."""
+            and require chi2 + parameter agreement with the TIGHT
+            (min_chi2_decrease=1e-7) reference fit from _tight_ref."""
+            chi2_ref, m_ref = _tight_ref()
             m_p = get_model(par, allow_tcb=True)
             for name, d in perturbed.items():
                 m_p[name].add_delta(d)
             f_p = make_fitter(m_p)
-            chi2_p = f_p.fit_toas(maxiter=12)
+            chi2_p = f_p.fit_toas(maxiter=30, min_chi2_decrease=1e-7)
             assert np.isfinite(chi2_p), f"{label} chi2 not finite"
-            rel = abs(chi2_p - chi2) / max(abs(chi2), 1e-12)
+            rel = abs(chi2_p - chi2_ref) / max(abs(chi2_ref), 1e-12)
             assert rel < 1e-3, (
-                f"{label}/auto chi2 mismatch: {chi2_p} vs {chi2}")
-            for name in model.free_params:
-                tol = max(5e-2 * (model[name].uncertainty or 0.0),
-                          1e-12 * max(1.0, abs(model[name].value_f64)))
+                f"{label}/tight-ref chi2 mismatch: {chi2_p} vs {chi2_ref}")
+            for name in m_ref.free_params:
+                tol = max(5e-2 * (m_ref[name].uncertainty or 0.0),
+                          1e-12 * max(1.0, abs(m_ref[name].value_f64)))
                 assert abs(m_p[name].value_f64
-                           - model[name].value_f64) < tol, (
-                    f"{label}/auto {name} mismatch")
+                           - m_ref[name].value_f64) < tol, (
+                    f"{label}/tight-ref {name} mismatch")
 
         # wideband fit on a fraction of trials: attach -pp_dm/-pp_dme
         # flags derived from the model's own DM(t) and run the stacked
