@@ -196,9 +196,18 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
     try:
         truth = get_model(par, allow_tcb=True)
         n = int(rng.integers(80, 240))
+        # two receivers with REAL sub-band scatter, not two delta
+        # functions: at exactly 2 distinct frequencies DM (1/f^2),
+        # FD (log f) and the offset span the same 2-dim space, so any
+        # par combining them fits along an exactly degenerate ridge
+        # with solver-dependent endpoints (seed 20061) — real backends
+        # never deliver single-frequency bands
+        band = rng.random(n) < 0.5
+        freqs = np.where(band, 1400.0 + rng.uniform(-100.0, 100.0, n),
+                         430.0 + rng.uniform(-30.0, 30.0, n))
         toas = make_fake_toas_uniform(
             53000, 56000, n, truth, obs="gbt",
-            freq_mhz=np.array([1400.0, 430.0]), error_us=1.0,
+            freq_mhz=freqs, error_us=1.0,
             add_noise=True, seed=int(rng.integers(2 ** 31)))
         # flag ~half the TOAs into the selector group the mask params
         # use — by an INDEPENDENT random draw, not i%2: the simulated
