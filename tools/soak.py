@@ -179,6 +179,31 @@ def random_par(rng: np.random.Generator) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _sim_flagged_toas(model, rng, n: int, flag_rng=None):
+    """Simulate n TOAs with scattered sub-band frequencies + random
+    selector flags — the ONE construction for the main trial and every
+    gate. Two delta-function frequencies make DM (1/f^2), FD (log f)
+    and the offset exactly collinear (seed 20061), and flags must not
+    correlate with bands (seed 10016) — both rules live here only.
+    ``flag_rng`` lets the main trial keep its historical stream split
+    (sim draws from the trial rng, flags from the (seed, 2) stream) so
+    recorded seeds reproduce."""
+    import dataclasses
+
+    from pint_tpu.toas import Flags
+
+    band = rng.random(n) < 0.5
+    freqs = np.where(band, 1400.0 + rng.uniform(-100.0, 100.0, n),
+                     430.0 + rng.uniform(-30.0, 30.0, n))
+    toas = make_fake_toas_uniform(
+        53000, 56000, n, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, seed=int(rng.integers(2 ** 31)))
+    frng = flag_rng if flag_rng is not None else rng
+    flags = Flags(dict(d, fe="L-wide" if frng.random() < 0.5 else "430")
+                  for d in toas.flags)
+    return dataclasses.replace(toas, flags=flags)
+
+
 def one_trial(seed: int) -> tuple[bool, str, dict]:
     """Returns (ok, failure_text, axes) — axes records which sampler
     dimensions and optional gates this trial exercised, so the committed
@@ -196,36 +221,16 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
     try:
         truth = get_model(par, allow_tcb=True)
         n = int(rng.integers(80, 240))
-        # two receivers with REAL sub-band scatter, not two delta
-        # functions: at exactly 2 distinct frequencies DM (1/f^2),
-        # FD (log f) and the offset span the same 2-dim space, so any
-        # par combining them fits along an exactly degenerate ridge
-        # with solver-dependent endpoints (seed 20061) — real backends
-        # never deliver single-frequency bands
-        band = rng.random(n) < 0.5
-        freqs = np.where(band, 1400.0 + rng.uniform(-100.0, 100.0, n),
-                         430.0 + rng.uniform(-30.0, 30.0, n))
-        toas = make_fake_toas_uniform(
-            53000, 56000, n, truth, obs="gbt",
-            freq_mhz=freqs, error_us=1.0,
-            add_noise=True, seed=int(rng.integers(2 ** 31)))
-        # flag ~half the TOAs into the selector group the mask params
-        # use — by an INDEPENDENT random draw, not i%2: the simulated
-        # frequencies alternate bands, so an i%2 flag makes a JUMP's
-        # selector column exactly collinear with DM's two-band column
-        # and the fit runs along a degenerate ridge whose endpoint is
-        # solver-dependent (found by seed 10016: dense SVD walked DM to
-        # -8.4e6 with sigma 2.9e7 while the jittered-Cholesky hybrid
-        # stayed put — 0.16% chi2 apart on a physically meaningless
-        # direction)
-        import dataclasses
+        # shared construction — scattered sub-band frequencies,
+        # band-independent selector flags (see _sim_flagged_toas);
+        # flags ride the (seed, 2) stream for reproducibility of
+        # recorded seeds
+        import dataclasses  # noqa: F401  (gates below use it)
 
-        from pint_tpu.toas import Flags
+        from pint_tpu.toas import Flags  # noqa: F401
 
-        frng = np.random.default_rng((seed, 2))
-        flags = Flags(dict(d, fe="L-wide" if frng.random() < 0.5 else "430")
-                      for d in toas.flags)
-        toas = dataclasses.replace(toas, flags=flags)
+        toas = _sim_flagged_toas(truth, rng, n,
+                                 flag_rng=np.random.default_rng((seed, 2)))
 
         model = get_model(par, allow_tcb=True)
         # perturb a random subset of free params at roughly-fittable
@@ -255,7 +260,10 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
 
         # optional extra harnesses draw from an INDEPENDENT stream so
         # adding/removing one never shifts the main trial's rng — a
-        # recorded failing seed stays reproducible across soak versions
+        # recorded failing seed stays reproducible across soak versions.
+        # New gates must be APPENDED (their probability draw comes after
+        # every existing gate's), so recorded gate compositions stay a
+        # stable prefix across versions.
         gates = np.random.default_rng((seed, 1))
 
         # parity fits compare CONVERGED minima, so both sides run with
@@ -381,6 +389,47 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
             ph = model.phase(ev_toas)
             fr = np.asarray(ph.frac.hi) + np.asarray(ph.frac.lo)
             assert np.all(np.isfinite(fr)), "event phase not finite"
+
+        # joint PTA fit on a fraction of red-noise trials: the sampled
+        # pulsar + a structure-identical companion (shifted sky/F0)
+        # through PTAGLSFitter's damped HD-correlated joint step — the
+        # flagship path fuzzed across the same component space as the
+        # single-pulsar fitters
+        if gates.random() < 0.08 and axes["has_rednoise"] and "RAJ" in par:
+            axes["gates"].append("pta_joint")
+            import re as _re
+
+            from pint_tpu.parallel.pta import PTAGLSFitter
+
+            # independent substream (matching the (seed, 1)/(seed, 2)
+            # pattern): the gate's variable draw count must not shift
+            # the shared `gates` stream for downstream harnesses, or
+            # recorded seeds stop reproducing their gate composition
+            prng = np.random.default_rng((seed, 3))
+            problems = []
+            for j in range(2):
+                # companion pulsar: same structure, sky shifted by
+                # rewriting the RAJ hour field (distinct positions keep
+                # the 2x2 Hellings-Downs matrix well-conditioned)
+                def _bump(mm, _j=j):
+                    h = (int(mm.group(1)) + 7 * _j) % 24
+                    return f"RAJ {h:02d}:{mm.group(2)}"
+
+                par_j = _re.sub(r"RAJ (\d+):(\S+)", _bump, par)
+                m_j = get_model(par_j, allow_tcb=True)
+                t_j = _sim_flagged_toas(m_j, prng, 60)
+                m_fit = get_model(par_j, allow_tcb=True)
+                m_fit["F0"].add_delta(2e-10)
+                problems.append((t_j, m_fit))
+            fpta = PTAGLSFitter(problems, gw_log10_amp=-13.9,
+                                gw_gamma=4.33, gw_nharm=3)
+            chi2_pta = fpta.fit_toas(maxiter=8)
+            assert np.isfinite(chi2_pta), "pta joint chi2 not finite"
+            for _t, m_j in problems:
+                for nm in m_j.free_params:
+                    assert np.isfinite(m_j[nm].value_f64), \
+                        f"pta {nm} not finite"
+
 
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
