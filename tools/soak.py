@@ -395,7 +395,11 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
         # through PTAGLSFitter's damped HD-correlated joint step — the
         # flagship path fuzzed across the same component space as the
         # single-pulsar fitters
-        if gates.random() < 0.08 and axes["has_rednoise"] and "RAJ" in par:
+        # preconditions (red noise AND equatorial) already select ~9% of
+        # trials, so the gate itself fires on half of those — an 0.08
+        # draw made pta_joint a ~0.7%-per-trial event that never ran in
+        # a 100-trial batch
+        if gates.random() < 0.5 and axes["has_rednoise"] and "RAJ" in par:
             axes["gates"].append("pta_joint")
             import re as _re
 
