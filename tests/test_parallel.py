@@ -153,14 +153,19 @@ def test_batched_heterogeneous_matches_individual():
     and uncertainties), union model + parameter-superset mask doing the
     heterogeneity.
     """
-    pars = [PAR, PAR + ELL1_LINES, PAR + JUMP_EFAC_LINES]
+    # two structures (isolated + ELL1 binary) exercise the union model
+    # and parameter-superset mask; the JUMP+EFAC masked-column semantics
+    # are pinned by test_batched_frozen_in_one_free_in_another and the
+    # full three-structure case runs at 20k TOAs/psr in scale_proof.py
+    # (each extra structure costs ~10 s of per-structure XLA compiles)
+    pars = [PAR + JUMP_EFAC_LINES, PAR + ELL1_LINES]
     problems, individuals = [], []
     for i, par in enumerate(pars):
         truth = get_model(par)
         # three bands: a JUMP on one band must not be degenerate with
         # DM + offset (with two bands it is, and the fit diverges).
         # 57 TOAs (19/band) is the tolerance floor for the 5%-sigma
-        # parity below; the full-size case runs in scale_proof.py
+        # parity below
         toas = make_fake_toas_uniform(
             53478, 54187, 57, truth, obs="gbt",
             freq_mhz=np.array([1400.0, 800.0, 430.0]), error_us=2.0,
@@ -174,11 +179,11 @@ def test_batched_heterogeneous_matches_individual():
         individuals.append(pert_i)
         problems.append((toas, pert_b))
 
-    bf = BatchedPulsarFitter(problems)  # default mesh: psr=gcd(3,8)=1, toa=8
+    bf = BatchedPulsarFitter(problems)
     assert "PB" in bf.free_params and any(
         k.startswith("JUMP") for k in bf.free_params)
     chi2 = bf.fit_toas(maxiter=2)
-    assert chi2.shape == (3,)
+    assert chi2.shape == (2,)
     for ind, (toas, bat) in zip(individuals, problems):
         for name in ind.free_params:
             a, b = ind[name], bat[name]
