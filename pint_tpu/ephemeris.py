@@ -55,7 +55,7 @@ def _rot_ecl_to_eq(xyz_ecl: Array) -> Array:
 # executables without limit in long sessions.
 from pint_tpu.utils.cache import LRUCache
 
-_POSVEL_JIT_CACHE = LRUCache(64)
+_POSVEL_JIT_CACHE = LRUCache(64, name="posvel")
 _posvel_cache_get = _POSVEL_JIT_CACHE.get_lru
 _posvel_cache_put = _POSVEL_JIT_CACHE.put_lru
 

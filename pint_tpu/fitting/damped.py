@@ -28,6 +28,8 @@ re-evaluated once with the full step to obtain the next proposal.
 
 from __future__ import annotations
 
+from pint_tpu import telemetry
+
 
 def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
                      min_chi2_decrease: float = 1e-3,
@@ -40,23 +42,38 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
     is the step output evaluated *at the returned deltas* (so its
     errors / covariance / noise coefficients are current); ``chi2`` is
     the actual chi2 there, not the linearized prediction.
+
+    Telemetry: every full-step evaluation runs under a ``fit.step``
+    span (first-in-process call = the compile span — every step
+    function blocks on its outputs, so span walls are honest) and every
+    probe under ``fit.probe``; the loop events feed the ``fit.*``
+    counters (iterations / accepts / halvings / probe_evals /
+    probe_rejects / converged / maxiter_exhausted) that make damping
+    behavior auditable from the rollup.
     """
-    new_deltas, info = iterate(deltas0)
+    with telemetry.jit_span("fit.step"):
+        new_deltas, info = iterate(deltas0)
     chi2 = float(info["chi2_at_input"])
     deltas = deltas0
     converged = False
     for _ in range(max(1, maxiter)):
+        telemetry.inc("fit.iterations")
         dx = {k: new_deltas[k] - deltas[k] for k in deltas}
         lam, applied = 1.0, False
         trial = trial_new = trial_info = None
         for _h in range(max_step_halvings):
+            if _h > 0:
+                telemetry.inc("fit.halvings")
             trial = {k: deltas[k] + lam * dx[k] for k in deltas}
             if _h == 0 or chi2_at is None:
-                trial_new, trial_info = iterate(trial)
+                with telemetry.jit_span("fit.step"):
+                    trial_new, trial_info = iterate(trial)
                 trial_chi2 = float(trial_info["chi2_at_input"])
             else:
+                telemetry.inc("fit.probe_evals")
                 trial_new = trial_info = None
-                trial_chi2 = float(chi2_at(trial))
+                with telemetry.jit_span("fit.probe"):
+                    trial_chi2 = float(chi2_at(trial))
             if trial_chi2 <= chi2 + 1e-12:
                 if trial_info is None:
                     # accepted via the cheap probe: one full evaluation
@@ -67,12 +84,15 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
                     # while the probe's is f64), so when the full value
                     # contradicts the acceptance, keep halving instead
                     # of applying an uphill step.
-                    trial_new, trial_info = iterate(trial)
+                    with telemetry.jit_span("fit.step"):
+                        trial_new, trial_info = iterate(trial)
                     trial_chi2 = float(trial_info["chi2_at_input"])
                     if trial_chi2 > chi2 + 1e-12:
+                        telemetry.inc("fit.probe_rejects")
                         lam *= 0.5
                         continue
                 applied = True
+                telemetry.inc("fit.accepts")
                 break
             lam *= 0.5
         if not applied:
@@ -85,4 +105,5 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
         if decrease < min_chi2_decrease:
             converged = True
             break
+    telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
     return deltas, info, chi2, converged

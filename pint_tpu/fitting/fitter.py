@@ -266,14 +266,19 @@ class WLSFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 1, threshold: float | None = None) -> float:
         """Iterate (residuals -> design matrix -> solve -> update); returns chi2."""
+        from pint_tpu import telemetry
+
+        telemetry.set_gauge("fit.ntoas", len(self.toas))
         chi2 = self.resids.chi2
         for it in range(max(1, maxiter)):
+            telemetry.inc("fit.iterations")
             if it > 0:  # self.resids is already current on entry
                 self.resids = self._new_resids()
-            M, names = self.get_designmatrix()
-            err = self.resids.get_errors_s()
-            sol = wls_solve(M, self.resids.time_resids, err, threshold)
-            x = np.asarray(sol["x"])
+            with telemetry.jit_span("fit.wls_iter"):
+                M, names = self.get_designmatrix()
+                err = self.resids.get_errors_s()
+                sol = wls_solve(M, self.resids.time_resids, err, threshold)
+                x = np.asarray(sol["x"])
             cov = np.asarray(sol["cov"])
             errors = np.sqrt(np.diag(cov))
             self.update_model(names, x, errors)

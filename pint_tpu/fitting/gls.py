@@ -197,22 +197,30 @@ class _DownhillMixin:
         # same convergence-floor knob as the hybrid/sharded fitters
         # (None = the class attribute), so callers can tighten any
         # north-star fitter uniformly
+        from pint_tpu import telemetry
+
         if min_chi2_decrease is not None:
             self.min_chi2_decrease = min_chi2_decrease
         self.converged = False
+        telemetry.set_gauge("fit.ntoas", len(self.toas))
         chi2 = self._chi2_now()
         for _ in range(max(1, maxiter)):
+            telemetry.inc("fit.iterations")
             snap = self._snapshot()
-            x, names, errors, cov = self._step(**kw)
+            with telemetry.jit_span("fit.step"):
+                x, names, errors, cov = self._step(**kw)
             lam = 1.0
             best_chi2 = chi2
             applied = False
             for _h in range(self.max_step_halvings):
+                if _h > 0:
+                    telemetry.inc("fit.halvings")
                 self._restore(snap)
                 self.update_model(names, lam * x, errors)
                 new_chi2 = self._chi2_now()
                 if new_chi2 <= best_chi2 + 1e-12:
                     applied = True
+                    telemetry.inc("fit.accepts")
                     break
                 lam *= 0.5
             if not applied:
@@ -228,6 +236,8 @@ class _DownhillMixin:
                 self.converged = True
                 break
             chi2 = new_chi2
+        telemetry.inc("fit.converged" if self.converged
+                      else "fit.maxiter_exhausted")
         return chi2
 
     def _step(self, **kw):

@@ -98,6 +98,10 @@ def build_noise_statics(model, toas) -> tuple[NoiseStatics, tuple[PLSpec, ...]]:
             pl_params.append((log10_amp, gamma))
     if epoch_idx is None:
         epoch_idx = np.zeros(n, dtype=np.int32)  # ne=0: everything is dummy
+    from pint_tpu import telemetry
+
+    telemetry.set_gauge("noise.ecorr_epochs", len(phi_e))
+    telemetry.set_gauge("noise.pl_components", len(specs))
     return (NoiseStatics(jnp.asarray(epoch_idx), jnp.asarray(phi_e),
                          jnp.asarray(pl_params).reshape(len(specs), 2)),
             tuple(specs))

@@ -366,11 +366,20 @@ class HybridGLSFitter(Fitter):
         return run_stage2_with_fallback(self, "stage2", run)
 
     def _iterate(self, base, deltas) -> tuple[dict, dict]:
-        packed = self._stage1(base, deltas)
-        out = self._run_stage2(jax.device_put(packed, self.accel))
-        # one device->host fetch; un-normalize on the full-range host
-        # (covariance entries reach ~1e-42 — below f32-range f64)
-        out = np.asarray(out)
+        from pint_tpu import telemetry
+
+        with telemetry.jit_span("hybrid.stage1_cpu"):
+            packed = self._stage1(base, deltas)
+            if telemetry.enabled():
+                # close the span at stage-1 completion (dispatch is
+                # async); disabled, keep the uninstrumented overlap
+                jax.block_until_ready(packed)
+        with telemetry.jit_span("hybrid.stage2_accel"):
+            out = self._run_stage2(jax.device_put(packed, self.accel))
+            # one device->host fetch; un-normalize on the full-range
+            # host (covariance entries reach ~1e-42 — below f32-range
+            # f64); the fetch also closes the span honestly
+            out = np.asarray(out)
         q, ne, p = self._q, self._ne, self._n_params
         o = 0
         xB = out[:q]; o = q
@@ -509,14 +518,18 @@ class HybridGLSFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 20,
                  min_chi2_decrease: float = 1e-3, **kw) -> float:
+        from pint_tpu import telemetry
         from pint_tpu.fitting.damped import downhill_iterate
 
+        telemetry.set_gauge("fit.ntoas", self._n_toas)
         base = jax.device_put(self.model.base_dd(), self.cpu)
         deltas0 = {k: jnp.zeros((), jnp.float64) for k in self._names}
-        deltas, sol, chi2, converged = downhill_iterate(
-            lambda d: self._iterate(base, d), deltas0, maxiter=maxiter,
-            min_chi2_decrease=min_chi2_decrease,
-            chi2_at=lambda d: self._chi2_at(base, d))
+        with telemetry.span("fit.hybrid_gls", ntoas=self._n_toas,
+                            accel=str(self.accel)):
+            deltas, sol, chi2, converged = downhill_iterate(
+                lambda d: self._iterate(base, d), deltas0, maxiter=maxiter,
+                min_chi2_decrease=min_chi2_decrease,
+                chi2_at=lambda d: self._chi2_at(base, d))
         cov = np.asarray(sol["cov"])
         errors = np.sqrt(np.diagonal(cov))
         for i, k in enumerate(self._names):

@@ -13,7 +13,26 @@ from __future__ import annotations
 import logging
 import sys
 
-LOG_FORMAT = "%(levelname)-7s %(name)s: %(message)s"
+LOG_FORMAT = "%(levelname)-9s %(name)s: %(message)s"
+
+# Telemetry-aware debug level: span begin/end mirroring
+# (pint_tpu.telemetry.spans, enabled via PINT_TPU_TELEMETRY_LOG) logs
+# between DEBUG and INFO — visible with setup(level="TELEMETRY") without
+# drowning in full DEBUG output, invisible at the INFO default.
+TELEMETRY = 15
+logging.addLevelName(TELEMETRY, "TELEMETRY")
+
+
+def get_logger(name: str = "pint_tpu") -> logging.Logger:
+    """The shared ``pint_tpu`` logger tree (one config via setup()).
+
+    Every module — telemetry mirroring included — logs through children
+    of the ``pint_tpu`` root logger, so a single :func:`setup` call
+    controls level, format and dedup for the whole package.
+    """
+    if name != "pint_tpu" and not name.startswith("pint_tpu."):
+        name = f"pint_tpu.{name}"
+    return logging.getLogger(name)
 
 
 class DedupFilter(logging.Filter):
@@ -40,9 +59,13 @@ def setup(level: str = "INFO", *, dedup: bool = True,
 
     Returns the package root logger. Repeated calls reconfigure (old
     handlers are removed), so scripts can call it unconditionally.
+    ``level`` accepts the stdlib names plus ``"TELEMETRY"`` (between
+    DEBUG and INFO — shows mirrored span begin/end lines).
     """
     logger = logging.getLogger("pint_tpu")
-    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    lvl = (TELEMETRY if level.upper() == "TELEMETRY"
+           else getattr(logging, level.upper(), logging.INFO))
+    logger.setLevel(lvl)
     for h in list(logger.handlers):
         logger.removeHandler(h)
     handler = logging.StreamHandler(stream or sys.stderr)

@@ -42,7 +42,7 @@ log = logging.getLogger(__name__)
 # leak in long structure-editing sessions (e.g. pintk).
 from pint_tpu.utils.cache import LRUCache
 
-_JIT_PROGRAM_CACHE = LRUCache(128)
+_JIT_PROGRAM_CACHE = LRUCache(128, name="jit_program")
 
 
 def _nan_safe(v):

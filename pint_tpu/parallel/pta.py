@@ -47,7 +47,7 @@ Array = jax.Array
 # fitters and pulsars
 from pint_tpu.utils.cache import LRUCache  # noqa: E402
 
-_STAGE2_CACHE = LRUCache(32)
+_STAGE2_CACHE = LRUCache(32, name="pta_stage2")
 
 
 def hellings_downs(cos_theta) -> Array:
@@ -705,10 +705,17 @@ class PTAGLSFitter:
         covariance), not the linearized prediction; ``self.converged``
         reports whether the loop stopped at a (numerical) optimum.
         """
+        from pint_tpu import telemetry
         from pint_tpu.fitting.damped import downhill_iterate
 
-        deltas, info, chi2, converged = downhill_iterate(
-            self.step, self.zero_flat(), maxiter=maxiter)
+        n_toas = sum(len(t) for t in self.toas_list)
+        telemetry.set_gauge("pta.n_pulsars", len(self.models))
+        telemetry.set_gauge("fit.ntoas", n_toas)
+        with telemetry.span("fit.pta_joint", n_pulsars=len(self.models),
+                            ntoas=n_toas,
+                            hybrid_accel=self.accel_dev is not None):
+            deltas, info, chi2, converged = downhill_iterate(
+                self.step, self.zero_flat(), maxiter=maxiter)
         self.converged = converged
         self.gw_coeffs = info["gw_coeffs"]
         errors = info["errors_fn"]()

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pint_tpu import telemetry
 from pint_tpu.fitting.damped import downhill_iterate
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
@@ -71,12 +72,14 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
     """
     mesh = mesh or make_mesh()
     n_shards = mesh.shape["toa"]
+    telemetry.set_gauge("mesh.devices", mesh.size)
+    telemetry.set_gauge("fit.ntoas", len(toas))
     padded = pad_toas(toas, pad_to_multiple(len(toas), n_shards))
     toas_sh = shard_toas(padded, mesh)
     step = jitted_wls_step(model)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
-    with mesh:
+    with mesh, telemetry.span("fit.sharded_wls", ntoas=len(toas)):
         return downhill_iterate(
             lambda d: step(base, d, toas_sh), deltas0, maxiter=maxiter,
             min_chi2_decrease=min_chi2_decrease)
@@ -125,6 +128,8 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
     """
     mesh = mesh or make_mesh()
     n_shards = mesh.shape["toa"]
+    telemetry.set_gauge("mesh.devices", mesh.size)
+    telemetry.set_gauge("fit.ntoas", len(toas))
     n_target = pad_to_multiple(len(toas), n_shards)
 
     noise, pl_specs = build_noise_statics(model, toas)
@@ -142,7 +147,7 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
     step = jitted_gls_step(model, pl_specs=pl_specs)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
-    with mesh:
+    with mesh, telemetry.span("fit.sharded_gls", ntoas=len(toas)):
         return downhill_iterate(
             lambda d: step(base, d, toas_sh, noise_sh), deltas0,
             maxiter=maxiter, min_chi2_decrease=min_chi2_decrease)

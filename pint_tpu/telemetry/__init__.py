@@ -1,0 +1,53 @@
+"""pint_tpu.telemetry — structured tracing, counters and run-health.
+
+The observability layer the fit pipeline reports through (see
+docs/ARCHITECTURE.md "Observability" for the span taxonomy, counter
+names and the compile-vs-execute measurement contract):
+
+* :func:`span` / :func:`jit_span` / :func:`traced` — wall-clock regions
+  with nesting, per-name sequence numbers and compile/execute kinds
+  (:mod:`pint_tpu.telemetry.spans`);
+* :func:`inc` / :func:`set_gauge` / :func:`max_gauge` — process-global
+  named counters and gauges (:mod:`pint_tpu.telemetry.counters`);
+* :func:`host_sample` / :func:`host_polluted` — load1/rss sampling so
+  polluted measurements are machine-flaggable
+  (:mod:`pint_tpu.telemetry.host`);
+* :func:`flush` / :func:`rollup` / :func:`write_rollup` — the JSON-lines
+  artifact and the end-of-run summary dict
+  (:mod:`pint_tpu.telemetry.export`);
+* ``python -m pint_tpu.telemetry.probe`` — the bounded backend liveness
+  probe used by tools/tpu_retry.sh.
+
+Disabled (the default unless ``PINT_TPU_TELEMETRY=1`` or an entry point
+calls :func:`configure`), every hook is a boolean check and return —
+cheap enough that the hot fit loops stay instrumented unconditionally.
+``PINT_TPU_TELEMETRY=0`` is a hard kill switch that wins over
+``configure(enabled=True)``.
+
+The telemetry modules themselves import only the standard library (no
+jax, no backend init): safe to import from any module at any time.
+Backend *init* happens only inside the probe's bounded subprocess —
+though running ``-m pint_tpu.telemetry.probe`` still imports the
+``pint_tpu`` package (and thus jax) in the parent, which is why
+tools/tpu_retry.sh keeps an outer ``timeout`` on the whole invocation.
+"""
+
+from __future__ import annotations
+
+from pint_tpu.telemetry.core import configure, enabled, jsonl_path, reset
+from pint_tpu.telemetry.counters import (counter_value, counters_delta,
+                                         counters_snapshot, gauges_snapshot,
+                                         inc, max_gauge, set_gauge)
+from pint_tpu.telemetry.export import (add_record, flush, rollup, span_stats,
+                                       write_rollup)
+from pint_tpu.telemetry.host import polluted as host_polluted
+from pint_tpu.telemetry.host import sample as host_sample
+from pint_tpu.telemetry.spans import jit_span, span, traced
+
+__all__ = [
+    "add_record", "configure", "counter_value", "counters_delta",
+    "counters_snapshot", "enabled", "flush", "gauges_snapshot",
+    "host_polluted", "host_sample", "inc", "jit_span", "jsonl_path",
+    "max_gauge", "reset", "rollup", "set_gauge", "span", "span_stats",
+    "traced", "write_rollup",
+]

@@ -1,0 +1,85 @@
+"""Process-global named counters and gauges.
+
+One registry, one lock: counter increments from the damped-fit outer
+loop, the jit-program caches, and any background probe thread serialize
+on ``_lock`` so concurrent ``inc`` calls can never lose updates
+(tests/test_telemetry.py exercises this under a thread pool).  The
+disabled fast path returns before touching the lock.
+
+Naming convention (dots as namespace separators, documented in
+docs/ARCHITECTURE.md):
+
+* ``fit.*``    — damped-loop events (iterations, accepts, halvings, ...)
+* ``cache.<name>.*`` — jit-program cache hit/miss/evict per cache
+* ``probe.*``  — backend liveness probe attempts/timeouts
+* gauges: ``mesh.devices``, ``fit.ntoas``, ``noise.ecorr_epochs``, ...
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pint_tpu.telemetry import core
+
+_lock = threading.Lock()
+_counters: dict[str, float] = {}
+_gauges: dict[str, float] = {}
+
+
+def inc(name: str, n: float = 1) -> None:
+    """Add ``n`` to counter ``name`` (no-op when telemetry is disabled)."""
+    if not core._enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Record the current value of gauge ``name`` (last write wins)."""
+    if not core._enabled:
+        return
+    with _lock:
+        _gauges[name] = float(value)
+
+
+def max_gauge(name: str, value: float) -> None:
+    """Record ``value`` only if it exceeds the gauge's current value."""
+    if not core._enabled:
+        return
+    with _lock:
+        prev = _gauges.get(name)
+        if prev is None or value > prev:
+            _gauges[name] = float(value)
+
+
+def counter_value(name: str, default: float = 0) -> float:
+    """Current value of counter ``name`` (0 when never incremented)."""
+    with _lock:
+        return _counters.get(name, default)
+
+
+def counters_snapshot() -> dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def gauges_snapshot() -> dict[str, float]:
+    with _lock:
+        return dict(_gauges)
+
+
+def counters_delta(before: dict[str, float]) -> dict[str, float]:
+    """Counters that moved since ``before`` (a counters_snapshot())."""
+    now = counters_snapshot()
+    out = {}
+    for k, v in now.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def _reset() -> None:
+    with _lock:
+        _counters.clear()
+        _gauges.clear()

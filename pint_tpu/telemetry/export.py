@@ -1,0 +1,170 @@
+"""JSON-lines exporter and end-of-run rollup.
+
+Two consumers, two shapes:
+
+* **JSON-lines artifact** (``PINT_TPU_TELEMETRY_PATH`` /
+  ``configure(jsonl_path=...)``): every span/probe record is one line;
+  each flushed batch is preceded by a ``{"type": "host", ...}`` line
+  (load1, rss, polluted flag) so any window of the file is
+  machine-checkable for pollution.  Lines append, so bench parent and
+  child processes share one artifact (records carry ``pid``).
+* **Rollup dict** (:func:`rollup`): per-span-name aggregates with
+  compile/execute split, final counter and gauge values, and a closing
+  host sample — the object bench.py embeds in its one-line JSON and the
+  soak attaches per trial.
+
+Aggregates update incrementally at record time, so the rollup works
+even with no jsonl path configured and with the raw-record buffer
+capped (``_MAX_BUFFER``; drops are counted, never silent).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from pint_tpu.telemetry import core, host
+
+SCHEMA_VERSION = 1
+
+_MAX_BUFFER = 50_000
+_FLUSH_EVERY = 500
+
+_lock = threading.Lock()
+_buffer: list[dict] = []
+_dropped = 0
+_span_stats: dict[str, dict] = {}
+
+
+def _stats_for(name: str) -> dict:
+    st = _span_stats.get(name)
+    if st is None:
+        st = _span_stats[name] = {
+            "count": 0, "total_s": 0.0, "min_s": float("inf"),
+            "max_s": 0.0, "compile_count": 0, "compile_s": 0.0,
+            "execute_count": 0, "execute_s": 0.0}
+    return st
+
+
+def add_span(rec: dict) -> None:
+    """Aggregate + buffer one closed-span record (spans.Span.__exit__)."""
+    with _lock:
+        st = _stats_for(rec["name"])
+        d = rec["dur_s"]
+        st["count"] += 1
+        st["total_s"] += d
+        st["min_s"] = min(st["min_s"], d)
+        st["max_s"] = max(st["max_s"], d)
+        kind = rec.get("kind")
+        if kind in ("compile", "execute"):
+            st[f"{kind}_count"] += 1
+            st[f"{kind}_s"] += d
+        _buffer_record(rec)
+
+
+def add_record(rec: dict) -> None:
+    """Buffer a non-span record (e.g. ``type="probe"``) for the jsonl."""
+    if not core._enabled:
+        return
+    rec.setdefault("t", time.time())
+    rec.setdefault("pid", os.getpid())
+    with _lock:
+        _buffer_record(rec)
+
+
+def _buffer_record(rec: dict) -> None:
+    # caller holds _lock
+    global _dropped
+    if core.jsonl_path() is None:
+        return  # aggregates only; nothing to write later
+    if len(_buffer) >= _MAX_BUFFER:
+        _dropped += 1
+        return
+    _buffer.append(rec)
+    if len(_buffer) >= _FLUSH_EVERY:
+        _flush_locked()
+
+
+def flush() -> None:
+    """Write buffered records (preceded by a host sample) to the jsonl."""
+    with _lock:
+        _flush_locked()
+
+
+# env-only library use (PINT_TPU_TELEMETRY=1 + _PATH, no entry point
+# calling flush/write_rollup) must still produce the artifact; a no-op
+# when nothing is buffered
+atexit.register(flush)
+
+
+def _flush_locked() -> None:
+    global _dropped
+    path = core.jsonl_path()
+    if path is None or not _buffer:
+        return
+    batch = [host.sample() | {"type": "host", "pid": os.getpid()}]
+    batch.extend(_buffer)
+    n_records = len(_buffer)
+    _buffer.clear()
+    try:
+        with open(path, "a") as fh:
+            fh.write("".join(json.dumps(r) + "\n" for r in batch))
+    except OSError:  # telemetry must never take down the computation —
+        _dropped += n_records  # but drops are counted, never silent
+
+
+def span_stats() -> dict[str, dict]:
+    """Copy of the per-name span aggregates (rounded for JSON)."""
+    with _lock:
+        out = {}
+        for name, st in _span_stats.items():
+            c = dict(st)
+            if c["count"] == 0:
+                c["min_s"] = 0.0
+            for k in ("total_s", "min_s", "max_s", "compile_s", "execute_s"):
+                c[k] = round(c[k], 6)
+            out[name] = c
+        return out
+
+
+def rollup() -> dict:
+    """End-of-run summary dict (also what ``write_rollup`` appends).
+
+    Flushes pending records first so the jsonl artifact and the rollup
+    describe the same run.
+    """
+    from pint_tpu.telemetry import counters
+
+    flush()
+    with _lock:
+        dropped = _dropped
+    return {"type": "rollup", "schema": SCHEMA_VERSION, "t": time.time(),
+            "pid": os.getpid(), "enabled": core.enabled(),
+            "spans": span_stats(),
+            "counters": counters.counters_snapshot(),
+            "gauges": counters.gauges_snapshot(),
+            "host": host.sample(), "dropped_records": dropped}
+
+
+def write_rollup() -> dict:
+    """Append the rollup as the artifact's closing line; returns it."""
+    r = rollup()
+    path = core.jsonl_path()
+    if path is not None:
+        try:
+            with open(path, "a") as fh:
+                fh.write(json.dumps(r) + "\n")
+        except OSError:
+            pass
+    return r
+
+
+def _reset() -> None:
+    global _dropped
+    with _lock:
+        _buffer.clear()
+        _span_stats.clear()
+        _dropped = 0

@@ -1,0 +1,61 @@
+"""Host-health sampling: is this measurement describing an idle machine?
+
+Five rounds of bench history (VERDICT.md) show numbers silently polluted
+by concurrent builder load — diagnosed after the fact by SIGSTOPping the
+other workload and re-running.  Every span batch and rollup therefore
+carries a host sample so pollution is machine-flaggable:
+
+* ``load1``  — 1-minute load average.  At bench-child start this is
+  dominated by *pre-existing* load (the child itself has run for
+  seconds), so ``polluted(load1_at_start)`` is the honest flag for "was
+  something else running".
+* ``rss_mb`` — resident set of this process (``/proc/self/statm``),
+  catching the other failure mode: measurements taken while swapping.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from pint_tpu.telemetry import core
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_mb() -> float:
+    """Resident set size [MiB] of this process; -1 when unreadable."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * _PAGE_SIZE / (1024 * 1024)
+    except (OSError, ValueError, IndexError):
+        return -1.0
+
+
+def load1() -> float:
+    try:
+        return os.getloadavg()[0]
+    except OSError:  # pragma: no cover — getloadavg can fail on exotic hosts
+        return -1.0
+
+
+def polluted(load1_value: float | None = None) -> bool:
+    """True when the (given or current) load1 exceeds the threshold.
+
+    The threshold (``PINT_TPU_TELEMETRY_LOAD1``, default 1.5) reads as:
+    one fully-busy process — ours, once it is running — plus 0.5 slack.
+    Sampled *before* heavy compute starts, load1 ~ pre-existing load and
+    anything over the threshold means a concurrent workload.
+    """
+    v = load1() if load1_value is None else load1_value
+    return v > core.load1_threshold()
+
+
+def sample() -> dict:
+    """One host-health record (attached to span batches and rollups)."""
+    v = load1()
+    return {"t": time.time(), "load1": round(v, 3),
+            "rss_mb": round(rss_mb(), 1), "cpu_count": os.cpu_count(),
+            "polluted": polluted(v),
+            "load1_threshold": core.load1_threshold()}

@@ -1,0 +1,103 @@
+"""Telemetry-backed backend liveness probe.
+
+Replaces the inline ``timeout 60 python -c "import jax; jax.devices()"``
+probe in ``tools/tpu_retry.sh``: same semantics (exit 0 alive, nonzero
+dead), but every attempt's latency, device count and timeout lands in
+the shared telemetry JSON-lines format (``{"type": "probe", ...}``
+records plus a closing rollup with ``probe.*`` counters), so tunnel
+liveness windows become a committed, analyzable artifact instead of
+free-text log lines.
+
+A dead tunnel HANGS backend init inside C++ (uninterruptible by signals
+in-process — the round-1 failure mode), so each probe runs ``jax.devices()``
+in a subprocess killed by ``subprocess.run(timeout=...)``.
+
+Usage (see tools/tpu_retry.sh):
+
+    python -m pint_tpu.telemetry.probe --timeout 60 --jsonl /tmp/probe.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+from pint_tpu.telemetry import core, counters, export
+
+_CHILD_CODE = (
+    "import json, jax; d = jax.devices(); "
+    "print(json.dumps({'n': len(d), 'platform': jax.default_backend(), "
+    "'device0': str(d[0])}))"
+)
+
+
+def probe_once(timeout_s: float) -> dict:
+    """One bounded backend-init attempt; returns a ``type="probe"`` record.
+
+    Counters: ``probe.attempts`` always, then exactly one of
+    ``probe.alive`` / ``probe.timeouts`` / ``probe.errors``.
+    """
+    counters.inc("probe.attempts")
+    t0 = time.perf_counter()
+    rec: dict = {"type": "probe", "timeout_s": timeout_s}
+    try:
+        proc = subprocess.run([sys.executable, "-c", _CHILD_CODE],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        rec["latency_s"] = round(time.perf_counter() - t0, 3)
+        parsed = None
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                # last line only: runtimes may emit warnings to stdout
+                parsed = json.loads(proc.stdout.strip().splitlines()[-1])
+            except ValueError:
+                parsed = None
+        if parsed is not None:
+            rec.update(parsed)
+            rec["alive"] = True
+            counters.inc("probe.alive")
+        else:
+            rec["alive"] = False
+            rec["error"] = ((proc.stderr or "")[-300:]
+                            or (proc.stdout or "")[-300:])
+            counters.inc("probe.errors")
+    except subprocess.TimeoutExpired:
+        rec["latency_s"] = round(time.perf_counter() - t0, 3)
+        rec["alive"] = False
+        rec["timed_out"] = True
+        counters.inc("probe.timeouts")
+    export.add_record(rec)
+    return rec
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="per-attempt backend-init bound [s]")
+    ap.add_argument("--attempts", type=int, default=1,
+                    help="probe attempts before giving up")
+    ap.add_argument("--sleep", type=float, default=0.0,
+                    help="pause between attempts [s]")
+    ap.add_argument("--jsonl", default="",
+                    help="append probe records + rollup here")
+    args = ap.parse_args(argv)
+
+    core.configure(enabled=True, jsonl_path=args.jsonl or None)
+    alive = False
+    for i in range(max(1, args.attempts)):
+        rec = probe_once(args.timeout)
+        print(json.dumps(rec), flush=True)
+        if rec.get("alive"):
+            alive = True
+            break
+        if i + 1 < args.attempts and args.sleep > 0:
+            time.sleep(args.sleep)
+    export.write_rollup()
+    return 0 if alive else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

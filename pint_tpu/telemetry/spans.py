@@ -1,0 +1,156 @@
+"""Structured wall-clock spans with compile-vs-execute separation.
+
+A span measures one host-observed region with ``time.perf_counter``
+(monotonic).  Because XLA dispatch is asynchronous, a span around device
+work is only honest if the caller closes it after
+``jax.block_until_ready`` — that is the measurement contract
+(docs/ARCHITECTURE.md "Observability"): **every instrumented fit path in
+this repo already blocks on its outputs before the span closes**, so
+span durations are true wall clock, not dispatch time.
+
+Compile vs execute: XLA compiles a program at its first execution, so
+the first call through a jitted step costs trace+compile+execute while
+steady-state calls cost execute only.  :func:`jit_span` labels the first
+span of each name in this process ``kind="compile"`` and later ones
+``kind="execute"`` — mirroring how the bench separates its explicit
+warm-up call from the timed reps.  Sites where the boundary is known
+exactly (bench.py's ``lower().compile()``) pass ``kind=`` explicitly.
+
+The disabled fast path: :func:`span` returns a shared no-op context
+manager — no allocation, no clock read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from pint_tpu.telemetry import core, export
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+_local = threading.local()          # per-thread open-span stack
+_seq_lock = threading.Lock()
+_name_seq: dict[str, int] = {}      # per-name call sequence numbers
+
+
+def _next_seq(name: str) -> int:
+    with _seq_lock:
+        n = _name_seq.get(name, 0)
+        _name_seq[name] = n + 1
+    return n
+
+
+class Span:
+    """One open measurement region; use via ``with span(name): ...``."""
+
+    __slots__ = ("name", "kind", "tags", "seq", "depth", "parent",
+                 "t_wall", "_t0", "dur_s")
+
+    def __init__(self, name: str, kind: str | None, tags: dict):
+        self.name = name
+        self.kind = kind
+        self.tags = tags
+        self.seq = _next_seq(name)
+        self.dur_s = -1.0
+
+    def __enter__(self):
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        self.depth = len(stack)
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        if core.mirror_logs():
+            _mirror("begin %s seq=%d depth=%d", self.name, self.seq,
+                    self.depth)
+        self.t_wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur_s = time.perf_counter() - self._t0
+        stack = _local.stack
+        if stack and stack[-1] is self:
+            stack.pop()
+        rec = {"type": "span", "name": self.name, "t": self.t_wall,
+               "dur_s": self.dur_s, "seq": self.seq, "depth": self.depth,
+               "parent": self.parent, "kind": self.kind, "pid": os.getpid()}
+        if self.tags:
+            rec.update(self.tags)
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        export.add_span(rec)
+        if core.mirror_logs():
+            _mirror("end   %s seq=%d dur=%.6fs%s", self.name, self.seq,
+                    self.dur_s, f" kind={self.kind}" if self.kind else "")
+        return False
+
+
+def _mirror(msg: str, *args) -> None:
+    from pint_tpu.logging import TELEMETRY, get_logger
+
+    get_logger("telemetry").log(TELEMETRY, msg, *args)
+
+
+def span(name: str, kind: str | None = None, **tags):
+    """Context manager recording one wall-clock region (no-op when off)."""
+    if not core._enabled:
+        return _NULL_SPAN
+    return Span(name, kind, tags)
+
+
+def jit_span(name: str, **tags):
+    """A span whose kind is compile (first call of ``name``) or execute.
+
+    The per-process first call through a jitted program pays
+    trace+compile; later calls are steady-state.  When one name covers
+    several compiled programs (e.g. a re-jit after an MXU-mode
+    fallback), the first-call heuristic undercounts compiles — sites
+    that know the exact boundary pass ``kind=`` to :func:`span`.
+    """
+    if not core._enabled:
+        return _NULL_SPAN
+    s = Span(name, None, tags)
+    s.kind = "compile" if s.seq == 0 else "execute"
+    return s
+
+
+def traced(name: str | None = None, kind: str | None = None):
+    """Decorator form: ``@traced("fit.wls")`` wraps the call in a span."""
+
+    def deco(fn):
+        import functools
+
+        label = name or f"{fn.__module__}.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not core._enabled:
+                return fn(*args, **kwargs)
+            with Span(label, kind, {}):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def _reset() -> None:
+    with _seq_lock:
+        _name_seq.clear()
+    _local.stack = []

@@ -325,7 +325,7 @@ def build_TOAs_from_raw(
 # executables forever in long sessions.
 from pint_tpu.utils.cache import LRUCache
 
-_PIPELINE_JIT_CACHE = LRUCache(32)
+_PIPELINE_JIT_CACHE = LRUCache(32, name="toa_pipeline")
 
 
 def _astrometric_pipeline(eph: Ephemeris, planets: bool,

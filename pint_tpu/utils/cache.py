@@ -11,19 +11,34 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from pint_tpu.telemetry import core as _tele_core
+from pint_tpu.telemetry import counters as _tele_counters
+
 
 class LRUCache(OrderedDict):
-    """OrderedDict with get-refreshes-recency and size-capped insertion."""
+    """OrderedDict with get-refreshes-recency and size-capped insertion.
 
-    def __init__(self, maxsize: int):
+    ``name`` opts the cache into telemetry: every lookup increments
+    ``cache.<name>.hit`` / ``cache.<name>.miss`` and every capacity
+    eviction ``cache.<name>.evict`` (pint_tpu.telemetry.counters) — the
+    hit rates of the fingerprinted program caches were unknown for five
+    rounds (ISSUE 1), and a recompile costs seconds while a hit costs
+    microseconds, so miss storms must be visible in the rollup.
+    """
+
+    def __init__(self, maxsize: int, name: str | None = None):
         super().__init__()
         self.maxsize = int(maxsize)
+        self.name = name
 
     def get_lru(self, key):
         """Value for ``key`` (refreshing its recency) or None."""
         val = self.get(key)
         if val is not None:
             self.move_to_end(key)
+        if self.name is not None and _tele_core._enabled:
+            _tele_counters.inc(f"cache.{self.name}."
+                               f"{'miss' if val is None else 'hit'}")
         return val
 
     def put_lru(self, key, val):
@@ -31,4 +46,6 @@ class LRUCache(OrderedDict):
         self[key] = val
         while len(self) > self.maxsize:
             self.popitem(last=False)
+            if self.name is not None and _tele_core._enabled:
+                _tele_counters.inc(f"cache.{self.name}.evict")
         return val

@@ -480,8 +480,18 @@ def main() -> int:
 
     import jax
 
+    from pint_tpu import telemetry
+
+    # per-trial telemetry (ISSUE 1): counter deltas (damped-loop events,
+    # program-cache hit/miss) + a host sample ride each trial record, so
+    # a slow or flaky trial is diagnosable from the committed SOAK JSON
+    telemetry.configure(
+        enabled=os.environ.get("PINT_TPU_TELEMETRY", "") != "0",
+        jsonl_path=os.environ.get("PINT_TPU_TELEMETRY_PATH") or None)
+
     record = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
               "git_sha": _git_sha(), "jax": jax.__version__,
+              "telemetry_enabled": telemetry.enabled(),
               "seed_base": args.seed, "trials_requested": args.trials,
               "n_pass": 0, "n_fail": 0, "fail_seeds": [], "trials": []}
 
@@ -498,20 +508,32 @@ def main() -> int:
     t0 = time.time()
     for i in range(args.trials):
         seed = args.seed + i
+        counters_before = telemetry.counters_snapshot()
         t1 = time.time()
-        ok, msg, axes = one_trial(seed)
+        with telemetry.span("soak.trial", seed=seed):
+            ok, msg, axes = one_trial(seed)
         wall = time.time() - t1
         if not ok:
             fails += 1
             record["fail_seeds"].append(seed)
             print(msg, flush=True)
         record["n_pass" if ok else "n_fail"] += 1
-        record["trials"].append({"seed": seed, "ok": ok,
-                                 "wall_s": round(wall, 1), **axes})
+        trial_rec = {"seed": seed, "ok": ok, "wall_s": round(wall, 1), **axes}
+        if telemetry.enabled():
+            host = telemetry.host_sample()
+            trial_rec["telemetry"] = {
+                "counters": telemetry.counters_delta(counters_before),
+                "load1": host["load1"], "polluted": host["polluted"]}
+        record["trials"].append(trial_rec)
         save()
         print(f"[{i + 1}/{args.trials}] seed {seed}: "
               f"{'ok' if ok else 'FAIL'} ({time.time() - t0:.0f}s)",
               flush=True)
+    if telemetry.enabled():
+        # whole-run rollup (span aggregates, cumulative counters, final
+        # host state) closes the record — and the jsonl when configured
+        record["telemetry_rollup"] = telemetry.write_rollup()
+        save()
     print(f"soak: {args.trials - fails}/{args.trials} passed")
     return min(fails, 255)  # raw count would wrap mod 256 (256 -> "clean")
 

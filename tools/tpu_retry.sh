@@ -12,6 +12,11 @@
 cd /root/repo || exit 1
 LOG=${TPU_RETRY_LOG:-/tmp/tpu_retry.log}
 EVID=TPU_EVIDENCE_r05.json
+# per-probe latency/timeout records land here as telemetry JSON-lines
+# (type="probe" lines + a rollup with probe.* counters per invocation),
+# replacing the old free-text "probe dead/ALIVE" log lines as the
+# machine-readable record of tunnel liveness windows
+PROBE_JSONL=${TPU_PROBE_JSONL:-/tmp/tpu_probe.jsonl}
 
 steps_done() {
     python - "$EVID" <<'EOF'
@@ -41,8 +46,13 @@ except Exception: print(-1)" 2>/dev/null || echo -1)
 
 echo "retry loop start $(date -u +%H:%M:%S)" >> "$LOG"
 for i in $(seq 1 400); do
-    # quick probe: 60s to list devices; skip the heavy run if dead
-    if ! timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    # quick probe: 60s to list devices; skip the heavy run if dead.
+    # The probe is the telemetry-backed python module (latency + timeout
+    # counters into $PROBE_JSONL); this wrapper stays a thin caller.
+    # The outer timeout bounds the probe PARENT too — its own jax import
+    # runs under the axon sitecustomize and must not hang the loop.
+    if ! timeout 90 python -m pint_tpu.telemetry.probe --timeout 60 \
+            --jsonl "$PROBE_JSONL" >> "$LOG" 2>&1; then
         echo "attempt $i $(date -u +%H:%M:%S): probe dead" >> "$LOG"
         sleep 180
         continue
