@@ -115,13 +115,17 @@ def _telemetry_fields() -> dict:
     ``host_polluted`` is machine-readable (satellite 1): True when load1
     at child start exceeded the threshold — replaces the judge's manual
     SIGSTOP ritual for deciding whether a number was taken on a loaded
-    host.
+    host. ``contended`` acts on the recorded load1 (VERDICT Weak #2):
+    load1 > 0.5 at start means another workload (e.g. a background
+    soak) already owned CPU when this bench began, so the committed
+    number must carry the flag.
     """
     from pint_tpu import telemetry
 
     start = _HOST_START or telemetry.host_sample()
     out = {"host_polluted": bool(start.get("polluted")),
-           "load1_start": start.get("load1")}
+           "load1_start": start.get("load1"),
+           "contended": bool((start.get("load1") or 0.0) > 0.5)}
     if not telemetry.enabled():
         out["telemetry"] = {"enabled": False}
         return out
@@ -310,6 +314,23 @@ def _flop_fields(flops: float, analytic: dict, value_s: float,
     return out
 
 
+def _best_of(times: list) -> tuple[float, dict]:
+    """Headline wall = best-of-k with spread (VERDICT Weak #2).
+
+    The minimum is the least-contended rep — robust to a background
+    workload stealing a core mid-run — and the spread makes the noise
+    of the set auditable instead of silently halving the committed
+    number. Callers guarantee k >= 3.
+    """
+    best = float(np.min(times))
+    return best, {
+        "reps": len(times),
+        "wall_median": round(float(np.median(times)), 6),
+        "wall_spread_pct": round(
+            100.0 * (float(np.max(times)) - best) / max(best, 1e-12), 1),
+    }
+
+
 def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
     """Shared mode-bench harness: build, warm, time reps, emit JSON.
 
@@ -333,11 +354,12 @@ def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
                     t0 = time.perf_counter()
                     fit()
                     times.append(time.perf_counter() - t0)
-            value = float(np.median(times))
+            value, rep_stats = _best_of(times)
             out = {"metric": metric, "value": round(value, 6), "unit": "s",
                    "vs_baseline": round(budget_s / value, 3),
                    "backend": jax.default_backend() + pinned,
                    "host_cores": os.cpu_count()}
+            out.update(rep_stats)
             out.update(extras(value))
             out.update(_telemetry_fields())
         _emit(out)
@@ -575,15 +597,16 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
             _, sol = f._iterate(base, deltas)
             jax.block_until_ready(sol["chi2"])
             times.append(time.perf_counter() - t0)
-    value = float(np.median(times))
+    value, rep_stats = _best_of(times)
     chi2 = float(np.asarray(sol["chi2"]))
-    stage1_s = float(np.median(s1_times))
+    stage1_s = float(np.min(s1_times))
 
     out_fields = {
         "metric": metric,
         "value": round(value, 6),
         "unit": "s",
         "vs_baseline": round(budget_s / value, 3),
+        **rep_stats,
         "backend": backend,
         "device": device,
         "host_cores": os.cpu_count(),
@@ -621,6 +644,73 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
         f"(~{q / 4:.0f} flop/B) compute-bound")
     out_fields.update(_telemetry_fields())
     _emit(out_fields)
+
+
+# headline fields of the compact stdout record (satellite 1): everything
+# a driver needs to judge the run; the roofline/FLOP/telemetry detail
+# lives in the committed BENCH_DETAIL artifact
+_COMPACT_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "backend", "device", "chi2",
+    "compile_s", "reps", "wall_median", "wall_spread_pct", "host_polluted",
+    "contended", "load1_start", "dd_self_check", "mode", "error",
+    "fallback_reason", "design_matrix_ms_per_toa", "mfu_pct", "gflops_s",
+    "skipped",
+)
+
+
+def _compact(record: dict, detail_name: str) -> dict:
+    out = {k: record[k] for k in _COMPACT_KEYS if k in record}
+    out["detail"] = detail_name
+    pta = record.get("pta")
+    if isinstance(pta, dict):
+        out["pta"] = {k: pta[k] for k in _COMPACT_KEYS if k in pta}
+
+    # hard <1500-char guarantee for the 2000-char tail: shed detail in
+    # dispensability order until it actually fits (long error/fallback
+    # strings are the realistic overflow path)
+    def fits() -> bool:
+        return len(json.dumps(out)) <= 1500
+
+    if not fits() and isinstance(out.get("pta"), dict):
+        out["pta"] = {k: out["pta"][k] for k in ("metric", "value", "error")
+                      if k in out["pta"]}
+    for key in ("error", "fallback_reason"):
+        if not fits() and isinstance(out.get(key), str):
+            out[key] = out[key][:200]
+    for key in ("pta", "mfu_pct", "gflops_s", "design_matrix_ms_per_toa",
+                "mode", "device", "load1_start", "wall_median",
+                "wall_spread_pct", "fallback_reason"):
+        if fits():
+            break
+        out.pop(key, None)
+    return out
+
+
+def _finish(record: dict) -> None:
+    """Persist the full record; print ONE compact line as the FINAL stdout.
+
+    Capture-proofing (VERDICT Weak #1): the driver keeps only a
+    2000-char stdout tail, which the old full record (roofline stages +
+    embedded telemetry rollup, ~6 kB) always overflowed — so committed
+    rounds had ``parsed: null`` despite a successful bench. The full
+    detail now lands in ``BENCH_DETAIL_r06.json`` (committed; override
+    with PINT_TPU_BENCH_DETAIL) and stdout carries only the <1500-char
+    headline record, so the tail always parses AND tools reading the
+    redirected stdout as one JSON document (tools/tpu_retry.sh) keep
+    working.
+    """
+    detail_path = os.environ.get(
+        "PINT_TPU_BENCH_DETAIL",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_DETAIL_r06.json"))
+    try:
+        with open(detail_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+            fh.write("\n")
+        detail_name = os.path.basename(detail_path)
+    except OSError as e:  # record the loss, keep the headline
+        detail_name = f"unwritable: {e}"
+    print(json.dumps(_compact(record, detail_name)))
 
 
 def main() -> None:
@@ -730,7 +820,7 @@ def main() -> None:
     result, fail = run_child({}, 0.6 * TOTAL_TIMEOUT_S)
     if result is not None and result.get("value", -1.0) > 0:
         attach_pta(result, {})
-        print(json.dumps(result))
+        _finish(result)
         return
     if result is not None:
         fail = result.get("error", fail) or fail
@@ -740,7 +830,7 @@ def main() -> None:
     # record why. Skip when the failed run was already on the CPU
     # backend (an identical rerun cannot succeed).
     if (result or {}).get("backend") == "cpu":
-        print(json.dumps(result))
+        _finish(result)
         return
     # the fallback gets only the remaining budget: TOTAL_TIMEOUT_S is a
     # hard bound on the whole bench (CI harnesses size timeouts from it).
@@ -756,7 +846,7 @@ def main() -> None:
     if cpu_result is not None and cpu_result.get("value", -1.0) > 0:
         cpu_result["fallback_reason"] = f"accelerator backend failed: {fail}"
         attach_pta(cpu_result, {"JAX_PLATFORMS": "cpu"})
-        print(json.dumps(cpu_result))
+        _finish(cpu_result)
         return
     _emit({"metric": diag_metric, "value": -1.0, "unit": "s",
            "vs_baseline": 0.0,
@@ -811,7 +901,8 @@ def _main_guarded() -> None:
         _run_smoke()
         return
     n = int(os.environ.get("PINT_TPU_BENCH_N", str(N_DEFAULT)))
-    reps = int(os.environ.get("PINT_TPU_BENCH_REPS", "5"))
+    # best-of-k needs k >= 3 for a meaningful spread (VERDICT Weak #2)
+    reps = max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "5")))
     mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
     if mode in ("pta", "wideband", "batch"):
         try:
@@ -894,7 +985,7 @@ def _main_guarded() -> None:
                 out = step(base, deltas, toas, noise)
                 jax.block_until_ready(out)
                 times.append(time.perf_counter() - t0)
-        value = float(np.median(times))
+        value, rep_stats = _best_of(times)
         chi2 = float(np.asarray(out[1]["chi2"]))
 
         # secondary BASELINE metric: jacfwd design-matrix build alone
@@ -918,13 +1009,14 @@ def _main_guarded() -> None:
                 t0 = time.perf_counter()
                 jax.block_until_ready(dm_fn(deltas))
                 dm_times.append(time.perf_counter() - t0)
-        dm_ms_per_toa = float(np.median(dm_times)) * 1e3 / n
+        dm_ms_per_toa = float(np.min(dm_times)) * 1e3 / n
 
         out_fields = {
             "metric": metric,
             "value": round(value, 6),
             "unit": "s",
             "vs_baseline": round(budget_s / value, 3),
+            **rep_stats,
             "backend": backend,
             "device": device,
             "host_cores": os.cpu_count(),

@@ -1,0 +1,228 @@
+"""Shape-bucketed program reuse for the fit hot path.
+
+Every distinct TOA count compiles a fresh XLA program (~5-40 s each on
+this toolchain) even when the fingerprinted program caches
+(``TimingModel._cached_jit``, the jitted fit steps) hit: the cached
+callable is shared, but ``jax.jit`` re-specializes per input *shape*.
+The persistent on-disk compile cache is closed on this host (XLA:CPU
+AOT reload segfaults — tests/conftest.py), so the one remaining
+compile-amortization lever is in-process: canonicalize the TOA-axis
+shape so different datasets execute the SAME compiled program.
+
+This module is the one home of that policy:
+
+* **Bucket sizes** (:func:`bucket_size`): next power of two, floored at
+  ``BUCKET_FLOOR`` — a session compiles ~log2(max n) programs per model
+  structure instead of one per TOA count. Above ``BUCKET_CEILING``
+  (default 16384, env ``PINT_TPU_BUCKET_MAX``) **exact shapes are kept**:
+  a one-shot large fit amortizes its own compile over many O(n)
+  iterations, while power-of-two padding would tax every iteration by
+  up to 2x compute. (The TOA *build* pipeline keeps bucketing at every
+  size — :func:`pipeline_bucket_size` — because it is elementwise and
+  runs once per dataset.) Sharded callers pass ``multiple=`` so the
+  bucket stays divisible by the mesh's TOA-shard count.
+* **Zero-weight padding** (:func:`pad_toas`, hoisted from
+  ``parallel/sharded_fit.py``): padding rows replicate the last TOA with
+  ``PAD_ERROR_US`` uncertainty (weight ~1e-24 of a real TOA), so every
+  weighted reduction — mean phase, Gram matrix, chi2, Fourier-span
+  min/max — is unchanged to f64 round-off while shapes stay static.
+  :func:`pad_solve_rows` is the matrix-level analogue for the dense
+  solvers: appended all-zero rows contribute *exactly* zero to every
+  Gram product, norm and chi2 term.
+* **Program-reuse accounting** (:func:`note_program`): process-global
+  registry of (program kind, structure fingerprint, shape) feeding the
+  ``cache.fit_program.hit`` / ``.miss`` telemetry counters — a ``miss``
+  is an XLA compile, a ``hit`` a warm-program execution, so the
+  recompile amortization claim is verifiable from any rollup
+  (tools/soak.py commits it per trial).
+
+Kill switch: ``PINT_TPU_FIT_BUCKETING=0`` restores exact-shape
+compilation everywhere (the parity tests run both ways).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.telemetry import core as _tele_core
+from pint_tpu.telemetry import counters as _tele_counters
+
+# padded TOAs carry this uncertainty -> weight ~1e-24 of a real TOA
+PAD_ERROR_US = 1e12
+
+BUCKET_FLOOR = 32
+
+
+def enabled() -> bool:
+    """Fit-path bucketing gate (read per call so tests can flip it)."""
+    return os.environ.get("PINT_TPU_FIT_BUCKETING", "") != "0"
+
+
+def bucket_ceiling() -> int:
+    """Largest TOA count still bucketed on the fit path (see module doc)."""
+    return int(os.environ.get("PINT_TPU_BUCKET_MAX", "16384"))
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def bucket_size(n: int, *, multiple: int = 1) -> int:
+    """Canonical fit-path TOA count for a dataset of ``n`` rows.
+
+    Next power of two (floored at ``BUCKET_FLOOR``) for n up to
+    ``bucket_ceiling()``; exact shape above it. Always rounded up to
+    ``multiple`` (a mesh's TOA-shard count) — powers of two already are
+    for power-of-two meshes, so sharded buckets coincide with dense ones
+    on the usual 2/4/8-device layouts.
+    """
+    if n <= 0:
+        raise ValueError(f"bucket_size needs n >= 1, got {n}")
+    if not enabled() or n > bucket_ceiling():
+        return _round_up(n, multiple)
+    b = max(BUCKET_FLOOR, 1 << (n - 1).bit_length())
+    return _round_up(b, multiple)
+
+
+def pipeline_bucket_size(n: int) -> int:
+    """Bucket policy of the fused TOA-build pipeline (pad + slice back).
+
+    The pipeline is elementwise over the TOA axis and runs once per
+    dataset, so it buckets at EVERY size: next power of two below 8192;
+    above, next multiple of 1024 — a power-of-two bucket would waste up
+    to 2x pipeline compute (e.g. 8824 -> 16384), which dominates big-N
+    builds, while multiples of 1024 waste < 12% and real sessions use
+    few distinct large sizes.
+    """
+    if n <= 8192:
+        return max(16, 1 << (n - 1).bit_length())
+    return _round_up(n, 1024)
+
+
+def pad_toas(toas, n_target: int):
+    """Extend a TOA table to ``n_target`` rows with zero-weight padding.
+
+    Padding rows replicate the last TOA but with enormous uncertainty, so
+    every weighted reduction (mean phase, Gram matrix, chi2) is unchanged
+    to machine precision while shapes stay static for XLA.
+    """
+    from pint_tpu.toas import Flags
+
+    n = len(toas)
+    if n_target < n:
+        raise ValueError(f"n_target {n_target} < ntoas {n}")
+    if n_target == n:
+        return toas
+    k = n_target - n
+
+    def pad_leaf(x):
+        x = jnp.asarray(x)
+        reps = jnp.repeat(x[-1:], k, axis=0)
+        return jnp.concatenate([x, reps], axis=0)
+
+    err = pad_leaf(toas.error_us).at[n:].set(PAD_ERROR_US)
+    padded = jax.tree.map(pad_leaf, toas)
+    return dataclasses.replace(
+        padded,
+        error_us=err,
+        flags=Flags(tuple(toas.flags) + tuple(dict(toas.flags[-1]) for _ in range(k))),
+    )
+
+
+def bucket_toas(toas, *, multiple: int = 1):
+    """``pad_toas`` to the canonical bucket (no-op at-bucket / disabled).
+
+    The padded table is memoized on the TOAs instance (keyed by target
+    size): ``phase()``/``designmatrix()`` run once per damped-loop
+    evaluation, and re-dispatching ~20 eager pad ops per call measurably
+    dominated warm small fits. TOAs tables are treated as immutable
+    everywhere (mutation goes through ``dataclasses.replace``, which
+    drops the memo), so the cache cannot go stale.
+    """
+    n = len(toas)
+    if n == 0:  # pintk can deselect every TOA; padding repeats row -1,
+        return toas  # which does not exist — pass empty tables through
+    n_target = bucket_size(n, multiple=multiple)
+    if n_target == n:
+        return toas
+    cache = getattr(toas, "_bucket_pad_memo", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(toas, "_bucket_pad_memo", cache)
+    padded = cache.get(n_target)
+    if padded is None:
+        padded = cache[n_target] = pad_toas(toas, n_target)
+    return padded
+
+
+def pad_solve_rows(n_target: int, r, sigma, *mats):
+    """Row-pad dense solver inputs to ``n_target`` with EXACT zeros.
+
+    Returns ``(r, sigma, *mats)`` with appended rows r=0, sigma=1 and
+    all-zero matrix rows (``None`` matrices pass through). Unlike the
+    TOA-table padding this is exact, not round-off-level: a zero row
+    contributes 0 to every column norm, Gram entry, gradient and chi2
+    term regardless of its weight, so ``wls_solve``/``gls_solve`` on the
+    padded system return bit-comparable solutions while compiling one
+    program per (bucket, column-count) instead of per dataset.
+    """
+    n = int(np.shape(r)[0])
+    if n_target == n:
+        return (r, sigma) + mats
+    if n_target < n:
+        raise ValueError(f"n_target {n_target} < n {n}")
+    k = n_target - n
+    out = [jnp.concatenate([jnp.asarray(r), jnp.zeros(k)]),
+           jnp.concatenate([jnp.asarray(sigma), jnp.ones(k)])]
+    for M in mats:
+        if M is None:
+            out.append(None)
+            continue
+        M = jnp.asarray(M)
+        out.append(jnp.concatenate([M, jnp.zeros((k, M.shape[1]))], axis=0))
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# program-reuse accounting (cache.fit_program.hit / .miss)
+# ----------------------------------------------------------------------
+# (kind, structure-fingerprint hash, shape) triples seen this process; a
+# new triple means jax.jit will trace + XLA-compile, a seen one is a
+# warm-program execution. Plain set: entries are tiny tuples and a
+# session sees O(structures x buckets) of them.
+_SEEN_PROGRAMS: set = set()
+
+
+def note_program(kind: str, fingerprint, shape) -> None:
+    """Record one execution of fit program ``kind`` at ``shape``.
+
+    ``fingerprint`` is anything hashable identifying the traced
+    structure (callers pass ``hash(model._fn_fingerprint())``; None for
+    model-free programs like the dense solvers).
+    """
+    if not _tele_core._enabled:
+        return
+    key = (kind, fingerprint, shape)
+    hit = key in _SEEN_PROGRAMS
+    _SEEN_PROGRAMS.add(key)
+    _tele_counters.inc(f"cache.fit_program.{'hit' if hit else 'miss'}")
+
+
+def toa_shape(toas) -> tuple:
+    """Hashable shape + sharding identity of a (possibly batched) table.
+
+    The input sharding is part of jax.jit's own specialization key — the
+    same shape on an 8-device mesh and a 1-device mesh are two compiled
+    programs — so it must be part of the accounting key too, or a
+    re-sharded fit would log a ``hit`` while paying a real compile.
+    (Known residual undercount, accepted as accounting noise: LRU
+    eviction of a cached callable, or id() reuse after GC, can make a
+    recompile register as a hit.)
+    """
+    return (tuple(np.shape(toas.freq_mhz)),
+            getattr(toas.freq_mhz, "sharding", None))

@@ -277,7 +277,14 @@ class WLSFitter(Fitter):
             with telemetry.jit_span("fit.wls_iter"):
                 M, names = self.get_designmatrix()
                 err = self.resids.get_errors_s()
-                sol = wls_solve(M, self.resids.time_resids, err, threshold)
+                # bucketed solve shape (exact zero rows — bucketing doc)
+                from pint_tpu import bucketing
+
+                nb = bucketing.bucket_size(len(self.toas))
+                r, err, M = bucketing.pad_solve_rows(
+                    nb, self.resids.time_resids, err, M)
+                bucketing.note_program("wls_solve", None, (nb, M.shape[1]))
+                sol = wls_solve(M, r, err, threshold)
                 x = np.asarray(sol["x"])
             cov = np.asarray(sol["cov"])
             errors = np.sqrt(np.diag(cov))

@@ -29,9 +29,42 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import bucketing
 from pint_tpu.fitting.fitter import Fitter, WLSFitter, wls_solve
 
 Array = jax.Array
+
+
+def _pad_gls_rows(n: int, r, sigma, M, T=None, owner=None):
+    """Bucket the dense solvers' row dimension with exact zero rows.
+
+    One compiled ``wls_solve``/``gls_solve`` per (bucket, columns)
+    instead of per TOA count; zero rows contribute exactly nothing to
+    any Gram/norm/chi2 term (pint_tpu.bucketing.pad_solve_rows). The
+    accounting kind follows the solver actually run (``wls_solve`` when
+    there is no noise basis) so the two call paths of one program share
+    one key. ``owner`` (a fitter) memoizes the padded noise basis: T is
+    fixed for the fitter's lifetime and O(n·k), so re-concatenating it
+    on every step/probe evaluation was measurable copy traffic.
+    """
+    nb = bucketing.bucket_size(n)
+    r, sigma, M = bucketing.pad_solve_rows(nb, r, sigma, M)
+    if T is None:
+        bucketing.note_program("wls_solve", None, (nb, M.shape[1]))
+        return r, sigma, M, None
+    bucketing.note_program("gls_solve", None, (nb, M.shape[1], T.shape[1]))
+    if int(T.shape[0]) != nb:
+        memo = getattr(owner, "_padded_T_memo", None) if owner else None
+        if memo is not None and memo[0] is T and memo[1] == nb:
+            T = memo[2]
+        else:
+            Tb = jnp.concatenate(
+                [jnp.asarray(T),
+                 jnp.zeros((nb - int(T.shape[0]), T.shape[1]))], axis=0)
+            if owner is not None:
+                owner._padded_T_memo = (T, nb, Tb)
+            T = Tb
+    return r, sigma, M, T
 
 
 @jax.jit
@@ -138,16 +171,23 @@ class GLSFitter(Fitter):
             M, names = self.get_designmatrix()
             sigma = self.resids.get_errors_s()
             r = self.resids.time_resids
-            M, r, sigma, T, phi = self._to_solve_device(M, r, sigma, T, phi)
-            if T is None:
+            # pad into LOCAL names: T persists across iterations and
+            # must stay unpadded (padding it twice would grow it)
+            if not full_cov:  # dense-C path stays exact-shape (O(n^2))
+                r, sigma, M, Tb = _pad_gls_rows(len(self.toas), r, sigma,
+                                                M, T, owner=self)
+            else:
+                Tb = T
+            M, r, sigma, Tb, phi = self._to_solve_device(M, r, sigma, Tb, phi)
+            if Tb is None:
                 sol = wls_solve(M, r, sigma)
                 sol = {"x": sol["x"], "cov": sol["cov"], "chi2": sol["chi2"],
                        "noise_coeffs": np.zeros(0)}
                 T_np = None
             else:
                 solve = gls_solve_full_cov if full_cov else gls_solve
-                sol = solve(M, T, phi, r, sigma)
-                T_np = np.asarray(T)
+                sol = solve(M, Tb, phi, r, sigma)
+                T_np = np.asarray(Tb)
             x = np.asarray(sol["x"])
             cov = np.asarray(sol["cov"])
             self.update_model(names, x, np.sqrt(np.diag(cov)))
@@ -155,7 +195,8 @@ class GLSFitter(Fitter):
             self.parameter_covariance_matrix = cov
             self.noise_coeffs = np.asarray(sol["noise_coeffs"])
             if T_np is not None and self.noise_coeffs.size:
-                self.resids_noise = T_np @ self.noise_coeffs
+                # slice off the bucketing pad rows (user-visible waveform)
+                self.resids_noise = (T_np @ self.noise_coeffs)[:len(self.toas)]
         self.resids = self._new_resids()
         return float(np.asarray(sol["chi2"]))
 
@@ -252,8 +293,10 @@ class DownhillWLSFitter(_DownhillMixin, WLSFitter):
 
     def _step(self, threshold: float | None = None, **kw):
         M, names = self.get_designmatrix()
-        sol = wls_solve(M, self.resids.time_resids,
-                        self.resids.get_errors_s(), threshold)
+        r, sigma, M, _ = _pad_gls_rows(len(self.toas),
+                                       self.resids.time_resids,
+                                       self.resids.get_errors_s(), M)
+        sol = wls_solve(M, r, sigma, threshold)
         cov = np.asarray(sol["cov"])
         return np.asarray(sol["x"]), names, np.sqrt(np.diag(cov)), cov
 
@@ -267,9 +310,11 @@ class DownhillGLSFitter(_DownhillMixin, GLSFitter):
             return self.resids.chi2
         # GLS chi2 of current residuals: r^T C^-1 r via the Woodbury
         # identity with a zero-column design matrix
-        M0 = jnp.zeros((len(self.toas), 0))
-        sol = gls_solve(M0, T, phi, self.resids.time_resids,
-                        self.resids.get_errors_s())
+        r, sigma, M0, T = _pad_gls_rows(
+            len(self.toas), self.resids.time_resids,
+            self.resids.get_errors_s(), jnp.zeros((len(self.toas), 0)), T,
+            owner=self)
+        sol = gls_solve(M0, T, phi, r, sigma)
         return float(np.asarray(sol["chi2"]))
 
     def _step(self, full_cov: bool = False, **kw):
@@ -277,6 +322,9 @@ class DownhillGLSFitter(_DownhillMixin, GLSFitter):
         M, names = self.get_designmatrix()
         sigma = self.resids.get_errors_s()
         r = self.resids.time_resids
+        if not full_cov:
+            r, sigma, M, T = _pad_gls_rows(len(self.toas), r, sigma, M, T,
+                                            owner=self)
         if T is None:
             sol = wls_solve(M, r, sigma)
         else:
@@ -284,6 +332,7 @@ class DownhillGLSFitter(_DownhillMixin, GLSFitter):
             sol = solve(M, T, phi, r, sigma)
             self.noise_coeffs = np.asarray(sol["noise_coeffs"])
             if self.noise_coeffs.size:
-                self.resids_noise = np.asarray(T) @ self.noise_coeffs
+                self.resids_noise = (np.asarray(T)
+                                     @ self.noise_coeffs)[:len(self.toas)]
         cov = np.asarray(sol["cov"])
         return np.asarray(sol["x"]), names, np.sqrt(np.diag(cov)), cov

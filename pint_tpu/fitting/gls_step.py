@@ -459,6 +459,11 @@ def jitted_gls_step(model, *, pl_specs: tuple[PLSpec, ...] = ()):
     ``TimingModel._cached_jit`` instead — one program per (structure
     fingerprint, pl_specs); values flow through the traced ``base``.
     """
-    return model._cached_jit(
-        ("gls_step", pl_specs),
-        lambda owner: make_gls_step(owner, pl_specs=pl_specs))
+    from pint_tpu.fitting.step import _counted_step
+
+    key = ("gls_step", pl_specs)
+    return _counted_step(
+        model._cached_jit(key,
+                          lambda owner: make_gls_step(owner,
+                                                      pl_specs=pl_specs)),
+        key, model)

@@ -130,4 +130,26 @@ def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
                            params=params)
         return jax.vmap(fn, in_axes=(0, 0, 0, 0)) if vmapped else fn
 
-    return model._cached_jit(key, build)
+    return _counted_step(model._cached_jit(key, build), key, model)
+
+
+def _counted_step(fn, key, model):
+    """Wrap a shared jitted step with per-shape program-reuse counters.
+
+    The cached callable is one object per model structure, but jax.jit
+    re-specializes per TOA shape — exactly what bucketing
+    (pint_tpu.bucketing) canonicalizes. Counting (kind, fingerprint,
+    shape) executions here makes the reuse auditable: a
+    ``cache.fit_program.miss`` is an XLA compile, a ``.hit`` a
+    warm-program execution.
+    """
+    from pint_tpu.bucketing import note_program, toa_shape
+
+    fp = hash(model._fn_fingerprint())
+    kind = key[0]
+
+    def counted(base, deltas, toas, *rest):
+        note_program(kind, (fp,) + tuple(key[1:]), toa_shape(toas))
+        return fn(base, deltas, toas, *rest)
+
+    return counted

@@ -105,12 +105,18 @@ class WidebandTOAFitter(Fitter):
         return self._noise_cache
 
     def _solve(self):
+        from pint_tpu.fitting.gls import _pad_gls_rows
+
         M, r, err, names = self._stacked_system()
         T, phi = self._noise_arrays_stacked()
-        if T is None:
+        # bucket the stacked 2n row dimension (exact zero rows; the
+        # cached T is padded into a LOCAL only — see GLSFitter.fit_toas)
+        r, err, M, Tb = _pad_gls_rows(int(r.shape[0]), r, err, M, T,
+                                      owner=self)
+        if Tb is None:
             sol = wls_solve(M, r, err)
         else:
-            sol = gls_solve(M, T, phi, r, err)
+            sol = gls_solve(M, Tb, phi, r, err)
         return sol, names
 
     def fit_toas(self, maxiter: int = 1, **kw) -> float:
@@ -142,11 +148,17 @@ class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
         T, phi = self._noise_arrays_stacked()
         if T is None:
             return self.resids.chi2
+        from pint_tpu.fitting.gls import _pad_gls_rows
+
         r = jnp.concatenate([self.resids.toa.time_resids, self.resids.dm_resids])
         err = jnp.concatenate([self.resids.toa.get_errors_s(),
                                self.resids.dm_errors])
         M0 = jnp.zeros((r.shape[0], 0))
-        sol = gls_solve(M0, T, phi, r, err)
+        # the memo key is (T identity, bucket), so the probe shares the
+        # step's padded T
+        r, err, M0, Tb = _pad_gls_rows(int(r.shape[0]), r, err, M0, T,
+                                       owner=self)
+        sol = gls_solve(M0, Tb, phi, r, err)
         return float(np.asarray(sol["chi2"]))
 
     def _step(self, **kw):

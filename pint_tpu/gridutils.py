@@ -32,7 +32,76 @@ def _chisq_at_points(toas, model, param_names: tuple[str, ...],
     C = N + U phi U^T (ECORR/red-noise bases at the model's current
     hyperparameters) via the Woodbury identity — the option the
     round-1 review flagged as missing (grid-chi2 was white-noise only).
+
+    The white-noise metric routes through the structure-fingerprinted
+    program cache with the TOA table *traced and bucketed*
+    (pint_tpu.bucketing): repeated grids in a session — around
+    successive fits, or over different same-structure datasets — reuse
+    ONE compiled program per (structure, gridded params, bucket) instead
+    of re-jitting a fresh closure every call. The GLS metric keeps the
+    per-call closure at exact shapes: its host-built dense noise basis U
+    is dataset-content-keyed, which a conservative program cache cannot
+    express (documented policy — docs/ARCHITECTURE.md).
     """
+    pairs = model._noise_basis_pairs(toas) if gls else []
+    if pairs:
+        return _chisq_at_points_dense_noise(toas, model, param_names,
+                                            points, solve_free, pairs)
+
+    from pint_tpu import bucketing
+
+    def build(owner):
+        free_rest = [n for n in owner.free_params if n not in param_names]
+        phase_fn = owner.phase_fn_toas()
+
+        def f(base, pts, tt):
+            err = owner.scaled_toa_uncertainty(tt)
+            w = 1.0 / jnp.square(err)
+            sqrtw = jnp.sqrt(w)
+            f0 = base["F0"].hi + base["F0"].lo
+
+            def whitened_resid(deltas):
+                ph = phase_fn(base, deltas, tt)
+                resid = ph.frac.hi + ph.frac.lo
+                resid = resid - jnp.sum(resid * w) / jnp.sum(w)
+                return resid / f0
+
+            def total_phase(deltas):
+                ph = phase_fn(base, deltas, tt)
+                return ph.int_part + (ph.frac.hi + ph.frac.lo)
+
+            def chi2_at(point):
+                deltas = {n: point[i] for i, n in enumerate(param_names)}
+                deltas.update({n: jnp.zeros(()) for n in free_rest})
+                r = whitened_resid(deltas)
+                if solve_free and free_rest:
+                    J = jax.jacfwd(total_phase)(deltas)
+                    cols = [jnp.ones_like(r) / f0] \
+                        + [-J[n] / f0 for n in free_rest]
+                    M = jnp.stack(cols, axis=1)
+                    x = wls_solve_gram(M, r, err)["x"]
+                    fitted = dict(deltas)
+                    for i, n in enumerate(free_rest):
+                        fitted[n] = x[i + 1]
+                    r = whitened_resid(fitted)
+                rw = r * sqrtw
+                return rw @ rw
+
+            return jax.vmap(chi2_at)(pts)
+
+        return f
+
+    fn = model._cached_jit(("grid_chisq", tuple(param_names), solve_free),
+                           build)
+    tt = bucketing.bucket_toas(toas)
+    bucketing.note_program("grid_chisq", (id(fn),),
+                           (len(tt), int(np.shape(points)[0])))
+    return np.asarray(fn(model.base_dd(), jnp.asarray(points), tt))
+
+
+def _chisq_at_points_dense_noise(toas, model, param_names, points,
+                                 solve_free, pairs) -> np.ndarray:
+    """GLS grid metric with the host-built dense noise basis (exact shapes)."""
     free_rest = [n for n in model.free_params if n not in param_names]
     base = model.base_dd()
     phase_fn = model.phase_fn_toas()
@@ -40,13 +109,8 @@ def _chisq_at_points(toas, model, param_names: tuple[str, ...],
     w = 1.0 / jnp.square(err)
     f0 = model.f0_f64
 
-    U = inv_phi = None
-    if gls:
-        pairs = model._noise_basis_pairs(toas)
-        if pairs:
-            U = jnp.asarray(np.concatenate([u for _, u, _ in pairs], axis=1))
-            inv_phi = jnp.asarray(
-                1.0 / np.concatenate([p for _, _, p in pairs]))
+    U = jnp.asarray(np.concatenate([u for _, u, _ in pairs], axis=1))
+    inv_phi = jnp.asarray(1.0 / np.concatenate([p for _, _, p in pairs]))
 
     def frac_phase(deltas):
         ph = phase_fn(base, deltas, toas)
@@ -63,16 +127,12 @@ def _chisq_at_points(toas, model, param_names: tuple[str, ...],
 
     sqrtw = jnp.sqrt(w)
 
-    if U is not None:
-        Aw = U * sqrtw[:, None]
-        S = jnp.diag(inv_phi) + Aw.T @ Aw
-        S_fac = jax.scipy.linalg.cho_factor(S, lower=True)
+    Aw = U * sqrtw[:, None]
+    S = jnp.diag(inv_phi) + Aw.T @ Aw
+    S_fac = jax.scipy.linalg.cho_factor(S, lower=True)
 
-        def cinv_w(X):  # whitened C^-1 via Woodbury: I - Aw S^-1 Aw^T
-            return X - Aw @ jax.scipy.linalg.cho_solve(S_fac, Aw.T @ X)
-    else:
-        def cinv_w(X):
-            return X
+    def cinv_w(X):  # whitened C^-1 via Woodbury: I - Aw S^-1 Aw^T
+        return X - Aw @ jax.scipy.linalg.cho_solve(S_fac, Aw.T @ X)
 
     def gls_solve_free(M, r):
         """Linearized free-parameter solve in the C metric."""
@@ -93,10 +153,7 @@ def _chisq_at_points(toas, model, param_names: tuple[str, ...],
             J = jax.jacfwd(total_phase)(deltas)
             cols = [jnp.ones_like(r) / f0] + [-J[n] / f0 for n in free_rest]
             M = jnp.stack(cols, axis=1)
-            if U is None:
-                x = wls_solve_gram(M, r, err)["x"]
-            else:
-                x = gls_solve_free(M, r)
+            x = gls_solve_free(M, r)
             fitted = dict(deltas)
             for i, n in enumerate(free_rest):
                 fitted[n] = x[i + 1]

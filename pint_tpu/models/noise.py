@@ -249,9 +249,20 @@ class EcorrNoise(NoiseComponent):
         n = len(t_s)
         idx = np.full(n, -1, dtype=np.int64)
         weights: list[float] = []
+        # shape-bucketing padding rows (pint_tpu.bucketing.pad_toas)
+        # replicate the LAST TOA's time and flags, so without this
+        # exclusion they would quantize into a phantom epoch glued onto
+        # the last real TOA — activating ECORR for it and breaking the
+        # weight-neutral padding invariant (observed: ne 0 -> 1 and a
+        # ~1% chi2 shift on a padded table). Padding rows are identified
+        # by their sentinel uncertainty and never form or join an epoch,
+        # making epoch structure independent of padding.
+        from pint_tpu.bucketing import PAD_ERROR_US
+
+        not_pad = np.asarray(toas.error_us) < PAD_ERROR_US
         for name in self.ecorr_names:
             p = self.param(name)
-            mask = np.asarray(toa_mask(p.selector, toas), bool)
+            mask = np.asarray(toa_mask(p.selector, toas), bool) & not_pad
             sel = np.nonzero(mask)[0]
             if sel.size == 0:
                 continue

@@ -417,11 +417,28 @@ class TimingModel:
         return ent
 
     def phase(self, toas, abs_phase: bool = True) -> phase_mod.Phase:
-        """Model phase at each TOA (reference: TimingModel.phase)."""
+        """Model phase at each TOA (reference: TimingModel.phase).
+
+        The TOA axis is bucketed (zero-weight pad + slice back,
+        pint_tpu.bucketing): the phase pipeline is elementwise over the
+        axis, so padded rows are exact and same-structure datasets of
+        different TOA counts execute ONE compiled program instead of
+        recompiling per count.
+        """
+        from pint_tpu import bucketing
+
         fn = self._cached_jit(
             ("phase", abs_phase),
             lambda owner: owner.phase_fn_toas(abs_phase=abs_phase))
-        return fn(self.base_dd(), {}, toas)
+        n = len(toas)
+        padded = bucketing.bucket_toas(toas)
+        # id(fn) identifies (structure fingerprint, key): the LRU pins it
+        bucketing.note_program("phase", (id(fn),), (len(padded),))
+        ph = fn(self.base_dd(), {}, padded)
+        if len(padded) == n:
+            return ph
+        return phase_mod.Phase(ph.int_part[:n],
+                               dd.DD(ph.frac.hi[:n], ph.frac.lo[:n]))
 
     def delay(self, toas) -> Array:
         """Total delay [s] (reference: TimingModel.delay)."""
@@ -511,7 +528,15 @@ class TimingModel:
 
         fn = self._cached_jit(("designmatrix", tuple(names), incoffset),
                               build)
-        return fn(self.base_dd(), toas), out_names
+        # bucketed TOA axis (see phase): jacfwd rows are per-TOA, so the
+        # padded rows slice off exactly
+        from pint_tpu import bucketing
+
+        n = len(toas)
+        padded = bucketing.bucket_toas(toas)
+        bucketing.note_program("designmatrix", (id(fn),), (len(padded),))
+        M = fn(self.base_dd(), padded)
+        return (M if len(padded) == n else M[:n]), out_names
 
     # ------------------------------------------------------------------
     # par-file output (reference: TimingModel.as_parfile)

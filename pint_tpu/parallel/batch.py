@@ -42,9 +42,8 @@ from pint_tpu.models.noise import ScaleToaError
 from pint_tpu.models.parameter import materialize_selector_masks
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.ops.dd import DD
-from pint_tpu.parallel.mesh import (make_mesh, pad_to_multiple, replicate,
-                                    shard_toas)
-from pint_tpu.parallel.sharded_fit import pad_toas
+from pint_tpu.bucketing import bucket_size, pad_toas
+from pint_tpu.parallel.mesh import make_mesh, replicate, shard_toas
 from pint_tpu.toas import Flags, TOAs
 
 # neutral values that make an absent component a no-op without 0/0: a
@@ -282,7 +281,10 @@ class BatchedPulsarFitter:
             self.base[pname] = DD(jnp.asarray(his), jnp.asarray(los))
 
         n_shards = self.mesh.shape["toa"]
-        n_max = pad_to_multiple(max(len(t) for t in self.toas_list), n_shards)
+        # bucketed common length: batches over similar TOA counts (and
+        # re-built batches as datasets grow) reuse one vmapped program
+        n_max = bucket_size(max(len(t) for t in self.toas_list),
+                            multiple=n_shards)
         prepped = [
             _materialize_for_pulsar(t, i, self.models, self.union, owners)
             for i, t in enumerate(self.toas_list)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu.bucketing import note_program, toa_shape
 from pint_tpu.constants import SECS_PER_DAY
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
                                        fourier_design,
@@ -488,13 +489,15 @@ class PTAGLSFitter:
                                  basis))
                 continue
             if self.mesh is not None:
+                from pint_tpu.bucketing import bucket_size, pad_toas
                 from pint_tpu.fitting.gls_step import pad_noise_statics
-                from pint_tpu.parallel.mesh import (pad_to_multiple,
-                                                    replicate, shard_toas)
-                from pint_tpu.parallel.sharded_fit import pad_toas
+                from pint_tpu.parallel.mesh import replicate, shard_toas
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
-                n_target = pad_to_multiple(len(toas), self.mesh.shape["toa"])
+                # bucketed (not just shard-rounded): same-structure
+                # pulsars of different TOA counts share one mesh program
+                n_target = bucket_size(len(toas),
+                                       multiple=self.mesh.shape["toa"])
                 noise = pad_noise_statics(noise, n_target)
                 toas = shard_toas(pad_toas(toas, n_target), self.mesh)
                 rep = NamedSharding(self.mesh, P())
@@ -577,6 +580,9 @@ class PTAGLSFitter:
             stacked = jnp.stack(packs)
         stacked_dev = jax.device_put(stacked, self.accel_dev)
         n = int(self._batched[3].shape[1])  # t_s is (P, n)
+        note_program("pta_stage2",
+                     (self.gw, pl_specs, p, self._mxu_mode, "vmapped"),
+                     tuple(stacked.shape))
 
         def run(mode):
             return self._stage2_prog(pl_specs, p, mode,
@@ -595,12 +601,20 @@ class PTAGLSFitter:
         # model-free: 68 pulsars with distinct frozen values but equal
         # (gw, pl_specs, p, mode) share ONE compiled program per shape.
         # ONE key convention for both the per-pulsar and vmapped paths.
-        key = (self.gw, pl_specs, p, mode, vmapped)
+        # The packed stage-1 buffer is donated on accelerator targets
+        # (dead after the call — fitting.hybrid.stage2_donate_argnums);
+        # donation is part of the key so a CPU-split fitter never shares
+        # a donating executable.
+        from pint_tpu.fitting.hybrid import stage2_donate_argnums
+
+        donate = stage2_donate_argnums(self.accel_dev)
+        key = (self.gw, pl_specs, p, mode, vmapped, donate)
         prog = _STAGE2_CACHE.get_lru(key)
         if prog is None:
             fn = make_pta_stage2(self.gw, pl_specs, p, mode)
             prog = _STAGE2_CACHE.put_lru(
-                key, jax.jit(jax.vmap(fn) if vmapped else fn))
+                key, jax.jit(jax.vmap(fn) if vmapped else fn,
+                             donate_argnums=donate))
         return prog
 
     def _unpack_gram(self, row, p: int, k_pl: int) -> dict:
@@ -649,6 +663,8 @@ class PTAGLSFitter:
         from pint_tpu.fitting.hybrid import run_stage2_with_fallback
 
         n = int(dev_args[3].shape[0])  # t_s
+        note_program("pta_stage2", (self.gw, pl_specs, p, self._mxu_mode),
+                     (n,))
         out = run_stage2_with_fallback(
             self, (pl_specs, p, n),
             lambda mode: self._stage2_prog(pl_specs, p, mode)(
@@ -679,6 +695,9 @@ class PTAGLSFitter:
                     self._deltas_for(model, deltas_list, i)))
                 continue
             _, gram, toas, noise, model, basis = entry
+            # id(gram) identifies (structure fingerprint, gw, pl_specs):
+            # the model-level LRU pins the callable
+            note_program("pta_gram", (id(gram),), toa_shape(toas))
             base = model.base_dd()
             deltas = self._deltas_for(model, deltas_list, i)
             if self.mesh is not None:

@@ -11,53 +11,20 @@ blocks, and a replicated Cholesky. No hand-written collectives.
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pint_tpu import telemetry
+# pad_toas/PAD_ERROR_US moved to pint_tpu.bucketing (the shared shape
+# policy home); re-exported here for the existing import sites
+from pint_tpu.bucketing import PAD_ERROR_US, bucket_size, pad_toas  # noqa: F401
 from pint_tpu.fitting.damped import downhill_iterate
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
                                        jitted_gls_step, pad_noise_statics)
 from pint_tpu.fitting.step import jitted_wls_step
-from pint_tpu.parallel.mesh import (make_mesh, pad_to_multiple, replicate,
-                                    shard_toas)
-from pint_tpu.toas import Flags, TOAs
-
-# padded TOAs carry this uncertainty -> weight ~1e-24 of a real TOA
-PAD_ERROR_US = 1e12
-
-
-def pad_toas(toas: TOAs, n_target: int) -> TOAs:
-    """Extend a TOA table to `n_target` rows with zero-weight padding.
-
-    Padding rows replicate the last TOA but with enormous uncertainty, so
-    every weighted reduction (mean phase, Gram matrix, chi2) is unchanged
-    to machine precision while shapes stay static for XLA.
-    """
-    n = len(toas)
-    if n_target < n:
-        raise ValueError(f"n_target {n_target} < ntoas {n}")
-    if n_target == n:
-        return toas
-    k = n_target - n
-
-    def pad_leaf(x):
-        x = jnp.asarray(x)
-        reps = jnp.repeat(x[-1:], k, axis=0)
-        return jnp.concatenate([x, reps], axis=0)
-
-    err = pad_leaf(toas.error_us).at[n:].set(PAD_ERROR_US)
-    padded = jax.tree.map(pad_leaf, toas)
-    return dataclasses.replace(
-        padded,
-        error_us=err,
-        flags=Flags(tuple(toas.flags) + tuple(dict(toas.flags[-1]) for _ in range(k))),
-    )
+from pint_tpu.parallel.mesh import make_mesh, replicate, shard_toas
 
 
 def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
@@ -74,8 +41,11 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
     n_shards = mesh.shape["toa"]
     telemetry.set_gauge("mesh.devices", mesh.size)
     telemetry.set_gauge("fit.ntoas", len(toas))
-    padded = pad_toas(toas, pad_to_multiple(len(toas), n_shards))
+    # bucketed (not just shard-rounded) padding: same-structure fits of
+    # different TOA counts execute one compiled step program
+    padded = pad_toas(toas, bucket_size(len(toas), multiple=n_shards))
     toas_sh = shard_toas(padded, mesh)
+    del padded  # drop the unsharded copy before the fit's peak
     step = jitted_wls_step(model)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
@@ -130,13 +100,15 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
     n_shards = mesh.shape["toa"]
     telemetry.set_gauge("mesh.devices", mesh.size)
     telemetry.set_gauge("fit.ntoas", len(toas))
-    n_target = pad_to_multiple(len(toas), n_shards)
+    # bucketed padding (see sharded_fit): cross-size program reuse
+    n_target = bucket_size(len(toas), multiple=n_shards)
 
     noise, pl_specs = build_noise_statics(model, toas)
     noise = pad_noise_statics(noise, n_target)
     padded = pad_toas(toas, n_target)
 
     toas_sh = shard_toas(padded, mesh)
+    del padded  # drop the unsharded copy before the fit's peak
     rep = NamedSharding(mesh, P())
     noise_sh = NoiseStatics(
         epoch_idx=jax.device_put(noise.epoch_idx,

@@ -531,15 +531,11 @@ def build_TOAs_from_arrays(
     # bucket the TOA axis (pad by repeating the last row): the pipeline
     # is elementwise over n, so padding is exact, and the whole suite /
     # a whole session compiles a bounded number of fused programs
-    # instead of one per distinct TOA count. Small n: next power of two
-    # (~log2 programs). Large n: next multiple of 1024 — a power-of-two
-    # bucket would waste up to 2x pipeline compute (e.g. 8824 -> 16384),
-    # which dominates big-N builds, while multiples of 1024 waste < 12%
-    # and real sessions use few distinct large sizes.
-    if n <= 8192:
-        n_pad = max(16, 1 << (n - 1).bit_length())
-    else:
-        n_pad = (n + 1023) & ~1023
+    # instead of one per distinct TOA count. The size policy lives with
+    # the fit-path bucketing in pint_tpu.bucketing (one home).
+    from pint_tpu.bucketing import pipeline_bucket_size
+
+    n_pad = pipeline_bucket_size(n)
 
     def _pad(x, fill=None):
         x = jnp.asarray(x)

@@ -148,17 +148,23 @@ EFAC FREQ 300 500 1.5
 def test_batched_heterogeneous_matches_individual():
     """VERDICT round-1 task 4: pulsars with *different* components batch.
 
-    Three pulsars — isolated, ELL1 binary, JUMP+EFAC — fitted in one
-    vmapped program must match their individual WLSFitter fits (values
-    and uncertainties), union model + parameter-superset mask doing the
-    heterogeneity.
+    Two structures — JUMP+EFAC and isolated — fitted in one vmapped
+    program must match their individual WLSFitter fits (values and
+    uncertainties), union model + parameter-superset mask doing the
+    heterogeneity. The convergence flags regress round-5 VERDICT Weak
+    #6 (the heterogeneous damped loop must reach converged given
+    iteration headroom; SCALE_r06's batched_het note has the knife-edge
+    story).
+
+    Suite-perf note (ISSUE-2 satellite): this used to batch an ELL1
+    binary as the second structure — the Kepler-chain jacfwd made the
+    union step the single most expensive compile of the suite (~34 s
+    test wall). The isolated pulsar exercises the same union/mask
+    machinery (absent components neutralized, masked columns) without
+    it; ELL1-in-batch coverage lives at full size in scale_proof.py's
+    batched_het config.
     """
-    # two structures (isolated + ELL1 binary) exercise the union model
-    # and parameter-superset mask; the JUMP+EFAC masked-column semantics
-    # are pinned by test_batched_frozen_in_one_free_in_another and the
-    # full three-structure case runs at 20k TOAs/psr in scale_proof.py
-    # (each extra structure costs ~10 s of per-structure XLA compiles)
-    pars = [PAR + JUMP_EFAC_LINES, PAR + ELL1_LINES]
+    pars = [PAR + JUMP_EFAC_LINES, PAR]
     problems, individuals = [], []
     for i, par in enumerate(pars):
         truth = get_model(par)
@@ -180,10 +186,15 @@ def test_batched_heterogeneous_matches_individual():
         problems.append((toas, pert_b))
 
     bf = BatchedPulsarFitter(problems)
-    assert "PB" in bf.free_params and any(
-        k.startswith("JUMP") for k in bf.free_params)
-    chi2 = bf.fit_toas(maxiter=2)
+    jumps = [k for k in bf.free_params if k.startswith("JUMP")]
+    assert jumps
+    # heterogeneity via the superset mask: the isolated pulsar's JUMP
+    # column is masked off
+    assert float(bf.param_mask[jumps[0]][0]) == 1.0
+    assert float(bf.param_mask[jumps[0]][1]) == 0.0
+    chi2 = bf.fit_toas(maxiter=8)
     assert chi2.shape == (2,)
+    assert bf.converged.all()
     for ind, (toas, bat) in zip(individuals, problems):
         for name in ind.free_params:
             a, b = ind[name], bat[name]

@@ -533,6 +533,31 @@ def main() -> int:
         # whole-run rollup (span aggregates, cumulative counters, final
         # host state) closes the record — and the jsonl when configured
         record["telemetry_rollup"] = telemetry.write_rollup()
+        # cross-trial program-reuse summary (ISSUE 2): the named program
+        # caches' hit/miss deltas summed over trials AFTER the first —
+        # with shape bucketing, later trials should mostly execute warm
+        # programs (a cache.*.miss is a fresh trace and, for
+        # cache.fit_program, an XLA compile)
+        hits = misses = 0
+        per_cache: dict[str, dict[str, int]] = {}
+        for t in record["trials"][1:]:
+            for k, v in (t.get("telemetry", {}).get("counters") or {}).items():
+                if not k.startswith("cache."):
+                    continue
+                _, cname, kind = k.split(".", 2)
+                if kind not in ("hit", "miss"):
+                    continue
+                per_cache.setdefault(cname, {"hit": 0, "miss": 0})[kind] += v
+                if kind == "hit":
+                    hits += v
+                else:
+                    misses += v
+        record["program_reuse"] = {
+            "cross_trial_hits": hits,
+            "cross_trial_misses": misses,
+            "cross_trial_hit_rate": round(hits / max(1, hits + misses), 4),
+            "per_cache": per_cache,
+        }
         save()
     print(f"soak: {args.trials - fails}/{args.trials} passed")
     return min(fails, 255)  # raw count would wrap mod 256 (256 -> "clean")
