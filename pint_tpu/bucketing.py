@@ -4,10 +4,12 @@ Every distinct TOA count compiles a fresh XLA program (~5-40 s each on
 this toolchain) even when the fingerprinted program caches
 (``TimingModel._cached_jit``, the jitted fit steps) hit: the cached
 callable is shared, but ``jax.jit`` re-specializes per input *shape*.
-The persistent on-disk compile cache is closed on this host (XLA:CPU
-AOT reload segfaults — tests/conftest.py), so the one remaining
-compile-amortization lever is in-process: canonicalize the TOA-axis
-shape so different datasets execute the SAME compiled program.
+The persistent on-disk compile cache was closed on this host for
+rounds 3-6 (XLA:CPU AOT reload segfault; round 7 re-measured and
+re-opened it — docs/COMPILE_CACHE.md), and is in any case only a
+compile-time cache: bucketing additionally cuts trace time and device
+dispatches by canonicalizing the TOA-axis shape so different datasets
+execute the SAME compiled program.
 
 This module is the one home of that policy:
 

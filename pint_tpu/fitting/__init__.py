@@ -3,6 +3,7 @@
 Reference equivalent: ``pint.fitter`` (src/pint/fitter.py).
 """
 
+from pint_tpu.fitting import device_loop  # noqa: F401
 from pint_tpu.fitting.fitter import Fitter, WLSFitter  # noqa: F401
 from pint_tpu.fitting.gls import (  # noqa: F401
     DownhillGLSFitter, DownhillWLSFitter, GLSFitter)

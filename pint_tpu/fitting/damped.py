@@ -107,3 +107,108 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
             break
     telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
     return deltas, info, chi2, converged
+
+
+def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
+                               probe_fetch, deltas0: dict, *,
+                               maxiter: int = 20,
+                               min_chi2_decrease: float = 1e-3,
+                               max_step_halvings: int = 8):
+    """:func:`downhill_iterate` with speculative probe pipelining.
+
+    For split fitters whose full step is (host stage) -> (asynchronous
+    accelerator stage) -> (blocking fetch) — the hybrid CPU-DD fitter —
+    the loop cannot be fused on-device (stage 1 must run on the host),
+    but the sync structure still leaves the host idle while the chip
+    executes stage 2. This driver overlaps that window: when a full
+    step for trial ``lam`` is dispatched, the CPU probe of the NEXT
+    halved candidate (``lam/2`` — known before the full result, since
+    it depends only on the current proposal) is dispatched speculatively
+    while the accelerator works. A rejected trial then finds its probe
+    already evaluated (the halving path pays zero probe latency); an
+    accepted one discards it (counted ``fit.probe_spec_wasted`` — CPU
+    cycles spent inside the accelerator's execution window).
+
+    The accept/halve/converge semantics and the judged-event counters
+    (``fit.iterations/accepts/halvings/probe_evals/probe_rejects``) are
+    IDENTICAL to :func:`downhill_iterate` — speculation changes when
+    work is dispatched, never what is judged (parity pinned by
+    tests/test_device_loop.py).
+
+    Contract: ``step_dispatch(deltas) -> handle`` starts a full step
+    without blocking, ``step_fetch(handle) -> (new_deltas, info)``
+    blocks; same for ``probe_dispatch``/``probe_fetch`` (probe value is
+    the scalar chi2 at the input).
+    """
+    with telemetry.jit_span("fit.step"):
+        new_deltas, info = step_fetch(step_dispatch(deltas0))
+    chi2 = float(info["chi2_at_input"])
+    deltas = deltas0
+    converged = False
+    for _ in range(max(1, maxiter)):
+        telemetry.inc("fit.iterations")
+        dx = {k: new_deltas[k] - deltas[k] for k in deltas}
+        lam, applied = 1.0, False
+        trial = trial_new = trial_info = None
+        spec = None  # (lam of the speculated candidate, probe handle)
+
+        def _speculate(lam_now, h_now, dx=dx, deltas=deltas):
+            if h_now + 1 >= max_step_halvings:
+                return None  # that halving would never be tried
+            telemetry.inc("fit.probe_speculated")
+            cand = {k: deltas[k] + (lam_now * 0.5) * dx[k] for k in deltas}
+            return (lam_now * 0.5, probe_dispatch(cand))
+
+        for _h in range(max_step_halvings):
+            if _h > 0:
+                telemetry.inc("fit.halvings")
+            trial = {k: deltas[k] + lam * dx[k] for k in deltas}
+            if _h == 0:
+                handle = step_dispatch(trial)
+                spec = _speculate(lam, _h)
+                with telemetry.jit_span("fit.step"):
+                    trial_new, trial_info = step_fetch(handle)
+                trial_chi2 = float(trial_info["chi2_at_input"])
+            else:
+                telemetry.inc("fit.probe_evals")
+                trial_new = trial_info = None
+                with telemetry.jit_span("fit.probe"):
+                    if spec is not None and spec[0] == lam:
+                        trial_chi2 = float(probe_fetch(spec[1]))
+                    else:
+                        if spec is not None:
+                            telemetry.inc("fit.probe_spec_wasted")
+                        trial_chi2 = float(probe_fetch(
+                            probe_dispatch(trial)))
+                spec = None
+            if trial_chi2 <= chi2 + 1e-12:
+                if trial_info is None:
+                    # probe-accepted: authoritative full re-check, with
+                    # the next halving's probe speculated under it
+                    handle = step_dispatch(trial)
+                    spec = _speculate(lam, _h)
+                    with telemetry.jit_span("fit.step"):
+                        trial_new, trial_info = step_fetch(handle)
+                    trial_chi2 = float(trial_info["chi2_at_input"])
+                    if trial_chi2 > chi2 + 1e-12:
+                        telemetry.inc("fit.probe_rejects")
+                        lam *= 0.5
+                        continue
+                applied = True
+                telemetry.inc("fit.accepts")
+                break
+            lam *= 0.5
+        if spec is not None:
+            telemetry.inc("fit.probe_spec_wasted")
+            spec = None
+        if not applied:
+            converged = True
+            break
+        decrease = chi2 - trial_chi2
+        deltas, chi2 = trial, trial_chi2
+        new_deltas, info = trial_new, trial_info
+        if decrease < min_chi2_decrease:
+            converged = True
+            break
+    telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    return deltas, info, chi2, converged

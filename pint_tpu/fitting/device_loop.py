@@ -1,0 +1,507 @@
+"""Fused damped Gauss-Newton: the whole accept/halve/converge loop on-device.
+
+Motivation (ISSUE 3): with shape bucketing making compiles rare, the
+dominant non-FLOP cost of a fit became the per-iteration dispatch+sync
+pattern of the host driver (:func:`pint_tpu.fitting.damped
+.downhill_iterate`) — one program launch and one blocking ``float(chi2)``
+device->host fetch per iteration *and per halving trial*. This module
+moves the entire loop inside XLA: a ``lax.while_loop`` whose carry is
+``(deltas, proposal, chi2, lam, halving/iteration counters, flags)``
+drives the SAME accept / halve / converge semantics, so a complete fit
+is ONE program launch and ONE host fetch regardless of iteration count.
+
+Semantics are the host driver's, preserved exactly (and pinned by
+tests/test_device_loop.py parity assertions):
+
+* the first (lam=1) trial of each iteration runs the FULL fused step
+  (its proposal is needed on acceptance, the common case);
+* halved trials are judged by the cheap residual-only chi2 *probe* when
+  one is provided — and a probe-accepted point is re-evaluated once with
+  the full step, whose chi2 is AUTHORITATIVE (the probe is a different
+  arithmetic path; when the full value contradicts the acceptance the
+  loop keeps halving instead of applying an uphill step);
+* ``min_chi2_decrease`` convergence floor, ``max_step_halvings`` cap,
+  and the ``fit.*`` telemetry counters (iterations / accepts / halvings
+  / probe_evals / probe_rejects / converged / maxiter_exhausted) — now
+  read from the returned carry in the single fetch instead of being
+  incremented per dispatch.
+
+The loop body executes exactly ONE step evaluation per ``while``
+iteration (a small state machine with an ``is_init`` first pass and an
+``is_recheck`` pass for probe-accepted trials), so the compiled program
+contains a single instance of the fused step — compile cost stays at
+~one step trace, not one per loop phase.
+
+``maxiter`` / ``min_chi2_decrease`` / ``max_step_halvings`` are traced
+operands: one compiled loop serves every hyperparameter setting.
+
+Kill switch: ``PINT_TPU_DEVICE_LOOP=0`` restores the host driver
+everywhere (the reference oracle; parity tests run both).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import telemetry
+from pint_tpu.utils.cache import LRUCache
+
+# accept tolerance of the host driver (damped.downhill_iterate)
+_EPS = 1e-12
+
+# compiled loop programs keyed by the caller's (kind, step-identity)
+# tuple; the captured step closures are the model-level cached jitted
+# steps, so entries stay valid for the life of those programs
+_LOOP_CACHE = LRUCache(32, name="device_loop")
+
+
+def enabled() -> bool:
+    """Device-loop gate (read per call so tests can flip the env var)."""
+    return os.environ.get("PINT_TPU_DEVICE_LOOP", "") != "0"
+
+
+def _sel(pred, a, b):
+    return jnp.where(pred, a, b)
+
+
+def _tree_sel(pred, ta, tb):
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), ta, tb)
+
+
+def _zeros_like_shapes(tree_shapes):
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tree_shapes)
+
+
+_COUNTERS = ("iterations", "accepts", "halvings", "probe_evals",
+             "probe_rejects")
+
+
+def build_damped_loop(full, probe=None):
+    """Build ``loop(deltas0, operands, maxiter, min_dec, max_halvings)``.
+
+    ``full(deltas, operands) -> (new_deltas, info)`` is the fused step
+    (``info["chi2_at_input"]`` judges the trial); ``probe(deltas,
+    operands) -> chi2`` is the optional residual-only evaluator for
+    halved trials. Both are traced INTO the loop program (cached jitted
+    steps inline under the outer jit). Returns a plain function suitable
+    for ``jax.jit``; the loop result is ``(deltas, info, chi2,
+    converged, counters)``.
+
+    Structure: a TWO-LEVEL while — full steps in the outer body, the
+    probe in an inner while over halved candidates — with no
+    ``lax.cond`` anywhere. XLA:CPU compiles elementwise fusion loops
+    inside ``cond`` branches ~1.5x slower than the same loops in a
+    plain computation (measured on this host; ``while`` bodies carry no
+    such penalty), and the full step's phase/jacfwd pipeline is exactly
+    that op class — a cond-based probe/full dispatch taxed every
+    ACCEPTED step to keep a rarely-taken probe branch. Here each outer
+    body runs exactly one full evaluation: the init pass, a first
+    (lam=1) trial, or the authoritative re-check of a probe-accepted
+    candidate; a rejected full drops into the inner probe loop, which
+    halves until a candidate looks downhill (next outer body re-checks
+    it) or halvings are exhausted (converged at the numerical optimum).
+    """
+    has_probe = probe is not None
+
+    def loop(deltas0, operands, maxiter, min_dec, max_halvings):
+        maxiter = jnp.maximum(jnp.asarray(maxiter, jnp.int32), 1)
+        max_halvings = jnp.maximum(jnp.asarray(max_halvings, jnp.int32), 1)
+        min_dec = jnp.asarray(min_dec, jnp.float64)
+
+        # info carry needs the right structure before the first full
+        # eval: abstract-eval the step (no ops emitted) and start from
+        # zeros — overwritten by the is_init pass before any read
+        info_shapes = jax.eval_shape(lambda d: full(d, operands)[1],
+                                     deltas0)
+        c0 = {
+            "deltas": deltas0,
+            "new_deltas": deltas0,
+            "dx": jax.tree.map(jnp.zeros_like, deltas0),
+            "info": _zeros_like_shapes(info_shapes),
+            "chi2": jnp.zeros((), jnp.float64),
+            "lam": jnp.ones((), jnp.float64),
+            "h": jnp.zeros((), jnp.int32),
+            "it": jnp.zeros((), jnp.int32),
+            "is_init": jnp.bool_(True),
+            "done": jnp.bool_(False),
+            "converged": jnp.bool_(False),
+            **{k: jnp.zeros((), jnp.int32) for k in _COUNTERS},
+        }
+
+        def body(c):
+            # this body's full evaluation: the init point (dx == 0), a
+            # first (lam=1, h=0) trial, or a probe-accepted candidate
+            # being authoritatively re-checked (h > 0)
+            trial = jax.tree.map(lambda d, x: d + c["lam"] * x,
+                                 c["deltas"], c["dx"])
+            t_new, t_info = full(trial, operands)
+            t_chi2 = t_info["chi2_at_input"]
+
+            accept_test = t_chi2 <= c["chi2"] + _EPS
+            p_init = c["is_init"]
+            p_acc = (~p_init) & accept_test
+            p_rej = (~p_init) & (~accept_test)
+            adopt = p_init | p_acc
+
+            deltas_n = _tree_sel(p_acc, trial, c["deltas"])
+            chi2_n = _sel(adopt, t_chi2, c["chi2"])
+            new_n = _tree_sel(adopt, t_new, c["new_deltas"])
+            info_n = _tree_sel(adopt, t_info, c["info"])
+            dx_n = _tree_sel(
+                adopt,
+                jax.tree.map(lambda a, b: a - b, new_n, deltas_n),
+                c["dx"])
+
+            decrease = c["chi2"] - t_chi2
+            conv_now = p_acc & (decrease < min_dec)
+            exhausted = p_acc & (c["it"] >= maxiter)
+
+            if has_probe:
+                # rejected full -> probe halved candidates until one
+                # looks downhill (the NEXT outer body re-checks it with
+                # the authoritative full value) or halvings run out.
+                # Counter parity with the host driver: halvings and
+                # probe_evals at probe-trial start; the re-check shares
+                # its candidate's h (no extra halving count).
+                def inner_cond(s):
+                    return s["run"] & (~s["found"]) \
+                        & (s["hp"] < max_halvings)
+
+                def inner_body(s):
+                    cand = jax.tree.map(lambda d, x: d + s["lam_p"] * x,
+                                        c["deltas"], c["dx"])
+                    pc = probe(cand, operands)
+                    found = pc <= c["chi2"] + _EPS
+                    return {
+                        "run": s["run"],
+                        "found": found,
+                        "hp": _sel(found, s["hp"], s["hp"] + 1),
+                        "lam_p": _sel(found, s["lam_p"],
+                                      s["lam_p"] * 0.5),
+                        "halv": s["halv"] + 1,
+                        "pev": s["pev"] + 1,
+                    }
+
+                s = jax.lax.while_loop(inner_cond, inner_body, {
+                    "run": p_rej,
+                    "found": jnp.bool_(False),
+                    "hp": c["h"] + 1,
+                    "lam_p": c["lam"] * 0.5,
+                    "halv": jnp.zeros((), jnp.int32),
+                    "pev": jnp.zeros((), jnp.int32),
+                })
+                probe_found = p_rej & s["found"]
+                rej_exh = p_rej & (~s["found"])
+                lam_r, h_r = s["lam_p"], s["hp"]
+                halv_inc, pev_inc = s["halv"], s["pev"]
+                # a rejecting full at h>0 is the re-check contradicting
+                # its probe's acceptance
+                prej_inc = (p_rej & (c["h"] > 0)).astype(jnp.int32)
+            else:
+                # no probe: halved trials are full evaluations — the
+                # next outer body simply runs at lam/2
+                rej_exh = p_rej & (c["h"] + 1 >= max_halvings)
+                probe_found = p_rej & (~rej_exh)
+                lam_r, h_r = c["lam"] * 0.5, c["h"] + 1
+                halv_inc = probe_found.astype(jnp.int32)
+                pev_inc = jnp.zeros((), jnp.int32)
+                prej_inc = jnp.zeros((), jnp.int32)
+
+            done_n = conv_now | exhausted | rej_exh
+            converged_n = conv_now | rej_exh
+
+            return {
+                "deltas": deltas_n,
+                "new_deltas": new_n,
+                "dx": dx_n,
+                "info": info_n,
+                "chi2": chi2_n,
+                "lam": _sel(adopt, 1.0, _sel(probe_found, lam_r,
+                                             c["lam"])),
+                "h": _sel(adopt, 0, _sel(probe_found, h_r, c["h"])),
+                "it": _sel(p_init, 1, _sel(p_acc, c["it"] + 1, c["it"])),
+                "is_init": jnp.bool_(False),
+                "done": done_n,
+                "converged": converged_n,
+                "iterations": c["iterations"]
+                + p_init.astype(jnp.int32)
+                + (p_acc & (~done_n)).astype(jnp.int32),
+                "accepts": c["accepts"] + p_acc.astype(jnp.int32),
+                "halvings": c["halvings"] + halv_inc,
+                "probe_evals": c["probe_evals"] + pev_inc,
+                "probe_rejects": c["probe_rejects"] + prej_inc,
+            }
+
+        out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
+        counters = {k: out[k] for k in _COUNTERS}
+        return (out["deltas"], out["info"], out["chi2"], out["converged"],
+                counters)
+
+    return loop
+
+
+def _launch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
+            shape):
+    """Shared launch/fetch tail of the scalar and batched runners: one
+    cached-program lookup, one launch, ONE device->host sync, counters
+    re-emitted to telemetry from the fetched carry."""
+    from pint_tpu.bucketing import note_program
+
+    prog = _LOOP_CACHE.get_lru(key)
+    if prog is None:
+        prog = _LOOP_CACHE.put_lru(key, jax.jit(builder()))
+    note_program(kind, fingerprint, tuple(shape))
+    telemetry.inc("fit.device_loop.launches")
+    with telemetry.jit_span(f"{kind}.program"):
+        out = prog(deltas0, operands, *hyper)
+        # the ONE device->host sync of the whole fit
+        deltas, info, chi2, converged, counters = jax.device_get(out)
+    telemetry.inc("fit.device_loop.fetches")
+    counters = {k: int(v) for k, v in counters.items()}
+    for k, v in counters.items():
+        if v:
+            telemetry.inc(f"fit.{k}", v)
+    return deltas, info, chi2, converged, counters
+
+
+def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
+               min_chi2_decrease=1e-3, max_step_halvings=8,
+               kind="device_loop", fingerprint=None, shape=()):
+    """Execute a fused damped fit: one launch, one fetch.
+
+    Same return contract as :func:`pint_tpu.fitting.damped
+    .downhill_iterate` plus the counters dict: ``(deltas, info, chi2,
+    converged, counters)`` with every array already fetched to host
+    numpy. ``key`` identifies the (step, probe) pair for the compiled-
+    loop cache; ``kind``/``fingerprint``/``shape`` feed the bucketing
+    program-reuse accounting (a ``cache.fit_program.miss`` under this
+    kind is an XLA compile of the whole loop program).
+    """
+    deltas, info, chi2, converged, counters = _launch(
+        lambda: build_damped_loop(full, probe), key, deltas0, operands,
+        (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
+        fingerprint=fingerprint, shape=shape)
+    converged = bool(converged)
+    telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    return deltas, info, float(chi2), converged, counters
+
+
+# ----------------------------------------------------------------------
+# batched (per-member lam carry) variant
+# ----------------------------------------------------------------------
+
+def _bwhere(mask, a, b):
+    """Member-wise where over leaves with a leading (B,) axis."""
+    m = jnp.reshape(mask, mask.shape + (1,) * (jnp.ndim(a) - 1))
+    return jnp.where(m, a, b)
+
+
+_BATCH_COUNTERS = ("iterations", "accepts", "halvings", "step_evals")
+
+
+def build_batched_loop(run):
+    """Batched analogue of :func:`build_damped_loop`.
+
+    ``run(deltas, operands) -> (new_deltas, info)`` is the vmapped step
+    over a leading pulsar axis; every judged quantity is a (B,) vector
+    and each member carries its own damping ``lam`` and convergence
+    flag — members halve independently on-device, with none of the host
+    masking rounds of the pre-fusion ``BatchedPulsarFitter`` loop. The
+    semantics mirror that host loop exactly (tests pin parity): one
+    batch-wide trial per body, member-wise acceptance via a zeroed
+    ``lam`` for already-settled members, and a final refresh evaluation
+    only when the last trial left some member away from its kept point.
+    """
+
+    def loop(deltas0, operands, maxiter, min_dec, max_halvings):
+        maxiter = jnp.maximum(jnp.asarray(maxiter, jnp.int32), 1)
+        max_halvings = jnp.maximum(jnp.asarray(max_halvings, jnp.int32), 1)
+        min_dec = jnp.asarray(min_dec, jnp.float64)
+
+        B = int(np.shape(jax.tree.leaves(deltas0)[0])[0])
+        info_shapes = jax.eval_shape(lambda d: run(d, operands)[1],
+                                     deltas0)
+        c0 = {
+            "deltas": deltas0,
+            "new_deltas": deltas0,
+            "dx": jax.tree.map(jnp.zeros_like, deltas0),
+            "info": _zeros_like_shapes(info_shapes),
+            "chi2": jnp.zeros(B, jnp.float64),
+            "lam": jnp.ones(B, jnp.float64),
+            "active": jnp.ones(B, bool),
+            "accepted": jnp.zeros(B, bool),
+            "converged": jnp.zeros(B, bool),
+            "h": jnp.zeros((), jnp.int32),
+            "it": jnp.zeros((), jnp.int32),
+            "is_init": jnp.bool_(True),
+            "is_final": jnp.bool_(False),
+            "done": jnp.bool_(False),
+            **{k: jnp.zeros((), jnp.int32) for k in _BATCH_COUNTERS},
+        }
+
+        def body(c):
+            live = c["active"] & (~c["accepted"])
+            # init: dx == 0 so the trial is deltas0 regardless of lam;
+            # final: a zero lam pins the trial at the kept points
+            lam_j = jnp.where(c["is_init"] | c["is_final"], 0.0,
+                              jnp.where(live, c["lam"], 0.0))
+            trial = jax.tree.map(
+                lambda d, x: d + jnp.reshape(
+                    lam_j, lam_j.shape + (1,) * (jnp.ndim(x) - 1)) * x,
+                c["deltas"], c["dx"])
+            t_new, t_info = run(trial, operands)
+            t_chi2 = t_info["chi2_at_input"]
+
+            p_init = c["is_init"]
+            p_final = c["is_final"]
+            p_norm = (~p_init) & (~p_final)
+
+            # ---- normal trial judgment (member-wise) ----
+            better = t_chi2 <= c["chi2"] + _EPS
+            newly = p_norm & live & better
+            deltas_n = jax.tree.map(lambda t, d: _bwhere(newly, t, d),
+                                    trial, c["deltas"])
+            new_n = jax.tree.map(lambda t, d: _bwhere(newly, t, d),
+                                 t_new, c["new_deltas"])
+            decrease = c["chi2"] - t_chi2
+            chi2_n = _sel(p_init, t_chi2,
+                          jnp.where(newly, t_chi2, c["chi2"]))
+            conv_n = c["converged"] | (newly & (decrease < min_dec))
+            acc_n = c["accepted"] | newly
+
+            inner_done = jnp.all(acc_n | (~c["active"]))
+            inner_exh = p_norm & (~inner_done) & (c["h"] + 1 >= max_halvings)
+            end_iter = p_norm & (inner_done | inner_exh)
+            # members with no downhill step left are at their optimum
+            conv_n = jnp.where(end_iter & c["active"] & (~acc_n),
+                               True, conv_n)
+            all_conv = jnp.all(conv_n)
+            stop_outer = end_iter & (all_conv | (c["it"] >= maxiter))
+            # the host driver re-evaluates at the kept points only when
+            # the last trial left an active member at a rejected lam
+            need_final = stop_outer & (~inner_done)
+            next_iter = end_iter & (~stop_outer)
+
+            # adopt the init evaluation / start the next iteration
+            start = p_init | next_iter
+            new_n = _tree_sel(p_init, t_new, new_n)
+            dx_n = _tree_sel(
+                start,
+                jax.tree.map(lambda a, b: a - b, new_n, deltas_n),
+                c["dx"])
+
+            lam_n = jnp.where(start, 1.0,
+                              jnp.where(p_norm & (~end_iter) & c["active"]
+                                        & (~acc_n), c["lam"] * 0.5,
+                                        c["lam"]))
+
+            return {
+                "deltas": deltas_n,
+                "new_deltas": new_n,
+                "dx": dx_n,
+                # every body IS an evaluation; its info is the freshest
+                # (init / final included — host parity for both)
+                "info": t_info,
+                "chi2": chi2_n,
+                "lam": lam_n,
+                "active": jnp.where(start, ~conv_n, c["active"]),
+                "accepted": jnp.where(start, False, acc_n),
+                "converged": conv_n,
+                "h": _sel(start | end_iter, 0,
+                          _sel(p_norm, c["h"] + 1, c["h"])),
+                "it": _sel(p_init, 1, _sel(next_iter, c["it"] + 1,
+                                           c["it"])),
+                "is_init": jnp.bool_(False),
+                "is_final": need_final,
+                "done": _sel(p_final, True, stop_outer & (~need_final)),
+                "iterations": c["iterations"]
+                + (p_init | next_iter).astype(jnp.int32),
+                "accepts": c["accepts"]
+                + jnp.sum(newly).astype(jnp.int32),
+                "halvings": c["halvings"]
+                + (p_norm & (c["h"] > 0)).astype(jnp.int32),
+                "step_evals": c["step_evals"] + 1,
+            }
+
+        out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
+        counters = {k: out[k] for k in _BATCH_COUNTERS}
+        return (out["deltas"], out["info"], out["chi2"], out["converged"],
+                counters)
+
+    return loop
+
+
+def run_damped_batched(run, deltas0, operands, *, key, maxiter=20,
+                       min_chi2_decrease=1e-3, max_step_halvings=8,
+                       kind="device_loop_batched", fingerprint=None,
+                       shape=()):
+    """Batched :func:`run_damped`: one launch + one fetch for the array.
+
+    Returns ``(deltas, info, chi2, converged, counters)`` with per-
+    member (B,) chi2 and converged arrays, fetched to host numpy.
+    """
+    deltas, info, chi2, converged, counters = _launch(
+        lambda: build_batched_loop(run), key, deltas0, operands,
+        (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
+        fingerprint=fingerprint, shape=shape)
+    return deltas, info, np.asarray(chi2), np.asarray(converged), counters
+
+
+# ----------------------------------------------------------------------
+# dense (single-device, bucketed) convenience entry points
+# ----------------------------------------------------------------------
+
+def dense_wls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
+                  max_step_halvings=8):
+    """Fused dense WLS fit: bucketed table, one program, one fetch.
+
+    The no-mesh flavor of :func:`pint_tpu.parallel.sharded_fit
+    .sharded_fit`; returns ``(deltas, info, chi2, converged, counters)``.
+    """
+    from pint_tpu import bucketing
+    from pint_tpu.fitting.step import jitted_wls_probe, jitted_wls_step
+
+    toas_b = bucketing.bucket_toas(toas)
+    step = jitted_wls_step(model, counted=False)
+    probe = jitted_wls_probe(model)
+    telemetry.set_gauge("fit.ntoas", len(toas))
+    return run_damped(
+        lambda d, ops: step(ops[0], d, *ops[1:]),
+        model.zero_deltas(), (model.base_dd(), toas_b),
+        probe=lambda d, ops: probe(ops[0], d, *ops[1:]),
+        key=("dense_wls", id(step), id(probe)),
+        maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings, kind="device_loop_wls",
+        fingerprint=(hash(model._fn_fingerprint()),),
+        shape=bucketing.toa_shape(toas_b))
+
+
+def dense_gls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
+                  max_step_halvings=8):
+    """Fused dense GLS fit (device-side noise bases): one program/fetch."""
+    from pint_tpu import bucketing
+    from pint_tpu.fitting.gls_step import (build_noise_statics,
+                                           jitted_gls_probe,
+                                           jitted_gls_step,
+                                           pad_noise_statics)
+
+    noise, pl_specs = build_noise_statics(model, toas)
+    n_target = bucketing.bucket_size(len(toas))
+    noise = pad_noise_statics(noise, n_target)
+    toas_b = bucketing.bucket_toas(toas)
+    step = jitted_gls_step(model, pl_specs=pl_specs, counted=False)
+    probe = jitted_gls_probe(model, pl_specs=pl_specs)
+    telemetry.set_gauge("fit.ntoas", len(toas))
+    return run_damped(
+        lambda d, ops: step(ops[0], d, *ops[1:]),
+        model.zero_deltas(), (model.base_dd(), toas_b, noise),
+        probe=lambda d, ops: probe(ops[0], d, *ops[1:]),
+        key=("dense_gls", id(step), id(probe)),
+        maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings, kind="device_loop_gls",
+        fingerprint=(hash(model._fn_fingerprint()), pl_specs),
+        shape=bucketing.toa_shape(toas_b))

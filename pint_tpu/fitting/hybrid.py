@@ -27,6 +27,8 @@ no split because longdouble numpy only ever runs on the host CPU.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -190,27 +192,22 @@ def make_resid_stage1(model, tzr=None):
     """CPU residual-only stage 1 for damped-loop probe steps.
 
     The DD phase pipeline without the jacfwd tangents — whitened
-    residuals ``r * sqrt(w)`` only. A halved/rejected trial point in
-    the damped outer loop needs just the noise-marginal chi2 at its
-    input (``downhill_iterate``'s ``chi2_at``), for which the design
-    matrix is never consulted; this program costs one phase evaluation
-    instead of 1 + n_params tangent passes. Cached per model structure
-    alongside :func:`make_whiten_stage1` (key ``("resid_stage1",)``).
+    residuals ``r * sqrt(w)`` only (residual convention shared with
+    every probe path via :func:`pint_tpu.fitting.step.make_resid_fn`).
+    A halved/rejected trial point in the damped outer loop needs just
+    the noise-marginal chi2 at its input (``downhill_iterate``'s
+    ``chi2_at``), for which the design matrix is never consulted; this
+    program costs one phase evaluation instead of 1 + n_params tangent
+    passes. Cached per model structure alongside
+    :func:`make_whiten_stage1` (key ``("resid_stage1",)``).
     """
-    if tzr is None:
-        tzr = model.get_tzr_toas()
-    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=tzr is not None)
-    has_phoff = model.has_component("PhaseOffset")
+    from pint_tpu.fitting.step import make_resid_fn
+
+    resid = make_resid_fn(model, tzr)
 
     def stage1r(base, deltas, toas):
-        f0 = base["F0"].hi + base["F0"].lo
-        ph = phase_fn(base, deltas, toas)
-        resid = ph.frac.hi + ph.frac.lo
-        err = model.scaled_toa_uncertainty(toas)
-        w = 1.0 / jnp.square(err)
-        if not has_phoff:
-            resid = resid - jnp.sum(resid * w) / jnp.sum(w)
-        return (resid / f0) * jnp.sqrt(w)
+        r, _err, w = resid(base, deltas, toas)
+        return r * jnp.sqrt(w)
 
     return stage1r
 
@@ -397,23 +394,41 @@ class HybridGLSFitter(Fitter):
         # single model structure -> one program key
         return run_stage2_with_fallback(self, "stage2", run)
 
-    def _iterate(self, base, deltas) -> tuple[dict, dict]:
+    def _stage1_packed(self, base, deltas, *, instrument: bool = False):
+        """Run stage 1; ``instrument`` wraps it in its telemetry span
+        with an honest completion sync (the plain driver's accounting).
+        The pipelined driver leaves instrumentation off so the dispatch
+        stays non-blocking (overlap is the point there)."""
         from pint_tpu import bucketing, telemetry
 
         bucketing.note_program("hybrid_step", self._prog_fp,
                                (self._n_toas,))
+        if not instrument:
+            return self._stage1(base, deltas)
         with telemetry.jit_span("hybrid.stage1_cpu"):
             packed = self._stage1(base, deltas)
             if telemetry.enabled():
                 # close the span at stage-1 completion (dispatch is
                 # async); disabled, keep the uninstrumented overlap
                 jax.block_until_ready(packed)
-        with telemetry.jit_span("hybrid.stage2_accel"):
-            out = self._run_stage2(jax.device_put(packed, self.accel))
-            # one device->host fetch; un-normalize on the full-range
-            # host (covariance entries reach ~1e-42 — below f32-range
-            # f64); the fetch also closes the span honestly
-            out = np.asarray(out)
+        return packed
+
+    def _iterate_dispatch(self, base, deltas):
+        """Start one full hybrid step WITHOUT blocking on its result.
+
+        Stage 1 (CPU) and stage 2 (accelerator) are both asynchronous
+        dispatches; the returned handle is the un-fetched stage-2 output
+        buffer. While it executes on the chip, the pipelined damped
+        driver runs the NEXT halved candidate's CPU probe under it
+        (fitting.damped.downhill_iterate_pipelined).
+        """
+        packed = self._stage1_packed(base, deltas)
+        return (self._run_stage2(jax.device_put(packed, self.accel)),
+                deltas)
+
+    def _iterate_finish(self, out, deltas) -> tuple[dict, dict]:
+        """Fetch + unpack a dispatched step (the one device->host sync)."""
+        out = np.asarray(out)
         q, ne, p = self._q, self._ne, self._n_params
         o = 0
         xB = out[:q]; o = q
@@ -430,6 +445,25 @@ class HybridGLSFitter(Fitter):
         new_deltas = {k: deltas[k] + sol["x"][i + self._off]
                       for i, k in enumerate(self._names)}
         return new_deltas, sol
+
+    def _iterate(self, base, deltas) -> tuple[dict, dict]:
+        from pint_tpu import telemetry
+
+        packed = self._stage1_packed(base, deltas, instrument=True)
+        # the span wraps DISPATCH + fetch: the first call's synchronous
+        # jit compile must land inside it, or the rollup's stage-2
+        # compile wall would be a fetch-sized lie (PR-1 honesty rule)
+        with telemetry.jit_span("hybrid.stage2_accel"):
+            out = self._run_stage2(jax.device_put(packed, self.accel))
+            # one device->host fetch; un-normalize on the full-range
+            # host (covariance entries reach ~1e-42 — below f32-range
+            # f64); the fetch also closes the span honestly
+            return self._iterate_finish(out, deltas)
+
+    def _iterate_fetch(self, handle) -> tuple[dict, dict]:
+        """Blocking half of :meth:`_iterate_dispatch`."""
+        out, deltas = handle
+        return self._iterate_finish(out, deltas)
 
     def _build_chi2_probe(self):
         """Constants + program for the O(n·k) noise-marginal chi2 probe.
@@ -531,12 +565,12 @@ class HybridGLSFitter(Fitter):
 
         return consts, jax.jit(chi2_fn)
 
-    def _chi2_at(self, base, deltas) -> float:
-        """Noise-marginal chi2 at ``deltas`` without a design matrix.
+    def _chi2_at_dispatch(self, base, deltas):
+        """Start the noise-marginal chi2 probe WITHOUT blocking.
 
         One residual-only CPU phase pass (no jacfwd tangents) + the
-        O(n·k) on-device probe — the damped loop's cheap trial-point
-        judge (``downhill_iterate(chi2_at=...)``).
+        O(n·k) CPU probe program; both dispatches are asynchronous, so
+        the pipelined driver can run this under an in-flight stage-2.
         """
         with jax.default_device(self.cpu):
             stage1r = self.model._cached_jit(
@@ -547,23 +581,61 @@ class HybridGLSFitter(Fitter):
             self._chi2_probe = self._build_chi2_probe()
         consts, prog = self._chi2_probe
         with jax.default_device(self.cpu):
-            out = prog(rw, self._probe_epoch_idx_cpu, *consts)
-        return float(np.asarray(out))
+            return prog(rw, self._probe_epoch_idx_cpu, *consts)
+
+    def _chi2_at(self, base, deltas) -> float:
+        """Noise-marginal chi2 at ``deltas`` without a design matrix
+        (the damped loop's cheap trial-point judge,
+        ``downhill_iterate(chi2_at=...)``)."""
+        return float(np.asarray(self._chi2_at_dispatch(base, deltas)))
+
+    def _pipeline_enabled(self) -> bool:
+        """Speculative probe pipelining gate.
+
+        Auto-on only when stage 2 runs on a REAL accelerator: the
+        speculation spends host CPU inside the chip's execution window,
+        which is free there but pure overhead on an all-CPU host (both
+        stages contend for the same cores). ``PINT_TPU_HYBRID_PIPELINE``
+        forces it on (1 — how the CPU-only parity tests exercise the
+        path) or off (0).
+        """
+        env = os.environ.get("PINT_TPU_HYBRID_PIPELINE", "")
+        if env == "0":
+            return False
+        if env == "1":
+            return True
+        return self.accel is not None and self.accel.platform != "cpu"
 
     def fit_toas(self, maxiter: int = 20,
                  min_chi2_decrease: float = 1e-3, **kw) -> float:
         from pint_tpu import telemetry
-        from pint_tpu.fitting.damped import downhill_iterate
+        from pint_tpu.fitting.damped import (downhill_iterate,
+                                             downhill_iterate_pipelined)
 
         telemetry.set_gauge("fit.ntoas", self._n_orig)
         base = jax.device_put(self.model.base_dd(), self.cpu)
         deltas0 = {k: jnp.zeros((), jnp.float64) for k in self._names}
         with telemetry.span("fit.hybrid_gls", ntoas=self._n_orig,
-                            accel=str(self.accel)):
-            deltas, sol, chi2, converged = downhill_iterate(
-                lambda d: self._iterate(base, d), deltas0, maxiter=maxiter,
-                min_chi2_decrease=min_chi2_decrease,
-                chi2_at=lambda d: self._chi2_at(base, d))
+                            accel=str(self.accel),
+                            pipelined=self._pipeline_enabled()):
+            if self._pipeline_enabled():
+                # the hybrid split cannot fuse its CPU stage 1 into a
+                # device loop; it pipelines instead — stage 2 for the
+                # current trial executes on the chip while the CPU
+                # probe of the next halved candidate runs speculatively
+                deltas, sol, chi2, converged = downhill_iterate_pipelined(
+                    lambda d: self._iterate_dispatch(base, d),
+                    self._iterate_fetch,
+                    lambda d: self._chi2_at_dispatch(base, d),
+                    lambda h: float(np.asarray(h)),
+                    deltas0, maxiter=maxiter,
+                    min_chi2_decrease=min_chi2_decrease)
+            else:
+                deltas, sol, chi2, converged = downhill_iterate(
+                    lambda d: self._iterate(base, d), deltas0,
+                    maxiter=maxiter,
+                    min_chi2_decrease=min_chi2_decrease,
+                    chi2_at=lambda d: self._chi2_at(base, d))
         cov = np.asarray(sol["cov"])
         errors = np.sqrt(np.diagonal(cov))
         for i, k in enumerate(self._names):

@@ -110,7 +110,8 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
 
 
 def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
-                    params: list[str] | None = None, vmapped: bool = False):
+                    params: list[str] | None = None, vmapped: bool = False,
+                    counted: bool = True):
     """Jitted :func:`make_wls_step`, shared across fitter instances.
 
     ``jax.jit(make_wls_step(model))`` compiles a fresh program per
@@ -121,6 +122,11 @@ def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
     compiled step per (structure fingerprint, step config), with free
     values flowing through the traced ``base``. ``vmapped`` builds the
     batched (pulsar-axis) masked variant used by BatchedPulsarFitter.
+
+    ``counted=False`` skips the per-execution program-reuse counter
+    wrapper — for callers that trace the step INTO a larger program
+    (the fused device loop), where a host-side counter per call would
+    fire once at trace time and never again.
     """
     key = ("wls_step", abs_phase, masked,
            tuple(params) if params is not None else None, vmapped)
@@ -130,7 +136,65 @@ def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
                            params=params)
         return jax.vmap(fn, in_axes=(0, 0, 0, 0)) if vmapped else fn
 
-    return _counted_step(model._cached_jit(key, build), key, model)
+    cached = model._cached_jit(key, build)
+    if not counted:
+        return cached
+    return _counted_step(cached, key, model)
+
+
+def make_resid_fn(model, tzr=None, *, abs_phase: bool = True):
+    """Build ``resid(base, deltas, toas) -> (r, err, w)`` — the shared
+    residual-only evaluator: one phase pass (no jacfwd tangents),
+    wrapped fractional residual in seconds with the step functions'
+    exact weighted-mean convention, plus the scaled uncertainties and
+    weights. The ONE home of the residual-prep block for every probe
+    path (WLS/GLS device-loop probes, the hybrid CPU probe stage) so
+    the convention cannot drift from the full steps' ``chi2_at_input``.
+    """
+    if tzr is None and abs_phase:
+        tzr = model.get_tzr_toas()
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
+    has_phoff = model.has_component("PhaseOffset")
+
+    def resid(base, deltas, toas):
+        f0 = base["F0"].hi + base["F0"].lo
+        ph = phase_fn(base, deltas, toas)
+        res = ph.frac.hi + ph.frac.lo
+        err = model.scaled_toa_uncertainty(toas)
+        w = 1.0 / jnp.square(err)
+        if not has_phoff:
+            res = res - jnp.sum(res * w) / jnp.sum(w)
+        return res / f0, err, w
+
+    return resid
+
+
+def make_wls_probe(model, tzr=None, *, abs_phase: bool = True):
+    """Build ``probe(base, deltas, toas) -> chi2`` — residual-only WLS chi2.
+
+    The device-loop analogue of the hybrid fitter's cheap trial judge:
+    one phase evaluation, no jacfwd tangents and no solve, computing
+    exactly the ``chi2_at_input`` expression of :func:`make_wls_step`.
+    A halved trial in the fused loop costs this instead of a full step;
+    the accepted point is still re-judged by the full step's
+    authoritative value (see fitting.device_loop).
+    """
+    resid = make_resid_fn(model, tzr, abs_phase=abs_phase)
+
+    def probe(base, deltas, toas):
+        r, _err, w = resid(base, deltas, toas)
+        return jnp.sum(jnp.square(r) * w)
+
+    return probe
+
+
+def jitted_wls_probe(model, *, abs_phase: bool = True):
+    """Model-cache-shared :func:`make_wls_probe` (same rationale as
+    :func:`jitted_wls_step`; uncounted — it is traced into the fused
+    loop program, never dispatched on its own)."""
+    key = ("wls_probe", abs_phase)
+    return model._cached_jit(
+        key, lambda owner: make_wls_probe(owner, abs_phase=abs_phase))
 
 
 def _counted_step(fn, key, model):

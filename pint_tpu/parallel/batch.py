@@ -311,11 +311,44 @@ class BatchedPulsarFitter:
         all — the batch is a single program, so partial evaluation
         would not be cheaper). Returns per-pulsar chi2;
         ``self.converged`` is the per-pulsar (B,) truth array.
+
+        Default path (``fitting.device_loop``): the whole loop runs
+        inside ONE fused XLA program with a per-member lam carry —
+        members halve independently on-device and the host sees one
+        launch + one fetch per fit instead of a masking round trip per
+        trial. ``PINT_TPU_DEVICE_LOOP=0`` restores this host loop (the
+        reference oracle; parity pinned by tests/test_device_loop.py).
         """
         B = len(self.models)
         deltas = {k: jnp.zeros(B) for k in self.free_params}
         base = replicate(self.base, self.mesh)
         mask = replicate(self.param_mask, self.mesh)
+
+        from pint_tpu.fitting import device_loop
+
+        if device_loop.enabled():
+            from pint_tpu.bucketing import toa_shape
+            from pint_tpu.fitting.step import jitted_wls_step
+
+            step_raw = jitted_wls_step(
+                self.union, abs_phase=False, masked=True,
+                params=self.free_params, vmapped=True, counted=False)
+            with self.mesh:
+                d_fit, info, chi2, converged, _cnt = \
+                    device_loop.run_damped_batched(
+                        lambda d, ops: step_raw(ops[0], d, *ops[1:]),
+                        deltas, (base, self.toas, mask),
+                        key=("batched", id(step_raw)), maxiter=maxiter,
+                        min_chi2_decrease=min_chi2_decrease,
+                        max_step_halvings=max_step_halvings,
+                        kind="device_loop_batched",
+                        fingerprint=(hash(self.union._fn_fingerprint()),
+                                     tuple(self.free_params)),
+                        shape=toa_shape(self.toas))
+            info = dict(info, chi2=info["chi2_at_input"])
+            self.converged = np.asarray(converged)
+            self._write_back(d_fit, info)
+            return np.asarray(info["chi2"])
 
         def run(d):
             return self.step(base, d, self.toas, mask)
@@ -368,6 +401,11 @@ class BatchedPulsarFitter:
                 _, info = run(deltas)
             info = dict(info, chi2=info["chi2_at_input"])
         self.converged = converged
+        self._write_back(deltas, info)
+        return np.asarray(info["chi2"])
+
+    def _write_back(self, deltas, info) -> None:
+        """Apply fitted deltas + uncertainties to every (owner) model."""
         for i, m in enumerate(self.models):
             for k in self.free_params:
                 if float(np.asarray(self.param_mask[k][i])) == 0.0:
@@ -381,4 +419,3 @@ class BatchedPulsarFitter:
                     continue
                 p.add_delta(float(np.asarray(deltas[k][i])))
                 p.uncertainty = float(np.asarray(info["errors"][k][i]))
-        return np.asarray(info["chi2"])

@@ -29,6 +29,7 @@ this is capability the TPU design adds on top of parity.
 
 from __future__ import annotations
 
+from contextlib import nullcontext as _nullcontext
 from typing import NamedTuple
 
 import jax
@@ -723,13 +724,23 @@ class PTAGLSFitter:
         (``r^T C^-1 r`` with C the full per-pulsar + HD-correlated GW
         covariance), not the linearized prediction; ``self.converged``
         reports whether the loop stopped at a (numerical) optimum.
+
+        On the plain / mesh paths the whole damped loop runs as ONE
+        fused XLA program (:meth:`_fit_device_loop`; kill switch
+        ``PINT_TPU_DEVICE_LOOP=0``). The hybrid CPU->accelerator split
+        cannot fuse its CPU stage 1 into a device loop, so it keeps the
+        host driver (with speculative probe pipelining — see
+        fitting.hybrid).
         """
         from pint_tpu import telemetry
+        from pint_tpu.fitting import device_loop
         from pint_tpu.fitting.damped import downhill_iterate
 
         n_toas = sum(len(t) for t in self.toas_list)
         telemetry.set_gauge("pta.n_pulsars", len(self.models))
         telemetry.set_gauge("fit.ntoas", n_toas)
+        if device_loop.enabled() and self.accel_dev is None:
+            return self._fit_device_loop(maxiter)
         with telemetry.span("fit.pta_joint", n_pulsars=len(self.models),
                             ntoas=n_toas,
                             hybrid_accel=self.accel_dev is not None):
@@ -743,6 +754,184 @@ class PTAGLSFitter:
                 par = model[name]
                 par.add_delta(float(deltas[(i, name)]))
                 par.uncertainty = float(errors[(i, name)])
+        self.chi2 = chi2
+        return chi2
+
+    def _make_joint_step(self, prepared):
+        """Traceable fused joint step for the device loop.
+
+        ``full(deltas, operands) -> (new_deltas, info)`` over a tuple of
+        per-pulsar delta dicts — the jnp port of :meth:`step`'s numpy
+        assembly (same arrow elimination, same GW core with the
+        HD-coupled prior, same noise-only merit restriction), with the
+        per-pulsar gram programs traced INTO the loop body. ``info``
+        carries the error-state (Ys / Ainvs / norms / core factor / y)
+        so uncertainties come from the carried accepted evaluation in
+        the single fetch, with no extra joint evaluation.
+        """
+        k = 2 * self.gw.nharm
+        metas = []  # (gram, model, p, off, k_pl) static per pulsar
+        for entry in prepared:
+            _, gram, _toas, _noise, model, basis = entry
+            p = (len(model.free_params)
+                 + (0 if model.has_component("PhaseOffset") else 1))
+            k_pl = int(basis[0].shape[1]) - k
+            metas.append((gram, model, p, k_pl))
+
+        def _elim(A, Bm, ct):
+            # the host path's block elimination, inlined into the loop
+            # trace (jitted callees inline) — ONE jitter/factorization
+            # scheme for both drivers, so they cannot diverge
+            if A.shape[0] == 0:
+                return (jnp.zeros((0, Bm.shape[1])), jnp.zeros(0),
+                        jnp.zeros((0, 0)))
+            return _eliminate_block(A, Bm, ct)
+
+        def _core(Ks, gs, gw_norms, hd_inv, phi_gw):
+            P = len(Ks)
+            Kd = jax.scipy.linalg.block_diag(*Ks)
+            gn = jnp.stack(gw_norms)
+            coup = (hd_inv[:, :, None]
+                    / (phi_gw[None, None, :]
+                       * gn[:, None, :] * gn[None, :, :]))
+            K4 = Kd.reshape(P, k, P, k)
+            jj = jnp.arange(k)
+            K4 = K4.at[:, jj, :, jj].add(coup.transpose(2, 0, 1))
+            K = K4.reshape(P * k, P * k)
+            K = K + jnp.eye(P * k) * (jnp.finfo(jnp.float64).eps
+                                      * jnp.trace(K))
+            cf = jax.scipy.linalg.cho_factor(K, lower=True)
+            return jax.scipy.linalg.cho_solve(cf, jnp.concatenate(gs)), cf
+
+        def full(deltas, ops):
+            bases, toas_t, noise_t, basis_t, hd_inv, phi_gw = ops
+            chi2_base = jnp.zeros(())
+            norms, gw_norms = [], []
+            As, Bs, Ds, cts, cgs = [], [], [], [], []
+            nAs, nBs, nDs, ncts, ncgs = [], [], [], [], []
+            for i, (gram, _model, p, k_pl) in enumerate(metas):
+                g = gram(bases[i], deltas[i], toas_t[i], noise_t[i],
+                         *basis_t[i])
+                S, rhs = g["S"], g["rhs"]
+                chi2_base = chi2_base + g["chi2_base"]
+                norm = g["norm"]
+                norms.append(norm)
+                gw_norms.append(norm[-k:])
+                m = S.shape[0] - k
+                As.append(S[:m, :m])
+                Bs.append(S[:m, m:])
+                Ds.append(S[m:, m:])
+                cts.append(rhs[:m])
+                cgs.append(rhs[m:])
+                Sn = S[p:, p:]
+                cn = rhs[p:]
+                nAs.append(Sn[:k_pl, :k_pl])
+                nBs.append(Sn[:k_pl, k_pl:])
+                nDs.append(Sn[k_pl:, k_pl:])
+                ncts.append(cn[:k_pl])
+                ncgs.append(cn[k_pl:])
+
+            # ---- full solve: proposed Gauss-Newton step ----
+            elim = [_elim(A, Bm, ct) for A, Bm, ct in zip(As, Bs, cts)]
+            Ys = [e[0] for e in elim]
+            zs = [e[1] for e in elim]
+            Ainvs = [e[2] for e in elim]
+            Ks = [D - Bm.T @ Y for D, Bm, Y in zip(Ds, Bs, Ys)]
+            gs = [cg - Bm.T @ z for cg, Bm, z in zip(cgs, Bs, zs)]
+            y, cf = _core(Ks, gs, gw_norms, hd_inv, phi_gw)
+
+            # ---- noise-only marginalization: actual chi2 at input ----
+            nelim = [_elim(A, Bm, ct)
+                     for A, Bm, ct in zip(nAs, nBs, ncts)]
+            nKs = [D - Bm.T @ e[0] for D, Bm, e in zip(nDs, nBs, nelim)]
+            ngs = [cg - Bm.T @ e[1] for cg, Bm, e in zip(ncgs, nBs, nelim)]
+            ny, _ncf = _core(nKs, ngs, gw_norms, hd_inv, phi_gw)
+            chi2_in = (chi2_base - jnp.concatenate(ngs) @ ny
+                       - sum((ct @ e[1] for ct, e in zip(ncts, nelim)),
+                             jnp.zeros(())))
+
+            new_deltas = []
+            for i, (_gram, model, p, _k_pl) in enumerate(metas):
+                off = 0 if model.has_component("PhaseOffset") else 1
+                y_i = y[i * k:(i + 1) * k]
+                x_t = zs[i] - Ys[i] @ y_i
+                xs = x_t[:p] / norms[i][:p]
+                new_deltas.append({
+                    name: deltas[i][name] + xs[j + off]
+                    for j, name in enumerate(model.free_params)})
+            info = {"chi2_at_input": chi2_in, "y": y, "core_cf": cf[0],
+                    "Ys": tuple(Ys), "Ainvs": tuple(Ainvs),
+                    "norms": tuple(norms)}
+            return tuple(new_deltas), info
+
+        return full, metas
+
+    def _fit_device_loop(self, maxiter: int) -> float:
+        """Joint damped fit as ONE fused XLA program (plain/mesh paths).
+
+        Per-pulsar grams, the two arrow eliminations, both GW-core
+        Choleskys, and the accept/halve/converge driver all live inside
+        a single ``lax.while_loop`` program — one launch and one fetch
+        per joint fit (the host driver dispatched 2 P-gram rounds plus
+        a device->host sync per trial). Uncertainties and GW
+        coefficients come from the carried error-state of the accepted
+        evaluation.
+        """
+        from pint_tpu import telemetry
+        from pint_tpu.fitting import device_loop
+
+        prepared = self._prepare()
+        assert all(e[0] == "plain" for e in prepared)
+        full, metas = self._make_joint_step(prepared)
+        k = 2 * self.gw.nharm
+        P = len(metas)
+        operands = (tuple(m.base_dd() for _g, m, _p, _k in metas),
+                    tuple(e[2] for e in prepared),
+                    tuple(e[3] for e in prepared),
+                    tuple(e[5] for e in prepared),
+                    jnp.asarray(self.hd_inv), jnp.asarray(self._phi_gw))
+        deltas0 = tuple(
+            {name: jnp.zeros((), jnp.float64) for name in m.free_params}
+            for _g, m, _p, _k in metas)
+        if self.mesh is not None:
+            from pint_tpu.parallel.mesh import replicate
+
+            operands = (replicate(operands[0], self.mesh),) + operands[1:]
+            deltas0 = replicate(deltas0, self.mesh)
+        key = ("pta_loop", tuple(id(m[0]) for m in metas),
+               self.mesh is not None)
+        n_toas = sum(len(t) for t in self.toas_list)
+        with telemetry.span("fit.pta_joint", n_pulsars=P, ntoas=n_toas,
+                            device_loop=True):
+            ctx = self.mesh if self.mesh is not None else _nullcontext()
+            with ctx:
+                deltas, info, chi2, converged, _cnt = \
+                    device_loop.run_damped(
+                        full, deltas0, operands, key=key, maxiter=maxiter,
+                        kind="device_loop_pta",
+                        fingerprint=key[1] + (self.gw,),
+                        shape=tuple(len(t) for t in self.toas_list))
+        self.converged = converged
+        # errors from the carried state of the accepted evaluation —
+        # exactly the host errors_fn algebra, on the fetched arrays
+        Lam = np.asarray(jax.scipy.linalg.cho_solve(
+            (jnp.asarray(info["core_cf"]), True), jnp.eye(P * k)))
+        y = np.asarray(info["y"])
+        gw_norms = [np.asarray(info["norms"][i])[-k:] for i in range(P)]
+        self.gw_coeffs = np.stack([
+            y[a * k:(a + 1) * k] / gw_norms[a] for a in range(P)])
+        for i, (_gram, model, p, _k_pl) in enumerate(metas):
+            off = 0 if model.has_component("PhaseOffset") else 1
+            Ys_i = np.asarray(info["Ys"][i])
+            Lam_ii = Lam[i * k:(i + 1) * k, i * k:(i + 1) * k]
+            YL = Ys_i[:p] @ Lam_ii
+            sig2 = (np.diag(np.asarray(info["Ainvs"][i]))[:p]
+                    + np.einsum("ij,ij->i", YL, Ys_i[:p]))
+            sig = np.sqrt(sig2) / np.asarray(info["norms"][i])[:p]
+            for j, name in enumerate(model.free_params):
+                par = model[name]
+                par.add_delta(float(np.asarray(deltas[i][name])))
+                par.uncertainty = float(sig[j + off])
         self.chi2 = chi2
         return chi2
 

@@ -18,12 +18,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pint_tpu import telemetry
 # pad_toas/PAD_ERROR_US moved to pint_tpu.bucketing (the shared shape
 # policy home); re-exported here for the existing import sites
-from pint_tpu.bucketing import PAD_ERROR_US, bucket_size, pad_toas  # noqa: F401
+from pint_tpu.bucketing import (PAD_ERROR_US, bucket_size,  # noqa: F401
+                                pad_toas, toa_shape)
+from pint_tpu.fitting import device_loop
 from pint_tpu.fitting.damped import downhill_iterate
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
-                                       jitted_gls_step, pad_noise_statics)
-from pint_tpu.fitting.step import jitted_wls_step
+                                       jitted_gls_probe, jitted_gls_step,
+                                       pad_noise_statics)
+from pint_tpu.fitting.step import jitted_wls_probe, jitted_wls_step
 from pint_tpu.parallel.mesh import make_mesh, replicate, shard_toas
 
 
@@ -46,9 +49,25 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
     padded = pad_toas(toas, bucket_size(len(toas), multiple=n_shards))
     toas_sh = shard_toas(padded, mesh)
     del padded  # drop the unsharded copy before the fit's peak
-    step = jitted_wls_step(model)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
+    if device_loop.enabled():
+        # the whole accept/halve/converge loop fused on-device: one
+        # program launch, one host fetch (fitting.device_loop)
+        step = jitted_wls_step(model, counted=False)
+        probe = jitted_wls_probe(model)
+        with mesh, telemetry.span("fit.sharded_wls", ntoas=len(toas)):
+            out = device_loop.run_damped(
+                lambda d, ops: step(ops[0], d, *ops[1:]), deltas0,
+                (base, toas_sh),
+                probe=lambda d, ops: probe(ops[0], d, *ops[1:]),
+                key=("sharded_wls", id(step), id(probe)),
+                maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+                kind="device_loop_wls",
+                fingerprint=(hash(model._fn_fingerprint()),),
+                shape=toa_shape(toas_sh))
+        return out[:4]
+    step = jitted_wls_step(model)
     with mesh, telemetry.span("fit.sharded_wls", ntoas=len(toas)):
         return downhill_iterate(
             lambda d: step(base, d, toas_sh), deltas0, maxiter=maxiter,
@@ -116,9 +135,25 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
         ecorr_phi=jax.device_put(noise.ecorr_phi, rep),
         pl_params=jax.device_put(noise.pl_params, rep),
     )
-    step = jitted_gls_step(model, pl_specs=pl_specs)
     base = replicate(model.base_dd(), mesh)
     deltas0 = replicate(model.zero_deltas(), mesh)
+    if device_loop.enabled():
+        # fused damped loop: one program launch + one fetch per fit,
+        # with the existing psum reductions inside the while body
+        step = jitted_gls_step(model, pl_specs=pl_specs, counted=False)
+        probe = jitted_gls_probe(model, pl_specs=pl_specs)
+        with mesh, telemetry.span("fit.sharded_gls", ntoas=len(toas)):
+            out = device_loop.run_damped(
+                lambda d, ops: step(ops[0], d, *ops[1:]), deltas0,
+                (base, toas_sh, noise_sh),
+                probe=lambda d, ops: probe(ops[0], d, *ops[1:]),
+                key=("sharded_gls", id(step), id(probe)),
+                maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+                kind="device_loop_gls",
+                fingerprint=(hash(model._fn_fingerprint()), pl_specs),
+                shape=toa_shape(toas_sh))
+        return out[:4]
+    step = jitted_gls_step(model, pl_specs=pl_specs)
     with mesh, telemetry.span("fit.sharded_gls", ntoas=len(toas)):
         return downhill_iterate(
             lambda d: step(base, d, toas_sh, noise_sh), deltas0,

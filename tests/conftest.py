@@ -27,17 +27,44 @@ if not _want_tpu:
 import pint_tpu  # noqa: E402,F401  (applies JAX_PLATFORMS, enables x64)
 import jax  # noqa: E402
 
-# NO persistent XLA compilation cache on the CPU backend: this jaxlib's
-# XLA:CPU AOT deserialization is broken on this host (reloading a cached
-# executable logs "machine feature mismatch ... could lead to execution
-# errors such as SIGILL" for +prefer-no-scatter/+prefer-no-gather, then
-# segfaults — reproduced with two identical pipeline jits in one
-# process, round 3). Opt back in explicitly with PINT_TPU_JAX_CACHE=1 on
-# hosts where the reload is sound.
-if os.environ.get("PINT_TPU_JAX_CACHE") == "1":
+# Persistent XLA compilation cache: ON by default for the suite
+# (round-7 measurement, docs/COMPILE_CACHE.md: cold 10:05, warm 6:35 vs
+# ~14:40 uncached on this host — the warm suite finally meets the 8:00
+# target). History: round 3 found this jaxlib's XLA:CPU AOT reload
+# unsafe here ("machine feature mismatch ... SIGILL", then a segfault
+# with two identical pipeline jits in one process), so the cache was
+# closed for three rounds; the round-7 re-measurement ran the full
+# suite cold AND fully-warm (every executable deserialized) green, so
+# the default flips. Opt OUT with PINT_TPU_JAX_CACHE=0 on hosts where
+# the reload misbehaves (the symptom is an XLA "machine feature
+# mismatch" log line followed by SIGILL/segfault); PINT_TPU_JAX_CACHE_DIR
+# overrides the location (default: <repo>/.jax_cache, gitignored).
+if os.environ.get("PINT_TPU_JAX_CACHE", "1") != "0":
+    def _host_cache_tag() -> str:
+        """Per-host cache subdir: the round-3 SIGILL mode was an
+        executable deserialized on a machine whose CPU features differ
+        from the writer's (e.g. one checkout on shared storage used
+        from two hosts). Keying the default dir by CPU model+flags
+        makes that cross-host reload impossible by construction."""
+        import hashlib
+        import platform
+
+        ident = platform.machine()
+        try:
+            with open("/proc/cpuinfo") as fh:
+                for line in fh:
+                    if line.startswith(("model name", "flags")):
+                        ident += line
+                        if line.startswith("flags"):
+                            break
+        except OSError:
+            pass
+        return hashlib.md5(ident.encode()).hexdigest()[:12]
+
     jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(__file__), "..",
-                                   ".jax_cache"))
+                      os.environ.get("PINT_TPU_JAX_CACHE_DIR")
+                      or os.path.join(os.path.dirname(__file__), "..",
+                                      ".jax_cache", _host_cache_tag()))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # under PINT_TPU_RUN_TPU_TESTS=1 the accelerator platform owns the

@@ -154,13 +154,29 @@ def test_pintbary(capsys):
 
 
 def test_console_scripts_registered():
-    import tomllib
-
-    with open("pyproject.toml", "rb") as f:
-        proj = tomllib.load(f)
-    scripts = proj["project"]["scripts"]
+    # tomllib is 3.11+; this suite must run on 3.10 (the pre-existing
+    # ModuleNotFoundError carried since seed). The scripts table is
+    # flat "name = module:func" lines, so a line scan is exact enough.
+    try:
+        import tomllib
+    except ModuleNotFoundError:
+        tomllib = None
+    if tomllib is not None:
+        with open("pyproject.toml", "rb") as f:
+            scripts = tomllib.load(f)["project"]["scripts"]
+    else:
+        scripts, in_table = {}, False
+        with open("pyproject.toml") as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("["):
+                    in_table = line == "[project.scripts]"
+                elif in_table and "=" in line:
+                    k, v = line.split("=", 1)
+                    scripts[k.strip()] = v.strip().strip('"')
     for name in ("pintempo", "zima", "tcb2tdb", "compare_parfiles", "pintbary"):
         assert name in scripts
+        assert scripts[name].startswith("pint_tpu.")
 
 
 def test_logging_setup_and_dedup(capsys):

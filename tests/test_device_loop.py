@@ -1,0 +1,463 @@
+"""Loop-semantics parity for the fused on-device damped loop (ISSUE 3).
+
+The tentpole claim: a complete damped Gauss-Newton fit executes as ONE
+XLA program launch with at most two host fetches, while reproducing the
+host driver (`fitting.damped.downhill_iterate`) EXACTLY — same accepted-
+step sequence (pinned through the iteration/accept/halving/probe
+counters), same final chi2 to f64 round-off, same converged flag —
+across the WLS / GLS / sharded / batched / PTA structures.
+
+The PAR strings match tests/test_sharded_gls.py / test_bucketing.py so
+compiled programs are shared across files (bucketing makes the shapes
+coincide; that sharing is itself part of the dispatch-count story).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pint_tpu import bucketing, telemetry
+from pint_tpu.fitting import device_loop
+from pint_tpu.fitting.damped import downhill_iterate
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas import Flags
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+NOISE = """
+EFAC -f fake 1.2
+EQUAD -f fake 0.5
+ECORR -f fake 1.1
+TNREDAMP -13.5
+TNREDGAM 3.5
+TNREDC 10
+"""
+
+FIT_COUNTERS = ("fit.iterations", "fit.accepts", "fit.halvings",
+                "fit.probe_evals", "fit.probe_rejects")
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+
+
+def _counted(fn):
+    before = telemetry.counters_snapshot()
+    out = fn()
+    delta = telemetry.counters_delta(before)
+    return out, {k: delta.get(k, 0) for k in FIT_COUNTERS}, delta
+
+
+def _problem(n, seed, noise=False, halving_pert=False):
+    par = PAR + (NOISE if noise else "")
+    model = get_model(par)
+    toas = make_fake_toas_uniform(53000, 56000, n, model, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=seed)
+    if noise:
+        toas = dataclasses.replace(
+            toas, flags=Flags(dict(d, f="fake") for d in toas.flags))
+    model["F0"].add_delta(3e-10 if halving_pert else 2e-10)
+    if halving_pert:
+        # joint F0/F1 offset: the Gauss-Newton step overshoots along the
+        # spin ridge, forcing step halvings (the acceptance criterion
+        # wants a fit with maxiter >= 5 and >= 1 halving)
+        model["F1"].add_delta(2e-18)
+    return toas, model
+
+
+# ----------------------------------------------------------------------
+# synthetic steps: the loop state machine vs the host driver, exactly
+# ----------------------------------------------------------------------
+
+def _quad_full(scale):
+    def full(deltas, ops):
+        x = deltas["x"]
+        return ({"x": x + scale * (3.0 - x)},
+                {"chi2_at_input": (x - 3.0) ** 2, "x_at": x})
+
+    return full
+
+
+def _quad_probe(deltas, ops):
+    return (deltas["x"] - 3.0) ** 2
+
+
+def _lying_probe(deltas, ops):
+    # optimistically scaled: accepts trials the authoritative full value
+    # rejects -> exercises the probe_rejects / keep-halving rule
+    return 0.25 * (deltas["x"] - 3.0) ** 2
+
+
+@pytest.mark.parametrize("scale,probe", [
+    (1.0, None), (3.2, None), (3.2, _quad_probe), (1e-3, None),
+    (4.6, _lying_probe),
+])
+def test_synthetic_parity_exact(scale, probe):
+    """Device machine == host driver: trajectory, chi2, converged, and
+    every fit.* counter, including halvings, probe evals and the
+    authoritative-recheck rejections (lying probe)."""
+    full = _quad_full(scale)
+    for maxiter, mdec, mh in ((10, 1e-3, 8), (50, 1e-10, 8),
+                              (3, 1e-30, 8), (5, 1e-10, 2)):
+        (hd, hi, hc, hconv), hcnt, _ = _counted(lambda: downhill_iterate(
+            lambda d: full(d, ()), {"x": 0.0}, maxiter=maxiter,
+            min_chi2_decrease=mdec, max_step_halvings=mh,
+            chi2_at=(lambda d: probe(d, ())) if probe else None))
+        (dd, di, dc, dconv, dcnt), dtel, _ = _counted(
+            lambda: device_loop.run_damped(
+                full, {"x": jnp.float64(0.0)}, (),
+                key=("synth", scale, probe is None, id(probe)),
+                probe=probe, maxiter=maxiter, min_chi2_decrease=mdec,
+                max_step_halvings=mh, kind="synth_loop"))
+        assert abs(float(dd["x"]) - hd["x"]) < 1e-12
+        assert abs(dc - hc) < 1e-14
+        assert dconv == hconv
+        assert hcnt == dtel, (hcnt, dtel)
+        assert float(di["x_at"]) == pytest.approx(hi["x_at"], abs=1e-12)
+        if probe is _lying_probe:
+            assert dtel["fit.probe_rejects"] > 0
+
+
+def test_synthetic_batched_parity():
+    """Per-member lam carry == the host batched loop (verbatim
+    transcription of BatchedPulsarFitter's pre-fusion driver), across
+    exact-Newton / overshooting / tiny-step / wild members."""
+    scales = np.array([1.0, 3.2, 1e-3, 4.6])
+    target = np.array([3.0, -2.0, 5.0, 1.0])
+    B = len(scales)
+
+    def run(deltas, ops):
+        x = deltas["x"]
+        return ({"x": x + scales * (target - x)},
+                {"chi2_at_input": (x - target) ** 2, "x_at": x})
+
+    def host_loop(maxiter, min_dec, max_halvings):
+        deltas = {"x": np.zeros(B)}
+        new_deltas, info = run(deltas, ())
+        chi2 = np.asarray(info["chi2_at_input"]).copy()
+        converged = np.zeros(B, dtype=bool)
+        trial_info = None
+        for _ in range(max(1, maxiter)):
+            dx = {k: np.asarray(new_deltas[k]) - deltas[k] for k in deltas}
+            lam = np.ones(B)
+            active = ~converged
+            accepted = np.zeros(B, dtype=bool)
+            for _h in range(max_halvings):
+                lam_j = np.where(active & ~accepted, lam, 0.0)
+                trial = {k: deltas[k] + lam_j * dx[k] for k in deltas}
+                trial_new, trial_info = run(trial, ())
+                trial_chi2 = np.asarray(trial_info["chi2_at_input"])
+                newly = active & ~accepted & (trial_chi2 <= chi2 + 1e-12)
+                deltas = {k: np.where(newly, trial[k], deltas[k])
+                          for k in deltas}
+                new_deltas = {k: np.where(newly, trial_new[k],
+                                          new_deltas[k]) for k in deltas}
+                decrease = chi2 - trial_chi2
+                chi2 = np.where(newly, trial_chi2, chi2)
+                converged |= newly & (decrease < min_dec)
+                accepted |= newly
+                if (accepted | ~active).all():
+                    break
+                lam = np.where(active & ~accepted, lam * 0.5, lam)
+            converged |= active & ~accepted
+            last_kept = bool((accepted | ~active).all())
+            if converged.all():
+                break
+        info = trial_info if last_kept else run(deltas, ())[1]
+        return deltas, info, chi2, converged
+
+    for maxiter, mdec, mh in ((10, 1e-3, 8), (2, 1e-30, 8),
+                              (8, 1e-10, 2), (12, 1e-6, 3)):
+        hd, hi, hc, hconv = host_loop(maxiter, mdec, mh)
+        dd, di, dc, dconv, _ = device_loop.run_damped_batched(
+            run, {"x": jnp.zeros(B)}, (), key=("bsynth",),
+            maxiter=maxiter, min_chi2_decrease=mdec,
+            max_step_halvings=mh, kind="bsynth_loop")
+        np.testing.assert_allclose(np.asarray(dd["x"]), hd["x"],
+                                   atol=1e-12)
+        np.testing.assert_allclose(dc, hc, atol=1e-14)
+        assert (np.asarray(dconv) == hconv).all()
+        np.testing.assert_allclose(np.asarray(di["x_at"]),
+                                   np.asarray(hi["x_at"]), atol=1e-12)
+
+
+# ----------------------------------------------------------------------
+# real fits: dense GLS oracle vs fused loop (and sharded against both)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gls_fits():
+    """One perturbed GLS problem fit three ways: host driver (oracle,
+    probe-assisted), fused dense loop, fused sharded loop."""
+    from pint_tpu.fitting.gls_step import (build_noise_statics,
+                                           jitted_gls_probe,
+                                           jitted_gls_step,
+                                           pad_noise_statics)
+
+    out = {}
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+
+    # host oracle: downhill_iterate over the SAME step+probe programs
+    toas, model = _problem(150, seed=11, noise=True, halving_pert=True)
+    noise, pl_specs = build_noise_statics(model, toas)
+    noise = pad_noise_statics(noise, bucketing.bucket_size(len(toas)))
+    toas_b = bucketing.bucket_toas(toas)
+    step = jitted_gls_step(model, pl_specs=pl_specs, counted=False)
+    probe = jitted_gls_probe(model, pl_specs=pl_specs)
+    base = model.base_dd()
+    (hd, hi, hc, hconv), hcnt, _ = _counted(lambda: downhill_iterate(
+        lambda d: step(base, d, toas_b, noise), model.zero_deltas(),
+        maxiter=6, min_chi2_decrease=1e-8,
+        chi2_at=lambda d: probe(base, d, toas_b, noise)))
+    out["host"] = (hd, hi, hc, hconv, hcnt)
+
+    # fused dense loop on an identical problem
+    toas2, model2 = _problem(150, seed=11, noise=True, halving_pert=True)
+    (dd, di, dc, dconv, dcnt), dtel, ddelta = _counted(
+        lambda: device_loop.dense_gls_fit(toas2, model2, maxiter=6,
+                                          min_chi2_decrease=1e-8))
+    out["device"] = (dd, di, dc, dconv, dtel, ddelta)
+
+    # fused sharded loop, same problem over the 8-device mesh
+    import jax
+
+    if len(jax.devices()) >= 8:
+        from pint_tpu.parallel import ShardedGLSFitter, make_mesh
+
+        toas3, model3 = _problem(150, seed=11, noise=True,
+                                 halving_pert=True)
+        f = ShardedGLSFitter(toas3, model3, mesh=make_mesh(8, psr_axis=1))
+        (sc,), scnt, sdelta = _counted(
+            lambda: (f.fit_toas(maxiter=6, min_chi2_decrease=1e-8),))
+        out["sharded"] = (f, sc, scnt, sdelta, model3)
+    return out
+
+
+def test_dense_gls_parity_with_halvings(gls_fits):
+    """Acceptance: a damped GLS fit at maxiter >= 5 with >= 1 halving
+    (verified by counters) matches the host loop — accepted-step
+    sequence (counter-for-counter), chi2 at f64 round-off, converged."""
+    hd, hi, hc, hconv, hcnt = gls_fits["host"]
+    dd, di, dc, dconv, dtel, _ = gls_fits["device"]
+    assert hcnt["fit.halvings"] >= 1, "problem must force a halving"
+    assert hcnt == dtel, (hcnt, dtel)
+    assert dconv == hconv
+    assert dc == pytest.approx(hc, rel=1e-9)
+    for k in hd:
+        assert float(dd[k]) == pytest.approx(float(hd[k]), rel=1e-9,
+                                             abs=1e-24), k
+    np.testing.assert_allclose(np.asarray(di["fourier_coeffs"]),
+                               np.asarray(hi["fourier_coeffs"]),
+                               rtol=1e-6, atol=1e-12)
+
+
+def test_dense_gls_one_launch_one_fetch(gls_fits):
+    """The fused fit is ONE program launch with <= 2 host fetches."""
+    _, _, _, _, _, delta = gls_fits["device"]
+    assert delta.get("fit.device_loop.launches", 0) == 1
+    assert delta.get("fit.device_loop.fetches", 0) <= 2
+
+
+def test_sharded_gls_parity(gls_fits):
+    """Sharded fused loop == host oracle (sharding is a layout, not an
+    algorithm change): same counters, same chi2/params/converged."""
+    if "sharded" not in gls_fits:
+        pytest.skip("needs the 8-device virtual CPU platform")
+    hd, hi, hc, hconv, hcnt = gls_fits["host"]
+    f, sc, scnt, sdelta, model3 = gls_fits["sharded"]
+    assert scnt == hcnt, (scnt, hcnt)
+    assert f.converged == hconv
+    assert sc == pytest.approx(hc, rel=1e-9)
+    # one launch + one fetch for the whole sharded fit too
+    assert sdelta.get("fit.device_loop.launches", 0) == 1
+    assert sdelta.get("fit.device_loop.fetches", 0) <= 2
+    _, model_ref = _problem(150, seed=11, noise=True, halving_pert=True)
+    for k, d in hd.items():
+        want = model_ref[k].value_f64 + float(d)
+        assert model3[k].value_f64 == pytest.approx(want, rel=1e-12,
+                                                    abs=1e-24), k
+
+
+def test_dense_wls_parity():
+    """dense_wls_fit (the WLS probe + full-step pair) == host driver
+    over the SAME step/probe programs: counters, chi2, parameters."""
+    from pint_tpu.fitting.step import jitted_wls_probe, jitted_wls_step
+
+    toas, model = _problem(60, seed=13, halving_pert=True)
+    toas_b = bucketing.bucket_toas(toas)
+    step = jitted_wls_step(model, counted=False)
+    probe = jitted_wls_probe(model)
+    base = model.base_dd()
+    (hd, _hi, hc, hconv), hcnt, _ = _counted(lambda: downhill_iterate(
+        lambda d: step(base, d, toas_b), model.zero_deltas(), maxiter=5,
+        min_chi2_decrease=1e-8,
+        chi2_at=lambda d: probe(base, d, toas_b)))
+
+    toas2, model2 = _problem(60, seed=13, halving_pert=True)
+    (dd, _di, dc, dconv, _), dtel, delta = _counted(
+        lambda: device_loop.dense_wls_fit(toas2, model2, maxiter=5,
+                                          min_chi2_decrease=1e-8))
+    assert hcnt == dtel, (hcnt, dtel)
+    assert dconv == hconv
+    assert dc == pytest.approx(hc, rel=1e-9)
+    for k in hd:
+        assert float(dd[k]) == pytest.approx(float(hd[k]), rel=1e-9,
+                                             abs=1e-24), k
+    assert delta.get("fit.device_loop.launches", 0) == 1
+    assert delta.get("fit.device_loop.fetches", 0) <= 2
+
+
+def test_device_loop_compiles_once_across_sizes():
+    """Second same-structure fit at a different TOA count: zero
+    fit-program misses (the loop program is bucket-shared), one launch,
+    one fetch — the dispatch-count acceptance via bucketing counters."""
+    toas, model = _problem(150, seed=21, noise=True)
+    device_loop.dense_gls_fit(toas, model, maxiter=3)
+
+    before = telemetry.counters_snapshot()
+    toas2, model2 = _problem(161, seed=22, noise=True)
+    _, _, chi2, _, _ = device_loop.dense_gls_fit(toas2, model2, maxiter=3)
+    delta = telemetry.counters_delta(before)
+    assert np.isfinite(chi2)
+    assert delta.get("cache.fit_program.miss", 0) == 0
+    assert delta.get("cache.fit_program.hit", 0) >= 1
+    assert delta.get("fit.device_loop.launches", 0) == 1
+    assert delta.get("fit.device_loop.fetches", 0) == 1
+
+
+def test_batched_device_loop_parity(monkeypatch):
+    """BatchedPulsarFitter: fused per-member lam carry == host masking
+    loop (chi2 vector, converged flags, written-back parameters)."""
+    from pint_tpu.parallel import BatchedPulsarFitter
+
+    def problems():
+        out = []
+        for i in range(2):
+            par = PAR.replace("61.485476554",
+                              f"{61.485476554 + 0.3 * i:.9f}")
+            truth = get_model(par)
+            toas = make_fake_toas_uniform(
+                53000, 56000, 60, truth, obs="gbt",
+                freq_mhz=np.array([1400.0, 430.0]), error_us=1.0,
+                add_noise=True, seed=31 + i)
+            pert = get_model(par)
+            pert["F0"].add_delta(2e-10 * (1 + i))
+            out.append((toas, pert))
+        return out
+
+    res = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("PINT_TPU_DEVICE_LOOP", mode)
+        bf = BatchedPulsarFitter(problems())
+        (chi2,), _, delta = _counted(lambda: (bf.fit_toas(maxiter=8),))
+        res[mode] = (chi2, bf.converged.copy(),
+                     [{k: m[k].value_f64 for k in m.free_params}
+                      for m in bf.models], delta)
+    c0, conv0, v0, del0 = res["0"]
+    c1, conv1, v1, del1 = res["1"]
+    np.testing.assert_allclose(c1, c0, rtol=1e-9)
+    assert (conv0 == conv1).all()
+    for a, b in zip(v0, v1):
+        for k in a:
+            assert b[k] == pytest.approx(a[k], rel=1e-10, abs=1e-24), k
+    # the kill switch really selects the path
+    assert del0.get("fit.device_loop.launches", 0) == 0
+    assert del1.get("fit.device_loop.launches", 0) == 1
+    assert del1.get("fit.device_loop.fetches", 0) <= 2
+
+
+def test_pta_device_loop_parity(monkeypatch):
+    """PTA joint fit: the fused program (grams + arrow elimination + GW
+    core inside the while body) == the host numpy driver — chi2,
+    converged, parameters AND uncertainties (carried error-state)."""
+    from pint_tpu.parallel.pta import PTAGLSFitter
+
+    def problems():
+        out = []
+        for i in range(2):
+            par = PAR.replace("17:48:52.75",
+                              f"{(i * 7) % 24:02d}:48:52.75") + NOISE
+            par = par.replace("TNREDC 10", "TNREDC 3")
+            truth = get_model(par)
+            toas = make_fake_toas_uniform(
+                53000, 56000, 40, truth, obs="gbt",
+                freq_mhz=np.array([1400.0, 430.0]), error_us=1.0,
+                add_noise=True, seed=41 + i)
+            toas = dataclasses.replace(
+                toas, flags=Flags(dict(d, f="fake") for d in toas.flags))
+            pert = get_model(par)
+            pert["F0"].add_delta(2e-10)
+            out.append((toas, pert))
+        return out
+
+    res = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("PINT_TPU_DEVICE_LOOP", mode)
+        f = PTAGLSFitter(problems(), gw_log10_amp=-13.9, gw_gamma=4.33,
+                         gw_nharm=2)
+        (chi2,), _, delta = _counted(lambda: (f.fit_toas(maxiter=4),))
+        res[mode] = (chi2, f.converged, f.gw_coeffs.copy(),
+                     [{k: (m[k].value_f64, m[k].uncertainty)
+                       for k in m.free_params} for m in f.models], delta)
+    c0, conv0, gw0, v0, del0 = res["0"]
+    c1, conv1, gw1, v1, del1 = res["1"]
+    assert c1 == pytest.approx(c0, rel=1e-9)
+    assert conv0 == conv1
+    np.testing.assert_allclose(gw1, gw0, rtol=1e-6, atol=1e-12)
+    for a, b in zip(v0, v1):
+        for k in a:
+            assert b[k][0] == pytest.approx(a[k][0], rel=1e-10,
+                                            abs=1e-24), k
+            assert b[k][1] == pytest.approx(a[k][1], rel=1e-6), k
+    assert del0.get("fit.device_loop.launches", 0) == 0
+    assert del1.get("fit.device_loop.launches", 0) == 1
+    assert del1.get("fit.device_loop.fetches", 0) <= 2
+
+
+def test_hybrid_pipeline_parity(monkeypatch):
+    """The speculative pipelined hybrid driver judges EXACTLY what the
+    plain probe driver judges: same chi2/params and identical counts of
+    every judged event, with speculation visible in its own counters."""
+    from pint_tpu.fitting.hybrid import HybridGLSFitter
+
+    res = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("PINT_TPU_HYBRID_PIPELINE", mode)
+        toas, model = _problem(50, seed=6, noise=True, halving_pert=True)
+        (chi2,), cnt, delta = _counted(
+            lambda: (HybridGLSFitter(toas, model).fit_toas(
+                maxiter=6, min_chi2_decrease=1e-8),))
+        res[mode] = (chi2, {k: model[k].value_f64
+                            for k in model.free_params}, cnt, delta)
+    c0, v0, cnt0, _ = res["0"]
+    c1, v1, cnt1, del1 = res["1"]
+    assert c1 == pytest.approx(c0, rel=1e-12)
+    for k in v0:
+        assert v1[k] == pytest.approx(v0[k], rel=1e-12, abs=1e-24), k
+    assert cnt0 == cnt1, (cnt0, cnt1)
+    assert del1.get("fit.probe_speculated", 0) >= 1

@@ -105,10 +105,10 @@ raise SystemExit(0 if str(d.get('backend', 'cpu')) not in ('cpu', 'None')
             # stdout is the compact headline; the full roofline/telemetry
             # record is the committed BENCH_DETAIL artifact (bench.py
             # _finish) — capture both
-            cp /tmp/bench_tpu.json BENCH_TPU_r06.json
-            git add BENCH_TPU_r06.json BENCH_DETAIL_r06.json
+            cp /tmp/bench_tpu.json BENCH_TPU_r07.json
+            git add BENCH_TPU_r07.json BENCH_DETAIL_r07.json
             git commit -m "On-TPU bench artifact captured live" \
-                -- BENCH_TPU_r06.json BENCH_DETAIL_r06.json >> "$LOG" 2>&1
+                -- BENCH_TPU_r07.json BENCH_DETAIL_r07.json >> "$LOG" 2>&1
             touch /tmp/tpu_retry.DONE
             exit 0
         fi
