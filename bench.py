@@ -423,25 +423,59 @@ def _bench_fit_loop(toas, noise, pl_specs, compiled_step,
 
     # warm both (host step is already the compiled headline program;
     # the device loop pays its one XLA compile here)
+    from pint_tpu.telemetry import recorder as _recorder
+
     t0 = time.perf_counter()
     *_ignored, d_counters = device_fit()
     loop_compile_s = time.perf_counter() - t0
+    d_trace = _recorder.last_trace()
     _, _, h_chi2, _ = host_fit()
     host_syncs = sync_count["n"]
 
-    # alternated reps, best-of-k both sides, ALL walls recorded: at
-    # local-CPU dispatch cost the two loops are near-tied (the device
+    # flight-recorder on/off A/B setup (ISSUE 4 acceptance: the trace
+    # ring riding the carry must cost within 5% of the ring-free loop).
+    # The recorder state is read per launch, so flipping the env var
+    # selects a differently-keyed (ring-free) compiled program; its one
+    # compile is paid here, before any timed rep.
+    rec_prev = os.environ.get("PINT_TPU_FLIGHT_RECORDER")
+    rec_was_on = _recorder.active()
+
+    def _set_rec(val):
+        if val is None:
+            os.environ.pop("PINT_TPU_FLIGHT_RECORDER", None)
+        else:
+            os.environ["PINT_TPU_FLIGHT_RECORDER"] = val
+
+    _set_rec("0")
+    try:
+        device_fit()  # compile + warm the ring-free loop
+    finally:
+        _set_rec(rec_prev)
+
+    # alternated reps, best-of-k all sides, ALL walls recorded: at
+    # local-CPU dispatch cost the loops are near-tied (the device
     # loop's eliminated syncs are ~µs here; the tunnel-scale win is the
     # 4->1 sync count), so the committed record must expose the rep
-    # noise rather than a single coin-flip pair
-    h_times, d_times = [], []
+    # noise rather than a single coin-flip pair. The recorder-on /
+    # recorder-off device fits alternate INSIDE the same rep so the
+    # overhead number measures the ring, not machine drift between two
+    # measurement phases.
+    h_times, d_times, d_off_times = [], [], []
     for _ in range(reps):
         t0 = time.perf_counter()
         _, _, d_chi2, _, d_counters = device_fit()
         d_times.append(time.perf_counter() - t0)
+        _set_rec("0")
+        try:
+            t0 = time.perf_counter()
+            device_fit()
+            d_off_times.append(time.perf_counter() - t0)
+        finally:
+            _set_rec(rec_prev)
         t0 = time.perf_counter()
         _, _, h_chi2, _ = host_fit()
         h_times.append(time.perf_counter() - t0)
+    d_on, d_off = float(np.min(d_times)), float(np.min(d_off_times))
 
     fetches = telemetry.counter_value("fit.device_loop.fetches", 0)
     # self-validating A/B: a committed wall comparison with diverging
@@ -467,6 +501,11 @@ def _bench_fit_loop(toas, noise, pl_specs, compiled_step,
         "chi2_host": round(float(h_chi2), 6),
         "chi2_device": round(float(d_chi2), 6),
         "device_counters": d_counters,
+        "recorder_was_on": rec_was_on,
+        "device_wall_recorder_off": round(d_off, 6),
+        "device_walls_recorder_off": [round(t, 4) for t in d_off_times],
+        "recorder_overhead_pct": round(100.0 * (d_on / d_off - 1.0), 2),
+        "trace": d_trace,
     }
 
 
@@ -762,7 +801,9 @@ _COMPACT_KEYS = (
 # the fit-loop A/B rides the compact line with only its headline fields
 # (full counters/chi2 cross-checks live in BENCH_DETAIL)
 _FIT_LOOP_COMPACT = ("host_wall", "device_wall", "host_syncs_host_loop",
-                     "host_syncs_device_loop", "parity_ok", "error")
+                     "host_syncs_device_loop", "parity_ok",
+                     "device_wall_recorder_off", "recorder_overhead_pct",
+                     "error")
 
 
 def _compact(record: dict, detail_name: str) -> dict:
@@ -813,7 +854,7 @@ def _finish(record: dict) -> None:
     detail_path = os.environ.get(
         "PINT_TPU_BENCH_DETAIL",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_DETAIL_r07.json"))
+                     "BENCH_DETAIL_r08.json"))
     try:
         with open(detail_path, "w") as fh:
             json.dump(record, fh, indent=1)
@@ -1083,11 +1124,17 @@ def _main_guarded() -> None:
         compile_s = time.perf_counter() - t0
 
         times = []
-        # optional XLA trace for the timed region (SURVEY §5 tracing row):
-        # view with tensorboard/xprof. One rep under the profiler.
-        profile_dir = os.environ.get("PINT_TPU_BENCH_PROFILE", "")
-        if profile_dir:
-            with jax.profiler.trace(profile_dir):
+        # optional XLA trace for the timed region (SURVEY §5 tracing
+        # row): one rep under telemetry.profile_span, gated on
+        # PINT_TPU_PROFILE_DIR (the legacy PINT_TPU_BENCH_PROFILE
+        # spelling is honored as an alias). View with tensorboard/xprof.
+        from pint_tpu.telemetry import core as _tele_core
+
+        legacy_dir = os.environ.get("PINT_TPU_BENCH_PROFILE", "")
+        if legacy_dir and not os.environ.get("PINT_TPU_PROFILE_DIR"):
+            os.environ["PINT_TPU_PROFILE_DIR"] = legacy_dir
+        if _tele_core.profile_dir():
+            with telemetry.profile_span("bench.profiled_rep"):
                 out = step(base, deltas, toas, noise)
                 jax.block_until_ready(out)
         for _ in range(reps):

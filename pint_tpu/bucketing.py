@@ -200,12 +200,20 @@ def pad_solve_rows(n_target: int, r, sigma, *mats):
 _SEEN_PROGRAMS: set = set()
 
 
-def note_program(kind: str, fingerprint, shape) -> None:
+def note_program(kind: str, fingerprint, shape, *, compiled=None) -> None:
     """Record one execution of fit program ``kind`` at ``shape``.
 
     ``fingerprint`` is anything hashable identifying the traced
     structure (callers pass ``hash(model._fn_fingerprint())``; None for
     model-free programs like the dense solvers).
+
+    ``compiled`` (optional) is the freshly AOT-compiled executable when
+    this execution paid an XLA compile: its ``cost_analysis()`` /
+    ``memory_analysis()`` are captured into ``program.<kind>.*`` gauges
+    and a ``type="program"`` telemetry record
+    (:func:`pint_tpu.telemetry.recorder.capture_program`) — per-program
+    flops/bytes accounting riding the same event as the
+    ``cache.fit_program.miss`` counter.
     """
     if not _tele_core._enabled:
         return
@@ -213,6 +221,10 @@ def note_program(kind: str, fingerprint, shape) -> None:
     hit = key in _SEEN_PROGRAMS
     _SEEN_PROGRAMS.add(key)
     _tele_counters.inc(f"cache.fit_program.{'hit' if hit else 'miss'}")
+    if compiled is not None:
+        from pint_tpu.telemetry import recorder
+
+        recorder.capture_program(kind, compiled, shape=shape)
 
 
 def toa_shape(toas) -> tuple:

@@ -29,6 +29,7 @@ re-evaluated once with the full step to obtain the next proposal.
 from __future__ import annotations
 
 from pint_tpu import telemetry
+from pint_tpu.telemetry import recorder
 
 
 def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
@@ -50,10 +51,19 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
     counters (iterations / accepts / halvings / probe_evals /
     probe_rejects / converged / maxiter_exhausted) that make damping
     behavior auditable from the rollup.
+
+    Flight recorder (``telemetry.recorder``): the driver records one
+    trace entry per FULL evaluation — the same entry semantics as the
+    fused device loop's on-device ring, so the oracle and the fused
+    program emit identical traces for the same fit (pinned by
+    tests/test_device_loop.py).
     """
+    rec = recorder.host_trace()
     with telemetry.jit_span("fit.step"):
         new_deltas, info = iterate(deltas0)
     chi2 = float(info["chi2_at_input"])
+    if rec:
+        rec.eval(chi2, 1.0)
     deltas = deltas0
     converged = False
     for _ in range(max(1, maxiter)):
@@ -64,16 +74,22 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
         for _h in range(max_step_halvings):
             if _h > 0:
                 telemetry.inc("fit.halvings")
+                if rec:
+                    rec.halving()
             trial = {k: deltas[k] + lam * dx[k] for k in deltas}
             if _h == 0 or chi2_at is None:
                 with telemetry.jit_span("fit.step"):
                     trial_new, trial_info = iterate(trial)
                 trial_chi2 = float(trial_info["chi2_at_input"])
+                if rec:
+                    rec.eval(trial_chi2, lam)
             else:
                 telemetry.inc("fit.probe_evals")
                 trial_new = trial_info = None
                 with telemetry.jit_span("fit.probe"):
                     trial_chi2 = float(chi2_at(trial))
+                if rec:
+                    rec.probe_eval()
             if trial_chi2 <= chi2 + 1e-12:
                 if trial_info is None:
                     # accepted via the cheap probe: one full evaluation
@@ -87,12 +103,16 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
                     with telemetry.jit_span("fit.step"):
                         trial_new, trial_info = iterate(trial)
                     trial_chi2 = float(trial_info["chi2_at_input"])
+                    if rec:
+                        rec.eval(trial_chi2, lam)
                     if trial_chi2 > chi2 + 1e-12:
                         telemetry.inc("fit.probe_rejects")
                         lam *= 0.5
                         continue
                 applied = True
                 telemetry.inc("fit.accepts")
+                if rec:
+                    rec.accept()
                 break
             lam *= 0.5
         if not applied:
@@ -106,6 +126,8 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
             converged = True
             break
     telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    if rec:
+        rec.emit()
     return deltas, info, chi2, converged
 
 
@@ -140,9 +162,12 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
     blocks; same for ``probe_dispatch``/``probe_fetch`` (probe value is
     the scalar chi2 at the input).
     """
+    rec = recorder.host_trace()
     with telemetry.jit_span("fit.step"):
         new_deltas, info = step_fetch(step_dispatch(deltas0))
     chi2 = float(info["chi2_at_input"])
+    if rec:
+        rec.eval(chi2, 1.0)
     deltas = deltas0
     converged = False
     for _ in range(max(1, maxiter)):
@@ -162,6 +187,8 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
         for _h in range(max_step_halvings):
             if _h > 0:
                 telemetry.inc("fit.halvings")
+                if rec:
+                    rec.halving()
             trial = {k: deltas[k] + lam * dx[k] for k in deltas}
             if _h == 0:
                 handle = step_dispatch(trial)
@@ -169,6 +196,8 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
                 with telemetry.jit_span("fit.step"):
                     trial_new, trial_info = step_fetch(handle)
                 trial_chi2 = float(trial_info["chi2_at_input"])
+                if rec:
+                    rec.eval(trial_chi2, lam)
             else:
                 telemetry.inc("fit.probe_evals")
                 trial_new = trial_info = None
@@ -181,6 +210,8 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
                         trial_chi2 = float(probe_fetch(
                             probe_dispatch(trial)))
                 spec = None
+                if rec:
+                    rec.probe_eval()
             if trial_chi2 <= chi2 + 1e-12:
                 if trial_info is None:
                     # probe-accepted: authoritative full re-check, with
@@ -190,12 +221,16 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
                     with telemetry.jit_span("fit.step"):
                         trial_new, trial_info = step_fetch(handle)
                     trial_chi2 = float(trial_info["chi2_at_input"])
+                    if rec:
+                        rec.eval(trial_chi2, lam)
                     if trial_chi2 > chi2 + 1e-12:
                         telemetry.inc("fit.probe_rejects")
                         lam *= 0.5
                         continue
                 applied = True
                 telemetry.inc("fit.accepts")
+                if rec:
+                    rec.accept()
                 break
             lam *= 0.5
         if spec is not None:
@@ -211,4 +246,6 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
             converged = True
             break
     telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    if rec:
+        rec.emit()
     return deltas, info, chi2, converged

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import telemetry
+from pint_tpu.telemetry import recorder
 from pint_tpu.utils.cache import LRUCache
 
 # accept tolerance of the host driver (damped.downhill_iterate)
@@ -80,7 +81,7 @@ _COUNTERS = ("iterations", "accepts", "halvings", "probe_evals",
              "probe_rejects")
 
 
-def build_damped_loop(full, probe=None):
+def build_damped_loop(full, probe=None, record=False):
     """Build ``loop(deltas0, operands, maxiter, min_dec, max_halvings)``.
 
     ``full(deltas, operands) -> (new_deltas, info)`` is the fused step
@@ -89,7 +90,9 @@ def build_damped_loop(full, probe=None):
     halved trials. Both are traced INTO the loop program (cached jitted
     steps inline under the outer jit). Returns a plain function suitable
     for ``jax.jit``; the loop result is ``(deltas, info, chi2,
-    converged, counters)``.
+    converged, counters, trace)`` — ``trace`` is the flight-recorder
+    ring (``telemetry.recorder``; one entry per body = per full-step
+    evaluation, returned in the same fetch) when ``record``, else None.
 
     Structure: a TWO-LEVEL while — full steps in the outer body, the
     probe in an inner while over halved candidates — with no
@@ -106,6 +109,7 @@ def build_damped_loop(full, probe=None):
     it) or halvings are exhausted (converged at the numerical optimum).
     """
     has_probe = probe is not None
+    trace_cap = recorder.trace_len() if record else 0
 
     def loop(deltas0, operands, maxiter, min_dec, max_halvings):
         maxiter = jnp.maximum(jnp.asarray(maxiter, jnp.int32), 1)
@@ -131,6 +135,17 @@ def build_damped_loop(full, probe=None):
             "converged": jnp.bool_(False),
             **{k: jnp.zeros((), jnp.int32) for k in _COUNTERS},
         }
+        if record:
+            # flight-recorder ring: one entry per body (= per full-step
+            # evaluation), written in place, fetched with the result
+            c0["trace"] = {
+                "chi2": jnp.zeros(trace_cap, jnp.float64),
+                "lam": jnp.zeros(trace_cap, jnp.float64),
+                "accepted": jnp.zeros(trace_cap, bool),
+                "halvings": jnp.zeros(trace_cap, jnp.int32),
+                "probe_evals": jnp.zeros(trace_cap, jnp.int32),
+            }
+            c0["tn"] = jnp.zeros((), jnp.int32)
 
         def body(c):
             # this body's full evaluation: the init point (dx == 0), a
@@ -214,7 +229,7 @@ def build_damped_loop(full, probe=None):
             done_n = conv_now | exhausted | rej_exh
             converged_n = conv_now | rej_exh
 
-            return {
+            out = {
                 "deltas": deltas_n,
                 "new_deltas": new_n,
                 "dx": dx_n,
@@ -235,36 +250,113 @@ def build_damped_loop(full, probe=None):
                 "probe_evals": c["probe_evals"] + pev_inc,
                 "probe_rejects": c["probe_rejects"] + prej_inc,
             }
+            if record:
+                # entry for THIS body's full evaluation; halvings /
+                # probe evals of the inner loop attach to its window
+                idx = jnp.mod(c["tn"], trace_cap)
+                tr = c["trace"]
+                out["trace"] = {
+                    "chi2": tr["chi2"].at[idx].set(t_chi2),
+                    "lam": tr["lam"].at[idx].set(c["lam"]),
+                    "accepted": tr["accepted"].at[idx].set(p_acc),
+                    "halvings": tr["halvings"].at[idx].set(halv_inc),
+                    "probe_evals": tr["probe_evals"].at[idx].set(pev_inc),
+                }
+                out["tn"] = c["tn"] + 1
+            return out
 
         out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
         counters = {k: out[k] for k in _COUNTERS}
+        trace = {"n": out["tn"], **out["trace"]} if record else None
         return (out["deltas"], out["info"], out["chi2"], out["converged"],
-                counters)
+                counters, trace)
 
     return loop
+
+
+def _args_sig(args):
+    """Hashable abstract signature of the loop-call arguments.
+
+    Tree structure + per-leaf (shape, dtype, sharding) — the same
+    specialization key ``jax.jit`` uses, computed up front so the AOT-
+    compiled executable can be reused explicitly (and its XLA cost /
+    memory analysis captured exactly once, at the compile).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = [treedef]
+    for leaf in leaves:
+        sig.append((np.shape(leaf), str(np.result_type(leaf)),
+                    getattr(leaf, "sharding", None)))
+    return tuple(sig)
+
+
+def _resolve_program(entry, deltas0, operands, hyper):
+    """(program, freshly_compiled, sig): the AOT executable for this
+    call signature, compiling (and caching) it on first sight.
+
+    AOT (``jit(...).lower(...).compile()``) instead of plain jit
+    dispatch so the compiled object is in hand for program accounting
+    (``recorder.capture_program``); the compile itself happens exactly
+    when jit would have compiled anyway. Any failure in the AOT path —
+    building OR hashing the signature, lowering, compiling — falls back
+    to the jitted callable (sig None when it cannot be cached):
+    accounting must never break a fit."""
+    try:
+        sig = _args_sig((deltas0, operands, hyper))
+        prog = entry["aot"].get(sig)  # hashes sig — inside the guard
+    except Exception:  # noqa: BLE001 — unhashable sharding etc.
+        return entry["jit"], None, None
+    if prog is not None:
+        return prog, None, sig
+    try:
+        prog = entry["jit"].lower(deltas0, operands, *hyper).compile()
+    except Exception:  # noqa: BLE001
+        prog = entry["jit"]
+    entry["aot"][sig] = prog
+    return prog, (prog if prog is not entry["jit"] else None), sig
 
 
 def _launch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
             shape):
     """Shared launch/fetch tail of the scalar and batched runners: one
     cached-program lookup, one launch, ONE device->host sync, counters
-    re-emitted to telemetry from the fetched carry."""
+    and the flight-recorder trace re-emitted to telemetry from the
+    fetched carry."""
     from pint_tpu.bucketing import note_program
 
-    prog = _LOOP_CACHE.get_lru(key)
-    if prog is None:
-        prog = _LOOP_CACHE.put_lru(key, jax.jit(builder()))
-    note_program(kind, fingerprint, tuple(shape))
+    # the recorder changes the carry (hence the compiled program), so
+    # it is part of the cache key; ditto the ring capacity
+    rec_on = recorder.active()
+    cache_key = (key, rec_on, recorder.trace_len() if rec_on else 0)
+    entry = _LOOP_CACHE.get_lru(cache_key)
+    if entry is None:
+        entry = _LOOP_CACHE.put_lru(
+            cache_key, {"jit": jax.jit(builder(rec_on)), "aot": {}})
+    prog, fresh, sig = _resolve_program(entry, deltas0, operands, hyper)
+    note_program(kind, fingerprint, tuple(shape), compiled=fresh)
     telemetry.inc("fit.device_loop.launches")
     with telemetry.jit_span(f"{kind}.program"):
-        out = prog(deltas0, operands, *hyper)
+        try:
+            out = prog(deltas0, operands, *hyper)
+        except Exception:
+            # an AOT executable is stricter than jit dispatch (exact
+            # avals); on any mismatch re-dispatch through jit — and
+            # unpoison the cache so later same-sig launches skip the
+            # known-bad executable
+            if prog is entry["jit"]:
+                raise
+            if sig is not None:
+                entry["aot"][sig] = entry["jit"]
+            out = entry["jit"](deltas0, operands, *hyper)
         # the ONE device->host sync of the whole fit
-        deltas, info, chi2, converged, counters = jax.device_get(out)
+        deltas, info, chi2, converged, counters, trace = jax.device_get(out)
     telemetry.inc("fit.device_loop.fetches")
     counters = {k: int(v) for k, v in counters.items()}
     for k, v in counters.items():
         if v:
             telemetry.inc(f"fit.{k}", v)
+    if trace is not None:
+        recorder.emit_device_trace(kind, trace)
     return deltas, info, chi2, converged, counters
 
 
@@ -282,7 +374,8 @@ def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
     kind is an XLA compile of the whole loop program).
     """
     deltas, info, chi2, converged, counters = _launch(
-        lambda: build_damped_loop(full, probe), key, deltas0, operands,
+        lambda rec: build_damped_loop(full, probe, record=rec), key,
+        deltas0, operands,
         (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
         fingerprint=fingerprint, shape=shape)
     converged = bool(converged)
@@ -303,7 +396,7 @@ def _bwhere(mask, a, b):
 _BATCH_COUNTERS = ("iterations", "accepts", "halvings", "step_evals")
 
 
-def build_batched_loop(run):
+def build_batched_loop(run, record=False):
     """Batched analogue of :func:`build_damped_loop`.
 
     ``run(deltas, operands) -> (new_deltas, info)`` is the vmapped step
@@ -315,7 +408,14 @@ def build_batched_loop(run):
     batch-wide trial per body, member-wise acceptance via a zeroed
     ``lam`` for already-settled members, and a final refresh evaluation
     only when the last trial left some member away from its kept point.
+
+    The flight recorder (``record=True``) traces the per-member
+    judgment: each body appends ``(chi2, lam, accepted)`` (B,)-vectors
+    — ``lam`` is the member-wise damping actually applied (0 for
+    settled members and the init/final passes) — so a non-converging
+    member of a batched fit is diagnosable from the single fetch.
     """
+    trace_cap = recorder.trace_len() if record else 0
 
     def loop(deltas0, operands, maxiter, min_dec, max_halvings):
         maxiter = jnp.maximum(jnp.asarray(maxiter, jnp.int32), 1)
@@ -342,6 +442,13 @@ def build_batched_loop(run):
             "done": jnp.bool_(False),
             **{k: jnp.zeros((), jnp.int32) for k in _BATCH_COUNTERS},
         }
+        if record:
+            c0["trace"] = {
+                "chi2": jnp.zeros((trace_cap, B), jnp.float64),
+                "lam": jnp.zeros((trace_cap, B), jnp.float64),
+                "accepted": jnp.zeros((trace_cap, B), bool),
+            }
+            c0["tn"] = jnp.zeros((), jnp.int32)
 
         def body(c):
             live = c["active"] & (~c["accepted"])
@@ -399,7 +506,7 @@ def build_batched_loop(run):
                                         & (~acc_n), c["lam"] * 0.5,
                                         c["lam"]))
 
-            return {
+            out = {
                 "deltas": deltas_n,
                 "new_deltas": new_n,
                 "dx": dx_n,
@@ -426,11 +533,22 @@ def build_batched_loop(run):
                 + (p_norm & (c["h"] > 0)).astype(jnp.int32),
                 "step_evals": c["step_evals"] + 1,
             }
+            if record:
+                idx = jnp.mod(c["tn"], trace_cap)
+                tr = c["trace"]
+                out["trace"] = {
+                    "chi2": tr["chi2"].at[idx].set(t_chi2),
+                    "lam": tr["lam"].at[idx].set(lam_j),
+                    "accepted": tr["accepted"].at[idx].set(newly),
+                }
+                out["tn"] = c["tn"] + 1
+            return out
 
         out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
         counters = {k: out[k] for k in _BATCH_COUNTERS}
+        trace = {"n": out["tn"], **out["trace"]} if record else None
         return (out["deltas"], out["info"], out["chi2"], out["converged"],
-                counters)
+                counters, trace)
 
     return loop
 
@@ -445,7 +563,8 @@ def run_damped_batched(run, deltas0, operands, *, key, maxiter=20,
     member (B,) chi2 and converged arrays, fetched to host numpy.
     """
     deltas, info, chi2, converged, counters = _launch(
-        lambda: build_batched_loop(run), key, deltas0, operands,
+        lambda rec: build_batched_loop(run, record=rec), key, deltas0,
+        operands,
         (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
         fingerprint=fingerprint, shape=shape)
     return deltas, info, np.asarray(chi2), np.asarray(converged), counters

@@ -239,12 +239,20 @@ class _DownhillMixin:
         # (None = the class attribute), so callers can tighten any
         # north-star fitter uniformly
         from pint_tpu import telemetry
+        from pint_tpu.telemetry import recorder
 
         if min_chi2_decrease is not None:
             self.min_chi2_decrease = min_chi2_decrease
         self.converged = False
         telemetry.set_gauge("fit.ntoas", len(self.toas))
+        # flight recorder: in this driver every trial IS a full chi2
+        # evaluation (no residual-only probe), so each trial appends an
+        # entry and halvings attach to the rejected predecessor — the
+        # no-probe flavor of the damped.py/device-loop trace contract
+        rec = recorder.host_trace()
         chi2 = self._chi2_now()
+        if rec:
+            rec.eval(chi2, 1.0)
         for _ in range(max(1, maxiter)):
             telemetry.inc("fit.iterations")
             snap = self._snapshot()
@@ -256,12 +264,18 @@ class _DownhillMixin:
             for _h in range(self.max_step_halvings):
                 if _h > 0:
                     telemetry.inc("fit.halvings")
+                    if rec:
+                        rec.halving()
                 self._restore(snap)
                 self.update_model(names, lam * x, errors)
                 new_chi2 = self._chi2_now()
+                if rec:
+                    rec.eval(new_chi2, lam)
                 if new_chi2 <= best_chi2 + 1e-12:
                     applied = True
                     telemetry.inc("fit.accepts")
+                    if rec:
+                        rec.accept()
                     break
                 lam *= 0.5
             if not applied:
@@ -279,6 +293,8 @@ class _DownhillMixin:
             chi2 = new_chi2
         telemetry.inc("fit.converged" if self.converged
                       else "fit.maxiter_exhausted")
+        if rec:
+            rec.emit("dense_downhill")
         return chi2
 
     def _step(self, **kw):

@@ -615,7 +615,7 @@ class HybridGLSFitter(Fitter):
         telemetry.set_gauge("fit.ntoas", self._n_orig)
         base = jax.device_put(self.model.base_dd(), self.cpu)
         deltas0 = {k: jnp.zeros((), jnp.float64) for k in self._names}
-        with telemetry.span("fit.hybrid_gls", ntoas=self._n_orig,
+        with telemetry.profile_span("fit.hybrid_gls", ntoas=self._n_orig,
                             accel=str(self.accel),
                             pipelined=self._pipeline_enabled()):
             if self._pipeline_enabled():

@@ -324,6 +324,7 @@ class BatchedPulsarFitter:
         base = replicate(self.base, self.mesh)
         mask = replicate(self.param_mask, self.mesh)
 
+        from pint_tpu import telemetry
         from pint_tpu.fitting import device_loop
 
         if device_loop.enabled():
@@ -333,7 +334,8 @@ class BatchedPulsarFitter:
             step_raw = jitted_wls_step(
                 self.union, abs_phase=False, masked=True,
                 params=self.free_params, vmapped=True, counted=False)
-            with self.mesh:
+            with self.mesh, telemetry.profile_span("fit.batched",
+                                                   n_pulsars=B):
                 d_fit, info, chi2, converged, _cnt = \
                     device_loop.run_damped_batched(
                         lambda d, ops: step_raw(ops[0], d, *ops[1:]),

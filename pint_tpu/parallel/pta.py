@@ -741,7 +741,7 @@ class PTAGLSFitter:
         telemetry.set_gauge("fit.ntoas", n_toas)
         if device_loop.enabled() and self.accel_dev is None:
             return self._fit_device_loop(maxiter)
-        with telemetry.span("fit.pta_joint", n_pulsars=len(self.models),
+        with telemetry.profile_span("fit.pta_joint", n_pulsars=len(self.models),
                             ntoas=n_toas,
                             hybrid_accel=self.accel_dev is not None):
             deltas, info, chi2, converged = downhill_iterate(
@@ -901,7 +901,7 @@ class PTAGLSFitter:
         key = ("pta_loop", tuple(id(m[0]) for m in metas),
                self.mesh is not None)
         n_toas = sum(len(t) for t in self.toas_list)
-        with telemetry.span("fit.pta_joint", n_pulsars=P, ntoas=n_toas,
+        with telemetry.profile_span("fit.pta_joint", n_pulsars=P, ntoas=n_toas,
                             device_loop=True):
             ctx = self.mesh if self.mesh is not None else _nullcontext()
             with ctx:

@@ -56,7 +56,7 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
         # program launch, one host fetch (fitting.device_loop)
         step = jitted_wls_step(model, counted=False)
         probe = jitted_wls_probe(model)
-        with mesh, telemetry.span("fit.sharded_wls", ntoas=len(toas)):
+        with mesh, telemetry.profile_span("fit.sharded_wls", ntoas=len(toas)):
             out = device_loop.run_damped(
                 lambda d, ops: step(ops[0], d, *ops[1:]), deltas0,
                 (base, toas_sh),
@@ -68,7 +68,7 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
                 shape=toa_shape(toas_sh))
         return out[:4]
     step = jitted_wls_step(model)
-    with mesh, telemetry.span("fit.sharded_wls", ntoas=len(toas)):
+    with mesh, telemetry.profile_span("fit.sharded_wls", ntoas=len(toas)):
         return downhill_iterate(
             lambda d: step(base, d, toas_sh), deltas0, maxiter=maxiter,
             min_chi2_decrease=min_chi2_decrease)
@@ -142,7 +142,7 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
         # with the existing psum reductions inside the while body
         step = jitted_gls_step(model, pl_specs=pl_specs, counted=False)
         probe = jitted_gls_probe(model, pl_specs=pl_specs)
-        with mesh, telemetry.span("fit.sharded_gls", ntoas=len(toas)):
+        with mesh, telemetry.profile_span("fit.sharded_gls", ntoas=len(toas)):
             out = device_loop.run_damped(
                 lambda d, ops: step(ops[0], d, *ops[1:]), deltas0,
                 (base, toas_sh, noise_sh),
@@ -154,7 +154,7 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
                 shape=toa_shape(toas_sh))
         return out[:4]
     step = jitted_gls_step(model, pl_specs=pl_specs)
-    with mesh, telemetry.span("fit.sharded_gls", ntoas=len(toas)):
+    with mesh, telemetry.profile_span("fit.sharded_gls", ntoas=len(toas)):
         return downhill_iterate(
             lambda d: step(base, d, toas_sh, noise_sh), deltas0,
             maxiter=maxiter, min_chi2_decrease=min_chi2_decrease)

@@ -15,8 +15,17 @@ names and the compile-vs-execute measurement contract):
 * :func:`flush` / :func:`rollup` / :func:`write_rollup` — the JSON-lines
   artifact and the end-of-run summary dict
   (:mod:`pint_tpu.telemetry.export`);
+* :mod:`pint_tpu.telemetry.recorder` — the flight recorder: per-iteration
+  traces of the fused damped fit (device trace ring + host-oracle
+  recorder + per-program XLA cost/memory accounting);
+* :func:`profile_span` — a span whose region is additionally captured by
+  the XLA profiler (env-gated on ``PINT_TPU_PROFILE_DIR``);
 * ``python -m pint_tpu.telemetry.probe`` — the bounded backend liveness
-  probe used by tools/tpu_retry.sh.
+  probe used by tools/tpu_retry.sh;
+* ``python -m pint_tpu.telemetry.report`` — the run-health report CLI
+  over one or more JSON-lines artifacts (span tree, iteration
+  timelines, cache hit rates, pollution windows, bench-regression
+  verdict).
 
 Disabled (the default unless ``PINT_TPU_TELEMETRY=1`` or an entry point
 calls :func:`configure`), every hook is a boolean check and return —
@@ -42,12 +51,12 @@ from pint_tpu.telemetry.export import (add_record, flush, rollup, span_stats,
                                        write_rollup)
 from pint_tpu.telemetry.host import polluted as host_polluted
 from pint_tpu.telemetry.host import sample as host_sample
-from pint_tpu.telemetry.spans import jit_span, span, traced
+from pint_tpu.telemetry.spans import jit_span, profile_span, span, traced
 
 __all__ = [
     "add_record", "configure", "counter_value", "counters_delta",
     "counters_snapshot", "enabled", "flush", "gauges_snapshot",
     "host_polluted", "host_sample", "inc", "jit_span", "jsonl_path",
-    "max_gauge", "reset", "rollup", "set_gauge", "span", "span_stats",
-    "traced", "write_rollup",
+    "max_gauge", "profile_span", "reset", "rollup", "set_gauge", "span",
+    "span_stats", "traced", "write_rollup",
 ]

@@ -62,6 +62,16 @@ def mirror_logs() -> bool:
     return _mirror_logs
 
 
+def profile_dir() -> str | None:
+    """XLA-profiler output dir (``PINT_TPU_PROFILE_DIR``; None = off).
+
+    Read per call (not cached at configure time): profiling is a
+    diagnostic mode flipped on for a single run, and the gate must work
+    for plain library use without any entry point calling configure.
+    """
+    return os.environ.get("PINT_TPU_PROFILE_DIR") or None
+
+
 def configure(*, enabled: bool | None = None, jsonl_path: str | None = None,
               load1_threshold: float | None = None,
               mirror_logs: bool | None = None) -> bool:
@@ -101,7 +111,7 @@ def reset() -> None:
     ``counters_delta`` snapshots instead, which don't disturb config.
     """
     global _enabled, _jsonl_path, _load1_threshold, _mirror_logs
-    from pint_tpu.telemetry import counters, export, spans
+    from pint_tpu.telemetry import counters, export, recorder, spans
 
     with _config_lock:
         _enabled = os.environ.get("PINT_TPU_TELEMETRY", "") == "1"
@@ -113,6 +123,7 @@ def reset() -> None:
     counters._reset()
     spans._reset()
     export._reset()
+    recorder._reset()
 
 
 # plain library use: PINT_TPU_TELEMETRY=1 turns everything on without

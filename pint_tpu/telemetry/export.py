@@ -28,10 +28,17 @@ import time
 
 from pint_tpu.telemetry import core, host
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 4): adds record types "trace" (flight-recorder iteration
+# timelines), "program" (per-program XLA cost/memory accounting) and
+# size-capped artifact rotation. v1 consumers remain compatible: every
+# v1 record type and field is unchanged — v2 only ADDS line types, and
+# readers that dispatch on "type" (the documented contract) skip
+# unknown ones.
+SCHEMA_VERSION = 2
 
 _MAX_BUFFER = 50_000
 _FLUSH_EVERY = 500
+DEFAULT_MAX_MB = 16.0
 
 _lock = threading.Lock()
 _buffer: list[dict] = []
@@ -100,11 +107,39 @@ def flush() -> None:
 atexit.register(flush)
 
 
+def _max_artifact_bytes() -> int:
+    """Rotation threshold (``PINT_TPU_TELEMETRY_MAX_MB``, default 16)."""
+    try:
+        mb = float(os.environ.get("PINT_TPU_TELEMETRY_MAX_MB",
+                                  str(DEFAULT_MAX_MB)))
+    except ValueError:
+        mb = DEFAULT_MAX_MB
+    return int(mb * 1e6)
+
+
+def _rotate_locked(path: str) -> None:
+    """Size-capped rotation: long-running sessions (and the committed
+    bench artifact) must not grow the jsonl unboundedly. One rotated
+    generation (``<path>.1``, overwritten) keeps the recent history
+    while bounding total disk at ~2x the cap; rotations are counted so
+    a rollup reveals that earlier records moved aside."""
+    from pint_tpu.telemetry import counters
+
+    try:
+        if os.path.getsize(path) <= _max_artifact_bytes():
+            return
+        os.replace(path, path + ".1")
+        counters.inc("telemetry.export.rotations")
+    except OSError:
+        pass  # missing file / unwritable dir: nothing to rotate
+
+
 def _flush_locked() -> None:
     global _dropped
     path = core.jsonl_path()
     if path is None or not _buffer:
         return
+    _rotate_locked(path)
     batch = [host.sample() | {"type": "host", "pid": os.getpid()}]
     batch.extend(_buffer)
     n_records = len(_buffer)

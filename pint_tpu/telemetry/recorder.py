@@ -1,0 +1,281 @@
+"""Flight recorder: per-iteration traces of the fused damped fit.
+
+PR 3 fused the whole accept/halve/converge loop into ONE XLA launch,
+which made the fastest fit path the least observable one: telemetry saw
+a fit as a single opaque span with no per-iteration chi2/lambda
+timeline. This module restores the timeline WITHOUT giving back the
+one-launch/one-fetch contract:
+
+* **Device side** (``fitting/device_loop.py``): a fixed-size trace ring
+  rides the ``lax.while_loop`` carry — one entry per loop body, i.e.
+  per full-step evaluation — and is returned with the loop output, so
+  it arrives in the SAME single ``device_get`` as the fit result. No
+  extra launches, no extra fetches; with the recorder off the carry
+  simply omits the ring (a different compiled program, hence part of
+  the loop-cache key).
+* **Host side** (``fitting/damped.py``): :class:`HostTrace` records the
+  host driver's evaluations at the same points, so the reference oracle
+  emits an IDENTICAL trace for the same fit — the parity tests compare
+  the two records entry by entry.
+* **Emission**: one ``type="trace"`` JSON-lines record per fit (the
+  whole timeline) plus, for device traces, per-iteration synthetic
+  spans named ``<kind>.iter`` with ``kind="device"`` — "synthetic"
+  because their wall time is unknown (the iterations executed inside
+  one opaque program); ``dur_s`` is 0 and only the sequence/judgment
+  fields are meaningful.
+
+**Trace entry semantics** (identical for both recorders): one entry per
+FULL step evaluation — the init pass, each first (lam=1) trial, and
+each authoritative re-check of a probe-accepted candidate. Fields:
+
+* ``chi2``        — the full step's chi2 at the evaluated trial point
+* ``lam``         — the damping factor of that trial
+* ``accepted``    — whether THIS evaluation was accepted (init: False)
+* ``halvings``    — step halvings following this evaluation before the
+  next full evaluation (the inner probe loop's count)
+* ``probe_evals`` — residual-only probe evaluations in that window
+
+The batched loop records the per-member vectors instead (every body is
+one batch-wide evaluation): ``chi2``/``lam``/``accepted`` of shape
+``(B,)`` per entry, where ``lam`` is the member-wise damping actually
+applied (0 for settled members and the init/final passes).
+
+Ring capacity is ``PINT_TPU_TRACE_LEN`` (default 64) entries; a fit
+that evaluates more wraps the ring and the emitted record reports the
+``dropped`` (oldest) count — never an error, never a reallocation.
+
+Kill switch: ``PINT_TPU_FLIGHT_RECORDER=0`` (default on). The recorder
+is additionally gated on telemetry being enabled: with telemetry off
+nothing is carried or recorded.
+
+This module also owns **per-program cost/memory accounting**
+(:func:`capture_program`): when a named program cache compiles a fresh
+XLA executable, the compiled object's ``cost_analysis()`` /
+``memory_analysis()`` are captured into ``program.<kind>.*`` gauges and
+a ``type="program"`` JSON-lines record — an honest per-stage roofline
+from the programs the run actually executed, replacing bench.py's
+ad-hoc probe as the only source of FLOP counts.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from pint_tpu.telemetry import core, counters, export
+
+DEFAULT_TRACE_LEN = 64
+
+# scalar-loop entry fields, in emission order
+FIELDS = ("chi2", "lam", "accepted", "halvings", "probe_evals")
+# batched-loop entry fields (per-member vectors)
+BATCH_FIELDS = ("chi2", "lam", "accepted")
+
+# the most recent emitted trace record (host or device), kept even when
+# no jsonl path is configured: tools/soak.py dumps it into per-trial
+# repro artifacts and the parity tests compare host vs device records
+_LAST_TRACE: dict | None = None
+
+
+def enabled() -> bool:
+    """Recorder gate (read per call so tests can flip the env var)."""
+    return os.environ.get("PINT_TPU_FLIGHT_RECORDER", "") != "0"
+
+
+def active() -> bool:
+    """True when a fit should carry/record a trace right now."""
+    return core._enabled and enabled()
+
+
+def trace_len() -> int:
+    """Ring capacity in entries (``PINT_TPU_TRACE_LEN``, default 64)."""
+    try:
+        n = int(os.environ.get("PINT_TPU_TRACE_LEN",
+                               str(DEFAULT_TRACE_LEN)))
+    except ValueError:
+        n = DEFAULT_TRACE_LEN
+    return max(4, n)
+
+
+def last_trace() -> dict | None:
+    """The most recent emitted trace record (None before any fit)."""
+    return _LAST_TRACE
+
+
+def _reset() -> None:
+    global _LAST_TRACE
+    _LAST_TRACE = None
+
+
+# ----------------------------------------------------------------------
+# emission (shared by the device ring and the host recorder)
+# ----------------------------------------------------------------------
+
+def emit_trace(kind: str, entries: dict, *, loop: str,
+               dropped: int = 0) -> dict:
+    """Build + emit one trace record; returns it (and stores last_trace).
+
+    ``entries`` maps field name -> list of per-evaluation values (lists
+    of per-member lists for the batched loop). Only the ``loop="device"``
+    flavor additionally emits per-iteration synthetic spans — the host
+    driver's evaluations already produced real ``fit.step`` spans.
+    """
+    global _LAST_TRACE
+    n = len(entries.get("chi2", ()))
+    rec = {"type": "trace", "loop": loop, "kind": kind,
+           "n": n + dropped, "recorded": n, "dropped": dropped}
+    rec.update(entries)
+    _LAST_TRACE = rec
+    if not core._enabled:
+        return rec
+    counters.inc("trace.emitted")
+    export.add_record(dict(rec))
+    if loop == "device":
+        t = time.time()
+        pid = os.getpid()
+        for i in range(n):
+            span_rec = {"type": "span", "name": f"{kind}.iter", "t": t,
+                        "dur_s": 0.0, "seq": i, "depth": 1,
+                        "parent": f"{kind}.program", "kind": "device",
+                        "pid": pid}
+            for f in FIELDS:
+                if f in entries:
+                    span_rec[f] = entries[f][i]
+            export.add_span(span_rec)
+    return rec
+
+
+def emit_device_trace(kind: str, trace: dict) -> dict:
+    """Re-emit a fetched device ring as an ordered trace record.
+
+    ``trace`` is the loop output: ``{"n": total-entry-count,
+    <field>: ring array, ...}`` with numpy arrays (already fetched).
+    Entries beyond the ring capacity wrapped; the oldest are dropped and
+    counted.
+    """
+    import numpy as np
+
+    n = int(trace["n"])
+    fields = [k for k in (FIELDS if np.ndim(trace["chi2"]) == 1
+                          else BATCH_FIELDS) if k in trace]
+    cap = int(np.shape(trace["chi2"])[0])
+    kept = min(n, cap)
+    idx = [(n - kept + j) % cap for j in range(kept)]
+    entries = {}
+    for f in fields:
+        arr = np.asarray(trace[f])
+        vals = arr[idx]
+        if vals.dtype == bool:
+            entries[f] = [bool(v) if vals.ndim == 1 else list(map(bool, v))
+                          for v in vals]
+        elif np.issubdtype(vals.dtype, np.integer):
+            entries[f] = [int(v) if vals.ndim == 1 else list(map(int, v))
+                          for v in vals]
+        else:
+            entries[f] = [float(v) if vals.ndim == 1
+                          else list(map(float, v)) for v in vals]
+    return emit_trace(kind, entries, loop="device", dropped=n - kept)
+
+
+# ----------------------------------------------------------------------
+# host-side recorder (the oracle's half of the parity contract)
+# ----------------------------------------------------------------------
+
+class HostTrace:
+    """Accumulates the host driver's per-evaluation trace entries.
+
+    Usage contract (``fitting/damped.py``): call :meth:`eval` after
+    every FULL step evaluation, :meth:`halving` / :meth:`probe_eval`
+    as those events occur (they attach to the most recent evaluation's
+    window), :meth:`accept` when the last evaluation is accepted, and
+    :meth:`emit` once at loop exit.
+    """
+
+    __slots__ = ("chi2", "lam", "accepted", "halvings", "probe_evals")
+
+    def __init__(self):
+        self.chi2: list = []
+        self.lam: list = []
+        self.accepted: list = []
+        self.halvings: list = []
+        self.probe_evals: list = []
+
+    def eval(self, chi2: float, lam: float) -> None:
+        self.chi2.append(float(chi2))
+        self.lam.append(float(lam))
+        self.accepted.append(False)
+        self.halvings.append(0)
+        self.probe_evals.append(0)
+
+    def accept(self) -> None:
+        self.accepted[-1] = True
+
+    def halving(self) -> None:
+        self.halvings[-1] += 1
+
+    def probe_eval(self) -> None:
+        self.probe_evals[-1] += 1
+
+    def emit(self, kind: str = "host_loop") -> dict:
+        return emit_trace(kind, {f: getattr(self, f) for f in FIELDS},
+                          loop="host")
+
+
+def host_trace() -> HostTrace | None:
+    """A fresh :class:`HostTrace` when recording is active, else None."""
+    return HostTrace() if active() else None
+
+
+# ----------------------------------------------------------------------
+# per-program cost / memory accounting
+# ----------------------------------------------------------------------
+
+def capture_program(kind: str, compiled, *, shape=None) -> None:
+    """Capture one freshly compiled program's XLA accounting.
+
+    ``compiled`` is a ``jax.stages.Compiled``; its ``cost_analysis()``
+    (flops, bytes accessed — XLA's static count of the whole fused
+    program) and ``memory_analysis()`` (argument/output/temp/code
+    bytes) land in ``program.<kind>.*`` gauges and one
+    ``type="program"`` JSON-lines record. Accounting must never take
+    down a fit: every probe is individually guarded and partial capture
+    is fine (XLA:CPU e.g. reports zero generated-code size).
+    """
+    if not core._enabled:
+        return
+    rec: dict = {"type": "program", "kind": kind}
+    if shape is not None:
+        try:
+            rec["shape"] = repr(tuple(shape))
+        except Exception:  # noqa: BLE001
+            pass
+    vals: dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if "flops" in ca:
+            vals["flops"] = float(ca["flops"])
+        if "bytes accessed" in ca:
+            vals["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for field, attr in (("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("peak_bytes", "temp_size_in_bytes"),
+                            ("code_bytes",
+                             "generated_code_size_in_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                vals[field] = float(v)
+    except Exception:  # noqa: BLE001
+        pass
+    if not vals:
+        return
+    rec.update(vals)
+    counters.inc("program.captures")
+    for field, v in vals.items():
+        counters.set_gauge(f"program.{kind}.{field}", v)
+    export.add_record(rec)

@@ -1,0 +1,417 @@
+"""Run-health report CLI over telemetry JSON-lines artifacts.
+
+Usage::
+
+    python -m pint_tpu.telemetry.report RUN.jsonl [MORE.jsonl ...]
+        [--bench BENCH_rNN.json] [--history BENCH_r01.json ...]
+        [--max-regress-pct 25] [--json]
+
+Renders, from one or more artifacts (``PINT_TPU_TELEMETRY_PATH`` files
+written by bench.py / tools/soak.py / plain library use):
+
+* **span tree** — per-name aggregates with the compile/execute/device
+  split, nested by the recorded parent relation;
+* **iteration timelines** — the flight-recorder ``trace`` records
+  (``telemetry.recorder``): per-fit chi2/lambda trajectories,
+  accept/halving structure, per-member summaries for batched fits;
+* **program accounting** — ``type="program"`` records (XLA
+  cost/memory analysis captured at each fresh compile);
+* **cache hit rates** — ``cache.<name>.{hit,miss,evict}`` counters from
+  the closing rollup;
+* **host-pollution windows** — spans of wall time whose ``host``
+  samples exceeded the load1 threshold (a number measured inside one is
+  suspect);
+* **bench-regression verdict** — the ``--bench`` record (a compact
+  bench.py stdout line / committed ``BENCH_rNN.json``) against the
+  committed trajectory (``--history``): FAIL when an uncontended
+  headline wall regresses more than ``--max-regress-pct`` (default 25)
+  over the best uncontended committed value for the same metric.
+
+Exit codes: ``0`` healthy (or verdict skipped for a contended run /
+no history), ``1`` bench regression, ``2`` unreadable input or usage
+error. Schema: understands v1 and v2 artifacts (v2 adds the ``trace``
+and ``program`` record types — unknown types are skipped, per the
+reader contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def load_jsonl(path: str) -> tuple[list[dict], int]:
+    """(records, unparseable-line count); raises OSError if unreadable."""
+    records, bad = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                bad += 1
+    return records, bad
+
+
+# ----------------------------------------------------------------------
+# section builders (pure: records in, summary dicts out)
+# ----------------------------------------------------------------------
+
+def span_tree(records: list[dict]) -> list[dict]:
+    """Per-name span aggregates nested by the recorded parent relation.
+
+    Returns a list of root nodes ``{"name", "count", "total_s",
+    "compile_count", "compile_s", "execute_count", "execute_s",
+    "device_count", "children": [...]}`` sorted by total time.
+    """
+    stats: dict[str, dict] = {}
+    parents: dict[str, dict] = {}
+    for r in records:
+        if r.get("type") != "span":
+            continue
+        st = stats.setdefault(r["name"], {
+            "name": r["name"], "count": 0, "total_s": 0.0,
+            "compile_count": 0, "compile_s": 0.0, "execute_count": 0,
+            "execute_s": 0.0, "device_count": 0, "children": []})
+        d = float(r.get("dur_s") or 0.0)
+        st["count"] += 1
+        st["total_s"] += d
+        kind = r.get("kind")
+        if kind in ("compile", "execute"):
+            st[f"{kind}_count"] += 1
+            st[f"{kind}_s"] += d
+        elif kind == "device":
+            st["device_count"] += 1
+        p = r.get("parent")
+        parents.setdefault(r["name"], {})
+        parents[r["name"]][p] = parents[r["name"]].get(p, 0) + 1
+    roots = []
+    for name, st in stats.items():
+        votes = parents.get(name, {})
+        parent = max(votes, key=votes.get) if votes else None
+        if parent is not None and parent in stats and parent != name:
+            stats[parent]["children"].append(st)
+        else:
+            roots.append(st)
+    for st in stats.values():
+        st["total_s"] = round(st["total_s"], 6)
+        st["compile_s"] = round(st["compile_s"], 6)
+        st["execute_s"] = round(st["execute_s"], 6)
+        st["children"].sort(key=lambda c: -c["total_s"])
+    roots.sort(key=lambda c: -c["total_s"])
+    return roots
+
+
+def trace_summaries(records: list[dict]) -> list[dict]:
+    """One summary per flight-recorder ``trace`` record."""
+    out = []
+    for r in records:
+        if r.get("type") != "trace":
+            continue
+        chi2 = r.get("chi2") or []
+        s = {"kind": r.get("kind"), "loop": r.get("loop"),
+             "n": r.get("n"), "recorded": r.get("recorded", len(chi2)),
+             "dropped": r.get("dropped", 0)}
+        if chi2 and isinstance(chi2[0], list):  # batched: per-member
+            accepted = r.get("accepted") or []
+            nmem = len(chi2[0])
+            s["members"] = nmem
+            s["chi2_final"] = [round(float(c), 6) for c in chi2[-1]]
+            s["accepts_per_member"] = [
+                sum(1 for row in accepted if row[m]) for m in range(nmem)]
+        else:
+            s["chi2_first"] = float(chi2[0]) if chi2 else None
+            s["chi2_final"] = float(chi2[-1]) if chi2 else None
+            s["accepts"] = sum(bool(a) for a in r.get("accepted") or [])
+            s["halvings"] = sum(r.get("halvings") or [])
+            s["probe_evals"] = sum(r.get("probe_evals") or [])
+            lams = r.get("lam") or []
+            s["lam_min"] = min(lams) if lams else None
+        out.append(s)
+    return out
+
+
+def program_summaries(records: list[dict]) -> list[dict]:
+    out = []
+    for r in records:
+        if r.get("type") != "program":
+            continue
+        out.append({k: r[k] for k in ("kind", "shape", "flops",
+                                      "bytes_accessed", "argument_bytes",
+                                      "output_bytes", "peak_bytes")
+                    if k in r})
+    return out
+
+
+def cache_rates(records: list[dict]) -> dict[str, dict]:
+    """Hit rates per named cache, from the LAST rollup's counters."""
+    counters: dict = {}
+    for r in records:
+        if r.get("type") == "rollup":
+            counters = r.get("counters") or counters
+    rates: dict[str, dict] = {}
+    for key, v in counters.items():
+        if not key.startswith("cache."):
+            continue
+        parts = key.split(".")
+        if len(parts) != 3 or parts[2] not in ("hit", "miss", "evict"):
+            continue
+        rates.setdefault(parts[1], {"hit": 0, "miss": 0, "evict": 0})
+        rates[parts[1]][parts[2]] = int(v)
+    for st in rates.values():
+        st["rate"] = round(st["hit"] / max(1, st["hit"] + st["miss"]), 4)
+    return rates
+
+
+def pollution_windows(records: list[dict]) -> dict:
+    """Contiguous wall-time windows of polluted host samples."""
+    samples = sorted((r for r in records if r.get("type") == "host"
+                      and "t" in r), key=lambda r: r["t"])
+    windows, cur = [], None
+    for s in samples:
+        if s.get("polluted"):
+            if cur is None:
+                cur = [s["t"], s["t"], 0]
+            cur[1] = s["t"]
+            cur[2] += 1
+        elif cur is not None:
+            windows.append(cur)
+            cur = None
+    if cur is not None:
+        windows.append(cur)
+    return {"samples": len(samples),
+            "polluted_samples": sum(1 for s in samples
+                                    if s.get("polluted")),
+            "windows": [{"start": w[0], "end": w[1], "samples": w[2]}
+                        for w in windows]}
+
+
+def bench_verdict(current: dict, history: list[dict],
+                  max_regress_pct: float) -> dict:
+    """Regression verdict of one headline record vs the trajectory.
+
+    ``status``: ``ok`` / ``regressed`` / ``skipped-contended`` (the
+    current run cannot be judged) / ``no-history`` (nothing comparable
+    committed) / ``invalid`` (the current record is a failed run).
+    ``fail`` is True only for ``regressed``.
+    """
+    metric = current.get("metric")
+    value = current.get("value")
+    out = {"metric": metric, "value": value,
+           "max_regress_pct": max_regress_pct, "fail": False}
+    if not isinstance(value, (int, float)) or value <= 0:
+        out["status"] = "invalid"
+        out["detail"] = current.get("error", "no positive headline value")
+        return out
+    if current.get("contended") or current.get("host_polluted"):
+        out["status"] = "skipped-contended"
+        out["detail"] = ("current run is contended/polluted; a wall "
+                         "comparison would judge the background load")
+        return out
+    refs = [h["value"] for h in history
+            if h.get("metric") == metric
+            and isinstance(h.get("value"), (int, float))
+            and h["value"] > 0
+            and not h.get("contended") and not h.get("host_polluted")]
+    if not refs:
+        out["status"] = "no-history"
+        out["detail"] = f"no uncontended committed record for {metric}"
+        return out
+    ref = min(refs)
+    regress = 100.0 * (value / ref - 1.0)
+    out.update(reference=ref, n_history=len(refs),
+               regress_pct=round(regress, 1))
+    if regress > max_regress_pct:
+        out["status"] = "regressed"
+        out["fail"] = True
+        out["detail"] = (f"{value:.3f}s vs best committed uncontended "
+                         f"{ref:.3f}s: +{regress:.1f}% > "
+                         f"{max_regress_pct:.0f}%")
+    else:
+        out["status"] = "ok"
+        out["detail"] = (f"{value:.3f}s vs best committed uncontended "
+                         f"{ref:.3f}s: {regress:+.1f}%")
+    return out
+
+
+# ----------------------------------------------------------------------
+# rendering
+# ----------------------------------------------------------------------
+
+def _fmt_node(st: dict, indent: int, lines: list[str]) -> None:
+    extras = []
+    if st["compile_count"]:
+        extras.append(f"compile {st['compile_count']}x "
+                      f"{st['compile_s']:.3f}s")
+    if st["execute_count"]:
+        extras.append(f"execute {st['execute_count']}x "
+                      f"{st['execute_s']:.3f}s")
+    if st["device_count"]:
+        extras.append(f"device {st['device_count']} iter")
+    tail = f"  [{' / '.join(extras)}]" if extras else ""
+    lines.append(f"{'  ' * indent}{st['name']:<40} {st['count']:>5}x "
+                 f"{st['total_s']:>10.3f}s{tail}")
+    for child in st["children"]:
+        _fmt_node(child, indent + 1, lines)
+
+
+def render(summary: dict) -> str:
+    lines = [f"telemetry run-health report "
+             f"({time.strftime('%Y-%m-%d %H:%M:%S')})"]
+    for src in summary["sources"]:
+        lines.append(f"  source: {src['path']}  ({src['records']} records"
+                     + (f", {src['unparseable']} unparseable"
+                        if src["unparseable"] else "") + ")")
+
+    lines.append("\n== span tree (compile/execute split) ==")
+    if summary["spans"]:
+        for root in summary["spans"]:
+            _fmt_node(root, 1, lines)
+    else:
+        lines.append("  (no span records)")
+
+    lines.append("\n== iteration timelines (flight recorder) ==")
+    if summary["traces"]:
+        for t in summary["traces"]:
+            if "members" in t:
+                lines.append(
+                    f"  {t['kind']} [{t['loop']}] {t['recorded']} evals x "
+                    f"{t['members']} members, accepts/member="
+                    f"{t['accepts_per_member']}, final chi2="
+                    f"{t['chi2_final']}")
+            else:
+                lines.append(
+                    f"  {t['kind']} [{t['loop']}] {t['recorded']} evals"
+                    + (f" (+{t['dropped']} dropped)" if t["dropped"]
+                       else "")
+                    + f": chi2 {t['chi2_first']:.6g} -> "
+                      f"{t['chi2_final']:.6g}, accepts {t['accepts']}, "
+                      f"halvings {t['halvings']}, probe_evals "
+                      f"{t['probe_evals']}, lam_min {t['lam_min']}")
+    else:
+        lines.append("  (no trace records)")
+
+    lines.append("\n== program accounting (XLA cost/memory) ==")
+    if summary["programs"]:
+        for p in summary["programs"]:
+            flops = p.get("flops")
+            lines.append(
+                f"  {p.get('kind'):<24} shape={p.get('shape', '?')} "
+                f"flops={flops:.3g}" if isinstance(flops, (int, float))
+                else f"  {p.get('kind'):<24} shape={p.get('shape', '?')}")
+            lines[-1] += "".join(
+                f" {k.replace('_bytes', '')}={p[k] / 1e6:.2f}MB"
+                for k in ("bytes_accessed", "argument_bytes",
+                          "output_bytes", "peak_bytes") if k in p)
+    else:
+        lines.append("  (no program records)")
+
+    lines.append("\n== cache hit rates ==")
+    if summary["caches"]:
+        for name, st in sorted(summary["caches"].items()):
+            lines.append(f"  cache.{name:<16} hit {st['hit']:>6} / miss "
+                         f"{st['miss']:>4} / evict {st['evict']:>3}  "
+                         f"rate {st['rate']:.1%}")
+    else:
+        lines.append("  (no cache counters in rollup)")
+
+    pol = summary["pollution"]
+    lines.append(f"\n== host pollution ==\n  {pol['polluted_samples']}/"
+                 f"{pol['samples']} samples polluted, "
+                 f"{len(pol['windows'])} window(s)")
+    for w in pol["windows"]:
+        lines.append(f"    {time.strftime('%H:%M:%S', time.localtime(w['start']))}"
+                     f" -> {time.strftime('%H:%M:%S', time.localtime(w['end']))}"
+                     f" ({w['samples']} samples)")
+
+    lines.append("\n== bench regression verdict ==")
+    v = summary.get("bench")
+    if v is None:
+        lines.append("  (no --bench record given; verdict skipped)")
+    else:
+        lines.append(f"  bench_verdict: {v['status']}  metric={v['metric']}"
+                     f"  value={v['value']}")
+        lines.append(f"    {v.get('detail', '')}")
+    return "\n".join(lines)
+
+
+def build_summary(paths: list[str], bench_path: str | None,
+                  history_paths: list[str],
+                  max_regress_pct: float) -> dict:
+    records: list[dict] = []
+    sources = []
+    for p in paths:
+        recs, bad = load_jsonl(p)
+        records.extend(recs)
+        sources.append({"path": p, "records": len(recs),
+                        "unparseable": bad})
+    summary = {
+        "sources": sources,
+        "spans": span_tree(records),
+        "traces": trace_summaries(records),
+        "programs": program_summaries(records),
+        "caches": cache_rates(records),
+        "pollution": pollution_windows(records),
+    }
+    if bench_path:
+        with open(bench_path) as fh:
+            current = json.load(fh)
+        history = []
+        for hp in history_paths:
+            with open(hp) as fh:
+                history.append(json.load(fh))
+        summary["bench"] = bench_verdict(current, history,
+                                         max_regress_pct)
+    return summary
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.telemetry.report",
+        description="Run-health report over telemetry JSONL artifacts.")
+    ap.add_argument("jsonl", nargs="*",
+                    help="telemetry JSON-lines artifact(s)")
+    ap.add_argument("--bench", default=None,
+                    help="current compact bench record (BENCH_rNN.json "
+                         "or a bench.py stdout line saved to a file)")
+    ap.add_argument("--history", nargs="*", default=[],
+                    help="committed bench trajectory records to judge "
+                         "--bench against")
+    ap.add_argument("--max-regress-pct", type=float, default=25.0,
+                    help="fail when the uncontended headline wall "
+                         "regresses more than this (default 25)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead of "
+                         "the text report")
+    args = ap.parse_args(argv)
+
+    if not args.jsonl and not args.bench:
+        ap.print_usage(sys.stderr)
+        print("report: need at least one JSONL artifact or --bench",
+              file=sys.stderr)
+        return 2
+    try:
+        summary = build_summary(args.jsonl, args.bench, args.history,
+                                args.max_regress_pct)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"report: unreadable input: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(render(summary))
+    v = summary.get("bench")
+    return 1 if (v and v["fail"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

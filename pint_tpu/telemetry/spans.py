@@ -130,6 +130,85 @@ def jit_span(name: str, **tags):
     return s
 
 
+_profiler_lock = threading.Lock()
+_profiler_active = False
+
+
+class _ProfileSpan:
+    """A span whose region is additionally captured by the XLA profiler.
+
+    The profiler session is process-global and non-reentrant, so only
+    the outermost active :func:`profile_span` starts/stops it; nested
+    ones degrade to plain spans. jax is imported lazily and only when a
+    trace actually starts — the telemetry package must stay importable
+    (and cheap) without jax.
+    """
+
+    __slots__ = ("_span", "_dir", "_started")
+
+    def __init__(self, span_obj, profile_dir):
+        self._span = span_obj
+        self._dir = profile_dir
+        self._started = False
+
+    def __enter__(self):
+        global _profiler_active
+        if self._dir:
+            with _profiler_lock:
+                if not _profiler_active:
+                    try:
+                        import jax
+
+                        jax.profiler.start_trace(self._dir)
+                        _profiler_active = True
+                        self._started = True
+                    except Exception:  # noqa: BLE001 — profiling must
+                        self._started = False  # never fail the fit
+        if self._span is not None:
+            if self._started:
+                self._span.tags["profiled"] = True
+            self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        global _profiler_active
+        if self._span is not None:
+            self._span.__exit__(exc_type, exc, tb)
+        if self._started:
+            with _profiler_lock:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001
+                    pass
+                _profiler_active = False
+                if core._enabled:
+                    from pint_tpu.telemetry import counters
+
+                    counters.inc("telemetry.profile.traces")
+        return False
+
+
+def profile_span(name: str, **tags):
+    """:func:`span` + an XLA profiler capture of the same region.
+
+    Env-gated: with ``PINT_TPU_PROFILE_DIR`` unset this is exactly
+    :func:`span` (the usual no-op when telemetry is off), so fitters,
+    bench and soak can wrap their hot regions unconditionally. With the
+    dir set, the region is additionally recorded via
+    ``jax.profiler.trace`` into that directory (view with
+    tensorboard/xprof); the emitted span carries ``profiled: true``.
+    """
+    pdir = core.profile_dir()
+    if not core._enabled and not pdir:
+        return _NULL_SPAN
+    s = Span(name, None, tags) if core._enabled else None
+    if not pdir:
+        return s
+    return _ProfileSpan(s, pdir)
+
+
 def traced(name: str | None = None, kind: str | None = None):
     """Decorator form: ``@traced("fit.wls")`` wraps the call in a span."""
 

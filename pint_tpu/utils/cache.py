@@ -42,10 +42,24 @@ class LRUCache(OrderedDict):
         return val
 
     def put_lru(self, key, val):
-        """Insert and evict least-recently-used entries over the cap."""
+        """Insert and evict least-recently-used entries over the cap.
+
+        A named cache's insert is its miss-fill; when the stored value
+        is an AOT-compiled executable (has ``cost_analysis``), its XLA
+        cost/memory accounting is captured under the cache's name
+        (``program.<name>.*`` gauges + a ``type="program"`` record).
+        Values that are plain jitted callables compile lazily per shape
+        and stay un-accounted here — the fit path routes those through
+        ``bucketing.note_program(compiled=...)`` instead.
+        """
         self[key] = val
         while len(self) > self.maxsize:
             self.popitem(last=False)
             if self.name is not None and _tele_core._enabled:
                 _tele_counters.inc(f"cache.{self.name}.evict")
+        if (self.name is not None and _tele_core._enabled
+                and hasattr(val, "cost_analysis")):
+            from pint_tpu.telemetry import recorder
+
+            recorder.capture_program(self.name, val)
         return val

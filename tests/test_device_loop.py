@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from pint_tpu import bucketing, telemetry
 from pint_tpu.fitting import device_loop
 from pint_tpu.fitting.damped import downhill_iterate
+from pint_tpu.telemetry import recorder
 from pint_tpu.models import get_model
 from pint_tpu.simulation import make_fake_toas_uniform
 from pint_tpu.toas import Flags
@@ -203,6 +204,124 @@ def test_synthetic_batched_parity():
         assert (np.asarray(dconv) == hconv).all()
         np.testing.assert_allclose(np.asarray(di["x_at"]),
                                    np.asarray(hi["x_at"]), atol=1e-12)
+        # batched flight recorder: per-member chi2/lam/accept vectors,
+        # one entry per body, in the same single fetch
+        tr = recorder.last_trace()
+        assert tr["loop"] == "device" and tr["n"] >= 1
+        assert len(tr["chi2"][0]) == B and len(tr["lam"][0]) == B
+        assert len(tr["accepted"][0]) == B
+        # deterministic pins: the init pass applies lam 0 to every
+        # member and accepts nobody; some member accepts later (a
+        # member CAN converge with zero accepts — halvings exhausted
+        # at its optimum — so only the batch-wide claim is exact)
+        assert tr["lam"][0] == [0.0] * B
+        assert tr["accepted"][0] == [False] * B
+        assert any(any(row) for row in tr["accepted"])
+
+
+# ----------------------------------------------------------------------
+# flight recorder (ISSUE 4): trace parity + zero-cost-to-the-fit pins
+# ----------------------------------------------------------------------
+
+def test_flight_recorder_off_bit_identical(monkeypatch):
+    """Acceptance: PINT_TPU_FLIGHT_RECORDER=1 (default) vs 0 — still one
+    launch and <= 2 fetches, and the fit trajectory / final chi2 /
+    fit.* counters are bit-identical; only the trace emission differs."""
+    full = _quad_full(4.6)
+    res = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("PINT_TPU_FLIGHT_RECORDER", mode)
+        (out), _, delta = _counted(lambda: device_loop.run_damped(
+            full, {"x": jnp.float64(0.0)}, (),
+            key=("rec_ab",), probe=_lying_probe, maxiter=10,
+            min_chi2_decrease=1e-10, kind="rec_ab_loop"))
+        res[mode] = (out, delta, recorder.last_trace(),
+                     delta.get("trace.emitted", 0))
+    (d1, i1, c1, conv1, cnt1), del1, tr1, em1 = res["1"]
+    (d0, i0, c0, conv0, cnt0), del0, tr0, em0 = res["0"]
+    assert float(d1["x"]) == float(d0["x"])          # bit-identical
+    assert c1 == c0
+    assert conv1 == conv0
+    assert cnt1 == cnt0
+    for mode_delta in (del1, del0):
+        assert mode_delta.get("fit.device_loop.launches", 0) == 1
+        assert mode_delta.get("fit.device_loop.fetches", 0) <= 2
+    assert em1 == 1 and tr1 is not None and tr1["loop"] == "device"
+    assert em0 == 0
+
+
+def test_flight_recorder_host_oracle_identical_trace():
+    """Acceptance: the host downhill_iterate oracle emits an IDENTICAL
+    trace for the same fit — entry count and every judgment field
+    (lam/accepted/halvings/probe_evals) exactly, chi2 values to f64
+    round-off (XLA:CPU contracts the trial's mul+add into an fma the
+    host's two-rounding arithmetic doesn't — the round-4 finding) —
+    including the lying-probe recheck structure."""
+    for scale, probe in ((3.2, _quad_probe), (4.6, _lying_probe),
+                         (3.2, None)):
+        full = _quad_full(scale)
+        for maxiter, mdec, mh in ((10, 1e-3, 8), (5, 1e-10, 2)):
+            downhill_iterate(
+                lambda d: full(d, ()), {"x": 0.0}, maxiter=maxiter,
+                min_chi2_decrease=mdec, max_step_halvings=mh,
+                chi2_at=(lambda d: probe(d, ())) if probe else None)
+            host_tr = recorder.last_trace()
+            assert host_tr["loop"] == "host"
+            device_loop.run_damped(
+                full, {"x": jnp.float64(0.0)}, (),
+                key=("trace_par", scale, probe is None, id(probe)),
+                probe=probe, maxiter=maxiter, min_chi2_decrease=mdec,
+                max_step_halvings=mh, kind="trace_par_loop")
+            dev_tr = recorder.last_trace()
+            assert dev_tr["loop"] == "device"
+            assert dev_tr["n"] == host_tr["n"]
+            for f in ("lam", "accepted", "halvings", "probe_evals"):
+                assert dev_tr[f] == host_tr[f], (scale, maxiter, mh, f)
+            np.testing.assert_allclose(dev_tr["chi2"], host_tr["chi2"],
+                                       rtol=1e-12)
+
+
+def test_flight_recorder_ring_wraps(monkeypatch):
+    """A fit with more evaluations than the ring keeps the LAST cap
+    entries and counts the dropped head — never an error."""
+    monkeypatch.setenv("PINT_TPU_TRACE_LEN", "8")
+    full = _quad_full(4.6)
+    downhill_iterate(lambda d: full(d, ()), {"x": 0.0}, maxiter=12,
+                     min_chi2_decrease=1e-12,
+                     chi2_at=lambda d: _quad_probe(d, ()))
+    host_tr = recorder.last_trace()
+    assert host_tr["n"] > 8, "problem must overflow the 8-entry ring"
+    device_loop.run_damped(
+        full, {"x": jnp.float64(0.0)}, (), key=("wrap",),
+        probe=_quad_probe, maxiter=12, min_chi2_decrease=1e-12,
+        kind="wrap_loop")
+    dev_tr = recorder.last_trace()
+    assert dev_tr["n"] == host_tr["n"]
+    assert dev_tr["recorded"] == 8
+    assert dev_tr["dropped"] == host_tr["n"] - 8
+    for f in ("lam", "accepted", "halvings", "probe_evals"):
+        assert dev_tr[f] == host_tr[f][-8:], f
+    np.testing.assert_allclose(dev_tr["chi2"], host_tr["chi2"][-8:],
+                               rtol=1e-12)
+
+
+def test_device_loop_program_accounting():
+    """A fresh device-loop compile captures XLA cost/memory accounting
+    into program.<kind>.* gauges (riding the fit_program.miss event)."""
+    full = _quad_full(1.0)
+    before = telemetry.counters_snapshot()
+    device_loop.run_damped(full, {"x": jnp.float64(0.0)}, (),
+                           key=("acct",), maxiter=4, kind="acct_loop")
+    delta = telemetry.counters_delta(before)
+    assert delta.get("program.captures", 0) == 1
+    gauges = telemetry.gauges_snapshot()
+    assert gauges["program.acct_loop.flops"] > 0
+    assert gauges["program.acct_loop.output_bytes"] > 0
+    # warm relaunch: no new compile, no new capture
+    before = telemetry.counters_snapshot()
+    device_loop.run_damped(full, {"x": jnp.float64(0.0)}, (),
+                           key=("acct",), maxiter=7, kind="acct_loop")
+    assert telemetry.counters_delta(before).get("program.captures", 0) == 0
 
 
 # ----------------------------------------------------------------------
@@ -317,12 +436,24 @@ def test_dense_wls_parity():
         lambda d: step(base, d, toas_b), model.zero_deltas(), maxiter=5,
         min_chi2_decrease=1e-8,
         chi2_at=lambda d: probe(base, d, toas_b)))
+    host_tr = recorder.last_trace()
 
     toas2, model2 = _problem(60, seed=13, halving_pert=True)
     (dd, _di, dc, dconv, _), dtel, delta = _counted(
         lambda: device_loop.dense_wls_fit(toas2, model2, maxiter=5,
                                           min_chi2_decrease=1e-8))
+    dev_tr = recorder.last_trace()
     assert hcnt == dtel, (hcnt, dtel)
+    # flight-recorder parity on a REAL fit: same structure exactly,
+    # same chi2 timeline to solver round-off (the two runs execute the
+    # same step/probe programs on independently simulated-but-identical
+    # problems)
+    assert dev_tr["loop"] == "device" and host_tr["loop"] == "host"
+    assert dev_tr["n"] == host_tr["n"]
+    for f in ("lam", "accepted", "halvings", "probe_evals"):
+        assert dev_tr[f] == host_tr[f], f
+    np.testing.assert_allclose(dev_tr["chi2"], host_tr["chi2"],
+                               rtol=1e-9)
     assert dconv == hconv
     assert dc == pytest.approx(hc, rel=1e-9)
     for k in hd:

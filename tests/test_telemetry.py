@@ -248,9 +248,10 @@ def test_jsonl_schema_roundtrip(tmp_path):
         assert key in host_rec
 
     # the rollup line round-trips the in-memory rollup (modulo its own
-    # timestamp) and carries the schema marker
+    # timestamp) and carries the schema marker (v2 since ISSUE 4: adds
+    # the "trace"/"program" record types, removes nothing from v1)
     last = lines[-1]
-    assert last["schema"] == roll["schema"] == 1
+    assert last["schema"] == roll["schema"] == 2
     assert last["counters"] == {"k": 2}
     assert last["gauges"] == {"g": 7.0}
     assert last["spans"]["s1"]["count"] == 1
@@ -280,6 +281,193 @@ def test_host_polluted_threshold():
     s = telemetry.host_sample()
     assert s["load1_threshold"] == 1e9
     assert s["polluted"] is False
+
+
+# ----------------------------------------------------------------------
+# exporter: buffer cap + size-capped rotation (ISSUE 4 satellites)
+# ----------------------------------------------------------------------
+
+def test_buffer_cap_overflow_counts_drops(tmp_path, monkeypatch):
+    """Forcing _MAX_BUFFER overflow never raises; drops are counted in
+    the rollup (and the non-dropped records still land in the jsonl)."""
+    from pint_tpu.telemetry import export
+
+    path = str(tmp_path / "cap.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    monkeypatch.setattr(export, "_MAX_BUFFER", 5)
+    monkeypatch.setattr(export, "_FLUSH_EVERY", 10 ** 9)  # no mid-flush
+    n = 25
+    for i in range(n):
+        telemetry.add_record({"type": "probe", "i": i})
+    roll = telemetry.write_rollup()
+    assert roll["dropped_records"] == n - 5
+    lines = [json.loads(l) for l in open(path)]
+    assert sum(1 for l in lines if l["type"] == "probe") == 5
+    assert lines[-1]["type"] == "rollup"
+
+
+def test_export_rotation_caps_artifact(tmp_path, monkeypatch):
+    """PINT_TPU_TELEMETRY_MAX_MB rotates <path> to <path>.1 and counts
+    a telemetry.export.rotations event."""
+    path = str(tmp_path / "rot.jsonl")
+    monkeypatch.setenv("PINT_TPU_TELEMETRY_MAX_MB", "0.0001")  # 100 B
+    telemetry.configure(enabled=True, jsonl_path=path)
+    for i in range(3):
+        telemetry.add_record({"type": "probe", "i": i})
+    telemetry.flush()  # writes > 100 B (host header + records)
+    telemetry.add_record({"type": "probe", "i": 99})
+    telemetry.flush()  # second flush sees the oversized file -> rotate
+    assert os.path.exists(path + ".1")
+    rotated = [json.loads(l) for l in open(path + ".1")]
+    assert any(r.get("i") == 0 for r in rotated)
+    fresh = [json.loads(l) for l in open(path)]
+    assert any(r.get("i") == 99 for r in fresh)
+    assert telemetry.counters_snapshot()["telemetry.export.rotations"] >= 1
+
+
+# ----------------------------------------------------------------------
+# flight-recorder records + program accounting (schema v2 types)
+# ----------------------------------------------------------------------
+
+def test_trace_record_roundtrip(tmp_path):
+    """recorder.emit_trace lands a type="trace" line; device traces add
+    per-iteration synthetic spans with kind="device"."""
+    from pint_tpu.telemetry import recorder
+
+    path = str(tmp_path / "trace.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    entries = {"chi2": [9.0, 1.0], "lam": [1.0, 1.0],
+               "accepted": [False, True], "halvings": [0, 0],
+               "probe_evals": [0, 0]}
+    recorder.emit_trace("t_loop", entries, loop="device")
+    telemetry.flush()
+    lines = [json.loads(l) for l in open(path)]
+    tr = next(l for l in lines if l["type"] == "trace")
+    assert tr["kind"] == "t_loop" and tr["loop"] == "device"
+    assert tr["n"] == 2 and tr["chi2"] == [9.0, 1.0]
+    iters = [l for l in lines if l["type"] == "span"
+             and l["name"] == "t_loop.iter"]
+    assert len(iters) == 2
+    assert all(s["kind"] == "device" for s in iters)
+    assert iters[1]["accepted"] is True
+    assert recorder.last_trace()["chi2"] == [9.0, 1.0]
+    assert telemetry.counters_snapshot()["trace.emitted"] == 1
+
+
+def test_host_trace_recorder_semantics():
+    """HostTrace windows: halvings/probe evals attach to the preceding
+    full evaluation, exactly like the device ring's inner-loop counts."""
+    from pint_tpu.telemetry import recorder
+
+    telemetry.configure(enabled=True)
+    rec = recorder.host_trace()
+    rec.eval(9.0, 1.0)       # init
+    rec.eval(16.0, 1.0)      # first trial, rejected
+    rec.halving()
+    rec.probe_eval()
+    rec.eval(4.0, 0.5)       # re-check, accepted
+    rec.accept()
+    out = rec.emit()
+    assert out["chi2"] == [9.0, 16.0, 4.0]
+    assert out["halvings"] == [0, 1, 0]
+    assert out["probe_evals"] == [0, 1, 0]
+    assert out["accepted"] == [False, False, True]
+    assert out["loop"] == "host"
+
+
+def test_capture_program_gauges_and_record(tmp_path):
+    """A freshly AOT-compiled program's XLA cost/memory analysis lands
+    in program.<kind>.* gauges and a type="program" record."""
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.telemetry import recorder
+
+    path = str(tmp_path / "prog.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    compiled = jax.jit(lambda x: x * 2.0 + 1.0).lower(
+        jnp.ones(8)).compile()
+    recorder.capture_program("t_prog", compiled, shape=(8,))
+    telemetry.flush()
+    gauges = telemetry.gauges_snapshot()
+    assert gauges["program.t_prog.flops"] > 0
+    assert gauges["program.t_prog.argument_bytes"] > 0
+    rec = next(l for l in map(json.loads, open(path))
+               if l["type"] == "program")
+    assert rec["kind"] == "t_prog" and rec["flops"] > 0
+    assert telemetry.counters_snapshot()["program.captures"] == 1
+
+
+def test_profile_span_writes_xla_trace(tmp_path, monkeypatch):
+    """profile_span is a plain span without PINT_TPU_PROFILE_DIR and an
+    XLA profiler capture with it (profiled tag on the span)."""
+    import jax.numpy as jnp
+
+    telemetry.configure(enabled=True)
+    with telemetry.profile_span("plain"):
+        pass
+    assert telemetry.span_stats()["plain"]["count"] == 1
+
+    pdir = str(tmp_path / "prof")
+    monkeypatch.setenv("PINT_TPU_PROFILE_DIR", pdir)
+    with telemetry.profile_span("profiled"):
+        jnp.ones(16).sum().block_until_ready()
+    assert telemetry.span_stats()["profiled"]["count"] == 1
+    # the profiler session wrote its capture directory
+    assert os.path.isdir(pdir) and os.listdir(pdir)
+    assert telemetry.counters_snapshot()["telemetry.profile.traces"] == 1
+
+
+# ----------------------------------------------------------------------
+# report CLI (ISSUE 4: run-health report)
+# ----------------------------------------------------------------------
+
+def _run_report(args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pint_tpu.telemetry.report", *args],
+        capture_output=True, text=True, timeout=timeout,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+
+
+def test_report_cli_fixture_and_verdict(tmp_path):
+    """Satellite: the report CLI over the checked-in mini artifact
+    renders every section; the bench verdict drives the exit code."""
+    fixture = os.path.join(REPO, "tests", "data", "telemetry_mini.jsonl")
+    proc = _run_report([fixture])
+    assert proc.returncode == 0, proc.stderr[-500:]
+    for section in ("span tree", "flight recorder", "program accounting",
+                    "cache hit rates", "host pollution",
+                    "bench regression verdict"):
+        assert section in proc.stdout, section
+    assert "device_loop_gls [device]" in proc.stdout
+    assert "host_loop [host]" in proc.stdout
+
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(
+        {"metric": "m", "value": 1.0, "contended": False}))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(
+        {"metric": "m", "value": 1.1, "contended": False}))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"metric": "m", "value": 1.6, "contended": False}))
+    contended = tmp_path / "cont.json"
+    contended.write_text(json.dumps(
+        {"metric": "m", "value": 9.0, "contended": True}))
+
+    proc = _run_report([fixture, "--bench", str(ok),
+                        "--history", str(hist)])
+    assert proc.returncode == 0 and "bench_verdict: ok" in proc.stdout
+    proc = _run_report(["--bench", str(bad), "--history", str(hist)])
+    assert proc.returncode == 1, proc.stdout[-300:]
+    assert "bench_verdict: regressed" in proc.stdout
+    proc = _run_report(["--bench", str(contended),
+                        "--history", str(hist)])
+    assert proc.returncode == 0
+    assert "bench_verdict: skipped-contended" in proc.stdout
+    # usage / unreadable input -> exit 2
+    assert _run_report([]).returncode == 2
+    assert _run_report([str(tmp_path / "missing.jsonl")]).returncode == 2
 
 
 # ----------------------------------------------------------------------
@@ -354,3 +542,11 @@ def test_bench_smoke_emits_rollup(tmp_path):
     lines = [json.loads(l) for l in open(path)]
     assert lines[-1]["type"] == "rollup"
     assert lines[-1]["schema"] == roll["schema"]
+
+    # satellite (ISSUE 4): the report CLI renders a fresh --smoke
+    # artifact (exit 0, fit spans + host-loop trace visible) — the CI
+    # smoke proves the producer AND the consumer end-to-end
+    rep = _run_report([path])
+    assert rep.returncode == 0, rep.stderr[-500:]
+    assert "fit.step" in rep.stdout
+    assert "dense_downhill [host]" in rep.stdout

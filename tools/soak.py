@@ -257,6 +257,8 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
         pre_chi2 = Residuals(toas, model).chi2
         f = Fitter.auto(toas, model)
         chi2 = f.fit_toas(maxiter=12)
+        axes["converged"] = bool(np.all(np.asarray(
+            getattr(f, "converged", True))))
         assert np.isfinite(chi2), f"chi2 not finite: {chi2}"
         assert chi2 <= pre_chi2 * 1.01 + 1e-6, (
             f"fit went uphill: {pre_chi2} -> {chi2}")
@@ -580,30 +582,63 @@ def main() -> int:
             fh.write("\n")
         os.replace(tmp, args.json_out)
 
+    def dump_repro(seed: int, ok: bool, axes: dict, deltas: dict) -> str:
+        """Per-trial repro artifact (ISSUE 4 satellite): the flight-
+        recorder trace of the trial's LAST fit plus the trial's counter
+        deltas, so a failed or non-converged trial is diagnosable from
+        the artifact instead of a host-oracle re-run. Returns the path
+        ('' when unwritable)."""
+        from pint_tpu.telemetry import recorder
+
+        out_dir = os.environ.get("PINT_TPU_SOAK_REPRO_DIR", ".")
+        path = os.path.join(out_dir, f"soak_repro_seed{seed}.json")
+        rec = {"seed": seed, "ok": ok, "axes": axes,
+               "counters": deltas, "trace": recorder.last_trace(),
+               "note": ("trace is the last recorded fit of the trial "
+                        "(gate fits included); reproduce with "
+                        f"--seed {seed} --trials 1")}
+        try:
+            with open(path, "w") as fh:
+                json.dump(rec, fh, indent=1)
+                fh.write("\n")
+            return path
+        except OSError:
+            return ""
+
     fails = 0
     t0 = time.time()
     for i in range(args.trials):
         seed = args.seed + i
         counters_before = telemetry.counters_snapshot()
         t1 = time.time()
-        with telemetry.span("soak.trial", seed=seed):
+        with telemetry.profile_span("soak.trial", seed=seed):
             ok, msg, axes = one_trial(seed)
         wall = time.time() - t1
+        deltas = telemetry.counters_delta(counters_before)
+        repro_path = ""
+        if telemetry.enabled() and (not ok
+                                    or axes.get("converged") is False):
+            repro_path = dump_repro(seed, ok, axes, deltas)
         if not ok:
             fails += 1
             record["fail_seeds"].append(seed)
             print(msg, flush=True)
         record["n_pass" if ok else "n_fail"] += 1
         trial_rec = {"seed": seed, "ok": ok, "wall_s": round(wall, 1), **axes}
+        if repro_path:
+            trial_rec["repro"] = repro_path
         if telemetry.enabled():
             host = telemetry.host_sample()
             trial_rec["telemetry"] = {
-                "counters": telemetry.counters_delta(counters_before),
+                "counters": deltas,
                 "load1": host["load1"], "polluted": host["polluted"]}
         record["trials"].append(trial_rec)
         save()
+        status = "ok" if ok else "FAIL"
+        if repro_path:
+            status += f" (repro: {repro_path})"
         print(f"[{i + 1}/{args.trials}] seed {seed}: "
-              f"{'ok' if ok else 'FAIL'} ({time.time() - t0:.0f}s)",
+              f"{status} ({time.time() - t0:.0f}s)",
               flush=True)
     if telemetry.enabled():
         # whole-run rollup (span aggregates, cumulative counters, final
