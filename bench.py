@@ -330,6 +330,32 @@ def _best_of(times: list) -> tuple[float, dict]:
     }
 
 
+def _contended_start() -> bool:
+    """Was another workload already loading the host at child start?"""
+    start = _HOST_START or {}
+    return bool((start.get("load1") or 0.0) > 0.5)
+
+
+def _timed_reps(run_rep, reps: int) -> tuple[float, dict, list]:
+    """Best-of-k with one automatic escalation (ISSUE-5 satellite).
+
+    ``run_rep()`` executes one rep and returns its wall. When the first
+    k reps spread more than 10% on an UNCONTENDED run, the set is
+    doubled ONCE before committing — r08 shipped a 17.2%-spread
+    headline where the spread was pure same-host noise; doubling the
+    sample is cheap insurance against committing an unlucky set. A
+    contended run keeps the honest small set (more reps under external
+    load measure the load, and the record carries ``contended`` anyway).
+    """
+    times = [run_rep() for _ in range(reps)]
+    value, stats = _best_of(times)
+    if stats["wall_spread_pct"] > 10.0 and not _contended_start():
+        times += [run_rep() for _ in range(reps)]
+        value, stats = _best_of(times)
+        stats["reps_escalated"] = True
+    return value, stats, times
+
+
 def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
     """Shared mode-bench harness: build, warm, time reps, emit JSON.
 
@@ -347,13 +373,14 @@ def _run_timed(metric: str, budget_s: float, reps: int, setup) -> None:
                 fit, extras = setup()
             with telemetry.span(f"bench.warm.{metric}", kind="compile"):
                 fit()  # compile + warm
-            times = []
-            for _ in range(reps):
+
+            def run_rep():
                 with telemetry.span(f"bench.rep.{metric}", kind="execute"):
                     t0 = time.perf_counter()
                     fit()
-                    times.append(time.perf_counter() - t0)
-            value, rep_stats = _best_of(times)
+                    return time.perf_counter() - t0
+
+            value, rep_stats, _times = _timed_reps(run_rep, reps)
             out = {"metric": metric, "value": round(value, 6), "unit": "s",
                    "vs_baseline": round(budget_s / value, 3),
                    "backend": jax.default_backend() + pinned,
@@ -507,6 +534,200 @@ def _bench_fit_loop(toas, noise, pl_specs, compiled_step,
         "recorder_overhead_pct": round(100.0 * (d_on / d_off - 1.0), 2),
         "trace": d_trace,
     }
+
+
+def _bench_fit_throughput(n_fits: int = 64, reps: int = 3) -> dict:
+    """Scheduled-vs-sequential A/B over >= 64 heterogeneous fits.
+
+    The ISSUE-5 committed measurement: a mixed request stream (4 model
+    structures x 2 TOA buckets, per-request free values) through the
+    throughput scheduler (fingerprint-bucketed batches, pow-2 member
+    padding, double-buffered dispatch) against the SAME fits run
+    one-after-another through the fused single-fit loop
+    (``device_loop.dense_wls_fit`` — the PR-3 baseline). Both sides
+    warm first; ``loop_compile_s`` reports the scheduled side's cold
+    compile and ``compile_amortized_over_n`` the per-fit wall with that
+    compile charged (amortization honesty: a throughput headline must
+    not hide its compile). Parity: every scheduled member must land on
+    its standalone fit (chi2 rel 1e-6, params within 1e-9 relative or
+    5% sigma — whichever is looser) with matching converged flags.
+    """
+    from pint_tpu import telemetry
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, ThroughputScheduler
+
+    base_par = _strip_par_lines(PAR, ("EFAC", "ECORR", "TNREDAMP",
+                                      "TNREDGAM", "TNREDC"))
+    variants = [
+        ("plain", base_par),
+        ("fd", base_par + "FD1 1.0e-5 1\n"),
+        ("jump_efac", base_par + "JUMP FREQ 300 500 1.0e-4 1\n"
+                                 "EFAC FREQ 300 500 1.2\n"),
+        ("phoff", base_par + "PHOFF 0.0 1\n"),
+    ]
+    rng = np.random.default_rng(9)
+    problems = []
+    for i in range(n_fits):
+        _name, par = variants[i % len(variants)]
+        par_i = par.replace("61.485476554",
+                            f"{61.485476554 + 0.05 * (i // 4):.9f}")
+        # two TOA buckets (64 / 128): the member axis AND the TOA
+        # bucket axis of batch formation both exercise
+        n = int(rng.integers(50, 62) if i % 2 == 0
+                else rng.integers(90, 120))
+        truth = get_model(par_i)
+        k = np.arange(n) % 3
+        freqs = np.where(k == 0, 430.0, np.where(k == 1, 1400.0, 800.0))
+        toas = _sim_flagged(truth, n, freqs, int(rng.integers(2 ** 31)))
+        problems.append((par_i, toas))
+
+    # FitRequest service defaults. The tight (25, 1e-8) hyper used by the
+    # single-fit records lengthens every chain ~4x and puts this A/B in
+    # the compute-bound regime (measured ~1.1x on this 2-core host, where
+    # the member axis cannot execute spatially in parallel); the serving
+    # claim is the overhead-bound regime a service actually runs in, so
+    # the A/B uses the scheduler's own request defaults on BOTH sides.
+    hyper = dict(maxiter=20, min_chi2_decrease=1e-3)
+
+    def fresh_models():
+        out = []
+        for par_i, toas in problems:
+            m = get_model(par_i)
+            m["F0"].add_delta(2e-10)
+            out.append((toas, m))
+        return out
+
+    def run_sequential(ms):
+        res = []
+        for toas, m in ms:
+            d, _info, chi2, conv, _cnt = device_loop.dense_wls_fit(
+                toas, m, **hyper)
+            res.append((chi2, conv,
+                        {k: m[k].value_f64 + float(d[k])
+                         for k in m.free_params}))
+        return res
+
+    sched_state = {}
+
+    def run_scheduled():
+        # the scheduler writes fitted values back, so each pass starts
+        # from freshly perturbed models (built OUTSIDE the timed wall).
+        # The timed wall covers submit + drain: per-request fingerprint
+        # canonicalization is mandatory service work, so excluding it
+        # would flatter the scheduled side (the sequential baseline's
+        # wall includes all of ITS per-fit host work)
+        ms = fresh_models()
+        s = ThroughputScheduler(max_queue=max(n_fits, 1))
+        t0 = time.perf_counter()
+        for i, (toas, m) in enumerate(ms):
+            s.submit(FitRequest(toas, m, tag=i, **hyper))
+        t_sub = time.perf_counter() - t0
+        res = s.drain()
+        sched_state.update(res=res, models=ms, last=s.last_drain,
+                           submit_s=t_sub)
+        return time.perf_counter() - t0
+
+    # warm both sides; the scheduled cold wall carries the batched loop
+    # compiles (one per (structure, TOA bucket, member bucket))
+    seq_models = fresh_models()
+    t0 = time.perf_counter()
+    seq_res = run_sequential(seq_models)
+    seq_cold = time.perf_counter() - t0
+    sched_cold = run_scheduled()
+
+    seq_walls, sched_walls = [], []
+    cache_delta = {}
+
+    def one_round():
+        nonlocal cache_delta, seq_res
+        for _ in range(reps):
+            before = telemetry.counters_snapshot()
+            sched_walls.append(run_scheduled())
+            cache_delta = telemetry.counters_delta(before)
+            t0 = time.perf_counter()
+            seq_res = run_sequential(seq_models)
+            seq_walls.append(time.perf_counter() - t0)
+
+    one_round()
+    # rep escalation (same 10%-spread rule as the headline)
+    if (100.0 * (max(sched_walls) - min(sched_walls))
+            / max(min(sched_walls), 1e-12) > 10.0
+            and not _contended_start()):
+        one_round()
+
+    seq_best, sched_best = float(np.min(seq_walls)), float(np.min(sched_walls))
+    last = sched_state["last"]
+
+    # parity: every member vs its standalone fused fit
+    n_bad, max_rel = 0, 0.0
+    for i, r in enumerate(sched_state["res"]):
+        chi2_seq, conv_seq, vals = seq_res[i]
+        m = sched_state["models"][i][1]
+        rel = abs(r.chi2 - float(chi2_seq)) / max(abs(float(chi2_seq)),
+                                                  1e-12)
+        max_rel = max(max_rel, rel)
+        p_ok = all(
+            abs(m[k].value_f64 - vals[k])
+            <= max(1e-9 * abs(vals[k]), 0.05 * (m[k].uncertainty or 0.0))
+            for k in m.free_params)
+        if rel > 1e-6 or bool(r.converged) != bool(conv_seq) or not p_ok:
+            n_bad += 1
+
+    hits = int(cache_delta.get("cache.fit_program.hit", 0))
+    misses = int(cache_delta.get("cache.fit_program.miss", 0))
+    loop_compile_s = max(sched_cold - sched_best, 0.0)
+    return {
+        "n_fits": n_fits,
+        "n_structures": len(variants),
+        "hyper": dict(hyper),
+        "sequential_wall": round(seq_best, 4),
+        "scheduled_wall": round(sched_best, 4),
+        # submit + drain; the last rep's submit share, for the record
+        "submit_s": round(sched_state["submit_s"], 4),
+        "speedup": round(seq_best / max(sched_best, 1e-12), 2),
+        "fits_per_s": round(n_fits / max(sched_best, 1e-12), 2),
+        "fits_per_s_sequential": round(n_fits / max(seq_best, 1e-12), 2),
+        "parity_ok": n_bad == 0,
+        "parity_failures": n_bad,
+        "parity_max_chi2_rel": float(f"{max_rel:.3g}"),
+        "batches": last["batches"],
+        "occupancy": last["occupancy"],
+        "overlap_efficiency": last["overlap_efficiency"],
+        "window": last["window"],
+        # one launch + one fetch per BATCH, pinned by the counters of
+        # the last timed drain
+        "launches_timed_drain": int(cache_delta.get(
+            "fit.device_loop.launches", 0)),
+        "fetches_timed_drain": int(cache_delta.get(
+            "fit.device_loop.fetches", 0)),
+        "program_cache_hit": hits,
+        "program_cache_miss": misses,
+        "program_cache_hit_rate": round(hits / max(1, hits + misses), 4),
+        # amortization honesty (satellite): the compile cost next to the
+        # per-fit wall, charged over this run's n
+        "loop_compile_s": round(loop_compile_s, 3),
+        "sequential_cold_s": round(seq_cold, 3),
+        "compile_amortized_over_n": {
+            "n": n_fits,
+            "per_fit_s": round(sched_best / n_fits, 5),
+            "per_fit_s_with_compile": round(
+                (sched_best + loop_compile_s) / n_fits, 5),
+        },
+        "sequential_walls": [round(t, 4) for t in seq_walls],
+        "scheduled_walls": [round(t, 4) for t in sched_walls],
+        "batch_detail": last["batch_detail"],
+    }
+
+
+def _sim_flagged(model, n: int, freqs, seed: int):
+    """Simulated-from-model TOAs at explicit frequencies (throughput
+    bench helper; the JUMP/EFAC selector structures need 3 bands)."""
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    return make_fake_toas_uniform(53000, 56000, n, model, obs="gbt",
+                                  freq_mhz=np.asarray(freqs),
+                                  error_us=1.0, add_noise=True, seed=seed)
 
 
 def _sim_toas(model, n: int, rng, *, epochs4: bool = False):
@@ -692,6 +913,31 @@ def bench_batch(n_psr: int, toas_per_psr: int, reps: int) -> None:
     _run_timed(metric, 30.0 * (n_psr * toas_per_psr / 6e5), reps, setup)
 
 
+def bench_throughput(n_fits: int, reps: int = 3) -> None:
+    """Standalone throughput mode (PINT_TPU_BENCH_MODE=throughput).
+
+    ``vs_baseline`` here is the scheduled-over-sequential speedup (the
+    sequential fused loop IS the baseline being improved on), so > 1
+    keeps its "faster than the reference" reading.
+    """
+    from pint_tpu import telemetry
+
+    metric = f"fit_throughput_{n_fits}fits_wall"
+    try:
+        with telemetry.span("bench.fit_throughput"):
+            rec = _bench_fit_throughput(n_fits=n_fits, reps=reps)
+        out = {"metric": metric, "value": rec["scheduled_wall"],
+               "unit": "s", "vs_baseline": rec["speedup"],
+               "backend": jax.default_backend(),
+               "host_cores": os.cpu_count(), "mode": "throughput",
+               "fit_throughput": rec}
+        out.update(_telemetry_fields())
+        _emit(out)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
 def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
                  backend: str, device: str, dd_ok_accel: bool) -> None:
     """GLS iteration with the CPU-DD -> accelerator-solve split.
@@ -727,19 +973,21 @@ def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
     # see HybridGLSFitter / gls_solve_normalized
     mode = "hybrid_cpu_dd_accel_solve_host_unnorm"
 
-    times, s1_times = [], []
-    for _ in range(reps):
+    s1_times, state = [], {}
+
+    def run_rep():
         t0 = time.perf_counter()
         s1 = f._stage1(base, deltas)
         jax.block_until_ready(s1)
         s1_times.append(time.perf_counter() - t0)
         with telemetry.span("bench.rep", kind="execute"):
             t0 = time.perf_counter()
-            _, sol = f._iterate(base, deltas)
-            jax.block_until_ready(sol["chi2"])
-            times.append(time.perf_counter() - t0)
-    value, rep_stats = _best_of(times)
-    chi2 = float(np.asarray(sol["chi2"]))
+            _, state["sol"] = f._iterate(base, deltas)
+            jax.block_until_ready(state["sol"]["chi2"])
+            return time.perf_counter() - t0
+
+    value, rep_stats, _times = _timed_reps(run_rep, reps)
+    chi2 = float(np.asarray(state["sol"]["chi2"]))
     stage1_s = float(np.min(s1_times))
 
     out_fields = {
@@ -805,6 +1053,13 @@ _FIT_LOOP_COMPACT = ("host_wall", "device_wall", "host_syncs_host_loop",
                      "device_wall_recorder_off", "recorder_overhead_pct",
                      "error")
 
+# the throughput A/B's compact footprint (acceptance headline numbers;
+# walls/batch detail live in BENCH_DETAIL)
+_THROUGHPUT_COMPACT = ("n_fits", "sequential_wall", "scheduled_wall",
+                       "speedup", "fits_per_s", "parity_ok", "occupancy",
+                       "batches", "program_cache_hit_rate",
+                       "loop_compile_s", "error")
+
 
 def _compact(record: dict, detail_name: str) -> dict:
     out = {k: record[k] for k in _COMPACT_KEYS if k in record}
@@ -812,6 +1067,10 @@ def _compact(record: dict, detail_name: str) -> dict:
     fl = record.get("fit_loop")
     if isinstance(fl, dict):
         out["fit_loop"] = {k: fl[k] for k in _FIT_LOOP_COMPACT if k in fl}
+    ft = record.get("fit_throughput")
+    if isinstance(ft, dict):
+        out["fit_throughput"] = {k: ft[k] for k in _THROUGHPUT_COMPACT
+                                 if k in ft}
     pta = record.get("pta")
     if isinstance(pta, dict):
         out["pta"] = {k: pta[k] for k in _COMPACT_KEYS if k in pta}
@@ -828,8 +1087,8 @@ def _compact(record: dict, detail_name: str) -> dict:
     for key in ("error", "fallback_reason"):
         if not fits() and isinstance(out.get(key), str):
             out[key] = out[key][:200]
-    for key in ("pta", "fit_loop", "mfu_pct", "gflops_s",
-                "design_matrix_ms_per_toa", "mode", "device",
+    for key in ("pta", "fit_throughput", "fit_loop", "mfu_pct",
+                "gflops_s", "design_matrix_ms_per_toa", "mode", "device",
                 "load1_start", "wall_median", "wall_spread_pct",
                 "fallback_reason"):
         if fits():
@@ -854,7 +1113,7 @@ def _finish(record: dict) -> None:
     detail_path = os.environ.get(
         "PINT_TPU_BENCH_DETAIL",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_DETAIL_r08.json"))
+                     "BENCH_DETAIL_r09.json"))
     try:
         with open(detail_path, "w") as fh:
             json.dump(record, fh, indent=1)
@@ -922,6 +1181,9 @@ def main() -> None:
             sys.exit(1)
         print(json.dumps(res))
         ok = res.get("value", -1.0) > 0 and "host_polluted" in res
+        # serve smoke acceptance: parity proven, occupancy reported
+        serve = res.get("serve") or {}
+        ok = ok and serve.get("parity_ok") is True and "occupancy" in serve
         if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
@@ -1006,6 +1268,55 @@ def main() -> None:
                     f"{(cpu_result or {}).get('error', cpu_fail)}"})
 
 
+def _smoke_serve() -> dict:
+    """CI serve smoke (ISSUE-5 satellite): 8 mixed requests through the
+    throughput scheduler — two structures in a 5/3 split so member
+    padding, grouping AND multi-batch formation run on every CI pass —
+    each request checked against its standalone fused fit."""
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, ThroughputScheduler
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par_a = ("PSRJ FAKE_SERVE\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+             "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+             "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+             "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    par_b = par_a.replace("DM 223.9", "DM 223.9 1")  # DM free: structure 2
+    hyper = dict(maxiter=10, min_chi2_decrease=1e-7)
+    reqs, standalone = [], []
+    for i in range(8):
+        par = (par_a if i < 5 else par_b).replace(
+            "61.485476554", f"{61.485476554 + 1e-3 * i:.9f}")
+        truth = get_model(par)
+        toas = make_fake_toas_uniform(53000, 56000, 40, truth, obs="@",
+                                      freq_mhz=np.array([1400.0, 430.0]),
+                                      error_us=2.0, add_noise=True,
+                                      seed=50 + i)
+        m = get_model(par)
+        m["F0"].add_delta(2e-10)
+        reqs.append(FitRequest(toas, m, tag=i, **hyper))
+        m2 = get_model(par)
+        m2["F0"].add_delta(2e-10)
+        standalone.append((toas, m2))
+    s = ThroughputScheduler(max_queue=8)
+    for r in reqs:
+        s.submit(r)
+    res = s.drain()
+    bad = 0
+    for r, (toas, m2) in zip(res, standalone):
+        _d, _i, chi2, conv, _c = device_loop.dense_wls_fit(toas, m2,
+                                                           **hyper)
+        rel = abs(r.chi2 - chi2) / max(abs(chi2), 1e-12)
+        if rel > 1e-6 or bool(r.converged) != bool(conv):
+            bad += 1
+    last = s.last_drain
+    return {"fits": len(res), "batches": last["batches"],
+            "occupancy": last["occupancy"],
+            "overlap_efficiency": last["overlap_efficiency"],
+            "parity_ok": bad == 0, "parity_failures": bad}
+
+
 def _run_smoke() -> None:
     """CI smoke: one tiny CPU fit proving the telemetry pipeline end-to-end.
 
@@ -1033,12 +1344,16 @@ def _run_smoke() -> None:
         with telemetry.span("bench.fit"):
             f = Fitter.auto(toas, model)
             chi2 = f.fit_toas(maxiter=3)
+        # scheduler smoke (ISSUE 5): the serve path runs every CI pass
+        with telemetry.span("bench.serve_smoke"):
+            serve = _smoke_serve()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
                "backend": jax.default_backend(),
                "chi2": round(float(chi2), 3),
-               "converged": bool(f.converged)}
+               "converged": bool(f.converged),
+               "serve": serve}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001
@@ -1056,7 +1371,7 @@ def _main_guarded() -> None:
     # best-of-k needs k >= 3 for a meaningful spread (VERDICT Weak #2)
     reps = max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "5")))
     mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
-    if mode in ("pta", "wideband", "batch"):
+    if mode in ("pta", "wideband", "batch", "throughput"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
@@ -1069,6 +1384,9 @@ def _main_guarded() -> None:
             bench_pta(n_psr, max(1, n // n_psr), reps)
         elif mode == "wideband":
             bench_wideband(n, reps)
+        elif mode == "throughput":
+            bench_throughput(int(os.environ.get("PINT_TPU_BENCH_FITS",
+                                                "64")), reps)
         else:
             bench_batch(n_psr, max(1, n // n_psr), reps)
         return
@@ -1123,7 +1441,6 @@ def _main_guarded() -> None:
             jax.block_until_ready(out)
         compile_s = time.perf_counter() - t0
 
-        times = []
         # optional XLA trace for the timed region (SURVEY §5 tracing
         # row): one rep under telemetry.profile_span, gated on
         # PINT_TPU_PROFILE_DIR (the legacy PINT_TPU_BENCH_PROFILE
@@ -1137,14 +1454,17 @@ def _main_guarded() -> None:
             with telemetry.profile_span("bench.profiled_rep"):
                 out = step(base, deltas, toas, noise)
                 jax.block_until_ready(out)
-        for _ in range(reps):
+        state = {}
+
+        def run_rep():
             with telemetry.span("bench.rep", kind="execute"):
                 t0 = time.perf_counter()
-                out = step(base, deltas, toas, noise)
-                jax.block_until_ready(out)
-                times.append(time.perf_counter() - t0)
-        value, rep_stats = _best_of(times)
-        chi2 = float(np.asarray(out[1]["chi2"]))
+                state["out"] = step(base, deltas, toas, noise)
+                jax.block_until_ready(state["out"])
+                return time.perf_counter() - t0
+
+        value, rep_stats, _times = _timed_reps(run_rep, reps)
+        chi2 = float(np.asarray(state["out"][1]["chi2"]))
 
         # secondary BASELINE metric: jacfwd design-matrix build alone
         names = model.free_params
@@ -1205,6 +1525,15 @@ def _main_guarded() -> None:
                     toas, noise, pl_specs, step, reps=5)
         except Exception as e:  # noqa: BLE001
             out_fields["fit_loop"] = {"error": f"{type(e).__name__}: {e}"}
+        # many-fit throughput A/B (ISSUE 5): the serving claim as a
+        # committed measurement. Guarded like fit_loop.
+        try:
+            with telemetry.span("bench.fit_throughput"):
+                out_fields["fit_throughput"] = _bench_fit_throughput(
+                    reps=reps)
+        except Exception as e:  # noqa: BLE001
+            out_fields["fit_throughput"] = {
+                "error": f"{type(e).__name__}: {e}"}
 
         dm_s = dm_ms_per_toa * n / 1e3
         la_frac = max(0.0, 1.0 - dm_s / value)

@@ -91,6 +91,43 @@ def bucket_size(n: int, *, multiple: int = 1) -> int:
     return _round_up(b, multiple)
 
 
+def member_bucket_size(b: int, *, floor: int = 1) -> int:
+    """Canonical member count for a batched fit program of ``b`` members.
+
+    The pulsar-batch analogue of :func:`bucket_size`: next power of two
+    (floored at ``floor``), so the throughput scheduler's batches of
+    similar-but-unequal request counts execute ONE vmapped loop program
+    per (structure, TOA bucket, member bucket) instead of one per exact
+    batch size. Pow-2 rounding bounds the padded-member tax at < 2x and
+    guarantees occupancy >= 0.5 whenever ``b >= floor / 2`` (dummy
+    members replicate a real member, so they converge with it and add
+    no loop iterations — see parallel.batch). Disabled
+    (``PINT_TPU_FIT_BUCKETING=0``) it degenerates to ``max(b, floor)``.
+    """
+    if b <= 0:
+        raise ValueError(f"member_bucket_size needs b >= 1, got {b}")
+    floor = max(1, int(floor))
+    if not enabled():
+        return max(b, floor)
+    return max(floor, 1 << (b - 1).bit_length())
+
+
+def note_batch_occupancy(n_real: int, n_members: int) -> None:
+    """Account one batched-fit launch's member occupancy.
+
+    Feeds the throughput-engine acceptance numbers: cumulative
+    ``batch.members.real`` / ``batch.members.pad`` counters (the
+    process-wide occupancy is real / (real + pad)) plus a
+    ``batch.occupancy.last`` gauge for the most recent batch.
+    """
+    if not _tele_core._enabled:
+        return
+    _tele_counters.inc("batch.members.real", n_real)
+    _tele_counters.inc("batch.members.pad", max(0, n_members - n_real))
+    _tele_counters.set_gauge("batch.occupancy.last",
+                             n_real / max(1, n_members))
+
+
 def pipeline_bucket_size(n: int) -> int:
     """Bucket policy of the fused TOA-build pipeline (pad + slice back).
 

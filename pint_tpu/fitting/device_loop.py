@@ -316,12 +316,49 @@ def _resolve_program(entry, deltas0, operands, hyper):
     return prog, (prog if prog is not entry["jit"] else None), sig
 
 
-def _launch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
-            shape):
-    """Shared launch/fetch tail of the scalar and batched runners: one
-    cached-program lookup, one launch, ONE device->host sync, counters
-    and the flight-recorder trace re-emitted to telemetry from the
-    fetched carry."""
+class InFlightFit:
+    """A dispatched fused fit whose single host fetch has not happened.
+
+    The handle the throughput scheduler's double-buffered pipeline holds
+    while the device executes: :func:`_dispatch` enqueued the whole loop
+    program (JAX async dispatch — the call returns as soon as the work
+    is queued) and the host is free to pack/whiten/pad the NEXT batch.
+    :meth:`fetch` performs the fit's ONE device->host sync, re-emits the
+    carried counters and flight-recorder trace, and returns the same
+    tuple the synchronous runners return.
+    """
+
+    __slots__ = ("_out", "_kind", "_result")
+
+    def __init__(self, out, kind):
+        self._out = out
+        self._kind = kind
+        self._result = None
+
+    def fetch(self):
+        """Block on the single device->host sync; idempotent."""
+        if self._result is None:
+            with telemetry.span(f"{self._kind}.fetch", kind="execute"):
+                # the ONE device->host sync of the whole fit
+                deltas, info, chi2, converged, counters, trace = \
+                    jax.device_get(self._out)
+            self._out = None  # free the device buffers' host references
+            telemetry.inc("fit.device_loop.fetches")
+            counters = {k: int(v) for k, v in counters.items()}
+            for k, v in counters.items():
+                if v:
+                    telemetry.inc(f"fit.{k}", v)
+            if trace is not None:
+                recorder.emit_device_trace(self._kind, trace)
+            self._result = (deltas, info, chi2, converged, counters)
+        return self._result
+
+
+def _dispatch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
+              shape) -> InFlightFit:
+    """Shared launch head of the runners: one cached-program lookup, one
+    launch, NO host sync — the returned handle's :meth:`InFlightFit
+    .fetch` is the fit's single device->host sync."""
     from pint_tpu.bucketing import note_program
 
     # the recorder changes the carry (hence the compiled program), so
@@ -348,16 +385,14 @@ def _launch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
             if sig is not None:
                 entry["aot"][sig] = entry["jit"]
             out = entry["jit"](deltas0, operands, *hyper)
-        # the ONE device->host sync of the whole fit
-        deltas, info, chi2, converged, counters, trace = jax.device_get(out)
-    telemetry.inc("fit.device_loop.fetches")
-    counters = {k: int(v) for k, v in counters.items()}
-    for k, v in counters.items():
-        if v:
-            telemetry.inc(f"fit.{k}", v)
-    if trace is not None:
-        recorder.emit_device_trace(kind, trace)
-    return deltas, info, chi2, converged, counters
+    return InFlightFit(out, kind)
+
+
+def _launch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
+            shape):
+    """Synchronous dispatch+fetch: one launch, ONE device->host sync."""
+    return _dispatch(builder, key, deltas0, operands, hyper, kind=kind,
+                     fingerprint=fingerprint, shape=shape).fetch()
 
 
 def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
@@ -396,7 +431,7 @@ def _bwhere(mask, a, b):
 _BATCH_COUNTERS = ("iterations", "accepts", "halvings", "step_evals")
 
 
-def build_batched_loop(run, record=False):
+def build_batched_loop(run, probe=None, record=False):
     """Batched analogue of :func:`build_damped_loop`.
 
     ``run(deltas, operands) -> (new_deltas, info)`` is the vmapped step
@@ -409,12 +444,26 @@ def build_batched_loop(run, record=False):
     ``lam`` for already-settled members, and a final refresh evaluation
     only when the last trial left some member away from its kept point.
 
+    ``probe(deltas, operands) -> (B,) chi2`` is the optional residual-
+    only evaluator for halved trials, the batched form of the scalar
+    loop's two-level structure: a full body whose trial a member
+    rejects drops that member into an inner member-wise probe ``while``
+    (synchronized halving depths, found members freeze their lam) and
+    the NEXT full body re-checks every probe-accepted candidate with
+    the authoritative step value. Without it every halving level is a
+    full vmapped step evaluation — the ISSUE-5 throughput A/B measured
+    ~84% of batched device time in those halving bodies (a damped fit
+    near its optimum burns its halving budget on the final iteration,
+    and a full body costs ~(P+1)x a probe body).
+
     The flight recorder (``record=True``) traces the per-member
     judgment: each body appends ``(chi2, lam, accepted)`` (B,)-vectors
     — ``lam`` is the member-wise damping actually applied (0 for
     settled members and the init/final passes) — so a non-converging
     member of a batched fit is diagnosable from the single fetch.
     """
+    if probe is not None:
+        return _build_batched_probe_loop(run, probe, record=record)
     trace_cap = recorder.trace_len() if record else 0
 
     def loop(deltas0, operands, maxiter, min_dec, max_halvings):
@@ -553,21 +602,261 @@ def build_batched_loop(run, record=False):
     return loop
 
 
-def run_damped_batched(run, deltas0, operands, *, key, maxiter=20,
-                       min_chi2_decrease=1e-3, max_step_halvings=8,
-                       kind="device_loop_batched", fingerprint=None,
-                       shape=()):
+_BATCH_PROBE_COUNTERS = _BATCH_COUNTERS + ("probe_evals", "probe_rejects")
+
+
+def _build_batched_probe_loop(run, probe, record=False):
+    """Probe flavor of :func:`build_batched_loop` (see there).
+
+    Each member's judged events are its standalone damped loop's
+    exactly — the vmapped step is member-diagonal, so member m's
+    accept/halve/converge walk depends only on its own state — but the
+    batch executes them CONTINUOUSLY: every full body advances every
+    unfinished member's own state machine by one authoritative
+    evaluation (its iteration-opening lam=1 trial or the re-check of a
+    probe-found candidate), and members halving at the same time share
+    member-wise probe rounds. There is no batch-wide iteration barrier:
+    the lockstep no-probe loop makes a member that accepted early ride
+    (at lam 0, but at full vmapped-body cost) while slower members
+    drain their halving ladders, which measured ~2.4x the per-member
+    full evaluations of the sequential fused loop on the ISSUE-5
+    throughput A/B — the barrier, not the vectorization, was the cost.
+    Finished members still occupy their vmap lane (static shapes) but
+    add no extra bodies.
+
+    Probe semantics match the scalar loop: halved candidates are judged
+    by the residual-only ``probe(deltas, operands) -> (B,) chi2``
+    (computing the step's own ``chi2_at_input`` expression), a found
+    candidate is re-checked by the authoritative full step, and a
+    contradicting re-check counts a ``probe_reject`` and resumes that
+    member's ladder one level deeper. ``info`` is carried member-wise
+    at each member's last ADOPTED point, so no final refresh pass is
+    needed.
+    """
+    trace_cap = recorder.trace_len() if record else 0
+
+    def loop(deltas0, operands, maxiter, min_dec, max_halvings):
+        maxiter = jnp.maximum(jnp.asarray(maxiter, jnp.int32), 1)
+        max_halvings = jnp.maximum(jnp.asarray(max_halvings, jnp.int32), 1)
+        min_dec = jnp.asarray(min_dec, jnp.float64)
+
+        B = int(np.shape(jax.tree.leaves(deltas0)[0])[0])
+        info_shapes = jax.eval_shape(lambda d: run(d, operands)[1],
+                                     deltas0)
+        c0 = {
+            "deltas": deltas0,
+            "new_deltas": deltas0,
+            "dx": jax.tree.map(jnp.zeros_like, deltas0),
+            "info": _zeros_like_shapes(info_shapes),
+            "chi2": jnp.zeros(B, jnp.float64),
+            "lam": jnp.ones(B, jnp.float64),
+            "h": jnp.zeros(B, jnp.int32),
+            "it": jnp.zeros(B, jnp.int32),
+            "init": jnp.ones(B, bool),    # member awaits its init eval
+            "pend": jnp.ones(B, bool),    # candidate awaits a full eval
+            "fin": jnp.zeros(B, bool),    # member's fit is finished
+            "converged": jnp.zeros(B, bool),
+            "done": jnp.bool_(False),
+            **{k: jnp.zeros((), jnp.int32)
+               for k in _BATCH_PROBE_COUNTERS},
+        }
+        if record:
+            c0["trace"] = {
+                "chi2": jnp.zeros((trace_cap, B), jnp.float64),
+                "lam": jnp.zeros((trace_cap, B), jnp.float64),
+                "accepted": jnp.zeros((trace_cap, B), bool),
+            }
+            c0["tn"] = jnp.zeros((), jnp.int32)
+
+        def body(c):
+            # one full evaluation: every pending member at its own
+            # candidate (init members at their start point — dx is 0),
+            # finished/probe-idle members riding at their kept point
+            act = c["pend"] & (~c["fin"])
+            lam_j = jnp.where(c["init"], 0.0,
+                              jnp.where(act, c["lam"], 0.0))
+            trial = jax.tree.map(
+                lambda d, x: d + jnp.reshape(
+                    lam_j, lam_j.shape + (1,) * (jnp.ndim(x) - 1)) * x,
+                c["deltas"], c["dx"])
+            t_new, t_info = run(trial, operands)
+            t_chi2 = t_info["chi2_at_input"]
+
+            norm = act & (~c["init"])
+            better = t_chi2 <= c["chi2"] + _EPS
+            newly = norm & better
+            rej = norm & (~better)
+            adopt = c["init"] | newly
+
+            deltas_n = jax.tree.map(lambda t, d: _bwhere(newly, t, d),
+                                    trial, c["deltas"])
+            new_n = jax.tree.map(lambda t, d: _bwhere(adopt, t, d),
+                                 t_new, c["new_deltas"])
+            info_n = jax.tree.map(lambda t, d: _bwhere(adopt, t, d),
+                                  t_info, c["info"])
+            decrease = c["chi2"] - t_chi2
+            chi2_n = jnp.where(adopt, t_chi2, c["chi2"])
+            conv_now = newly & (decrease < min_dec)
+            maxed = newly & (c["it"] >= maxiter)
+            fin_acc = conv_now | maxed
+
+            # accepting members open their next iteration immediately
+            # (member-wise dx from THIS body's proposal); nobody waits
+            # for a batch-wide iteration boundary
+            startm = adopt & (~fin_acc)
+            dx_n = jax.tree.map(
+                lambda a, b, d: _bwhere(startm, a - b, d),
+                new_n, deltas_n, c["dx"])
+
+            # rejected members walk their probe ladder (member-wise
+            # depths; found members freeze their candidate)
+            def inner_cond(s):
+                return jnp.any(s["seek"] & (s["hp"] < max_halvings))
+
+            def inner_body(s):
+                sk = s["seek"] & (s["hp"] < max_halvings)
+                lam_pj = jnp.where(sk, s["lam_p"], 0.0)
+                cand = jax.tree.map(
+                    lambda d, x: d + jnp.reshape(
+                        lam_pj,
+                        lam_pj.shape + (1,) * (jnp.ndim(x) - 1)) * x,
+                    deltas_n, dx_n)
+                pc = probe(cand, operands)
+                fnd = sk & (pc <= chi2_n + _EPS)
+                cont = sk & (~fnd)
+                n_sk = jnp.sum(sk).astype(jnp.int32)
+                return {
+                    "seek": s["seek"] & (~fnd),
+                    "found": s["found"] | fnd,
+                    "hp": jnp.where(cont, s["hp"] + 1, s["hp"]),
+                    "lam_p": jnp.where(cont, s["lam_p"] * 0.5,
+                                       s["lam_p"]),
+                    "halv": s["halv"] + n_sk,
+                    "pev": s["pev"] + n_sk,
+                }
+
+            s = jax.lax.while_loop(inner_cond, inner_body, {
+                "seek": rej,
+                "found": jnp.zeros(B, bool),
+                "hp": c["h"] + 1,
+                "lam_p": c["lam"] * 0.5,
+                "halv": jnp.zeros((), jnp.int32),
+                "pev": jnp.zeros((), jnp.int32),
+            })
+            probe_found = s["found"]
+            # no downhill step left: at the numerical optimum
+            exhausted = rej & (~s["found"])
+
+            conv_n = c["converged"] | conv_now | exhausted
+            fin_n = c["fin"] | fin_acc | exhausted
+            pend_n = startm | probe_found
+
+            out = {
+                "deltas": deltas_n,
+                "new_deltas": new_n,
+                "dx": dx_n,
+                "info": info_n,
+                "chi2": chi2_n,
+                "lam": jnp.where(startm, 1.0,
+                                 jnp.where(probe_found, s["lam_p"],
+                                           c["lam"])),
+                "h": jnp.where(startm, 0,
+                               jnp.where(probe_found, s["hp"], c["h"])),
+                "it": jnp.where(c["init"], 1,
+                                jnp.where(newly & (~fin_acc),
+                                          c["it"] + 1, c["it"])),
+                "init": jnp.zeros(B, bool),
+                "pend": pend_n,
+                "fin": fin_n,
+                "converged": conv_n,
+                "done": jnp.all(fin_n),
+                "iterations": c["iterations"]
+                + jnp.sum(c["init"] | (newly & (~fin_acc)))
+                .astype(jnp.int32),
+                "accepts": c["accepts"]
+                + jnp.sum(newly).astype(jnp.int32),
+                "halvings": c["halvings"] + s["halv"],
+                "probe_evals": c["probe_evals"] + s["pev"],
+                # a rejecting full at h>0 is the re-check contradicting
+                # its member's probe acceptance
+                "probe_rejects": c["probe_rejects"]
+                + jnp.sum(rej & (c["h"] > 0)).astype(jnp.int32),
+                "step_evals": c["step_evals"] + 1,
+            }
+            if record:
+                idx = jnp.mod(c["tn"], trace_cap)
+                tr = c["trace"]
+                out["trace"] = {
+                    "chi2": tr["chi2"].at[idx].set(t_chi2),
+                    "lam": tr["lam"].at[idx].set(lam_j),
+                    "accepted": tr["accepted"].at[idx].set(newly),
+                }
+                out["tn"] = c["tn"] + 1
+            return out
+
+        out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
+        counters = {k: out[k] for k in _BATCH_PROBE_COUNTERS}
+        trace = {"n": out["tn"], **out["trace"]} if record else None
+        return (out["deltas"], out["info"], out["chi2"], out["converged"],
+                counters, trace)
+
+    return loop
+
+
+def run_damped_batched(run, deltas0, operands, *, key, probe=None,
+                       maxiter=20, min_chi2_decrease=1e-3,
+                       max_step_halvings=8, kind="device_loop_batched",
+                       fingerprint=None, shape=()):
     """Batched :func:`run_damped`: one launch + one fetch for the array.
 
     Returns ``(deltas, info, chi2, converged, counters)`` with per-
     member (B,) chi2 and converged arrays, fetched to host numpy.
     """
-    deltas, info, chi2, converged, counters = _launch(
-        lambda rec: build_batched_loop(run, record=rec), key, deltas0,
-        operands,
+    return dispatch_damped_batched(
+        run, deltas0, operands, key=key, probe=probe, maxiter=maxiter,
+        min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings, kind=kind,
+        fingerprint=fingerprint, shape=shape).fetch()
+
+
+class InFlightBatchedFit:
+    """A dispatched batched fit; :meth:`fetch` is its one host sync.
+
+    Thin per-member-typed wrapper over :class:`InFlightFit` so the
+    serve pipeline gets numpy (B,) chi2/converged vectors — the batched
+    loop's per-member convergence early-exit mask arrives in the same
+    single fetch as the fit result.
+    """
+
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner: InFlightFit):
+        self._inner = inner
+
+    def fetch(self):
+        deltas, info, chi2, converged, counters = self._inner.fetch()
+        return (deltas, info, np.asarray(chi2), np.asarray(converged),
+                counters)
+
+
+def dispatch_damped_batched(run, deltas0, operands, *, key, probe=None,
+                            maxiter=20, min_chi2_decrease=1e-3,
+                            max_step_halvings=8,
+                            kind="device_loop_batched", fingerprint=None,
+                            shape=()) -> InFlightBatchedFit:
+    """Asynchronous :func:`run_damped_batched`: launch without blocking.
+
+    The throughput scheduler's building block (pint_tpu.serve): the
+    whole fused batched loop is enqueued and the call returns
+    immediately, so the host can prepare the next batch while the
+    device executes this one. ``.fetch()`` on the returned handle
+    performs the fit's single device->host sync.
+    """
+    return InFlightBatchedFit(_dispatch(
+        lambda rec: build_batched_loop(run, probe, record=rec), key,
+        deltas0, operands,
         (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
-        fingerprint=fingerprint, shape=shape)
-    return deltas, info, np.asarray(chi2), np.asarray(converged), counters
+        fingerprint=fingerprint, shape=shape))
 
 
 # ----------------------------------------------------------------------

@@ -20,9 +20,32 @@ from pint_tpu.fitting.fitter import wls_solve_gram
 Array = jax.Array
 
 
+def _circular_recenter(resid_turns, w):
+    """Rotate wrapped phase residuals by their weighted circular mean.
+
+    Anchorless (``abs_phase=False``) wrapped residuals carry an
+    arbitrary constant offset; when it lands near ±0.5 turns the
+    per-TOA wrap straddles the boundary (half the residuals come out
+    +0.5, half −0.5) and the weighted-mean subtraction destroys phase
+    coherence — chi2 jumps to wrap scale and the damped loop "converges"
+    to garbage. The circular mean is offset-equivariant, so subtracting
+    it and re-wrapping re-centers the cluster at 0 whatever the offset;
+    the linear mean subtraction / PHOFF column then sees coherent
+    residuals. A pure re-anchoring: no effect on the jacobian, and the
+    post-rotation residuals equal the un-rotated ones minus a constant
+    whenever no TOA actually wraps.
+    """
+    ang = 2.0 * jnp.pi * resid_turns
+    circ = jnp.arctan2(jnp.sum(jnp.sin(ang) * w),
+                       jnp.sum(jnp.cos(ang) * w)) / (2.0 * jnp.pi)
+    shifted = resid_turns - circ
+    return shifted - jnp.round(shifted)
+
+
 def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
-                  masked: bool = False, params: list[str] | None = None):
-    """Build ``step(base, deltas, toas[, mask]) -> (new_deltas, info)``.
+                  masked: bool = False, params: list[str] | None = None,
+                  traced_tzr: bool = False):
+    """Build ``step(base, deltas, toas[, mask][, tzr]) -> (new_deltas, info)``.
 
     `base` is the DD linearization point (model.base_dd()); `deltas` the
     current float64 corrections per free parameter. One call performs a
@@ -32,28 +55,35 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
 
     F0 is read from the traced `base`, so the same compiled step serves a
     ``vmap``-ed batch of pulsars with different spin frequencies.
-    ``abs_phase=False`` skips the TZR anchor (the batched path, where the
-    weighted-mean subtraction absorbs the absolute phase anyway).
+    ``abs_phase=False`` skips the TZR anchor (the anchorless batched
+    fallback; the wrapped residuals are re-centered on their circular
+    mean first — see :func:`_circular_recenter`). ``traced_tzr=True``
+    instead takes the TZR anchor table as a trailing *traced* argument:
+    the batched fitter stacks one-row per-member TZR tables so every
+    batch member computes the exact dense anchored convention.
 
-    ``masked=True`` adds a 4th argument ``mask: {name: 0/1 scalar}``
+    ``masked=True`` adds a ``mask: {name: 0/1 scalar}`` argument
     that zeroes design-matrix columns — the parameter-superset mechanism
     letting one compiled step serve heterogeneous pulsars (a masked
     column solves to a zero delta; the batched fitter skips its update).
     """
-    if tzr is None and abs_phase:
+    if tzr is None and abs_phase and not traced_tzr:
         tzr = model.get_tzr_toas()
-    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
+    anchorless = tzr is None and not traced_tzr
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase,
+                                   traced_tzr=traced_tzr)
     names = params if params is not None else model.free_params
     # explicit PHOFF replaces the implicit offset column + mean
     # subtraction (see TimingModel.designmatrix)
     has_phoff = model.has_component("PhaseOffset")
     off = 0 if has_phoff else 1
 
-    def step(base, deltas, toas, mask=None):
+    def step(base, deltas, toas, mask=None, tzr_toas=None):
         f0 = base["F0"].hi + base["F0"].lo
 
         def total_phase(d):
-            ph = phase_fn(base, d, toas)
+            ph = (phase_fn(base, d, toas, tzr_toas) if traced_tzr
+                  else phase_fn(base, d, toas))
             # aux carries the wrapped fractional phase from the SAME
             # primal evaluation: one DD pipeline trace serves both the
             # residual and the jacobian (the guarded primal keeps the
@@ -70,6 +100,8 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
         w = 1.0 / jnp.square(err)
 
         J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
+        if anchorless:
+            resid_turns = _circular_recenter(resid_turns, w)
         if not has_phoff:
             resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
@@ -102,6 +134,12 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
                             "chi2_at_input": chi2_in}
 
     if not masked:
+        if traced_tzr:
+            def step_unmasked_tzr(base, deltas, toas, tzr_toas):
+                return step(base, deltas, toas, None, tzr_toas)
+
+            return step_unmasked_tzr
+
         def step_unmasked(base, deltas, toas):
             return step(base, deltas, toas)
 
@@ -111,7 +149,7 @@ def make_wls_step(model, tzr=None, *, abs_phase: bool = True,
 
 def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
                     params: list[str] | None = None, vmapped: bool = False,
-                    counted: bool = True):
+                    counted: bool = True, traced_tzr: bool = False):
     """Jitted :func:`make_wls_step`, shared across fitter instances.
 
     ``jax.jit(make_wls_step(model))`` compiles a fresh program per
@@ -129,12 +167,16 @@ def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
     fire once at trace time and never again.
     """
     key = ("wls_step", abs_phase, masked,
-           tuple(params) if params is not None else None, vmapped)
+           tuple(params) if params is not None else None, vmapped,
+           traced_tzr)
 
     def build(owner):
         fn = make_wls_step(owner, abs_phase=abs_phase, masked=masked,
-                           params=params)
-        return jax.vmap(fn, in_axes=(0, 0, 0, 0)) if vmapped else fn
+                           params=params, traced_tzr=traced_tzr)
+        if not vmapped:
+            return fn
+        n_args = 3 + (1 if masked else 0) + (1 if traced_tzr else 0)
+        return jax.vmap(fn, in_axes=(0,) * n_args)
 
     cached = model._cached_jit(key, build)
     if not counted:
@@ -142,7 +184,8 @@ def jitted_wls_step(model, *, abs_phase: bool = True, masked: bool = False,
     return _counted_step(cached, key, model)
 
 
-def make_resid_fn(model, tzr=None, *, abs_phase: bool = True):
+def make_resid_fn(model, tzr=None, *, abs_phase: bool = True,
+                  traced_tzr: bool = False):
     """Build ``resid(base, deltas, toas) -> (r, err, w)`` — the shared
     residual-only evaluator: one phase pass (no jacfwd tangents),
     wrapped fractional residual in seconds with the step functions'
@@ -151,17 +194,24 @@ def make_resid_fn(model, tzr=None, *, abs_phase: bool = True):
     path (WLS/GLS device-loop probes, the hybrid CPU probe stage) so
     the convention cannot drift from the full steps' ``chi2_at_input``.
     """
-    if tzr is None and abs_phase:
+    if tzr is None and abs_phase and not traced_tzr:
         tzr = model.get_tzr_toas()
-    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
+    anchorless = tzr is None and not traced_tzr
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase,
+                                   traced_tzr=traced_tzr)
     has_phoff = model.has_component("PhaseOffset")
 
-    def resid(base, deltas, toas):
+    def resid(base, deltas, toas, tzr_toas=None):
         f0 = base["F0"].hi + base["F0"].lo
-        ph = phase_fn(base, deltas, toas)
+        ph = (phase_fn(base, deltas, toas, tzr_toas) if traced_tzr
+              else phase_fn(base, deltas, toas))
         res = ph.frac.hi + ph.frac.lo
         err = model.scaled_toa_uncertainty(toas)
         w = 1.0 / jnp.square(err)
+        if anchorless:
+            # same circular re-centering as make_wls_step, so the probe
+            # chi2 stays the step's exact chi2_at_input expression
+            res = _circular_recenter(res, w)
         if not has_phoff:
             res = res - jnp.sum(res * w) / jnp.sum(w)
         return res / f0, err, w
@@ -169,7 +219,8 @@ def make_resid_fn(model, tzr=None, *, abs_phase: bool = True):
     return resid
 
 
-def make_wls_probe(model, tzr=None, *, abs_phase: bool = True):
+def make_wls_probe(model, tzr=None, *, abs_phase: bool = True,
+                   traced_tzr: bool = False):
     """Build ``probe(base, deltas, toas) -> chi2`` — residual-only WLS chi2.
 
     The device-loop analogue of the hybrid fitter's cheap trial judge:
@@ -177,9 +228,20 @@ def make_wls_probe(model, tzr=None, *, abs_phase: bool = True):
     exactly the ``chi2_at_input`` expression of :func:`make_wls_step`.
     A halved trial in the fused loop costs this instead of a full step;
     the accepted point is still re-judged by the full step's
-    authoritative value (see fitting.device_loop).
+    authoritative value (see fitting.device_loop). ``traced_tzr=True``
+    takes the TZR anchor table as a trailing traced argument (the
+    batched fitter's per-member stacked anchors, as in
+    :func:`make_wls_step`).
     """
-    resid = make_resid_fn(model, tzr, abs_phase=abs_phase)
+    resid = make_resid_fn(model, tzr, abs_phase=abs_phase,
+                          traced_tzr=traced_tzr)
+
+    if traced_tzr:
+        def probe_tzr(base, deltas, toas, tzr_toas):
+            r, _err, w = resid(base, deltas, toas, tzr_toas)
+            return jnp.sum(jnp.square(r) * w)
+
+        return probe_tzr
 
     def probe(base, deltas, toas):
         r, _err, w = resid(base, deltas, toas)
@@ -188,13 +250,21 @@ def make_wls_probe(model, tzr=None, *, abs_phase: bool = True):
     return probe
 
 
-def jitted_wls_probe(model, *, abs_phase: bool = True):
+def jitted_wls_probe(model, *, abs_phase: bool = True,
+                     traced_tzr: bool = False, vmapped: bool = False):
     """Model-cache-shared :func:`make_wls_probe` (same rationale as
     :func:`jitted_wls_step`; uncounted — it is traced into the fused
     loop program, never dispatched on its own)."""
-    key = ("wls_probe", abs_phase)
-    return model._cached_jit(
-        key, lambda owner: make_wls_probe(owner, abs_phase=abs_phase))
+    key = ("wls_probe", abs_phase, traced_tzr, vmapped)
+
+    def build(owner):
+        fn = make_wls_probe(owner, abs_phase=abs_phase,
+                            traced_tzr=traced_tzr)
+        if not vmapped:
+            return fn
+        return jax.vmap(fn, in_axes=(0,) * (3 + (1 if traced_tzr else 0)))
+
+    return model._cached_jit(key, build)
 
 
 def _counted_step(fn, key, model):

@@ -20,6 +20,18 @@ from pint_tpu.models.parameter import float_param, mjd_param, str_param
 from pint_tpu.ops import dd
 
 
+# TZR tables keyed by VALUE (mjd string, site, freq, ephem, planets),
+# shared process-wide: a throughput-scheduler workload materializes a
+# fresh model per request, and a per-instance cache made every request
+# re-run the full 1-row TOA pipeline (~15 ms each — it dominated batch
+# prep). Tables are immutable but the keys are request-supplied, so a
+# long-running service with heterogeneous traffic would grow the dict
+# unboundedly — cap it FIFO (re-materializing an evicted epoch costs
+# one 1-row pipeline run, not correctness).
+_TZR_TABLES: dict[tuple, object] = {}
+_TZR_TABLES_MAX = 128
+
+
 class AbsPhase(Component):
     category = "absolute_phase"
     is_phase = False  # handled specially by TimingModel (needs a second TOA set)
@@ -30,7 +42,6 @@ class AbsPhase(Component):
         self.add_param(str_param("TZRSITE", default="ssb", desc="TZR observatory"))
         self.add_param(float_param("TZRFRQ", units="MHz", default=np.inf,
                                    desc="TZR observing frequency"))
-        self._tzr_cache: dict[str, object] = {}
 
     @classmethod
     def applicable(cls, pf) -> bool:
@@ -43,16 +54,19 @@ class AbsPhase(Component):
         return self
 
     def get_tzr_toas(self, ephem: str = "builtin_analytic", planets: bool = True):
-        """One-row TOAs table at the TZR epoch (cached)."""
-        key = f"{ephem}:{planets}"
-        if key not in self._tzr_cache:
+        """One-row TOAs table at the TZR epoch (value-cached process-wide)."""
+        mjd_str = dd.to_string(self.param("TZRMJD").as_dd(), ndigits=25)
+        freq = self.param("TZRFRQ").value_f64
+        if not np.isfinite(freq) or freq == 0.0:
+            freq = 1e12  # effectively infinite frequency: no dispersion
+        site = str(self.param("TZRSITE").value)
+        key = (mjd_str, site, freq, ephem, planets)
+        if key not in _TZR_TABLES:
             from pint_tpu.io.timfile import RawTOA, TimFile
             from pint_tpu.toas import get_TOAs
 
-            mjd_str = dd.to_string(self.param("TZRMJD").as_dd(), ndigits=25)
-            freq = self.param("TZRFRQ").value_f64
-            if not np.isfinite(freq) or freq == 0.0:
-                freq = 1e12  # effectively infinite frequency: no dispersion
-            tf = TimFile(toas=[RawTOA(mjd_str, 0.0, freq, str(self.param("TZRSITE").value))])
-            self._tzr_cache[key] = get_TOAs(tf, ephem=ephem, planets=planets)
-        return self._tzr_cache[key]
+            while len(_TZR_TABLES) >= _TZR_TABLES_MAX:
+                _TZR_TABLES.pop(next(iter(_TZR_TABLES)))
+            tf = TimFile(toas=[RawTOA(mjd_str, 0.0, freq, site)])
+            _TZR_TABLES[key] = get_TOAs(tf, ephem=ephem, planets=planets)
+        return _TZR_TABLES[key]

@@ -259,23 +259,37 @@ def toa_mask(selector: tuple[str, ...], toas):
 
     Trace-safe: masks over static metadata (flags) come back as concrete
     numpy constants; masks over data fields (jump_group, obs_index, MJD,
-    freq) are computed with jnp ops, so the result may be a traced array
-    when `toas` is a jit argument (the sharded fit path). Host callers
-    (e.g. ECORR quantization on a concrete table) can np.asarray() it.
+    freq) are computed with jnp ops when the table is traced (a jit
+    argument on the sharded fit path), so the result may be a traced
+    array there. On a CONCRETE table the same selectors are evaluated in
+    pure numpy instead: every eager jnp comparison is an XLA dispatch
+    (~0.1 ms), and the batched-fitter prep evaluates selectors per
+    member per batch — measured as the dominant host cost of a
+    throughput-scheduler drain before this fast path.
     """
+    import jax
     import jax.numpy as jnp
 
     n = len(toas)
     if not selector:
         return np.ones(n, dtype=bool)
+
+    def _host(x):
+        """numpy view of a data leaf, or None when it is traced."""
+        return None if isinstance(x, jax.core.Tracer) else np.asarray(x)
+
     # materialized masks (data leaves) win: the batched/stacked paths strip
     # the static flags, so flag selectors must already be arrays there
     mk = " ".join(selector)
     am = getattr(toas, "aux_masks", None)
     if am and mk in am:
-        return am[mk] != 0.0
+        m = _host(am[mk])
+        return am[mk] != 0.0 if m is None else m != 0.0
     key = selector[0].lstrip("-").lower()
     if key == "tim_jump":
+        g = _host(toas.jump_group)
+        if g is not None:
+            return g == int(selector[1])
         return jnp.asarray(toas.jump_group) == int(selector[1])
     if key in ("tel", "obs"):
         from pint_tpu import observatory as obs_mod
@@ -285,12 +299,21 @@ def toa_mask(selector: tuple[str, ...], toas):
             ti = toas.obs_names.index(target)
         except ValueError:
             return np.zeros(n, dtype=bool)
+        oi = _host(toas.obs_index)
+        if oi is not None:
+            return oi == ti
         return jnp.asarray(toas.obs_index) == ti
     if key == "mjd":
-        mjds = toas.tdb.hi + toas.tdb.lo
+        hi, lo = _host(toas.tdb.hi), _host(toas.tdb.lo)
+        if hi is not None and lo is not None:
+            mjds = hi + lo
+        else:
+            mjds = toas.tdb.hi + toas.tdb.lo
         return (mjds >= float(selector[1])) & (mjds <= float(selector[2]))
     if key == "freq":
-        f = jnp.asarray(toas.freq_mhz)
+        f = _host(toas.freq_mhz)
+        if f is None:
+            f = jnp.asarray(toas.freq_mhz)
         return (f >= float(selector[1])) & (f <= float(selector[2]))
     # generic flag match: -fe L-wide, -f 430_PUPPI, -sys ... The O(n)
     # flag scan depends only on (selector, toas), so cache it on the

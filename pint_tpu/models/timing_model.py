@@ -184,14 +184,34 @@ class TimingModel:
             ph = phase_mod.add(ph, c.phase(p, tt, delay, aux))
         return ph
 
-    def phase_fn_toas(self, *, abs_phase: bool = True, tzr=None):
+    def phase_fn_toas(self, *, abs_phase: bool = True, tzr=None,
+                      traced_tzr: bool = False):
         """Build ``fn(base, deltas, toas) -> Phase`` with TOAs as a traced arg.
 
         This is the sharding-friendly form: the TOA table enters as a jit
         argument, so its leaves can carry ``NamedSharding`` over the TOA
         axis of a device mesh (pint_tpu.parallel). ``tzr`` (if any) stays
         closed over — it is a single replicated reference TOA.
+
+        ``traced_tzr=True`` returns ``fn(base, deltas, toas, tzr_toas)``
+        with the TZR anchor table as a fourth *traced* argument instead
+        of a closure constant: under ``vmap`` each batch member then
+        anchors at its own stacked one-row TZR table — the exact dense
+        convention, member by member — while the compiled program stays
+        one-per-structure (anchor values ride the traced table, like
+        free parameter values ride ``base``).
         """
+        if traced_tzr:
+            def fn_traced(base: dict[str, DD], deltas: dict[str, Array],
+                          toas, tzr_toas) -> phase_mod.Phase:
+                p = self.resolve(base, deltas)
+                ph = self._phase_at(p, toas)
+                # same PHOFF-outside-the-anchor rule as the closure form
+                return phase_mod.add(ph, phase_mod.neg(
+                    self._phase_at(p, tzr_toas,
+                                   skip_categories=("phase_offset",))))
+
+            return fn_traced
         if tzr is None and abs_phase:
             tzr = self.get_tzr_toas()
 

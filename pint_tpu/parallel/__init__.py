@@ -20,5 +20,6 @@ from pint_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, shard_toas, replicate)
 from pint_tpu.parallel.sharded_fit import (  # noqa: F401
     ShardedGLSFitter, ShardedWLSFitter, sharded_fit, sharded_gls_fit)
-from pint_tpu.parallel.batch import BatchedPulsarFitter, pad_toas  # noqa: F401
+from pint_tpu.parallel.batch import BatchedPulsarFitter  # noqa: F401
+from pint_tpu.bucketing import pad_toas  # noqa: F401
 from pint_tpu.parallel.pta import PTAGLSFitter, hellings_downs  # noqa: F401

@@ -39,10 +39,9 @@ import numpy as np
 from pint_tpu.fitting.step import jitted_wls_step
 from pint_tpu.models.jump import PhaseJump
 from pint_tpu.models.noise import ScaleToaError
-from pint_tpu.models.parameter import materialize_selector_masks
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.ops.dd import DD
-from pint_tpu.bucketing import bucket_size, pad_toas
+from pint_tpu.bucketing import bucket_size
 from pint_tpu.parallel.mesh import make_mesh, replicate, shard_toas
 from pint_tpu.toas import Flags, TOAs
 
@@ -86,21 +85,45 @@ def _structural_state(c) -> tuple:
     return tuple(out)
 
 
-def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple, str]]]:
+def build_union_model(models) -> tuple[TimingModel, dict[str, dict[int, tuple]]]:
     """Union of the models' components for batched fitting.
 
     Returns (union_model, owners) where ``owners`` maps each merged
-    mask-parameter's synthetic selector key to (owner pulsar index,
-    original selector, original parameter name) — non-owners get a zero
-    mask at materialization, and fit results are written back to the
-    owner's own parameter (the union name is synthetic).
+    mask-parameter's synthetic selector key to a per-member dict
+    ``{member index: (original selector, original name, original
+    frozen)}`` — non-owner members get a zero mask at materialization,
+    and fit results are written back to each owner's own parameter (the
+    union name is synthetic).
+
+    Structurally identical entries are DEDUPED into one shared union
+    parameter instead of one per member: a scheduler batch of B
+    same-structure pulsars used to carry B synthetic JUMP columns (B-1
+    masked to zero per member), tripling the per-iteration jacfwd cost
+    of the fused batched loop. A JUMP dedupes on its selector alone —
+    per-member values ride the traced ``base`` as (B,) leaves like any
+    plain parameter. EFAC/EQUAD values are host-side trace constants
+    (``scale_sigma`` reads ``value_f64``), so scale entries dedup only
+    when frozen with an identical (kind, selector, value) triple.
     """
     plain: dict[str, object] = {}
     scale = ScaleToaError()
     jump = PhaseJump()
-    owners: dict[str, tuple[int, tuple, str]] = {}
+    owners: dict[str, dict[int, tuple]] = {}
+    shared: dict[tuple, str] = {}  # dedup key -> synthetic owners key
+    by_key: dict[str, object] = {}  # synthetic owners key -> union Param
     binary_classes: set[str] = set()
     tag = 0
+
+    def _join(dk, i, p) -> bool:
+        """Attach member ``i``'s param to an existing shared entry."""
+        key = shared.get(dk)
+        if key is None or i in owners[key]:
+            return False
+        owners[key][i] = (p.selector, p.name, p.frozen)
+        if not p.frozen:
+            by_key[key].frozen = False
+        return True
+
     for i, m in enumerate(models):
         for c in m.components:
             if getattr(c, "is_noise_basis", False):
@@ -110,11 +133,19 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple, 
             if isinstance(c, ScaleToaError):
                 for p in c.params:
                     kind = p.name.rstrip("0123456789")
+                    dk = (("scale", kind, p.selector, p.value_f64)
+                          if p.frozen else None)
+                    if dk is not None and _join(dk, i, p):
+                        continue
                     sel = ("batched", str(tag))
                     np_ = scale._add(kind, sel, value=p.value_f64)
                     np_.value = p.value
                     np_.frozen = p.frozen
-                    owners[" ".join(sel)] = (i, p.selector, p.name)
+                    key = " ".join(sel)
+                    owners[key] = {i: (p.selector, p.name, p.frozen)}
+                    by_key[key] = np_
+                    if dk is not None:
+                        shared[dk] = key
                     tag += 1
                 continue
             # exact type: DelayJump subclasses PhaseJump but applies in
@@ -127,10 +158,19 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple, 
                     "use per-pulsar fitters or PhaseJump")
             if type(c) is PhaseJump:
                 for p in c.params:
+                    # jump values are traced (phase reads the resolved
+                    # base), so same-selector jumps share one column
+                    # with per-member (B,) values
+                    dk = ("jump", p.selector)
+                    if _join(dk, i, p):
+                        continue
                     sel = ("batched", str(tag))
                     np_ = jump.add_jump(sel, frozen=p.frozen)
                     np_.value = p.value
-                    owners[" ".join(sel)] = (i, p.selector, p.name)
+                    key = " ".join(sel)
+                    owners[key] = {i: (p.selector, p.name, p.frozen)}
+                    by_key[key] = np_
+                    shared[dk] = key
                     tag += 1
                 continue
             name = type(c).__name__
@@ -165,39 +205,110 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, tuple[int, tuple, 
 
 
 def _materialize_for_pulsar(toas, i, models, union, owners):
-    """All selector masks as data, with non-owner mask params zeroed."""
-    toas = materialize_selector_masks(list(models) + [union], toas)
-    masks = dict(toas.aux_masks)
-    n = len(toas)
+    """All selector masks as data, with non-owner mask params zeroed.
+
+    Only the UNION's selectors are materialized — they are the complete
+    set the stacked table is ever consulted for (the union is the model
+    every traced evaluation runs), and the synthetic merged keys are
+    skipped entirely because the ``owners`` loop overwrites each one
+    (owner's original selector, zeros elsewhere). Materializing every
+    member model's own selectors too — the previous behavior — made
+    batch prep O(B^2) in dead keys.
+    """
     from pint_tpu.models.parameter import toa_mask
 
-    for key, (owner, orig_sel, _name) in owners.items():
-        if owner == i:
-            masks[key] = jnp.asarray(
-                np.asarray(toa_mask(orig_sel, toas)), jnp.float64)
+    masks = dict(toas.aux_masks)
+    n = len(toas)
+    for p in union.params.values():
+        if not p.selector:
+            continue
+        key = " ".join(p.selector)
+        if key in masks or key in owners:
+            continue
+        masks[key] = np.asarray(toa_mask(p.selector, toas),
+                                dtype=np.float64)
+    zeros = np.zeros(n)
+    for key, ent in owners.items():
+        info = ent.get(i)
+        if info is not None:
+            masks[key] = np.asarray(toa_mask(info[0], toas),
+                                    dtype=np.float64)
         else:
-            masks[key] = jnp.zeros(n)
+            masks[key] = zeros
     return dataclasses.replace(toas, aux_masks=masks)
 
 
-def _strip_static(toas: TOAs) -> TOAs:
+def _strip_static(toas: TOAs, n: int | None = None) -> TOAs:
     """Erase per-pulsar static metadata so stacked treedefs match.
 
     Safe because every flag-based selector has been materialized into
     ``aux_masks`` (data) first; site names are not consulted during
     tracing (obs-dependent quantities were precomputed into the table).
+    ``n`` is the post-padding row count the static flags must claim
+    (static aux is part of pytree equality, so every member must agree
+    BEFORE the leaves are stacked).
     """
-    n = len(toas)
+    n = len(toas) if n is None else n
     return dataclasses.replace(
         toas, flags=Flags({} for _ in range(n)), obs_names=("batched",),
         ephem_name="batched")
 
 
 def stack_toas(toas_list: list[TOAs], n_pad: int | None = None) -> TOAs:
-    """Pad to a common length and stack along a new leading pulsar axis."""
+    """Pad to a common length and stack along a new leading pulsar axis.
+
+    Pure-numpy pad + stack: the previous per-member ``pad_toas`` +
+    ``jnp.stack`` dispatched ~20 eager device ops per member per leaf,
+    which dominated throughput-scheduler host prep (0.22 s of a 0.27 s
+    warm 16-member batch build). Leaves stay NUMPY — both callers shard
+    the stacked table immediately (``shard_toas`` / ``_shard_psr_only``
+    device_put every leaf), so materializing jnp arrays here transferred
+    each leaf twice (measured: ~40% of a warm 16-member ctor). Padding
+    policy is ``bucketing.pad_toas``'s exactly: pad rows replicate the
+    member's last TOA with ``PAD_ERROR_US`` uncertainty (zero-weight
+    rows).
+    """
+    from pint_tpu.bucketing import PAD_ERROR_US
+
     n_max = n_pad or max(len(t) for t in toas_list)
-    stripped = [_strip_static(pad_toas(t, n_max)) for t in toas_list]
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *stripped)
+    k_pads = [n_max - len(t) for t in toas_list]
+    if any(k < 0 for k in k_pads):
+        raise ValueError(f"n_pad {n_max} < a member's TOA count")
+
+    def pad_np(x, k):
+        x = np.asarray(x)
+        if k == 0:
+            return x
+        return np.concatenate([x, np.repeat(x[-1:], k, axis=0)], axis=0)
+
+    def stack_leaf(*xs):
+        return np.stack([pad_np(x, k) for x, k in zip(xs, k_pads)])
+
+    stripped = [_strip_static(t, n_max) for t in toas_list]
+    stacked = jax.tree.map(stack_leaf, *stripped)
+    if any(k_pads):
+        err = np.array(stacked.error_us)
+        for i, k in enumerate(k_pads):
+            if k:
+                err[i, n_max - k:] = PAD_ERROR_US
+        stacked = dataclasses.replace(stacked, error_us=err)
+    return stacked
+
+
+def _shard_psr_only(toas: TOAs, mesh):
+    """Mesh-place a stacked (B, 1) table with ONLY "psr" sharded.
+
+    The stacked TZR anchor tables are one row per member — a length-1
+    TOA axis cannot shard over a >1 "toa" mesh axis, and sharding it
+    buys nothing (one row), so every data axis but the member axis is
+    replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        spec = P("psr", *([None] * (jnp.ndim(x) - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, toas)
 
 
 class BatchedPulsarFitter:
@@ -207,27 +318,51 @@ class BatchedPulsarFitter:
     superset mask; see module docstring). Per-pulsar parameter values are
     stacked into (B,)-shaped DD leaves; neutral values stand in for
     parameters a pulsar does not have.
+
+    ``pad_members`` (the throughput scheduler's member-count bucket,
+    pint_tpu.bucketing.member_bucket_size) extends the batch with dummy
+    members replicating the LAST real problem — deepcopied models, so
+    write-back never aliases a real parameter. Dummies are bit-inert on
+    real members: vmapped evaluation is member-independent, and a dummy
+    converges in lockstep with the member it clones, so it adds no loop
+    iterations either. Results (``fit_toas`` return, ``converged``) are
+    sliced to the real members.
     """
 
     def __init__(self, problems: list[tuple[TOAs, object]], mesh=None,
-                 psr_axis: int | None = None):
+                 psr_axis: int | None = None,
+                 pad_members: int | None = None):
         if not problems:
             raise ValueError("no problems given")
+        self.n_real = len(problems)
+        if pad_members is not None and pad_members > len(problems):
+            import copy as _copy
+
+            last_t, last_m = problems[-1]
+            problems = list(problems) + [
+                (last_t, _copy.deepcopy(last_m))
+                for _ in range(pad_members - len(problems))]
         self.toas_list = [t for t, _ in problems]
         self.models = [m for _, m in problems]
+        from pint_tpu.bucketing import note_batch_occupancy
+
+        note_batch_occupancy(self.n_real, len(self.models))
         self.union, owners = build_union_model(self.models)
 
         # free-parameter union + per-pulsar 0/1 masks. Mask params that
         # were merged (JUMP/EFAC family) are fitted under their synthetic
         # union names; the owner's own per-model name is skipped and the
         # result written back through ``_merged_owner``.
-        merged = {(i, nm) for (i, _sel, nm) in owners.values()}
-        self._merged_owner: dict[str, tuple[int, str]] = {}
+        merged = {(i, info[1])
+                  for ent in owners.values() for i, info in ent.items()}
+        # union name -> {member: (orig name, orig frozen)}
+        self._merged_owner: dict[str, dict[int, tuple[str, bool]]] = {}
         for p in self.union.params.values():
             key = " ".join(p.selector) if p.selector else ""
             if key in owners:
-                owner, _sel, orig_name = owners[key]
-                self._merged_owner[p.name] = (owner, orig_name)
+                self._merged_owner[p.name] = {
+                    i: (info[1], info[2])
+                    for i, info in owners[key].items()}
         names: list[str] = []
         for i, m in enumerate(self.models):
             for k in m.free_params:
@@ -245,15 +380,23 @@ class BatchedPulsarFitter:
             row = []
             for k in names:
                 if k in self._merged_owner:
-                    owner, _ = self._merged_owner[k]
-                    row.append(1.0 if owner == i and not self.union[k].frozen
+                    # a member fits a shared merged column iff it owns
+                    # an entry AND its own parameter is free
+                    info = self._merged_owner[k].get(i)
+                    row.append(1.0 if info is not None and not info[1]
                                else 0.0)
                 else:
                     row.append(1.0 if k in m.params and k in m.free_params
                                else 0.0)
             mask_rows.append(row)
-        self.param_mask = {k: jnp.asarray([mask_rows[i][j] for i in range(B)])
-                           for j, k in enumerate(names)}
+        # numpy until dispatch: ``replicate`` device_puts the leaves at
+        # fit time, and host-side consumers (_write_back's owner check)
+        # index these per member — eager jnp scalars there cost an XLA
+        # dispatch each (~900 per 64-fit drain; measured at 40% of the
+        # throughput scheduler's fetch stage)
+        self.param_mask = {
+            k: np.asarray([mask_rows[i][j] for i in range(B)])
+            for j, k in enumerate(names)}
 
         if mesh is None:
             ndev = len(jax.devices())
@@ -266,19 +409,27 @@ class BatchedPulsarFitter:
         for pname, up in self.union.params.items():
             if not up.is_numeric:
                 continue
+            key = " ".join(up.selector) if up.selector else ""
+            ent = owners.get(key)
             his, los = [], []
-            for m in self.models:
-                if pname in m.params:
+            for i, m in enumerate(self.models):
+                if ent is not None:
+                    # merged mask param: each owner member's OWN value
+                    # (a shared JUMP column fits per-member amplitudes
+                    # through the traced base); neutral elsewhere
+                    info = ent.get(i)
+                    p = m[info[1]] if info is not None else None
+                    his.append(p.hi if p is not None
+                               else neutral_value(pname))
+                    los.append(p.lo if p is not None else 0.0)
+                elif pname in m.params:
                     his.append(m[pname].hi)
                     los.append(m[pname].lo)
-                elif " ".join(up.selector) in owners:
-                    # merged mask param: union holds the owner's value
-                    his.append(up.hi)
-                    los.append(up.lo)
                 else:
                     his.append(neutral_value(pname))
                     los.append(0.0)
-            self.base[pname] = DD(jnp.asarray(his), jnp.asarray(los))
+            self.base[pname] = DD(np.asarray(his, dtype=np.float64),
+                                  np.asarray(los, dtype=np.float64))
 
         n_shards = self.mesh.shape["toa"]
         # bucketed common length: batches over similar TOA counts (and
@@ -291,13 +442,38 @@ class BatchedPulsarFitter:
         ]
         self.toas = shard_toas(stack_toas(prepped, n_max), self.mesh,
                                batched=True)
-        # abs_phase off: the weighted-mean subtraction absorbs TZR anchors.
+        # TZR anchoring: when every member carries an AbsPhase (TZRMJD),
+        # the one-row TZR tables are stacked and traced through the step
+        # so each member computes the exact DENSE anchored convention —
+        # the anchorless (abs_phase=False) wrapped-phase path is offset-
+        # fragile: a member whose constant phase offset lands near ±0.5
+        # turns wraps incoherently and fits to garbage (found by the
+        # ISSUE-5 throughput A/B; regression-pinned in tests/test_serve
+        # .py). Members without TZRMJD fall back to the anchorless path,
+        # now guarded by the circular re-centering in fitting.step.
+        tzr_list = [m.get_tzr_toas() for m in self.models]
+        if all(t is not None for t in tzr_list):
+            prepped_tzr = [
+                _materialize_for_pulsar(t, i, self.models, self.union,
+                                        owners)
+                for i, t in enumerate(tzr_list)
+            ]
+            self.tzr = _shard_psr_only(stack_toas(prepped_tzr), self.mesh)
+        else:
+            self.tzr = None
         # params= is the fitter's free-param union — a parameter frozen in
         # the model that contributed the union component may still be free
         # in another pulsar (its column is masked per pulsar).
-        self.step = jitted_wls_step(self.union, abs_phase=False,
+        self.step = jitted_wls_step(self.union,
+                                    abs_phase=self.tzr is not None,
+                                    traced_tzr=self.tzr is not None,
                                     masked=True, params=self.free_params,
                                     vmapped=True)
+        # the union is never mutated after construction (fit results
+        # write back to the MEMBER models), so its fingerprint hash is
+        # stable — dispatch_fit reuses it instead of re-hashing the
+        # whole component stack per launch
+        self._union_fp_hash = hash(self.union._fn_fingerprint())
 
     def fit_toas(self, maxiter: int = 20,
                  min_chi2_decrease: float = 1e-3,
@@ -320,41 +496,43 @@ class BatchedPulsarFitter:
         reference oracle; parity pinned by tests/test_device_loop.py).
         """
         B = len(self.models)
-        deltas = {k: jnp.zeros(B) for k in self.free_params}
-        base = replicate(self.base, self.mesh)
-        mask = replicate(self.param_mask, self.mesh)
 
         from pint_tpu import telemetry
         from pint_tpu.fitting import device_loop
 
         if device_loop.enabled():
-            from pint_tpu.bucketing import toa_shape
-            from pint_tpu.fitting.step import jitted_wls_step
+            with telemetry.profile_span("fit.batched", n_pulsars=B):
+                return self.dispatch_fit(
+                    maxiter=maxiter,
+                    min_chi2_decrease=min_chi2_decrease,
+                    max_step_halvings=max_step_halvings).finish()
 
-            step_raw = jitted_wls_step(
-                self.union, abs_phase=False, masked=True,
-                params=self.free_params, vmapped=True, counted=False)
-            with self.mesh, telemetry.profile_span("fit.batched",
-                                                   n_pulsars=B):
-                d_fit, info, chi2, converged, _cnt = \
-                    device_loop.run_damped_batched(
-                        lambda d, ops: step_raw(ops[0], d, *ops[1:]),
-                        deltas, (base, self.toas, mask),
-                        key=("batched", id(step_raw)), maxiter=maxiter,
-                        min_chi2_decrease=min_chi2_decrease,
-                        max_step_halvings=max_step_halvings,
-                        kind="device_loop_batched",
-                        fingerprint=(hash(self.union._fn_fingerprint()),
-                                     tuple(self.free_params)),
-                        shape=toa_shape(self.toas))
-            info = dict(info, chi2=info["chi2_at_input"])
-            self.converged = np.asarray(converged)
-            self._write_back(d_fit, info)
-            return np.asarray(info["chi2"])
+        deltas = {k: jnp.zeros(B) for k in self.free_params}
+        base = replicate(self.base, self.mesh)
+        mask = replicate(self.param_mask, self.mesh)
+
+        from pint_tpu.fitting.step import jitted_wls_probe
+
+        anchored = self.tzr is not None
+        probe_step = jitted_wls_probe(
+            self.union, abs_phase=anchored, traced_tzr=anchored,
+            vmapped=True)
 
         def run(d):
+            if anchored:
+                return self.step(base, d, self.toas, mask, self.tzr)
             return self.step(base, d, self.toas, mask)
 
+        def run_probe(d):
+            if anchored:
+                return np.asarray(probe_step(base, d, self.toas,
+                                             self.tzr))
+            return np.asarray(probe_step(base, d, self.toas))
+
+        # the reference transcription of the fused batched loop (see
+        # device_loop._build_batched_probe_loop): full evaluations judge
+        # fresh (lam=1) trials and re-check probe-found candidates; the
+        # member-wise residual-only probe walks the halving ladder
         with self.mesh:
             new_deltas, info = run(deltas)
             chi2 = np.asarray(info["chi2_at_input"]).copy()
@@ -362,17 +540,22 @@ class BatchedPulsarFitter:
             for _ in range(max(1, maxiter)):
                 dx = {k: new_deltas[k] - deltas[k] for k in deltas}
                 lam = np.ones(B)
+                h = np.zeros(B, dtype=int)
                 active = ~converged
                 accepted = np.zeros(B, dtype=bool)
-                trial_new = trial_info = None
-                for _h in range(max_step_halvings):
-                    lam_j = jnp.asarray(np.where(active & ~accepted,
-                                                 lam, 0.0))
-                    trial = {k: deltas[k] + lam_j * dx[k] for k in deltas}
+                pending = active.copy()
+                rej = np.zeros(B, dtype=bool)
+                trial_info = None
+                while pending.any():
+                    act = active & ~accepted & pending
+                    lam_j = jnp.asarray(np.where(act, lam, 0.0))
+                    trial = {k: deltas[k] + lam_j * dx[k]
+                             for k in deltas}
                     trial_new, trial_info = run(trial)
                     trial_chi2 = np.asarray(trial_info["chi2_at_input"])
                     better = trial_chi2 <= chi2 + 1e-12
-                    newly = active & ~accepted & better
+                    newly = act & better
+                    rej = act & ~better
                     # keep the accepted pulsars' trial state
                     keep = jnp.asarray(newly)
                     deltas = {k: jnp.where(keep, trial[k], deltas[k])
@@ -384,17 +567,31 @@ class BatchedPulsarFitter:
                     chi2 = np.where(newly, trial_chi2, chi2)
                     converged |= newly & (decrease < min_chi2_decrease)
                     accepted |= newly
-                    if (accepted | ~active).all():
-                        break
-                    lam = np.where(active & ~accepted, lam * 0.5, lam)
-                # pulsars with no downhill step left are at their optimum
-                converged |= active & ~accepted
-                # when the inner loop drained every active pulsar, the
-                # last trial evaluated each pulsar exactly at its kept
-                # deltas (accepted ones at their trial, the rest at
-                # lam=0); only a rejected-final-trial exit needs a fresh
-                # evaluation at the kept points
-                last_eval_at_kept = bool((accepted | ~active).all())
+                    # rejected members probe halved candidates
+                    seek = rej.copy()
+                    found = np.zeros(B, dtype=bool)
+                    hp = h + 1
+                    lam_p = lam * 0.5
+                    while (seek & (hp < max_step_halvings)).any():
+                        sk = seek & (hp < max_step_halvings)
+                        lam_pj = jnp.asarray(np.where(sk, lam_p, 0.0))
+                        cand = {k: deltas[k] + lam_pj * dx[k]
+                                for k in deltas}
+                        pc = run_probe(cand)
+                        fnd = sk & (pc <= chi2 + 1e-12)
+                        found |= fnd
+                        seek &= ~fnd
+                        cont = sk & ~fnd
+                        hp = np.where(cont, hp + 1, hp)
+                        lam_p = np.where(cont, lam_p * 0.5, lam_p)
+                    # no downhill step left: at the numerical optimum
+                    converged |= rej & ~found & active
+                    pending = rej & found
+                    lam = np.where(pending, lam_p, lam)
+                    h = np.where(pending, hp, h)
+                # the last full evaluation was at every member's kept
+                # point unless it rejected some member's candidate
+                last_eval_at_kept = not bool(rej.any())
                 if converged.all():
                     break
             if last_eval_at_kept and trial_info is not None:
@@ -402,22 +599,138 @@ class BatchedPulsarFitter:
             else:
                 _, info = run(deltas)
             info = dict(info, chi2=info["chi2_at_input"])
-        self.converged = converged
+        self.converged = converged[:self.n_real]
         self._write_back(deltas, info)
-        return np.asarray(info["chi2"])
+        return np.asarray(info["chi2"])[:self.n_real]
+
+    def dispatch_fit(self, maxiter: int = 20,
+                     min_chi2_decrease: float = 1e-3,
+                     max_step_halvings: int = 8):
+        """Launch the fused batched fit WITHOUT blocking on the result.
+
+        The throughput scheduler's device stage (pint_tpu.serve): the
+        whole damped loop is enqueued as one XLA program and this call
+        returns a handle immediately, so the host can pack the next
+        batch while the device executes this one. ``handle.finish()``
+        performs the fit's single device->host fetch, writes fitted
+        parameters back into the (real) models, sets ``self.converged``
+        and returns the per-real-member chi2 array — exactly
+        ``fit_toas``'s contract, split at the sync point.
+
+        With the device loop disabled (``PINT_TPU_DEVICE_LOOP=0``) the
+        host driver cannot be suspended mid-loop, so the fit runs
+        synchronously here and the handle is already resolved.
+        """
+        from pint_tpu import telemetry
+        from pint_tpu.fitting import device_loop
+
+        if not device_loop.enabled():
+            chi2 = self.fit_toas(maxiter=maxiter,
+                                 min_chi2_decrease=min_chi2_decrease,
+                                 max_step_halvings=max_step_halvings)
+            return _ResolvedBatchFit(self, chi2)
+
+        from pint_tpu.bucketing import toa_shape
+        from pint_tpu.fitting.step import jitted_wls_probe, jitted_wls_step
+
+        B = len(self.models)
+        anchored = self.tzr is not None
+        deltas = {k: np.zeros(B) for k in self.free_params}
+        base = replicate(self.base, self.mesh)
+        mask = replicate(self.param_mask, self.mesh)
+        step_raw = jitted_wls_step(
+            self.union, abs_phase=anchored, traced_tzr=anchored,
+            masked=True, params=self.free_params, vmapped=True,
+            counted=False)
+        # halved trials are judged by the residual-only probe — the
+        # chi2 doesn't read the design matrix, so the probe takes no
+        # mask — and re-checked by the authoritative full step
+        probe_raw = jitted_wls_probe(
+            self.union, abs_phase=anchored, traced_tzr=anchored,
+            vmapped=True)
+        if anchored:
+            operands = (base, self.toas, mask, self.tzr)
+
+            def probe_ops(d, ops):
+                return probe_raw(ops[0], d, ops[1], ops[3])
+        else:
+            operands = (base, self.toas, mask)
+
+            def probe_ops(d, ops):
+                return probe_raw(ops[0], d, ops[1])
+        with self.mesh, telemetry.span("fit.batched.dispatch",
+                                       n_pulsars=B):
+            handle = device_loop.dispatch_damped_batched(
+                lambda d, ops: step_raw(ops[0], d, *ops[1:]),
+                deltas, operands, probe=probe_ops,
+                key=("batched", id(step_raw), id(probe_raw)),
+                maxiter=maxiter,
+                min_chi2_decrease=min_chi2_decrease,
+                max_step_halvings=max_step_halvings,
+                kind="device_loop_batched",
+                fingerprint=(self._union_fp_hash,
+                             tuple(self.free_params), anchored),
+                shape=toa_shape(self.toas))
+        return _InFlightBatchPulsarFit(self, handle)
 
     def _write_back(self, deltas, info) -> None:
-        """Apply fitted deltas + uncertainties to every (owner) model."""
-        for i, m in enumerate(self.models):
+        """Apply fitted deltas + uncertainties to every REAL (owner)
+        model; padded dummy members' rows are discarded.
+
+        Whole (B,) arrays convert to numpy ONCE before the member loop:
+        per-element jnp indexing here cost one eager XLA dispatch per
+        (member, param) pair — ~900 of them per 64-fit scheduler drain,
+        the single largest host cost of the throughput fetch stage."""
+        deltas = {k: np.asarray(deltas[k]) for k in self.free_params}
+        errors = {k: np.asarray(info["errors"][k])
+                  for k in self.free_params}
+        for i, m in enumerate(self.models[:self.n_real]):
             for k in self.free_params:
-                if float(np.asarray(self.param_mask[k][i])) == 0.0:
+                if self.param_mask[k][i] == 0.0:
                     continue
                 if k in self._merged_owner:
-                    owner, orig_name = self._merged_owner[k]
-                    p = self.models[owner][orig_name]
+                    own = self._merged_owner[k].get(i)
+                    if own is None:
+                        continue  # unreachable: the mask row is 0
+                    p = m[own[0]]
                 elif k in m.params:
                     p = m[k]
                 else:
                     continue
-                p.add_delta(float(np.asarray(deltas[k][i])))
-                p.uncertainty = float(np.asarray(info["errors"][k][i]))
+                p.add_delta(float(deltas[k][i]))
+                p.uncertainty = float(errors[k][i])
+
+
+class _ResolvedBatchFit:
+    """Already-finished dispatch handle (host-loop fallback path)."""
+
+    __slots__ = ("fitter", "_chi2")
+
+    def __init__(self, fitter, chi2):
+        self.fitter = fitter
+        self._chi2 = chi2
+
+    def finish(self) -> np.ndarray:
+        return self._chi2
+
+
+class _InFlightBatchPulsarFit:
+    """A dispatched batched fit: ``finish()`` = fetch + write-back."""
+
+    __slots__ = ("fitter", "_handle", "_chi2")
+
+    def __init__(self, fitter: BatchedPulsarFitter, handle):
+        self.fitter = fitter
+        self._handle = handle
+        self._chi2 = None
+
+    def finish(self) -> np.ndarray:
+        """The fit's one device->host sync; idempotent."""
+        if self._chi2 is None:
+            f = self.fitter
+            d_fit, info, _chi2, converged, _cnt = self._handle.fetch()
+            info = dict(info, chi2=info["chi2_at_input"])
+            f.converged = np.asarray(converged)[:f.n_real]
+            f._write_back(d_fit, info)
+            self._chi2 = np.asarray(info["chi2"])[:f.n_real]
+        return self._chi2

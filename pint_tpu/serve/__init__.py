@@ -1,0 +1,23 @@
+"""pint_tpu.serve — the throughput engine for many-fit workloads.
+
+One fit is one fused XLA program (fitting.device_loop); this package
+makes a *stream* of fits cheap: a bounded request queue, fingerprint-
+bucketed continuous batching into the fused batched loop (B compatible
+fits = ONE launch + ONE fetch), pow-2 member padding with bit-inert
+dummies, and a double-buffered dispatch pipeline that overlaps host
+packing with device execution. See docs/ARCHITECTURE.md "Throughput
+engine" for the batch-formation policy and backpressure contract.
+"""
+
+from pint_tpu.serve.fingerprint import (  # noqa: F401
+    batchable, short_id, structure_fingerprint)
+from pint_tpu.serve.pipeline import run_pipeline  # noqa: F401
+from pint_tpu.serve.scheduler import (  # noqa: F401
+    BatchPlan, FitHandle, FitRequest, FitResult, ServeQueueFull,
+    ThroughputScheduler)
+
+__all__ = [
+    "BatchPlan", "FitHandle", "FitRequest", "FitResult", "ServeQueueFull",
+    "ThroughputScheduler", "batchable", "run_pipeline", "short_id",
+    "structure_fingerprint",
+]

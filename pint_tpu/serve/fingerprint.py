@@ -1,0 +1,82 @@
+"""Model-structure fingerprints for throughput-scheduler batch grouping.
+
+The scheduler (pint_tpu.serve.scheduler) may place two requests in one
+batch — and reuse one compiled program across batches — only when their
+traced fit programs are identical up to values that flow through traced
+arguments. The canonical key for that is the model's own
+``_fn_fingerprint()`` (the audited identity of everything the jitted
+entry points close over: component stack + trace facts, frozen /
+unfittable parameter values, selectors, backend-relevant header keys —
+FREE fittable values are excluded because they ride the traced
+``base_dd``). "Same structure, different parameter values" therefore
+hashes equal by construction, which is exactly the reuse the issue
+asks to extend beyond hand-built homogeneous batches.
+
+Two additions on top of ``_fn_fingerprint``:
+
+* **structural state** (DMX MJD windows, IFunc node epochs, glitch
+  indices) is pinned explicitly — ``build_union_model`` refuses to
+  merge components whose non-parameter state differs, so the group key
+  must split them even if a component's ``trace_facts`` hook happens
+  not to cover some attribute (belt and braces: equal fingerprint must
+  imply the union build succeeds);
+* **batchability**: models the vmapped WLS union cannot express at all
+  (correlated-noise bases, delay-side jumps, wideband tables) get
+  ``batchable=False`` and are routed through the per-request
+  passthrough path instead of a batch.
+"""
+
+from __future__ import annotations
+
+
+def _structural_state(model) -> tuple:
+    """Non-parameter component state that must match across a batch —
+    ``parallel.batch._structural_state`` per component, so the group key
+    and the union builder can never disagree about what "structural"
+    means (a new DMX-like attribute added there splits groups here)."""
+    from pint_tpu.parallel.batch import _structural_state as _component
+
+    return tuple((type(c).__name__, _component(c))
+                 for c in model.components)
+
+
+def batchable(model, toas=None) -> tuple[bool, str]:
+    """(ok, reason): can this fit be a vmapped WLS batch member?
+
+    The model rejections mirror ``parallel.batch.build_union_model``;
+    wideband-ness lives on the TOAs (``toas.is_wideband()`` — the same
+    dispatch ``Fitter.auto`` uses), so pass the request's table to
+    route wideband fits too. A fit failing here is served through the
+    scheduler's passthrough path (a normal per-request fit), never an
+    error.
+    """
+    from pint_tpu.models.jump import PhaseJump
+
+    if toas is not None and getattr(toas, "is_wideband", lambda: False)():
+        return False, "wideband TOAs"
+    for c in model.components:
+        if getattr(c, "is_noise_basis", False):
+            return False, f"correlated-noise basis {type(c).__name__}"
+        if isinstance(c, PhaseJump) and type(c) is not PhaseJump:
+            return False, f"delay-side jump {type(c).__name__}"
+    return True, ""
+
+
+def structure_fingerprint(model, toas=None) -> tuple:
+    """Hashable batch-group identity of a fit's structure.
+
+    Equal fingerprints guarantee (a) ``build_union_model`` accepts the
+    set, and (b) same-shape batches trace to one compiled loop program
+    (the union's own ``_fn_fingerprint`` is determined by the members').
+    Pass ``toas`` so wideband tables get a passthrough fingerprint.
+    """
+    ok, _reason = batchable(model, toas)
+    return (ok, model._fn_fingerprint(), _structural_state(model))
+
+
+def short_id(fp: tuple) -> str:
+    """Stable 8-hex-digit label of a fingerprint for telemetry/records
+    (content digest, not ``hash()`` — that is salted per process)."""
+    import hashlib
+
+    return hashlib.sha1(repr(fp).encode()).hexdigest()[:8]

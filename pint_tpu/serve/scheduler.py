@@ -1,0 +1,341 @@
+"""Throughput scheduler: fingerprint-bucketed continuous batching.
+
+The north star is a *service*: many independent fit requests, not one
+fast fit. After PR 3 a single fit is one XLA launch, but a stream of
+fits still executed strictly one-after-another, each paying its own
+launch + fetch + host-prep serialization. This module closes that gap
+with the standard serving-system moves (continuous batching a la Orca,
+double-buffered dispatch):
+
+1. **Bounded queue** — :meth:`ThroughputScheduler.submit` enqueues a
+   :class:`FitRequest` and returns a :class:`FitHandle`; a full queue
+   raises :class:`ServeQueueFull` (backpressure is the caller's signal
+   to drain, never silent dropping).
+2. **Batch formation** (:meth:`ThroughputScheduler.plan`) — queued
+   requests group by (structure fingerprint, TOA-count bucket, fit
+   hyperparameters); each group chunks to ``max_batch_members`` and
+   pads to the pow-2 member bucket
+   (:func:`pint_tpu.bucketing.member_bucket_size`) with bit-inert dummy
+   members, so B structurally-compatible fits cost ONE fused program
+   launch and ONE fetch — and same-group batches across drains reuse
+   one compiled program (the fit-program cache).
+3. **Double-buffered dispatch** (:mod:`pint_tpu.serve.pipeline`) —
+   while batch k executes on-device, the host packs/whitens/pads batch
+   k+1; a bounded in-flight window keeps device memory bounded.
+
+Models the vmapped WLS union cannot express (correlated-noise bases,
+delay-side jumps, wideband) are served through a **passthrough** path —
+a per-request ``Fitter.auto`` fit in its own singleton batch — so the
+scheduler accepts any model the library can fit.
+
+Telemetry: ``serve.*`` counters/gauges plus one ``type="serve"``
+JSON-lines record per drain (per-batch occupancy, queue latency,
+overlap efficiency, fits/s) — rendered by ``python -m
+pint_tpu.telemetry.report`` under "throughput engine".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from pint_tpu import bucketing, telemetry
+from pint_tpu.serve import fingerprint as _fp
+from pint_tpu.serve.pipeline import run_pipeline
+
+
+class ServeQueueFull(RuntimeError):
+    """submit() on a full queue: drain (or widen max_queue) and retry."""
+
+
+@dataclasses.dataclass
+class FitRequest:
+    """One fit: a TOA table + a (perturbed) model to fit in place."""
+
+    toas: Any
+    model: Any
+    maxiter: int = 20
+    min_chi2_decrease: float = 1e-3
+    max_step_halvings: int = 8
+    tag: Any = None
+
+
+@dataclasses.dataclass
+class FitResult:
+    """Per-request outcome; ``request.model`` holds the fitted values."""
+
+    tag: Any
+    request: FitRequest
+    chi2: float
+    converged: bool
+    batch: int
+    group: str
+    n_members: int
+    occupancy: float
+    queue_latency_s: float
+    passthrough: bool = False
+
+
+class FitHandle:
+    """Future-like handle returned by :meth:`ThroughputScheduler.submit`."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self):
+        self._result: FitResult | None = None
+
+    def done(self) -> bool:
+        return self._result is not None
+
+    def result(self) -> FitResult:
+        if self._result is None:
+            raise RuntimeError("request not drained yet; call "
+                               "ThroughputScheduler.drain() first")
+        return self._result
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """One planned program launch (inspectable, pure — no device work)."""
+
+    kind: str                 # "batched" | "passthrough"
+    group: str                # fingerprint short id
+    indices: list[int]        # queue positions of the member requests
+    toa_bucket: int
+    n_members: int            # padded member count (1 for passthrough)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.indices) / max(1, self.n_members)
+
+
+class ThroughputScheduler:
+    """Bounded-queue continuous batching over the fused batched loop.
+
+    Parameters: ``max_queue`` bounds :meth:`submit` (backpressure);
+    ``max_batch_members`` caps one program's member count;
+    ``member_floor`` floors the pow-2 member bucket (tests use it to
+    force dummy padding); ``window`` is the double-buffer depth
+    (in-flight batches); ``mesh`` is forwarded to the batched fitter.
+    """
+
+    def __init__(self, *, max_queue: int = 256,
+                 max_batch_members: int = 64, member_floor: int = 1,
+                 window: int = 2, mesh=None):
+        if max_queue < 1 or max_batch_members < 1:
+            raise ValueError("max_queue and max_batch_members must be >= 1")
+        self.max_queue = max_queue
+        self.max_batch_members = max_batch_members
+        self.member_floor = max(1, member_floor)
+        self.window = max(1, window)
+        self.mesh = mesh
+        self._queue: list[tuple[FitRequest, FitHandle, float, tuple]] = []
+        self.last_drain: dict | None = None
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def submit(self, request: FitRequest) -> FitHandle:
+        """Enqueue one request; raises :class:`ServeQueueFull` when the
+        bounded queue is at capacity (the backpressure contract).
+
+        The structure fingerprint is canonicalized HERE, once per
+        request on the enqueue path (it is ~1 ms of model hashing — in
+        the drain it would serialize with every batch), so an
+        unfingerprintable model fails fast at submission and
+        :meth:`plan`/:meth:`drain` only group precomputed keys."""
+        if len(self._queue) >= self.max_queue:
+            telemetry.inc("serve.rejected")
+            raise ServeQueueFull(
+                f"queue at capacity ({self.max_queue}); drain() first")
+        handle = FitHandle()
+        fp = _fp.structure_fingerprint(request.model, request.toas)
+        self._queue.append((request, handle, time.perf_counter(), fp))
+        telemetry.inc("serve.requests")
+        return handle
+
+    def pending(self) -> int:
+        return len(self._queue)
+
+    # ------------------------------------------------------------------
+    # batch formation
+    # ------------------------------------------------------------------
+    def plan(self) -> list[BatchPlan]:
+        """Group the queue into program launches (pure; queue untouched).
+
+        Group key = (structure fingerprint, TOA bucket, fit
+        hyperparameters): equal keys guarantee one union program; the
+        TOA bucket uses the fit-path policy (``bucketing.bucket_size``)
+        so unequal-length tables sharing a bucket share a batch via the
+        existing zero-weight ``pad_toas`` rows. Groups keep submission
+        order; each chunks at ``max_batch_members`` and pads to the
+        pow-2 member bucket.
+        """
+        groups: dict[tuple, list[int]] = {}
+        order: list[tuple] = []
+        for i, (req, _h, _t, fp) in enumerate(self._queue):
+            key = (fp, bucketing.bucket_size(len(req.toas)),
+                   req.maxiter, req.min_chi2_decrease,
+                   req.max_step_halvings)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(i)
+        plans: list[BatchPlan] = []
+        for key in order:
+            fp, bucket = key[0], key[1]
+            idxs = groups[key]
+            if not fp[0]:          # the fingerprint's batchable bit
+                plans.extend(
+                    BatchPlan("passthrough", _fp.short_id(fp), [i],
+                              bucket, 1) for i in idxs)
+                continue
+            for j in range(0, len(idxs), self.max_batch_members):
+                chunk = idxs[j:j + self.max_batch_members]
+                # the pow-2 member bucket must not round past the
+                # caller's hard cap (a 48-cap chunk padded to 64 would
+                # break the device-memory bound the cap exists for)
+                plans.append(BatchPlan(
+                    "batched", _fp.short_id(fp), chunk, bucket,
+                    min(bucketing.member_bucket_size(
+                            len(chunk), floor=self.member_floor),
+                        self.max_batch_members)))
+        return plans
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def drain(self) -> list[FitResult]:
+        """Fit every queued request; resolve handles; empty the queue.
+
+        Batches flow through the double-buffered pipeline: host prep of
+        batch k+1 overlaps device execution of batch k, with at most
+        ``window`` batches in flight. Returns results in submission
+        order (batch execution order is a scheduling detail).
+        """
+        if not self._queue:
+            return []
+        queue, self._queue = self._queue, []
+        plans = self._plans_for(queue)
+
+        def _prep(plan: BatchPlan):
+            if plan.kind == "passthrough":
+                from pint_tpu.fitting.fitter import Fitter
+
+                req = queue[plan.indices[0]][0]
+                return Fitter.auto(req.toas, req.model)
+            from pint_tpu.parallel.batch import BatchedPulsarFitter
+
+            problems = [(queue[i][0].toas, queue[i][0].model)
+                        for i in plan.indices]
+            with telemetry.span("serve.prep", members=plan.n_members):
+                return BatchedPulsarFitter(problems, mesh=self.mesh,
+                                           pad_members=plan.n_members)
+
+        def _dispatch(prepped):
+            plan, fitter = prepped._serve_plan, prepped
+            req0 = queue[plan.indices[0]][0]
+            if plan.kind == "passthrough":
+                # host-driven fitters cannot be suspended mid-loop: the
+                # fit runs here, already resolved at fetch time. Every
+                # Fitter.auto target is a _DownhillMixin, whose loop
+                # reads the halving cap off the instance
+                fitter.max_step_halvings = req0.max_step_halvings
+                chi2 = fitter.fit_toas(
+                    maxiter=req0.maxiter,
+                    min_chi2_decrease=req0.min_chi2_decrease)
+                return (chi2, fitter)
+            return fitter.dispatch_fit(
+                maxiter=req0.maxiter,
+                min_chi2_decrease=req0.min_chi2_decrease,
+                max_step_halvings=req0.max_step_halvings)
+
+        def _fetch(handle, plan: BatchPlan):
+            out: list[FitResult] = []
+            if plan.kind == "passthrough":
+                chi2, fitter = handle
+                chi2 = np.atleast_1d(np.asarray(chi2, dtype=float))
+                conv = np.atleast_1d(np.asarray(fitter.converged))
+            else:
+                chi2 = np.asarray(handle.finish(), dtype=float)
+                conv = np.asarray(handle.fitter.converged)
+            # stamped AFTER finish(): queue latency must include the
+            # device wait, not just the time to reach the fetch stage
+            t_done = time.perf_counter()
+            for m, i in enumerate(plan.indices):
+                req, rh, t_sub, _fp_i = queue[i]
+                res = FitResult(
+                    tag=req.tag, request=req, chi2=float(chi2[m]),
+                    converged=bool(np.all(conv[m])), batch=plan._seq,
+                    group=plan.group, n_members=plan.n_members,
+                    occupancy=plan.occupancy,
+                    queue_latency_s=round(t_done - t_sub, 6),
+                    passthrough=plan.kind == "passthrough")
+                rh._result = res
+                out.append(res)
+            return out
+
+        # thread each plan through prep so dispatch/fetch see it
+        def prep_with_plan(plan):
+            prepped = _prep(plan)
+            prepped._serve_plan = plan
+            return prepped
+
+        for seq, plan in enumerate(plans):
+            plan._seq = seq
+        try:
+            per_batch, stats = run_pipeline(
+                plans, prep=prep_with_plan,
+                dispatch=_dispatch,
+                fetch=lambda h, plan: _fetch(h, plan), window=self.window)
+        except BaseException:
+            # one bad batch must not strand the rest of the drain:
+            # every request whose handle is still unresolved goes back
+            # on the queue (ahead of anything submitted meanwhile) so
+            # the caller can retry — nothing is ever silently dropped
+            self._queue[:0] = [e for e in queue if e[1]._result is None]
+            raise
+
+        results: list[FitResult] = [None] * len(queue)
+        for plan, batch_results in zip(plans, per_batch):
+            for i, res in zip(plan.indices, batch_results):
+                results[i] = res
+
+        n_real = sum(len(p.indices) for p in plans)
+        n_members = sum(p.n_members for p in plans)
+        occupancy = n_real / max(1, n_members)
+        fits_per_s = n_real / max(stats["wall_s"], 1e-12)
+        telemetry.inc("serve.batches", len(plans))
+        telemetry.inc("serve.batches.passthrough",
+                      sum(p.kind == "passthrough" for p in plans))
+        telemetry.set_gauge("serve.occupancy", occupancy)
+        telemetry.set_gauge("serve.fits_per_s", round(fits_per_s, 3))
+        telemetry.set_gauge("serve.overlap_efficiency",
+                            stats["overlap_efficiency"])
+        self.last_drain = {
+            "type": "serve", "fits": n_real, "batches": len(plans),
+            "occupancy": round(occupancy, 4),
+            "fits_per_s": round(fits_per_s, 3),
+            "queue_latency_s_mean": round(
+                float(np.mean([r.queue_latency_s for r in results])), 6),
+            "window": self.window,
+            "batch_detail": [
+                {"kind": p.kind, "group": p.group,
+                 "toa_bucket": p.toa_bucket, "real": len(p.indices),
+                 "members": p.n_members,
+                 "occupancy": round(p.occupancy, 4)} for p in plans],
+            **stats,
+        }
+        telemetry.add_record(dict(self.last_drain))
+        return results
+
+    def _plans_for(self, queue) -> list[BatchPlan]:
+        """plan() against an already-dequeued snapshot."""
+        saved, self._queue = self._queue, queue
+        try:
+            return self.plan()
+        finally:
+            self._queue = saved

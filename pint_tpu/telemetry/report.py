@@ -16,6 +16,9 @@ written by bench.py / tools/soak.py / plain library use):
   accept/halving structure, per-member summaries for batched fits;
 * **program accounting** — ``type="program"`` records (XLA
   cost/memory analysis captured at each fresh compile);
+* **throughput engine** — ``type="serve"`` records (one per scheduler
+  drain: batch occupancy, fits/s, host/device overlap efficiency,
+  queue latency — pint_tpu.serve);
 * **cache hit rates** — ``cache.<name>.{hit,miss,evict}`` counters from
   the closing rollup;
 * **host-pollution windows** — spans of wall time whose ``host``
@@ -149,6 +152,24 @@ def program_summaries(records: list[dict]) -> list[dict]:
                                       "bytes_accessed", "argument_bytes",
                                       "output_bytes", "peak_bytes")
                     if k in r})
+    return out
+
+
+def serve_summaries(records: list[dict]) -> list[dict]:
+    """One summary per throughput-scheduler drain (``type="serve"``)."""
+    out = []
+    for r in records:
+        if r.get("type") != "serve":
+            continue
+        s = {k: r.get(k) for k in
+             ("fits", "batches", "occupancy", "fits_per_s",
+              "overlap_efficiency", "prep_s", "wait_s", "wall_s",
+              "queue_latency_s_mean", "window")}
+        detail = r.get("batch_detail") or []
+        s["passthrough"] = sum(1 for b in detail
+                               if b.get("kind") == "passthrough")
+        s["groups"] = len({b.get("group") for b in detail})
+        out.append(s)
     return out
 
 
@@ -315,6 +336,19 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no program records)")
 
+    lines.append("\n== throughput engine (serve drains) ==")
+    if summary["serve"]:
+        for s in summary["serve"]:
+            lines.append(
+                f"  {s['fits']} fits / {s['batches']} batch(es) "
+                f"({s['groups']} group(s), {s['passthrough']} "
+                f"passthrough): occupancy {s['occupancy']}, "
+                f"{s['fits_per_s']} fits/s, overlap "
+                f"{s['overlap_efficiency']}, queue latency "
+                f"{s['queue_latency_s_mean']}s")
+    else:
+        lines.append("  (no serve records)")
+
     lines.append("\n== cache hit rates ==")
     if summary["caches"]:
         for name, st in sorted(summary["caches"].items()):
@@ -359,6 +393,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "spans": span_tree(records),
         "traces": trace_summaries(records),
         "programs": program_summaries(records),
+        "serve": serve_summaries(records),
         "caches": cache_rates(records),
         "pollution": pollution_windows(records),
     }

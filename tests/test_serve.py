@@ -1,0 +1,367 @@
+"""Throughput engine (ISSUE 5): scheduler grouping, backpressure,
+ordering, member-padding parity, per-batch launch/fetch accounting.
+
+The PAR matches tests/test_device_loop.py / test_parallel.py so the
+union/batched programs are shared across files where the shapes
+coincide (bucketing + the process-global jit cache).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pint_tpu import bucketing, telemetry
+from pint_tpu.models import get_model
+from pint_tpu.serve import (FitRequest, ServeQueueFull,
+                            ThroughputScheduler, structure_fingerprint)
+from pint_tpu.serve.pipeline import run_pipeline
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.telemetry import recorder
+from pint_tpu.toas import Flags
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+NOISE = """
+EFAC -f fake 1.2
+ECORR -f fake 1.1
+"""
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+
+
+def _make_toas(par: str, n: int, seed: int):
+    truth = get_model(par)
+    return make_fake_toas_uniform(53000, 56000, n, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=seed)
+
+
+def _request(par: str, toas, pert_f0: float = 2e-10, tag=None,
+             **hyper) -> FitRequest:
+    pert = get_model(par)
+    pert["F0"].add_delta(pert_f0)
+    return FitRequest(toas, pert, tag=tag, **hyper)
+
+
+@pytest.fixture(scope="module")
+def toas_a():
+    """One 60-TOA table reused everywhere (bucket 64)."""
+    return _make_toas(PAR, 60, seed=201)
+
+
+# ----------------------------------------------------------------------
+# pure policy: member buckets, pipeline mechanics, batch formation
+# ----------------------------------------------------------------------
+
+def test_member_bucket_size():
+    assert bucketing.member_bucket_size(1) == 1
+    assert bucketing.member_bucket_size(3) == 4
+    assert bucketing.member_bucket_size(4) == 4
+    assert bucketing.member_bucket_size(5) == 8
+    assert bucketing.member_bucket_size(2, floor=4) == 4
+    with pytest.raises(ValueError):
+        bucketing.member_bucket_size(0)
+    # occupancy >= 0.5 by construction for b >= floor
+    for b in range(1, 70):
+        assert b / bucketing.member_bucket_size(b) >= 0.5
+
+
+def test_member_bucket_kill_switch(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_FIT_BUCKETING", "0")
+    assert bucketing.member_bucket_size(5) == 5
+    assert bucketing.member_bucket_size(2, floor=4) == 4
+
+
+def test_pipeline_window_and_order():
+    """The in-flight window bounds outstanding handles (backpressure);
+    results come back in item order with full overlap bookkeeping."""
+    outstanding, peak, log = [0], [0], []
+
+    def prep(i):
+        log.append(("prep", i))
+        return i
+
+    def dispatch(i):
+        outstanding[0] += 1
+        peak[0] = max(peak[0], outstanding[0])
+        log.append(("dispatch", i))
+        return i
+
+    def fetch(h, item):
+        outstanding[0] -= 1
+        log.append(("fetch", h))
+        return h * 10
+
+    results, stats = run_pipeline(range(5), prep=prep, dispatch=dispatch,
+                                  fetch=fetch, window=2)
+    assert results == [0, 10, 20, 30, 40]
+    assert peak[0] == 2  # the window IS the in-flight bound
+    # batch 1's prep happened before batch 0's fetch: the overlap
+    assert log.index(("prep", 1)) < log.index(("fetch", 0))
+    assert stats["wall_s"] >= 0 and "overlap_efficiency" in stats
+
+
+def test_plan_groups_by_structure_bucket_and_hyper(toas_a):
+    """Batch formation: same structure+bucket+hyper share a batch;
+    a structure variant, a different TOA bucket, and different fit
+    hyperparameters each split; member counts pad to pow 2."""
+    toas_big = _make_toas(PAR, 150, seed=205)  # bucket 256
+    s = ThroughputScheduler(max_queue=16)
+    for i in range(3):
+        s.submit(_request(PAR, toas_a, tag=f"a{i}"))
+    s.submit(_request(PAR + "FD1 1e-5 1\n", toas_a, tag="fd"))
+    s.submit(_request(PAR, toas_big, tag="big"))
+    s.submit(_request(PAR, toas_a, tag="hyper", maxiter=7))
+    plans = s.plan()
+    assert [(p.kind, len(p.indices), p.n_members) for p in plans] == [
+        ("batched", 3, 4), ("batched", 1, 1), ("batched", 1, 1),
+        ("batched", 1, 1)]
+    assert plans[0].toa_bucket == 64 and plans[2].toa_bucket == 256
+    assert plans[0].occupancy == 0.75
+    # same structure, different free values -> ONE fingerprint
+    assert plans[0].group != plans[1].group
+    assert plans[0].group == plans[2].group
+
+
+def test_plan_chunks_at_max_batch_members(toas_a):
+    s = ThroughputScheduler(max_queue=16, max_batch_members=2)
+    for i in range(5):
+        s.submit(_request(PAR, toas_a, tag=i))
+    plans = s.plan()
+    assert [len(p.indices) for p in plans] == [2, 2, 1]
+
+
+def test_fingerprint_value_invariance(toas_a):
+    """Same structure, different FREE values -> equal fingerprint; a
+    frozen-value change or component change -> different."""
+    m1 = get_model(PAR)
+    m2 = get_model(PAR)
+    m2["F0"].add_delta(5e-9)
+    assert structure_fingerprint(m1) == structure_fingerprint(m2)
+    m3 = get_model(PAR.replace("PEPOCH        53750.000000",
+                               "PEPOCH        53751.000000"))
+    assert structure_fingerprint(m1) != structure_fingerprint(m3)
+    m4 = get_model(PAR + "FD1 1e-5 1\n")
+    assert structure_fingerprint(m1) != structure_fingerprint(m4)
+
+
+def test_backpressure_queue_full(toas_a):
+    s = ThroughputScheduler(max_queue=2)
+    s.submit(_request(PAR, toas_a))
+    s.submit(_request(PAR, toas_a))
+    before = telemetry.counters_snapshot()
+    with pytest.raises(ServeQueueFull):
+        s.submit(_request(PAR, toas_a))
+    assert telemetry.counters_delta(before).get("serve.rejected") == 1
+    s.drain()
+    s.submit(_request(PAR, toas_a))  # capacity freed by the drain
+
+
+# ----------------------------------------------------------------------
+# member-padding parity (satellite 1)
+# ----------------------------------------------------------------------
+
+def _fitted_state(model):
+    return {k: (model[k].value_f64, model[k].uncertainty)
+            for k in model.free_params}
+
+
+@pytest.fixture(scope="module")
+def padded_vs_real(toas_a):
+    """The acceptance A/B: ONE real request padded with 3 dummies vs
+    the same request batched with 3 REAL copies of itself — same
+    compiled program (B=4), identical member data, so every difference
+    would be a padding artifact."""
+    telemetry.configure(enabled=True)
+    out = {}
+    for mode in ("real", "padded"):
+        n_real = 4 if mode == "real" else 1
+        reqs = [_request(PAR, toas_a, tag=i) for i in range(n_real)]
+        s = ThroughputScheduler(max_queue=8, member_floor=4)
+        handles = [s.submit(r) for r in reqs]
+        before = telemetry.counters_snapshot()
+        res = s.drain()
+        out[mode] = {
+            "results": res,
+            "state": _fitted_state(reqs[0].model),
+            "trace": recorder.last_trace(),
+            "delta": telemetry.counters_delta(before),
+            "handles": handles,
+        }
+    return out
+
+
+def test_padded_member_bit_identical_to_real_comember(padded_vs_real):
+    """Bit-identity pin: member 0 fitted through a dummy-padded batch
+    == through an all-real batch of identical members — parameters,
+    uncertainties, chi2, converged, and the WHOLE flight-recorder
+    trace (trajectory) bitwise."""
+    real, padded = padded_vs_real["real"], padded_vs_real["padded"]
+    r0, p0 = real["results"][0], padded["results"][0]
+    assert p0.chi2 == r0.chi2  # bitwise
+    assert p0.converged == r0.converged
+    assert p0.n_members == 4 and p0.occupancy == 0.25
+    assert r0.occupancy == 1.0
+    for k, (v, u) in real["state"].items():
+        pv, pu = padded["state"][k]
+        assert pv == v, k      # bitwise
+        assert pu == u, k
+    # trajectory: the device trace (per-member chi2/lam/accept vectors)
+    # is identical entry-for-entry — dummies clone the real member, so
+    # the loop takes the same path
+    tr, tp = real["trace"], padded["trace"]
+    assert tr["loop"] == tp["loop"] == "device"
+    assert tp["n"] == tr["n"]
+    assert tp["chi2"] == tr["chi2"]
+    assert tp["lam"] == tr["lam"]
+    assert tp["accepted"] == tr["accepted"]
+
+
+def test_one_launch_one_fetch_per_batch(padded_vs_real):
+    for mode in ("real", "padded"):
+        delta = padded_vs_real[mode]["delta"]
+        assert delta.get("fit.device_loop.launches", 0) == 1
+        assert delta.get("fit.device_loop.fetches", 0) == 1
+    # occupancy accounting (bucketing.note_batch_occupancy)
+    assert padded_vs_real["padded"]["delta"].get("batch.members.pad") == 3
+    assert padded_vs_real["padded"]["delta"].get("batch.members.real") == 1
+
+
+def test_program_reuse_across_batches(padded_vs_real):
+    """The second drain (same structure, same shapes) re-executes the
+    FIRST drain's compiled loop program: zero fit-program misses."""
+    delta2 = padded_vs_real["padded"]["delta"]
+    assert delta2.get("cache.fit_program.miss", 0) == 0
+    assert delta2.get("cache.fit_program.hit", 0) >= 1
+
+
+def test_padded_member_matches_standalone_fused(padded_vs_real, toas_a):
+    """A padded batch member reaches the standalone fused batch-of-1
+    fit (different program: B=1 vs B=4) to solver round-off."""
+    from pint_tpu.parallel import BatchedPulsarFitter
+
+    req = _request(PAR, toas_a)
+    bf = BatchedPulsarFitter([(req.toas, req.model)])
+    chi2 = bf.fit_toas(maxiter=20)
+    assert chi2.shape == (1,)
+    p0 = padded_vs_real["padded"]["results"][0]
+    assert p0.chi2 == pytest.approx(float(chi2[0]), rel=1e-9)
+    ref = _fitted_state(req.model)
+    for k, (v, u) in padded_vs_real["padded"]["state"].items():
+        assert v == pytest.approx(ref[k][0], rel=1e-9, abs=1e-24), k
+        assert u == pytest.approx(ref[k][1], rel=1e-6), k
+
+
+def test_handles_and_ordering(padded_vs_real):
+    """Handles resolve to their own request's result; drain returns
+    submission order."""
+    real = padded_vs_real["real"]
+    for i, h in enumerate(real["handles"]):
+        assert h.done()
+        assert h.result().tag == i
+    assert [r.tag for r in real["results"]] == [0, 1, 2, 3]
+
+
+def test_unresolved_handle_raises(toas_a):
+    s = ThroughputScheduler(max_queue=4)
+    h = s.submit(_request(PAR, toas_a))
+    assert not h.done()
+    with pytest.raises(RuntimeError, match="drain"):
+        h.result()
+    s.drain()
+    assert h.done()
+
+
+# ----------------------------------------------------------------------
+# passthrough: models the vmapped WLS union cannot express
+# ----------------------------------------------------------------------
+
+def test_noise_model_passthrough(toas_a):
+    """A correlated-noise request is served (singleton passthrough) and
+    matches the standalone Fitter.auto fit; a WLS request in the same
+    drain still batches."""
+    from pint_tpu.fitting.fitter import Fitter
+
+    par_n = PAR + NOISE
+    toas_n = dataclasses.replace(
+        toas_a, flags=Flags(dict(d, f="fake") for d in toas_a.flags))
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(_request(par_n, toas_n, tag="noise", maxiter=6))
+    s.submit(_request(PAR, toas_a, tag="wls", maxiter=6))
+    plans = s.plan()
+    assert sorted(p.kind for p in plans) == ["batched", "passthrough"]
+    res = {r.tag: r for r in s.drain()}
+    assert res["noise"].passthrough and not res["wls"].passthrough
+    assert np.isfinite(res["noise"].chi2)
+
+    ref = get_model(par_n)
+    ref["F0"].add_delta(2e-10)
+    f = Fitter.auto(toas_n, ref)
+    chi2_ref = f.fit_toas(maxiter=6)
+    assert res["noise"].chi2 == pytest.approx(chi2_ref, rel=1e-9)
+    assert res["noise"].converged == bool(f.converged)
+
+
+def test_wideband_passthrough(toas_a):
+    """Wideband-ness lives on the TOAs, not the model: the SAME model
+    with a wideband table must route passthrough (Fitter.auto picks the
+    wideband fitter there) while its narrowband twin batches."""
+    from pint_tpu.fitting.fitter import Fitter
+
+    truth = get_model(PAR)
+    dm_true = np.asarray(truth.total_dm(toas_a))
+    toas_wb = dataclasses.replace(
+        toas_a, flags=Flags(dict(d, pp_dm=str(float(m)), pp_dme="1e-4")
+                            for d, m in zip(toas_a.flags, dm_true)))
+    assert toas_wb.is_wideband()
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(_request(PAR, toas_wb, tag="wb", maxiter=6))
+    s.submit(_request(PAR, toas_a, tag="nb", maxiter=6))
+    plans = s.plan()
+    assert sorted(p.kind for p in plans) == ["batched", "passthrough"]
+    res = {r.tag: r for r in s.drain()}
+    assert res["wb"].passthrough and not res["nb"].passthrough
+
+    ref = get_model(PAR)
+    ref["F0"].add_delta(2e-10)
+    f = Fitter.auto(toas_wb, ref)
+    assert type(f).__name__ == "WidebandDownhillFitter"
+    chi2_ref = f.fit_toas(maxiter=6)
+    assert res["wb"].chi2 == pytest.approx(chi2_ref, rel=1e-9)
+    assert res["wb"].converged == bool(f.converged)
+
+
+def test_serve_record_emitted(padded_vs_real, toas_a):
+    """Each drain leaves a type="serve" record with the occupancy /
+    overlap / throughput fields the report CLI renders."""
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(_request(PAR, toas_a))
+    s.drain()
+    rec = s.last_drain
+    assert rec["type"] == "serve" and rec["fits"] == 1
+    for key in ("occupancy", "fits_per_s", "overlap_efficiency",
+                "prep_s", "wait_s", "batch_detail",
+                "queue_latency_s_mean"):
+        assert key in rec, key
+    assert rec["batch_detail"][0]["kind"] == "batched"

@@ -532,6 +532,11 @@ def test_bench_smoke_emits_rollup(tmp_path):
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["metric"] == "smoke_fit_wall" and out["value"] > 0
     assert out["converged"] is True
+    # ISSUE-5 satellite: the scheduler smoke runs every CI pass — 8
+    # mixed requests, parity vs standalone fused fits, occupancy report
+    assert out["serve"]["parity_ok"] is True
+    assert out["serve"]["fits"] == 8 and out["serve"]["batches"] >= 2
+    assert 0.5 <= out["serve"]["occupancy"] <= 1.0
     assert isinstance(out["host_polluted"], bool)
     roll = out["telemetry"]
     assert roll["spans"]["fit.step"]["count"] >= 2

@@ -517,6 +517,70 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
                     f"wideband-spacecraft {nm} not finite"
 
 
+        # throughput-scheduler mix (ISSUE 5): the trial's model (plus a
+        # structure variant when possible) as a heterogeneous request
+        # mix through pint_tpu.serve — random structures fuzz batch
+        # formation, member padding, the passthrough route (noise-basis
+        # models) and the fused batched loop; each request must land on
+        # its own standalone tight fit. APPENDED gate, own substream.
+        if gates.random() < 0.15:
+            axes["gates"].append("serve")
+            from pint_tpu.serve import FitRequest, ThroughputScheduler
+
+            srng = np.random.default_rng((seed, 8))
+            k_req = int(srng.integers(3, 6))
+            # structure variant: drop the F1 line for half the requests
+            # (when present and not anchoring an F2) so the mix spans
+            # two fingerprints
+            par_v = "\n".join(ln for ln in par.splitlines()
+                              if not ln.startswith("F1 ")) + "\n"
+            have_variant = par_v != par and "F2 " not in par
+            specs = []
+            for j in range(k_req):
+                par_j = (par_v if have_variant and j % 2 else par)
+                m_truth = get_model(par_j, allow_tcb=True)
+                t_j = _sim_flagged_toas(m_truth, srng,
+                                        int(srng.integers(60, 140)))
+                specs.append((par_j, t_j))
+
+            def _perturbed_model(par_j):
+                m_j = get_model(par_j, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_j.free_params:
+                        m_j[name].add_delta(d)
+                return m_j
+
+            sched = ThroughputScheduler(max_queue=k_req)
+            for j, (par_j, t_j) in enumerate(specs):
+                sched.submit(FitRequest(t_j, _perturbed_model(par_j),
+                                        maxiter=30,
+                                        min_chi2_decrease=1e-7, tag=j))
+            serve_res = sched.drain()
+            axes["serve"] = {
+                "requests": k_req,
+                "batches": sched.last_drain["batches"],
+                "occupancy": sched.last_drain["occupancy"],
+                "passthrough": sum(r.passthrough for r in serve_res),
+            }
+            for r in serve_res:
+                par_j, t_j = specs[r.tag]
+                assert np.isfinite(r.chi2), f"serve chi2 not finite ({r.tag})"
+                m_ref = _perturbed_model(par_j)
+                f_ref = Fitter.auto(t_j, m_ref)
+                chi2_ref = f_ref.fit_toas(maxiter=30,
+                                          min_chi2_decrease=1e-7)
+                rel = abs(r.chi2 - chi2_ref) / max(abs(chi2_ref), 1e-12)
+                assert rel < 1e-3, (
+                    f"serve/standalone chi2 mismatch ({r.tag}): "
+                    f"{r.chi2} vs {chi2_ref}")
+                m_fit = r.request.model
+                for name in m_ref.free_params:
+                    tol = max(5e-2 * (m_ref[name].uncertainty or 0.0),
+                              1e-10 * max(1.0, abs(m_ref[name].value_f64)))
+                    assert abs(m_fit[name].value_f64
+                               - m_ref[name].value_f64) < tol, (
+                        f"serve/standalone {name} mismatch ({r.tag})")
+
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
         model2 = get_model(par2)
