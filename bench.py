@@ -674,6 +674,23 @@ def _bench_fit_throughput(n_fits: int = 64, reps: int = 3) -> dict:
         if rel > 1e-6 or bool(r.converged) != bool(conv_seq) or not p_ok:
             n_bad += 1
 
+    # fault-idle A/B (ISSUE 6): the fault machinery must cost nothing
+    # when idle. "off" = unarmed (the default every serve caller gets);
+    # "armed" = a configured FaultPlan with every probability zero (all
+    # hooks reached, nothing injected). Alternated reps, best-of each.
+    from pint_tpu.serve import faults as _faults
+
+    idle_walls: dict = {"off": [], "armed": []}
+    for mode in ("off", "armed", "off", "armed"):
+        _faults.configure(_faults.FaultPlan(seed=0) if mode == "armed"
+                          else None)
+        try:
+            idle_walls[mode].append(run_scheduled())
+        finally:
+            _faults.configure(None)
+    idle_off = float(np.min(idle_walls["off"]))
+    idle_armed = float(np.min(idle_walls["armed"]))
+
     hits = int(cache_delta.get("cache.fit_program.hit", 0))
     misses = int(cache_delta.get("cache.fit_program.miss", 0))
     loop_compile_s = max(sched_cold - sched_best, 0.0)
@@ -716,6 +733,14 @@ def _bench_fit_throughput(n_fits: int = 64, reps: int = 3) -> dict:
         },
         "sequential_walls": [round(t, 4) for t in seq_walls],
         "scheduled_walls": [round(t, 4) for t in sched_walls],
+        "fault_idle_ab": {
+            "off_wall": round(idle_off, 4),
+            "armed_wall": round(idle_armed, 4),
+            "off_walls": [round(t, 4) for t in idle_walls["off"]],
+            "armed_walls": [round(t, 4) for t in idle_walls["armed"]],
+            "armed_overhead_pct": round(
+                100.0 * (idle_armed / max(idle_off, 1e-12) - 1.0), 2),
+        },
         "batch_detail": last["batch_detail"],
     }
 
@@ -1184,6 +1209,10 @@ def main() -> None:
         # serve smoke acceptance: parity proven, occupancy reported
         serve = res.get("serve") or {}
         ok = ok and serve.get("parity_ok") is True and "occupancy" in serve
+        # chaos smoke acceptance (ISSUE 6): structured statuses under
+        # injected faults + unaffected-member bitwise parity
+        chaos = res.get("chaos") or {}
+        ok = ok and chaos.get("ok") is True
         if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
@@ -1317,6 +1346,89 @@ def _smoke_serve() -> dict:
             "parity_ok": bad == 0, "parity_failures": bad}
 
 
+def _smoke_chaos() -> dict:
+    """CI chaos smoke (ISSUE 6): injected faults through the scheduler.
+
+    One 4-member batch with member 3's table NaN-poisoned, plus a
+    deterministic transient device error on every first dispatch
+    attempt (faults.FaultPlan(device_err=1.0)). Asserted every CI pass:
+    the drain never raises, the poisoned member quarantines with its
+    flight-recorder trace attached, the dispatch retry fires and
+    succeeds, and the three clean co-members are BITWISE identical to
+    an uninjected drain of the same batch (member-diagonal vmap)."""
+    import dataclasses as _dc
+
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, ThroughputScheduler, faults
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = ("PSRJ FAKE_CHAOS\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    hyper = dict(maxiter=10, min_chi2_decrease=1e-7)
+
+    def build_requests(poison_member):
+        reqs = []
+        for i in range(4):
+            par_i = par.replace("61.485476554",
+                                f"{61.485476554 + 1e-3 * i:.9f}")
+            truth = get_model(par_i)
+            toas = make_fake_toas_uniform(
+                53000, 56000, 40, truth, obs="@",
+                freq_mhz=np.array([1400.0, 430.0]), error_us=2.0,
+                add_noise=True, seed=70 + i)
+            if i == poison_member:
+                err = np.array(toas.error_us, dtype=np.float64)
+                err[0] = np.nan
+                toas = _dc.replace(toas, error_us=err)
+            m = get_model(par_i)
+            m["F0"].add_delta(2e-10)
+            reqs.append(FitRequest(toas, m, tag=i, **hyper))
+        return reqs
+
+    def run(poison, plan):
+        from pint_tpu import telemetry
+
+        faults.configure(plan)
+        try:
+            s = ThroughputScheduler(max_queue=4, retry_backoff_s=0.0)
+            for r in build_requests(poison):
+                s.submit(r)
+            before = telemetry.counters_snapshot()
+            res = s.drain()
+            delta = telemetry.counters_delta(before)
+        finally:
+            faults.configure(None)
+        params = [{k: (r.request.model[k].value_f64,
+                       r.request.model[k].uncertainty)
+                   for k in r.request.model.free_params} for r in res]
+        return res, params, delta
+
+    clean_res, clean_params, _ = run(poison=None, plan=None)
+    chaos_res, chaos_params, delta = run(
+        poison=3, plan=faults.FaultPlan(seed=0, device_err=1.0))
+
+    statuses = [r.status for r in chaos_res]
+    parity_bitwise = all(chaos_params[i] == clean_params[i]
+                         for i in range(3))
+    ok = (all(r.status == "ok" for r in clean_res)
+          and statuses[:3] == ["ok"] * 3
+          and statuses[3] == "quarantined"
+          and chaos_res[3].trace is not None
+          and chaos_res[3].error is not None
+          and int(delta.get("serve.retry.dispatch", 0)) >= 1
+          and int(delta.get("serve.quarantine.count", 0)) == 1
+          and parity_bitwise)
+    return {"ok": ok, "statuses": statuses,
+            "parity_bitwise": parity_bitwise,
+            "dispatch_retries": int(delta.get("serve.retry.dispatch", 0)),
+            "quarantined": int(delta.get("serve.quarantine.count", 0)),
+            "quarantine_trace_evals": (
+                len(chaos_res[3].trace.get("chi2", []))
+                if chaos_res[3].trace else 0)}
+
+
 def _run_smoke() -> None:
     """CI smoke: one tiny CPU fit proving the telemetry pipeline end-to-end.
 
@@ -1347,13 +1459,16 @@ def _run_smoke() -> None:
         # scheduler smoke (ISSUE 5): the serve path runs every CI pass
         with telemetry.span("bench.serve_smoke"):
             serve = _smoke_serve()
+        # chaos smoke (ISSUE 6): the fault paths run every CI pass
+        with telemetry.span("bench.chaos_smoke"):
+            chaos = _smoke_chaos()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
                "backend": jax.default_backend(),
                "chi2": round(float(chi2), 3),
                "converged": bool(f.converged),
-               "serve": serve}
+               "serve": serve, "chaos": chaos}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001

@@ -28,6 +28,8 @@ re-evaluated once with the full step to obtain the next proposal.
 
 from __future__ import annotations
 
+import math
+
 from pint_tpu import telemetry
 from pint_tpu.telemetry import recorder
 
@@ -66,7 +68,11 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
         rec.eval(chi2, 1.0)
     deltas = deltas0
     converged = False
-    for _ in range(max(1, maxiter)):
+    # divergence mirror of the fused device loop (ISSUE 6): the first
+    # non-finite FULL evaluation terminates the fit at the last kept
+    # point with ``diverged`` flagged in info, converged False
+    diverged = not math.isfinite(chi2)
+    for _ in (() if diverged else range(max(1, maxiter))):
         telemetry.inc("fit.iterations")
         dx = {k: new_deltas[k] - deltas[k] for k in deltas}
         lam, applied = 1.0, False
@@ -83,6 +89,9 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
                 trial_chi2 = float(trial_info["chi2_at_input"])
                 if rec:
                     rec.eval(trial_chi2, lam)
+                if not math.isfinite(trial_chi2):
+                    diverged = True
+                    break
             else:
                 telemetry.inc("fit.probe_evals")
                 trial_new = trial_info = None
@@ -105,6 +114,9 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
                     trial_chi2 = float(trial_info["chi2_at_input"])
                     if rec:
                         rec.eval(trial_chi2, lam)
+                    if not math.isfinite(trial_chi2):
+                        diverged = True
+                        break
                     if trial_chi2 > chi2 + 1e-12:
                         telemetry.inc("fit.probe_rejects")
                         lam *= 0.5
@@ -115,6 +127,8 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
                     rec.accept()
                 break
             lam *= 0.5
+        if diverged:
+            break
         if not applied:
             # no downhill direction left: we are at (numerical) optimum
             converged = True
@@ -125,10 +139,14 @@ def downhill_iterate(iterate, deltas0: dict, *, maxiter: int = 20,
         if decrease < min_chi2_decrease:
             converged = True
             break
-    telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    if diverged:
+        telemetry.inc("fit.diverged")
+    else:
+        telemetry.inc("fit.converged" if converged
+                      else "fit.maxiter_exhausted")
     if rec:
         rec.emit()
-    return deltas, info, chi2, converged
+    return deltas, dict(info, diverged=diverged), chi2, converged
 
 
 def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
@@ -170,7 +188,8 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
         rec.eval(chi2, 1.0)
     deltas = deltas0
     converged = False
-    for _ in range(max(1, maxiter)):
+    diverged = not math.isfinite(chi2)
+    for _ in (() if diverged else range(max(1, maxiter))):
         telemetry.inc("fit.iterations")
         dx = {k: new_deltas[k] - deltas[k] for k in deltas}
         lam, applied = 1.0, False
@@ -198,6 +217,9 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
                 trial_chi2 = float(trial_info["chi2_at_input"])
                 if rec:
                     rec.eval(trial_chi2, lam)
+                if not math.isfinite(trial_chi2):
+                    diverged = True
+                    break
             else:
                 telemetry.inc("fit.probe_evals")
                 trial_new = trial_info = None
@@ -223,6 +245,9 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
                     trial_chi2 = float(trial_info["chi2_at_input"])
                     if rec:
                         rec.eval(trial_chi2, lam)
+                    if not math.isfinite(trial_chi2):
+                        diverged = True
+                        break
                     if trial_chi2 > chi2 + 1e-12:
                         telemetry.inc("fit.probe_rejects")
                         lam *= 0.5
@@ -236,6 +261,8 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
         if spec is not None:
             telemetry.inc("fit.probe_spec_wasted")
             spec = None
+        if diverged:
+            break
         if not applied:
             converged = True
             break
@@ -245,7 +272,11 @@ def downhill_iterate_pipelined(step_dispatch, step_fetch, probe_dispatch,
         if decrease < min_chi2_decrease:
             converged = True
             break
-    telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    if diverged:
+        telemetry.inc("fit.diverged")
+    else:
+        telemetry.inc("fit.converged" if converged
+                      else "fit.maxiter_exhausted")
     if rec:
         rec.emit()
-    return deltas, info, chi2, converged
+    return deltas, dict(info, diverged=diverged), chi2, converged

@@ -26,6 +26,16 @@ tests/test_device_loop.py parity assertions):
   read from the returned carry in the single fetch instead of being
   incremented per dispatch.
 
+Divergence (ISSUE 6): a fit whose FULL evaluation produces a non-finite
+chi2 (NaN-poisoned table, overflowing step) terminates immediately with
+a ``diverged`` flag riding the while-loop carry, returned as
+``info["diverged"]`` in the SAME single fetch, never an extra sync. The
+batched loops carry a per-member (B,) flag: a diverging member is
+finished (its deltas stay at the last kept point) while co-members
+proceed untouched — vmapped evaluation is member-diagonal, so their
+trajectories stay bit-identical to an undiverged batch (pinned by
+tests/test_faults.py). ``converged`` is never True for a diverged fit.
+
 The loop body executes exactly ONE step evaluation per ``while``
 iteration (a small state machine with an ``is_init`` first pass and an
 ``is_recheck`` pass for probe-accepted trials), so the compiled program
@@ -133,6 +143,7 @@ def build_damped_loop(full, probe=None, record=False):
             "is_init": jnp.bool_(True),
             "done": jnp.bool_(False),
             "converged": jnp.bool_(False),
+            "diverged": jnp.bool_(False),
             **{k: jnp.zeros((), jnp.int32) for k in _COUNTERS},
         }
         if record:
@@ -156,10 +167,15 @@ def build_damped_loop(full, probe=None, record=False):
             t_new, t_info = full(trial, operands)
             t_chi2 = t_info["chi2_at_input"]
 
-            accept_test = t_chi2 <= c["chi2"] + _EPS
+            # a non-finite full evaluation is divergence: terminate the
+            # fit at the last kept point instead of probing NaN ladders
+            # (all the new predicates are False for finite fits, so a
+            # healthy fit's trajectory is bit-identical to pre-flag)
+            bad = ~jnp.isfinite(t_chi2)
+            accept_test = (t_chi2 <= c["chi2"] + _EPS) & (~bad)
             p_init = c["is_init"]
             p_acc = (~p_init) & accept_test
-            p_rej = (~p_init) & (~accept_test)
+            p_rej = (~p_init) & (~accept_test) & (~bad)
             adopt = p_init | p_acc
 
             deltas_n = _tree_sel(p_acc, trial, c["deltas"])
@@ -226,7 +242,7 @@ def build_damped_loop(full, probe=None, record=False):
                 pev_inc = jnp.zeros((), jnp.int32)
                 prej_inc = jnp.zeros((), jnp.int32)
 
-            done_n = conv_now | exhausted | rej_exh
+            done_n = conv_now | exhausted | rej_exh | bad
             converged_n = conv_now | rej_exh
 
             out = {
@@ -242,6 +258,7 @@ def build_damped_loop(full, probe=None, record=False):
                 "is_init": jnp.bool_(False),
                 "done": done_n,
                 "converged": converged_n,
+                "diverged": c["diverged"] | bad,
                 "iterations": c["iterations"]
                 + p_init.astype(jnp.int32)
                 + (p_acc & (~done_n)).astype(jnp.int32),
@@ -268,8 +285,8 @@ def build_damped_loop(full, probe=None, record=False):
         out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
         counters = {k: out[k] for k in _COUNTERS}
         trace = {"n": out["tn"], **out["trace"]} if record else None
-        return (out["deltas"], out["info"], out["chi2"], out["converged"],
-                counters, trace)
+        return (out["deltas"], dict(out["info"], diverged=out["diverged"]),
+                out["chi2"], out["converged"], counters, trace)
 
     return loop
 
@@ -414,7 +431,11 @@ def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
         (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
         fingerprint=fingerprint, shape=shape)
     converged = bool(converged)
-    telemetry.inc("fit.converged" if converged else "fit.maxiter_exhausted")
+    if bool(np.asarray(info.get("diverged", False))):
+        telemetry.inc("fit.diverged")
+    else:
+        telemetry.inc("fit.converged" if converged
+                      else "fit.maxiter_exhausted")
     return deltas, info, float(chi2), converged, counters
 
 
@@ -484,6 +505,7 @@ def build_batched_loop(run, probe=None, record=False):
             "active": jnp.ones(B, bool),
             "accepted": jnp.zeros(B, bool),
             "converged": jnp.zeros(B, bool),
+            "diverged": jnp.zeros(B, bool),
             "h": jnp.zeros((), jnp.int32),
             "it": jnp.zeros((), jnp.int32),
             "is_init": jnp.bool_(True),
@@ -500,7 +522,7 @@ def build_batched_loop(run, probe=None, record=False):
             c0["tn"] = jnp.zeros((), jnp.int32)
 
         def body(c):
-            live = c["active"] & (~c["accepted"])
+            live = c["active"] & (~c["accepted"]) & (~c["diverged"])
             # init: dx == 0 so the trial is deltas0 regardless of lam;
             # final: a zero lam pins the trial at the kept points
             lam_j = jnp.where(c["is_init"] | c["is_final"], 0.0,
@@ -517,8 +539,14 @@ def build_batched_loop(run, probe=None, record=False):
             p_norm = (~p_init) & (~p_final)
 
             # ---- normal trial judgment (member-wise) ----
-            better = t_chi2 <= c["chi2"] + _EPS
+            # a member whose full evaluation is non-finite diverges:
+            # finished at its last kept point, never adopted, never
+            # counted converged (every new predicate is False for
+            # finite members — co-member trajectories are bit-exact)
+            bad = ~jnp.isfinite(t_chi2)
+            better = (t_chi2 <= c["chi2"] + _EPS) & (~bad)
             newly = p_norm & live & better
+            div_n = c["diverged"] | (bad & (p_init | (p_norm & live)))
             deltas_n = jax.tree.map(lambda t, d: _bwhere(newly, t, d),
                                     trial, c["deltas"])
             new_n = jax.tree.map(lambda t, d: _bwhere(newly, t, d),
@@ -529,13 +557,13 @@ def build_batched_loop(run, probe=None, record=False):
             conv_n = c["converged"] | (newly & (decrease < min_dec))
             acc_n = c["accepted"] | newly
 
-            inner_done = jnp.all(acc_n | (~c["active"]))
+            inner_done = jnp.all(acc_n | (~c["active"]) | div_n)
             inner_exh = p_norm & (~inner_done) & (c["h"] + 1 >= max_halvings)
             end_iter = p_norm & (inner_done | inner_exh)
             # members with no downhill step left are at their optimum
-            conv_n = jnp.where(end_iter & c["active"] & (~acc_n),
-                               True, conv_n)
-            all_conv = jnp.all(conv_n)
+            conv_n = jnp.where(end_iter & c["active"] & (~acc_n)
+                               & (~div_n), True, conv_n)
+            all_conv = jnp.all(conv_n | div_n)
             stop_outer = end_iter & (all_conv | (c["it"] >= maxiter))
             # the host driver re-evaluates at the kept points only when
             # the last trial left an active member at a rejected lam
@@ -564,9 +592,11 @@ def build_batched_loop(run, probe=None, record=False):
                 "info": t_info,
                 "chi2": chi2_n,
                 "lam": lam_n,
-                "active": jnp.where(start, ~conv_n, c["active"]),
+                "active": jnp.where(start, ~(conv_n | div_n),
+                                    c["active"]),
                 "accepted": jnp.where(start, False, acc_n),
                 "converged": conv_n,
+                "diverged": div_n,
                 "h": _sel(start | end_iter, 0,
                           _sel(p_norm, c["h"] + 1, c["h"])),
                 "it": _sel(p_init, 1, _sel(next_iter, c["it"] + 1,
@@ -596,8 +626,8 @@ def build_batched_loop(run, probe=None, record=False):
         out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
         counters = {k: out[k] for k in _BATCH_COUNTERS}
         trace = {"n": out["tn"], **out["trace"]} if record else None
-        return (out["deltas"], out["info"], out["chi2"], out["converged"],
-                counters, trace)
+        return (out["deltas"], dict(out["info"], diverged=out["diverged"]),
+                out["chi2"], out["converged"], counters, trace)
 
     return loop
 
@@ -656,6 +686,7 @@ def _build_batched_probe_loop(run, probe, record=False):
             "pend": jnp.ones(B, bool),    # candidate awaits a full eval
             "fin": jnp.zeros(B, bool),    # member's fit is finished
             "converged": jnp.zeros(B, bool),
+            "diverged": jnp.zeros(B, bool),
             "done": jnp.bool_(False),
             **{k: jnp.zeros((), jnp.int32)
                for k in _BATCH_PROBE_COUNTERS},
@@ -682,10 +713,17 @@ def _build_batched_probe_loop(run, probe, record=False):
             t_new, t_info = run(trial, operands)
             t_chi2 = t_info["chi2_at_input"]
 
+            # a live member whose full evaluation is non-finite diverges:
+            # finished at its last kept point, out of the probe ladder,
+            # never adopted or counted converged. Every new predicate is
+            # False for finite members, so co-member trajectories stay
+            # bit-identical to an undiverged batch (member-diagonal)
+            bad = ~jnp.isfinite(t_chi2)
             norm = act & (~c["init"])
-            better = t_chi2 <= c["chi2"] + _EPS
+            better = (t_chi2 <= c["chi2"] + _EPS) & (~bad)
             newly = norm & better
-            rej = norm & (~better)
+            rej = norm & (~better) & (~bad)
+            div_now = bad & (c["init"] | norm)
             adopt = c["init"] | newly
 
             deltas_n = jax.tree.map(lambda t, d: _bwhere(newly, t, d),
@@ -702,8 +740,9 @@ def _build_batched_probe_loop(run, probe, record=False):
 
             # accepting members open their next iteration immediately
             # (member-wise dx from THIS body's proposal); nobody waits
-            # for a batch-wide iteration boundary
-            startm = adopt & (~fin_acc)
+            # for a batch-wide iteration boundary. A diverging init
+            # member must NOT open an iteration (its proposal is NaN)
+            startm = adopt & (~fin_acc) & (~div_now)
             dx_n = jax.tree.map(
                 lambda a, b, d: _bwhere(startm, a - b, d),
                 new_n, deltas_n, c["dx"])
@@ -748,7 +787,8 @@ def _build_batched_probe_loop(run, probe, record=False):
             exhausted = rej & (~s["found"])
 
             conv_n = c["converged"] | conv_now | exhausted
-            fin_n = c["fin"] | fin_acc | exhausted
+            div_n = c["diverged"] | div_now
+            fin_n = c["fin"] | fin_acc | exhausted | div_now
             pend_n = startm | probe_found
 
             out = {
@@ -769,6 +809,7 @@ def _build_batched_probe_loop(run, probe, record=False):
                 "pend": pend_n,
                 "fin": fin_n,
                 "converged": conv_n,
+                "diverged": div_n,
                 "done": jnp.all(fin_n),
                 "iterations": c["iterations"]
                 + jnp.sum(c["init"] | (newly & (~fin_acc)))
@@ -797,8 +838,8 @@ def _build_batched_probe_loop(run, probe, record=False):
         out = jax.lax.while_loop(lambda c: ~c["done"], body, c0)
         counters = {k: out[k] for k in _BATCH_PROBE_COUNTERS}
         trace = {"n": out["tn"], **out["trace"]} if record else None
-        return (out["deltas"], out["info"], out["chi2"], out["converged"],
-                counters, trace)
+        return (out["deltas"], dict(out["info"], diverged=out["diverged"]),
+                out["chi2"], out["converged"], counters, trace)
 
     return loop
 

@@ -97,6 +97,12 @@ class Fitter:
         self.parameter_covariance_matrix: np.ndarray | None = None
         self.fit_params: list[str] = []
         self.converged = False
+        # structured-failure flags (ISSUE 6): a fit that produced a
+        # non-finite chi2 or ran on a degenerate table is FLAGGED, never
+        # silently "converged" — the serve layer maps this to its
+        # diverged/quarantined statuses
+        self.diverged = False
+        self.diverged_reason: str | None = None
 
     def _new_resids(self):
         return self.resid_cls(self.toas, self.model, track_mode=self.track_mode)
@@ -292,5 +298,11 @@ class WLSFitter(Fitter):
             self.fit_params = [n for n in names if n != "Offset"]
             self.parameter_covariance_matrix = cov
         self.resids = self._new_resids()
-        self.converged = abs(self.resids.chi2 - chi2) < 1e-8 * max(1.0, chi2)
-        return self.resids.chi2
+        final = self.resids.chi2
+        self.diverged = not np.isfinite(final)
+        if self.diverged:
+            self.diverged_reason = f"non-finite chi2 ({final})"
+            telemetry.inc("fit.diverged")
+        self.converged = (not self.diverged
+                          and abs(final - chi2) < 1e-8 * max(1.0, chi2))
+        return final

@@ -198,7 +198,14 @@ class GLSFitter(Fitter):
                 # slice off the bucketing pad rows (user-visible waveform)
                 self.resids_noise = (T_np @ self.noise_coeffs)[:len(self.toas)]
         self.resids = self._new_resids()
-        return float(np.asarray(sol["chi2"]))
+        final = float(np.asarray(sol["chi2"]))
+        self.diverged = not np.isfinite(final)
+        if self.diverged:
+            from pint_tpu import telemetry
+
+            self.diverged_reason = f"non-finite chi2 ({final})"
+            telemetry.inc("fit.diverged")
+        return final
 
     def get_noise_residuals(self) -> np.ndarray | None:
         """Realized correlated-noise waveform [s] at each TOA."""
@@ -244,7 +251,22 @@ class _DownhillMixin:
         if min_chi2_decrease is not None:
             self.min_chi2_decrease = min_chi2_decrease
         self.converged = False
+        self.diverged = False
+        self.diverged_reason = None
         telemetry.set_gauge("fit.ntoas", len(self.toas))
+        # degenerate-table guard (ISSUE 6): a table with no usable
+        # weight (every TOA error non-finite or non-positive) has no
+        # objective — running the solver would manufacture a chi2-0
+        # "perfect fit" with zero/NaN uncertainties. Flag and return
+        # without touching the model (a structured failure, never a
+        # silent one).
+        errs = np.asarray(self.resids.get_errors_s())
+        if not np.any(np.isfinite(errs) & (errs > 0)):
+            self.diverged = True
+            self.diverged_reason = "all-zero-weight table (no finite " \
+                                   "positive TOA uncertainty)"
+            telemetry.inc("fit.diverged")
+            return float("nan")
         # flight recorder: in this driver every trial IS a full chi2
         # evaluation (no residual-only probe), so each trial appends an
         # entry and halvings attach to the rejected predecessor — the
@@ -253,6 +275,15 @@ class _DownhillMixin:
         chi2 = self._chi2_now()
         if rec:
             rec.eval(chi2, 1.0)
+        if not np.isfinite(chi2):
+            # divergence at entry (NaN-poisoned table): flagged, model
+            # untouched — mirrors the fused device loop's diverged flag
+            self.diverged = True
+            self.diverged_reason = f"non-finite chi2 at entry ({chi2})"
+            telemetry.inc("fit.diverged")
+            if rec:
+                rec.emit("dense_downhill")
+            return float(chi2)
         for _ in range(max(1, maxiter)):
             telemetry.inc("fit.iterations")
             snap = self._snapshot()
@@ -261,6 +292,7 @@ class _DownhillMixin:
             lam = 1.0
             best_chi2 = chi2
             applied = False
+            saw_finite = False
             for _h in range(self.max_step_halvings):
                 if _h > 0:
                     telemetry.inc("fit.halvings")
@@ -269,6 +301,7 @@ class _DownhillMixin:
                 self._restore(snap)
                 self.update_model(names, lam * x, errors)
                 new_chi2 = self._chi2_now()
+                saw_finite = saw_finite or bool(np.isfinite(new_chi2))
                 if rec:
                     rec.eval(new_chi2, lam)
                 if new_chi2 <= best_chi2 + 1e-12:
@@ -279,9 +312,17 @@ class _DownhillMixin:
                     break
                 lam *= 0.5
             if not applied:
-                # no downhill step found: restore and stop
+                # no downhill step found: restore and stop. When every
+                # trial chi2 was non-finite the solver produced garbage
+                # (NaN step from a degenerate solve), not an optimum —
+                # that is divergence, not convergence
                 self._restore(snap)
                 self._chi2_now()
+                if not saw_finite:
+                    self.diverged = True
+                    self.diverged_reason = ("step produced non-finite "
+                                            "chi2 at every damping level")
+                    break
                 self.converged = True
                 break
             self.fit_params = [n for n in names if n != "Offset"]
@@ -291,8 +332,11 @@ class _DownhillMixin:
                 self.converged = True
                 break
             chi2 = new_chi2
-        telemetry.inc("fit.converged" if self.converged
-                      else "fit.maxiter_exhausted")
+        if self.diverged:
+            telemetry.inc("fit.diverged")
+        else:
+            telemetry.inc("fit.converged" if self.converged
+                          else "fit.maxiter_exhausted")
         if rec:
             rec.emit("dense_downhill")
         return chi2

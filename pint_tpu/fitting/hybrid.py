@@ -636,6 +636,13 @@ class HybridGLSFitter(Fitter):
                     maxiter=maxiter,
                     min_chi2_decrease=min_chi2_decrease,
                     chi2_at=lambda d: self._chi2_at(base, d))
+        # a diverged fit (non-finite chi2, flagged in-loop) must never
+        # write NaN parameters/uncertainties back into the model
+        self.diverged = bool(np.asarray(sol.get("diverged", False)))
+        if self.diverged:
+            self.diverged_reason = f"non-finite chi2 ({chi2})"
+            self.converged = False
+            return chi2
         cov = np.asarray(sol["cov"])
         errors = np.sqrt(np.diagonal(cov))
         for i, k in enumerate(self._names):

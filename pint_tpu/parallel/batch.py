@@ -344,6 +344,9 @@ class BatchedPulsarFitter:
                 for _ in range(pad_members - len(problems))]
         self.toas_list = [t for t, _ in problems]
         self.models = [m for _, m in problems]
+        # per-real-member flags; fit_toas / finish() overwrite
+        self.converged = np.zeros(self.n_real, dtype=bool)
+        self.diverged = np.zeros(self.n_real, dtype=bool)
         from pint_tpu.bucketing import note_batch_occupancy
 
         note_batch_occupancy(self.n_real, len(self.models))
@@ -599,7 +602,13 @@ class BatchedPulsarFitter:
             else:
                 _, info = run(deltas)
             info = dict(info, chi2=info["chi2_at_input"])
-        self.converged = converged[:self.n_real]
+        # host-loop divergence flag (the device loop carries this in the
+        # while-loop state): a member whose chi2 is non-finite never
+        # converged and must not write NaN back into its model
+        div = ~np.isfinite(np.asarray(info["chi2"]))
+        info = dict(info, diverged=div)
+        self.converged = (converged & ~div)[:self.n_real]
+        self.diverged = div[:self.n_real]
         self._write_back(deltas, info)
         return np.asarray(info["chi2"])[:self.n_real]
 
@@ -684,7 +693,14 @@ class BatchedPulsarFitter:
         deltas = {k: np.asarray(deltas[k]) for k in self.free_params}
         errors = {k: np.asarray(info["errors"][k])
                   for k in self.free_params}
+        # a diverged member's deltas/errors are not trustworthy (NaN or
+        # at an arbitrary last-kept point of a poisoned objective):
+        # leave its model untouched — the serve layer quarantines it
+        div = np.asarray(info.get("diverged",
+                                  np.zeros(len(self.models), bool)))
         for i, m in enumerate(self.models[:self.n_real]):
+            if div[i]:
+                continue
             for k in self.free_params:
                 if self.param_mask[k][i] == 0.0:
                     continue
@@ -731,6 +747,9 @@ class _InFlightBatchPulsarFit:
             d_fit, info, _chi2, converged, _cnt = self._handle.fetch()
             info = dict(info, chi2=info["chi2_at_input"])
             f.converged = np.asarray(converged)[:f.n_real]
+            f.diverged = np.asarray(
+                info.get("diverged",
+                         np.zeros(len(f.models), bool)))[:f.n_real]
             f._write_back(d_fit, info)
             self._chi2 = np.asarray(info["chi2"])[:f.n_real]
         return self._chi2

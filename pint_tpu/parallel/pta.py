@@ -379,6 +379,8 @@ class PTAGLSFitter:
         self.toas_list = [t for t, _ in problems]
         self.models = [m for _, m in problems]
         self.mesh = mesh
+        self.diverged = False
+        self.diverged_reason: str | None = None
         # hybrid CPU-DD -> accelerator-gram split (same architecture as
         # fitting.hybrid.HybridGLSFitter): auto-enabled when the default
         # backend is an accelerator (whose emulated f64 cannot run the
@@ -747,6 +749,14 @@ class PTAGLSFitter:
             deltas, info, chi2, converged = downhill_iterate(
                 self.step, self.zero_flat(), maxiter=maxiter)
         self.converged = converged
+        # a diverged joint fit (non-finite chi2) is FLAGGED and never
+        # writes NaN parameters/uncertainties back into the models
+        self.diverged = bool(np.asarray(info.get("diverged", False)))
+        if self.diverged:
+            self.diverged_reason = f"non-finite chi2 ({chi2})"
+            self.converged = False
+            self.chi2 = chi2
+            return chi2
         self.gw_coeffs = info["gw_coeffs"]
         errors = info["errors_fn"]()
         for i, model in enumerate(self.models):
@@ -912,6 +922,13 @@ class PTAGLSFitter:
                         fingerprint=key[1] + (self.gw,),
                         shape=tuple(len(t) for t in self.toas_list))
         self.converged = converged
+        # a diverged joint fit is FLAGGED; no NaN write-back
+        self.diverged = bool(np.asarray(info.get("diverged", False)))
+        if self.diverged:
+            self.diverged_reason = f"non-finite chi2 ({chi2})"
+            self.converged = False
+            self.chi2 = chi2
+            return chi2
         # errors from the carried state of the accepted evaluation —
         # exactly the host errors_fn algebra, on the fetched arrays
         Lam = np.asarray(jax.scipy.linalg.cho_solve(

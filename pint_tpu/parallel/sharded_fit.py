@@ -90,6 +90,13 @@ class ShardedWLSFitter(Fitter):
         deltas, info, chi2, converged = sharded_fit(
             self.toas, self.model, mesh=self.mesh, maxiter=maxiter,
             min_chi2_decrease=min_chi2_decrease)
+        # a diverged fit (non-finite chi2 — loop's in-carry flag) must
+        # be FLAGGED and must not write NaN params/uncertainties back
+        self.diverged = bool(np.asarray(info.get("diverged", False)))
+        if self.diverged:
+            self.diverged_reason = f"non-finite chi2 ({chi2})"
+            self.converged = False
+            return chi2
         errors = info["errors"]
         for name, d in deltas.items():
             p = self.model[name]
@@ -179,6 +186,12 @@ class ShardedGLSFitter(Fitter):
         deltas, info, chi2, converged = sharded_gls_fit(
             self.toas, self.model, mesh=self.mesh, maxiter=maxiter,
             min_chi2_decrease=min_chi2_decrease)
+        # flagged, never silent NaN write-back (see ShardedWLSFitter)
+        self.diverged = bool(np.asarray(info.get("diverged", False)))
+        if self.diverged:
+            self.diverged_reason = f"non-finite chi2 ({chi2})"
+            self.converged = False
+            return chi2
         errors = info["errors"]
         for name, d in deltas.items():
             p = self.model[name]

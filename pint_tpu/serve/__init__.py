@@ -5,19 +5,25 @@ makes a *stream* of fits cheap: a bounded request queue, fingerprint-
 bucketed continuous batching into the fused batched loop (B compatible
 fits = ONE launch + ONE fetch), pow-2 member padding with bit-inert
 dummies, and a double-buffered dispatch pipeline that overlaps host
-packing with device execution. See docs/ARCHITECTURE.md "Throughput
-engine" for the batch-formation policy and backpressure contract.
+packing with device execution. Every request resolves to a structured
+status (never an exception tearing down a drain): per-request
+isolation, deadlines, transient-error retries, quarantine and a
+degradation ladder, with seed-driven chaos in
+:mod:`pint_tpu.serve.faults`. See docs/ARCHITECTURE.md "Throughput
+engine" and "Failure domains & degradation ladder".
 """
 
+from pint_tpu.serve import faults  # noqa: F401
 from pint_tpu.serve.fingerprint import (  # noqa: F401
     batchable, short_id, structure_fingerprint)
 from pint_tpu.serve.pipeline import run_pipeline  # noqa: F401
 from pint_tpu.serve.scheduler import (  # noqa: F401
-    BatchPlan, FitHandle, FitRequest, FitResult, ServeQueueFull,
-    ThroughputScheduler)
+    STATUSES, BatchPlan, FitHandle, FitRequest, FitResult, ServeQueueFull,
+    ThroughputScheduler, transient_error)
 
 __all__ = [
-    "BatchPlan", "FitHandle", "FitRequest", "FitResult", "ServeQueueFull",
-    "ThroughputScheduler", "batchable", "run_pipeline", "short_id",
-    "structure_fingerprint",
+    "BatchPlan", "FitHandle", "FitRequest", "FitResult", "STATUSES",
+    "ServeQueueFull", "ThroughputScheduler", "batchable", "faults",
+    "run_pipeline", "short_id", "structure_fingerprint",
+    "transient_error",
 ]

@@ -10,7 +10,8 @@ double-buffered dispatch):
 1. **Bounded queue** — :meth:`ThroughputScheduler.submit` enqueues a
    :class:`FitRequest` and returns a :class:`FitHandle`; a full queue
    raises :class:`ServeQueueFull` (backpressure is the caller's signal
-   to drain, never silent dropping).
+   to drain, never silent dropping) carrying the queue depth and a
+   retry-after hint derived from the recent drain rate.
 2. **Batch formation** (:meth:`ThroughputScheduler.plan`) — queued
    requests group by (structure fingerprint, TOA-count bucket, fit
    hyperparameters); each group chunks to ``max_batch_members`` and
@@ -28,10 +29,36 @@ delay-side jumps, wideband) are served through a **passthrough** path —
 a per-request ``Fitter.auto`` fit in its own singleton batch — so the
 scheduler accepts any model the library can fit.
 
-Telemetry: ``serve.*`` counters/gauges plus one ``type="serve"``
-JSON-lines record per drain (per-batch occupancy, queue latency,
-overlap efficiency, fits/s) — rendered by ``python -m
-pint_tpu.telemetry.report`` under "throughput engine".
+**Failure domains (ISSUE 6).** Every submitted request resolves to a
+:class:`FitResult` with a ``status`` — one of :data:`STATUSES` — and an
+exception in one batch can never tear down a drain:
+
+* a batch member whose on-device fit produces non-finite chi2 (the
+  device loop's ``diverged`` carry, read in the same single fetch) is
+  retried ONCE as a standalone passthrough fit, then **quarantined**
+  with its flight-recorder trace attached to the failure record;
+* a failed prep/dispatch/fetch stage salvages its members through
+  per-request passthrough fits (``failed`` only when that also raises);
+* transient ``XlaRuntimeError``-class dispatch/fetch errors retry with
+  exponential backoff (``max_dispatch_retries`` x ``retry_backoff_s``,
+  the tools/tpu_retry.sh probe-then-retry idea in-library);
+* ``deadline_s`` is checked at formation (expired requests resolve
+  ``timed_out`` without running) and again after ``finish()``;
+* under sustained batch failure the scheduler walks a **degradation
+  ladder**: first every plan becomes an isolated passthrough (blast
+  radius one request), then load sheds predictably — submit rejects at
+  half capacity and the drain resolves the NEWEST queued requests
+  beyond it as ``rejected`` with a retry-after hint — rather than
+  collapsing. A clean drain heals the ladder.
+
+Fault injection for all of the above lives in
+:mod:`pint_tpu.serve.faults` (seed-driven, zero-cost when off).
+
+Telemetry: ``serve.*`` counters/gauges (now including ``serve.fault.*``
+/ ``serve.retry.*`` / ``serve.quarantine.*`` / ``serve.status.*``), one
+``type="serve"`` record per drain and one ``type="fault"`` record per
+failure event — rendered by ``python -m pint_tpu.telemetry.report``
+under "throughput engine" and "failure domains".
 """
 
 from __future__ import annotations
@@ -44,16 +71,70 @@ import numpy as np
 
 from pint_tpu import bucketing, telemetry
 from pint_tpu.serve import fingerprint as _fp
+from pint_tpu.serve import faults as _faults
 from pint_tpu.serve.pipeline import run_pipeline
+
+#: the request-status taxonomy (docs/ARCHITECTURE.md "Failure domains")
+STATUSES = ("ok", "nonconverged", "diverged", "failed", "timed_out",
+            "quarantined", "rejected")
 
 
 class ServeQueueFull(RuntimeError):
-    """submit() on a full queue: drain (or widen max_queue) and retry."""
+    """submit() on a full queue: drain (or widen max_queue) and retry.
+
+    Carries the actionable context: ``depth`` / ``max_queue`` at the
+    rejection, a ``retry_after_s`` hint (queue depth over the recent
+    drain rate), and whether the scheduler was in its ``degraded``
+    shedding state (capacity halved).
+    """
+
+    def __init__(self, depth: int = 0, max_queue: int = 0,
+                 retry_after_s: float | None = None,
+                 degraded: bool = False):
+        self.depth = depth
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self.degraded = degraded
+        msg = f"queue at capacity ({depth}/{max_queue}"
+        if degraded:
+            msg += ", degraded: shedding at half capacity"
+        msg += "); drain() first"
+        if retry_after_s is not None:
+            msg += f" and retry after ~{retry_after_s:g}s"
+        super().__init__(msg)
+
+
+# transient = worth re-dispatching the SAME work: the jaxlib runtime
+# error classes a flaky device/tunnel surfaces, plus the grpc-ish status
+# strings they carry (the probe-then-retry policy of tools/tpu_retry.sh)
+_TRANSIENT_TYPES = ("XlaRuntimeError", "JaxRuntimeError")
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "RESOURCE_EXHAUSTED",
+                      "DEADLINE_EXCEEDED", "ABORTED", "INTERNAL",
+                      "connection", "socket closed")
+
+
+def transient_error(exc: BaseException) -> bool:
+    """Is this a retry-worthy device/runtime failure (vs a model bug)?"""
+    if isinstance(exc, _faults.InjectedDeviceError):
+        return True
+    if isinstance(exc, _faults.InjectedFault):
+        return False
+    if type(exc).__name__ in _TRANSIENT_TYPES:
+        return True
+    if isinstance(exc, (RuntimeError, OSError)):
+        return any(m in str(exc) for m in _TRANSIENT_MARKERS)
+    return False
 
 
 @dataclasses.dataclass
 class FitRequest:
-    """One fit: a TOA table + a (perturbed) model to fit in place."""
+    """One fit: a TOA table + a (perturbed) model to fit in place.
+
+    ``deadline_s`` (optional) is a per-request latency budget counted
+    from submit: expired before formation -> resolved ``timed_out``
+    without running; expired when the result lands -> the fit is
+    attached but the status reports the SLA miss.
+    """
 
     toas: Any
     model: Any
@@ -61,11 +142,21 @@ class FitRequest:
     min_chi2_decrease: float = 1e-3
     max_step_halvings: int = 8
     tag: Any = None
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
 class FitResult:
-    """Per-request outcome; ``request.model`` holds the fitted values."""
+    """Per-request outcome envelope.
+
+    ``status`` is one of :data:`STATUSES`; ``request.model`` holds the
+    fitted values only for ``ok`` / ``nonconverged`` / ``timed_out``
+    (a diverged/quarantined fit never writes back NaN parameters).
+    ``trace`` carries the member's flight-recorder record on
+    quarantine; ``retry_after_s`` the shed hint on ``rejected``;
+    ``injected`` names the fault pint_tpu.serve.faults planted (chaos
+    runs only — diagnostics, never behavior).
+    """
 
     tag: Any
     request: FitRequest
@@ -77,6 +168,24 @@ class FitResult:
     occupancy: float
     queue_latency_s: float
     passthrough: bool = False
+    status: str = "ok"
+    error: str | None = None
+    attempts: int = 1
+    trace: dict | None = None
+    retry_after_s: float | None = None
+    injected: str | None = None
+
+    @property
+    def fitted(self) -> bool:
+        """Did a fit complete and write back (status-taxonomy helper)?
+
+        A ``timed_out`` request counts only when the fit actually ran
+        (deadline missed after finish — finite chi2 attached); one that
+        expired before formation never ran and holds stale parameters.
+        """
+        if self.status in ("ok", "nonconverged"):
+            return True
+        return self.status == "timed_out" and bool(np.isfinite(self.chi2))
 
 
 class FitHandle:
@@ -112,6 +221,52 @@ class BatchPlan:
         return len(self.indices) / max(1, self.n_members)
 
 
+class _FailedBatch:
+    """Pipeline-stage failure marker: the batch's members get salvaged
+    through per-request passthrough fits at the fetch stage."""
+
+    __slots__ = ("plan", "error", "stage", "attempts")
+
+    def __init__(self, plan, error, stage, attempts=1):
+        self.plan = plan
+        self.error = error
+        self.stage = stage
+        self.attempts = attempts
+
+
+class _BatchState:
+    """In-flight state threaded through prep -> dispatch -> fetch."""
+
+    __slots__ = ("plan", "fitter", "handle", "resolved", "trace",
+                 "attempts", "hyper")
+
+    def __init__(self, plan, fitter=None):
+        self.plan = plan
+        self.fitter = fitter
+        self.handle = None
+        self.resolved = None  # passthrough: (chi2, conv, div, reason)
+        self.trace = None     # passthrough: trace captured at fit time
+        self.attempts = 1
+        self.hyper = None
+
+
+def _member_trace(trace: dict | None, m: int) -> dict | None:
+    """Member ``m``'s slice of a batched flight-recorder record."""
+    from pint_tpu.telemetry.recorder import BATCH_FIELDS
+
+    if trace is None:
+        return None
+    out = {k: trace[k] for k in ("type", "loop", "kind", "n", "recorded",
+                                 "dropped") if k in trace}
+    out["member"] = m
+    for f in BATCH_FIELDS:  # the authoritative per-member field list
+        rows = trace.get(f)
+        if rows:
+            out[f] = [row[m] if isinstance(row, (list, tuple)) else row
+                      for row in rows]
+    return out
+
+
 class ThroughputScheduler:
     """Bounded-queue continuous batching over the fused batched loop.
 
@@ -120,11 +275,19 @@ class ThroughputScheduler:
     ``member_floor`` floors the pow-2 member bucket (tests use it to
     force dummy padding); ``window`` is the double-buffer depth
     (in-flight batches); ``mesh`` is forwarded to the batched fitter.
+
+    Fault-domain knobs: ``max_dispatch_retries`` transient re-dispatches
+    per batch, ``retry_backoff_s`` the exponential backoff base (0 in
+    tests), ``degrade_after`` the consecutive-failing-drain count that
+    trips the degradation ladder.
     """
 
     def __init__(self, *, max_queue: int = 256,
                  max_batch_members: int = 64, member_floor: int = 1,
-                 window: int = 2, mesh=None):
+                 window: int = 2, mesh=None,
+                 max_dispatch_retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 degrade_after: int = 2):
         if max_queue < 1 or max_batch_members < 1:
             raise ValueError("max_queue and max_batch_members must be >= 1")
         self.max_queue = max_queue
@@ -132,28 +295,73 @@ class ThroughputScheduler:
         self.member_floor = max(1, member_floor)
         self.window = max(1, window)
         self.mesh = mesh
-        self._queue: list[tuple[FitRequest, FitHandle, float, tuple]] = []
+        self.max_dispatch_retries = max(0, max_dispatch_retries)
+        self.retry_backoff_s = max(0.0, retry_backoff_s)
+        self.degrade_after = max(1, degrade_after)
+        # (request, handle, t_submit, fingerprint, meta) — meta carries
+        # the submit sequence number and any injected-fault label
+        self._queue: list[tuple[FitRequest, FitHandle, float, tuple,
+                                dict]] = []
+        self._seq = 0          # submit sequence (fault-injection key)
+        self._drain_seq = 0
+        self._fail_streak = 0  # consecutive drains with a failed batch
+        self._drain_rate: float | None = None  # EWMA fits/s
         self.last_drain: dict | None = None
+
+    # ------------------------------------------------------------------
+    # degradation ladder
+    # ------------------------------------------------------------------
+    def degraded(self) -> bool:
+        """Ladder tripped: ``degrade_after`` consecutive drains each had
+        at least one batch exhaust its retries. While degraded, plans
+        are isolated passthroughs and capacity halves (shedding)."""
+        return self._fail_streak >= self.degrade_after
+
+    def _retry_after_hint(self, depth: int) -> float:
+        """Seconds until the queue plausibly has room: depth over the
+        EWMA drain rate (bounded); depth-scaled default with no
+        history."""
+        rate = self._drain_rate or 0.0
+        if rate <= 0.0:
+            return round(max(1.0, 0.02 * depth), 3)
+        return round(min(60.0, max(0.05, depth / rate)), 3)
 
     # ------------------------------------------------------------------
     # intake
     # ------------------------------------------------------------------
     def submit(self, request: FitRequest) -> FitHandle:
         """Enqueue one request; raises :class:`ServeQueueFull` when the
-        bounded queue is at capacity (the backpressure contract).
+        bounded queue is at capacity (the backpressure contract) — at
+        HALF capacity while the degradation ladder is shedding.
 
         The structure fingerprint is canonicalized HERE, once per
         request on the enqueue path (it is ~1 ms of model hashing — in
         the drain it would serialize with every batch), so an
         unfingerprintable model fails fast at submission and
         :meth:`plan`/:meth:`drain` only group precomputed keys."""
-        if len(self._queue) >= self.max_queue:
+        degraded = self.degraded()
+        cap = self.max_queue if not degraded else max(1, self.max_queue // 2)
+        if len(self._queue) >= cap:
+            depth = len(self._queue)
             telemetry.inc("serve.rejected")
-            raise ServeQueueFull(
-                f"queue at capacity ({self.max_queue}); drain() first")
+            raise ServeQueueFull(depth=depth, max_queue=self.max_queue,
+                                 retry_after_s=self._retry_after_hint(depth),
+                                 degraded=degraded)
+        seq = self._seq
+        self._seq += 1
+        injected = None
+        plan_f = _faults.active()
+        if plan_f is not None:
+            toas, model, injected = plan_f.corrupt_request(
+                seq, request.toas, request.model)
+            if injected is not None:
+                request = dataclasses.replace(request, toas=toas,
+                                              model=model)
+                telemetry.inc(f"serve.fault.injected.{injected}")
         handle = FitHandle()
         fp = _fp.structure_fingerprint(request.model, request.toas)
-        self._queue.append((request, handle, time.perf_counter(), fp))
+        self._queue.append((request, handle, time.perf_counter(), fp,
+                            {"seq": seq, "injected": injected}))
         telemetry.inc("serve.requests")
         return handle
 
@@ -173,10 +381,15 @@ class ThroughputScheduler:
         existing zero-weight ``pad_toas`` rows. Groups keep submission
         order; each chunks at ``max_batch_members`` and pads to the
         pow-2 member bucket.
+
+        Degradation-ladder level 1: while :meth:`degraded`, EVERY plan
+        is an isolated passthrough — under suspected systemic failure
+        the blast radius of any one launch is one request.
         """
+        degraded = self.degraded()
         groups: dict[tuple, list[int]] = {}
         order: list[tuple] = []
-        for i, (req, _h, _t, fp) in enumerate(self._queue):
+        for i, (req, _h, _t, fp, _m) in enumerate(self._queue):
             key = (fp, bucketing.bucket_size(len(req.toas)),
                    req.maxiter, req.min_chi2_decrease,
                    req.max_step_halvings)
@@ -188,7 +401,7 @@ class ThroughputScheduler:
         for key in order:
             fp, bucket = key[0], key[1]
             idxs = groups[key]
-            if not fp[0]:          # the fingerprint's batchable bit
+            if not fp[0] or degraded:  # unbatchable OR isolation mode
                 plans.extend(
                     BatchPlan("passthrough", _fp.short_id(fp), [i],
                               bucket, 1) for i in idxs)
@@ -208,106 +421,370 @@ class ThroughputScheduler:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
+    def _passthrough_fit(self, req: FitRequest):
+        """One standalone ``Fitter.auto`` fit; returns
+        ``(chi2, converged, diverged, reason)``. Raises on hard errors
+        (the caller maps that to ``failed``)."""
+        from pint_tpu.fitting.fitter import Fitter
+
+        f = Fitter.auto(req.toas, req.model)
+        # every Fitter.auto target is a _DownhillMixin, whose loop reads
+        # the halving cap off the instance
+        f.max_step_halvings = req.max_step_halvings
+        chi2 = f.fit_toas(maxiter=req.maxiter,
+                          min_chi2_decrease=req.min_chi2_decrease)
+        chi2 = float(np.atleast_1d(np.asarray(chi2, dtype=float))[0])
+        diverged = bool(getattr(f, "diverged", False)) \
+            or not np.isfinite(chi2)
+        reason = getattr(f, "diverged_reason", None) \
+            or (f"non-finite chi2 ({chi2})" if diverged else None)
+        return chi2, bool(np.all(np.asarray(f.converged))), diverged, reason
+
+    def _envelope(self, entry, *, status, plan=None, chi2=float("nan"),
+                  converged=False, error=None, attempts=1, trace=None,
+                  retry_after_s=None, passthrough=False,
+                  t_done=None) -> FitResult:
+        """Build + resolve one request's result envelope (counters,
+        deadline override, fault record)."""
+        req, handle, t_sub, _fp_i, meta = entry
+        if t_done is None:
+            t_done = time.perf_counter()
+        if (status in ("ok", "nonconverged") and req.deadline_s is not None
+                and (t_done - t_sub) > req.deadline_s):
+            telemetry.inc("serve.deadline.timeouts")
+            status = "timed_out"
+            error = (f"deadline_s={req.deadline_s:g} exceeded "
+                     f"(latency {t_done - t_sub:.3f}s); the completed "
+                     "fit is attached")
+        res = FitResult(
+            tag=req.tag, request=req, chi2=float(chi2),
+            converged=bool(converged),
+            batch=getattr(plan, "_seq", -1) if plan is not None else -1,
+            group=plan.group if plan is not None else "",
+            n_members=plan.n_members if plan is not None else 0,
+            occupancy=plan.occupancy if plan is not None else 0.0,
+            queue_latency_s=round(t_done - t_sub, 6),
+            passthrough=passthrough, status=status, error=error,
+            attempts=attempts, trace=trace, retry_after_s=retry_after_s,
+            injected=meta.get("injected"))
+        handle._result = res
+        telemetry.inc(f"serve.status.{status}")
+        if status not in ("ok", "nonconverged"):
+            rec = {"type": "fault", "status": status,
+                   "tag": repr(req.tag), "group": res.group,
+                   "error": error, "attempts": attempts,
+                   "injected": res.injected,
+                   "queue_latency_s": res.queue_latency_s}
+            if trace is not None:
+                rec["trace"] = trace
+            telemetry.add_record(rec)
+        return res
+
+    def _salvage(self, live, plan, failure: _FailedBatch):
+        """A batch stage failed: fit every member standalone instead.
+
+        Success -> ``ok``/``nonconverged``/``diverged`` on the member's
+        own merits; a second failure -> ``failed`` with both errors.
+        A passthrough plan whose DISPATCH stage failed already WAS the
+        standalone fit — re-running the identical deterministic fit
+        would just double the cost of the same exception, so it maps
+        straight to ``failed``."""
+        telemetry.add_record({
+            "type": "fault", "status": "batch_" + failure.stage,
+            "group": plan.group, "kind": plan.kind,
+            "members": len(plan.indices), "attempts": failure.attempts,
+            "error": f"{type(failure.error).__name__}: {failure.error}"})
+        if plan.kind == "passthrough" and failure.stage == "dispatch":
+            telemetry.inc("serve.fault.request")
+            return [self._envelope(
+                live[i], status="failed", plan=plan,
+                error=f"standalone fit raised "
+                      f"{type(failure.error).__name__}: {failure.error}",
+                attempts=failure.attempts, passthrough=True)
+                for i in plan.indices]
+        out = []
+        for i in plan.indices:
+            entry = live[i]
+            telemetry.inc("serve.retry.passthrough")
+            try:
+                chi2, conv, div, reason = self._passthrough_fit(entry[0])
+                if div:
+                    telemetry.inc("serve.fault.diverged")
+                    out.append(self._envelope(
+                        entry, status="diverged", plan=plan, chi2=chi2,
+                        error=f"batch {failure.stage} failed "
+                              f"({failure.error}); standalone retry "
+                              f"diverged: {reason}",
+                        attempts=failure.attempts + 1, passthrough=True))
+                else:
+                    telemetry.inc("serve.retry.success")
+                    out.append(self._envelope(
+                        entry, status="ok" if conv else "nonconverged",
+                        plan=plan, chi2=chi2, converged=conv,
+                        attempts=failure.attempts + 1, passthrough=True))
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                telemetry.inc("serve.fault.request")
+                out.append(self._envelope(
+                    entry, status="failed", plan=plan,
+                    error=f"batch {failure.stage} stage: "
+                          f"{type(failure.error).__name__}: "
+                          f"{failure.error}; passthrough retry: "
+                          f"{type(e).__name__}: {e}",
+                    attempts=failure.attempts + 1, passthrough=True))
+        return out
+
+    def _retry_diverged(self, entry, plan, trace, m):
+        """Batch member diverged on-device: ONE standalone retry, then
+        quarantine with the member's flight-recorder trace attached."""
+        telemetry.inc("serve.fault.diverged")
+        telemetry.inc("serve.retry.passthrough")
+        mtrace = _member_trace(trace, m)
+        try:
+            chi2, conv, div, reason = self._passthrough_fit(entry[0])
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            telemetry.inc("serve.quarantine.count")
+            return self._envelope(
+                entry, status="quarantined", plan=plan, trace=mtrace,
+                error="diverged in batch (non-finite chi2); standalone "
+                      f"retry raised {type(e).__name__}: {e}",
+                attempts=2, passthrough=True)
+        if div:
+            telemetry.inc("serve.quarantine.count")
+            return self._envelope(
+                entry, status="quarantined", plan=plan, chi2=chi2,
+                trace=mtrace,
+                error="diverged in batch (non-finite chi2); standalone "
+                      f"retry also diverged: {reason}",
+                attempts=2, passthrough=True)
+        telemetry.inc("serve.retry.success")
+        return self._envelope(
+            entry, status="ok" if conv else "nonconverged", plan=plan,
+            chi2=chi2, converged=conv, attempts=2, passthrough=True)
+
     def drain(self) -> list[FitResult]:
         """Fit every queued request; resolve handles; empty the queue.
 
         Batches flow through the double-buffered pipeline: host prep of
         batch k+1 overlaps device execution of batch k, with at most
         ``window`` batches in flight. Returns results in submission
-        order (batch execution order is a scheduling detail).
+        order (batch execution order is a scheduling detail). Every
+        request resolves to a structured status — a fault in one batch
+        salvages its own members and never strands the rest.
         """
+        from pint_tpu.telemetry import recorder
+
         if not self._queue:
             return []
         queue, self._queue = self._queue, []
-        plans = self._plans_for(queue)
+        self._drain_seq += 1
+        drain_id = self._drain_seq
+        plan_f = _faults.active()
+        t_form = time.perf_counter()
+        results: list[FitResult | None] = [None] * len(queue)
+
+        # ladder level 2 (shedding): while degraded, the NEWEST requests
+        # beyond half capacity are rejected with a retry-after hint —
+        # predictable load shedding instead of a collapsing backlog
+        live_idx = list(range(len(queue)))
+        if self.degraded():
+            cap = max(1, self.max_queue // 2)
+            if len(live_idx) > cap:
+                hint = self._retry_after_hint(len(queue))
+                for i in live_idx[cap:]:
+                    telemetry.inc("serve.shed")
+                    results[i] = self._envelope(
+                        queue[i], status="rejected", retry_after_s=hint,
+                        error=f"shed: degraded after {self._fail_streak} "
+                              f"failing drains, queue {len(queue)} > "
+                              f"degraded capacity {cap}; retry after "
+                              f"~{hint:g}s", t_done=t_form)
+                live_idx = live_idx[:cap]
+
+        # deadline check at formation: an already-expired request must
+        # not consume a batch slot just to miss harder
+        kept = []
+        for i in live_idx:
+            req = queue[i][0]
+            if (req.deadline_s is not None
+                    and t_form - queue[i][2] > req.deadline_s):
+                telemetry.inc("serve.deadline.timeouts")
+                results[i] = self._envelope(
+                    queue[i], status="timed_out", t_done=t_form,
+                    error=f"deadline_s={req.deadline_s:g} expired before "
+                          "batch formation")
+            else:
+                kept.append(i)
+
+        live = [queue[i] for i in kept]
+        plans = self._plans_for(live)
+        fail_batches = 0
+
+        def _hyper(plan):
+            req0 = live[plan.indices[0]][0]
+            return dict(maxiter=req0.maxiter,
+                        min_chi2_decrease=req0.min_chi2_decrease,
+                        max_step_halvings=req0.max_step_halvings)
 
         def _prep(plan: BatchPlan):
+            state = _BatchState(plan)
+            state.hyper = _hyper(plan)
+            try:
+                if plan_f is not None:
+                    plan_f.maybe_prep_fault((drain_id, plan._seq))
+                if plan.kind == "passthrough":
+                    return state  # Fitter.auto built at dispatch time
+                from pint_tpu.parallel.batch import BatchedPulsarFitter
+
+                problems = [(live[i][0].toas, live[i][0].model)
+                            for i in plan.indices]
+                with telemetry.span("serve.prep",
+                                    members=plan.n_members):
+                    state.fitter = BatchedPulsarFitter(
+                        problems, mesh=self.mesh,
+                        pad_members=plan.n_members)
+                return state
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                telemetry.inc("serve.fault.prep")
+                return _FailedBatch(plan, e, "prep")
+
+        def _dispatch(state):
+            if isinstance(state, _FailedBatch):
+                return state
+            plan = state.plan
+            while True:
+                try:
+                    if plan_f is not None and plan.kind == "batched":
+                        plan_f.maybe_device_error(
+                            (drain_id, plan._seq), state.attempts - 1)
+                    if plan.kind == "passthrough":
+                        # host-driven fitters cannot be suspended
+                        # mid-loop: the fit runs here, already resolved
+                        # at fetch time. The trace is captured NOW —
+                        # by fetch time a later batch's dispatch may
+                        # have overwritten last_trace()
+                        req0 = live[plan.indices[0]][0]
+                        state.resolved = self._passthrough_fit(req0)
+                        state.trace = recorder.last_trace()
+                    else:
+                        state.handle = state.fitter.dispatch_fit(
+                            **state.hyper)
+                    return state
+                except Exception as e:  # noqa: BLE001
+                    if (state.attempts <= self.max_dispatch_retries
+                            and transient_error(e)):
+                        telemetry.inc("serve.retry.dispatch")
+                        if self.retry_backoff_s > 0:
+                            time.sleep(self.retry_backoff_s
+                                       * 2 ** (state.attempts - 1))
+                        state.attempts += 1
+                        continue
+                    telemetry.inc("serve.fault.dispatch")
+                    return _FailedBatch(plan, e, "dispatch",
+                                        state.attempts)
+
+        def _fetch(state, plan: BatchPlan):
+            nonlocal fail_batches
+            if isinstance(state, _FailedBatch):
+                fail_batches += 1
+                return self._salvage(live, plan, state)
             if plan.kind == "passthrough":
-                from pint_tpu.fitting.fitter import Fitter
-
-                req = queue[plan.indices[0]][0]
-                return Fitter.auto(req.toas, req.model)
-            from pint_tpu.parallel.batch import BatchedPulsarFitter
-
-            problems = [(queue[i][0].toas, queue[i][0].model)
-                        for i in plan.indices]
-            with telemetry.span("serve.prep", members=plan.n_members):
-                return BatchedPulsarFitter(problems, mesh=self.mesh,
-                                           pad_members=plan.n_members)
-
-        def _dispatch(prepped):
-            plan, fitter = prepped._serve_plan, prepped
-            req0 = queue[plan.indices[0]][0]
-            if plan.kind == "passthrough":
-                # host-driven fitters cannot be suspended mid-loop: the
-                # fit runs here, already resolved at fetch time. Every
-                # Fitter.auto target is a _DownhillMixin, whose loop
-                # reads the halving cap off the instance
-                fitter.max_step_halvings = req0.max_step_halvings
-                chi2 = fitter.fit_toas(
-                    maxiter=req0.maxiter,
-                    min_chi2_decrease=req0.min_chi2_decrease)
-                return (chi2, fitter)
-            return fitter.dispatch_fit(
-                maxiter=req0.maxiter,
-                min_chi2_decrease=req0.min_chi2_decrease,
-                max_step_halvings=req0.max_step_halvings)
-
-        def _fetch(handle, plan: BatchPlan):
-            out: list[FitResult] = []
-            if plan.kind == "passthrough":
-                chi2, fitter = handle
-                chi2 = np.atleast_1d(np.asarray(chi2, dtype=float))
-                conv = np.atleast_1d(np.asarray(fitter.converged))
-            else:
-                chi2 = np.asarray(handle.finish(), dtype=float)
-                conv = np.asarray(handle.fitter.converged)
+                entry = live[plan.indices[0]]
+                chi2, conv, div, reason = state.resolved
+                if div:
+                    telemetry.inc("serve.fault.diverged")
+                    return [self._envelope(
+                        entry, status="diverged", plan=plan, chi2=chi2,
+                        error=f"standalone fit diverged: {reason}",
+                        trace=state.trace,
+                        attempts=state.attempts, passthrough=True)]
+                return [self._envelope(
+                    entry, status="ok" if conv else "nonconverged",
+                    plan=plan, chi2=chi2, converged=conv,
+                    attempts=state.attempts, passthrough=True)]
+            while True:
+                try:
+                    # the deferred async-dispatch error surfaces at this
+                    # sync; one retry "attempt" = fresh dispatch + fetch
+                    if state.handle is None:
+                        state.handle = state.fitter.dispatch_fit(
+                            **state.hyper)
+                    chi2 = np.asarray(state.handle.finish(), dtype=float)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    state.handle = None  # never refetch a failed handle
+                    if (state.attempts <= self.max_dispatch_retries
+                            and transient_error(e)):
+                        telemetry.inc("serve.retry.dispatch")
+                        if self.retry_backoff_s > 0:
+                            time.sleep(self.retry_backoff_s
+                                       * 2 ** (state.attempts - 1))
+                        state.attempts += 1
+                        continue
+                    telemetry.inc("serve.fault.fetch")
+                    fail_batches += 1
+                    return self._salvage(live, plan,
+                                         _FailedBatch(plan, e, "fetch",
+                                                      state.attempts))
+            fitter = state.fitter
+            conv = np.asarray(fitter.converged)
+            div = np.asarray(fitter.diverged)
+            # the batch's device trace (per-member vectors), captured
+            # before any passthrough retry overwrites last_trace()
+            trace = recorder.last_trace() if bool(div.any()) else None
             # stamped AFTER finish(): queue latency must include the
             # device wait, not just the time to reach the fetch stage
             t_done = time.perf_counter()
+            out = []
             for m, i in enumerate(plan.indices):
-                req, rh, t_sub, _fp_i = queue[i]
-                res = FitResult(
-                    tag=req.tag, request=req, chi2=float(chi2[m]),
-                    converged=bool(np.all(conv[m])), batch=plan._seq,
-                    group=plan.group, n_members=plan.n_members,
-                    occupancy=plan.occupancy,
-                    queue_latency_s=round(t_done - t_sub, 6),
-                    passthrough=plan.kind == "passthrough")
-                rh._result = res
-                out.append(res)
+                entry = live[i]
+                if bool(div[m]):
+                    out.append(self._retry_diverged(entry, plan,
+                                                    trace, m))
+                else:
+                    out.append(self._envelope(
+                        entry,
+                        status="ok" if bool(np.all(conv[m]))
+                        else "nonconverged",
+                        plan=plan, chi2=float(chi2[m]),
+                        converged=bool(np.all(conv[m])),
+                        attempts=state.attempts, t_done=t_done))
             return out
-
-        # thread each plan through prep so dispatch/fetch see it
-        def prep_with_plan(plan):
-            prepped = _prep(plan)
-            prepped._serve_plan = plan
-            return prepped
 
         for seq, plan in enumerate(plans):
             plan._seq = seq
         try:
             per_batch, stats = run_pipeline(
-                plans, prep=prep_with_plan,
-                dispatch=_dispatch,
-                fetch=lambda h, plan: _fetch(h, plan), window=self.window)
+                plans, prep=_prep, dispatch=_dispatch,
+                fetch=_fetch, window=self.window)
         except BaseException:
-            # one bad batch must not strand the rest of the drain:
-            # every request whose handle is still unresolved goes back
-            # on the queue (ahead of anything submitted meanwhile) so
-            # the caller can retry — nothing is ever silently dropped
+            # the stages above are isolation boundaries, so this fires
+            # only on a scheduler bug: every request whose handle is
+            # still unresolved goes back on the queue (ahead of anything
+            # submitted meanwhile) so the caller can retry — nothing is
+            # ever silently dropped
             self._queue[:0] = [e for e in queue if e[1]._result is None]
             raise
 
-        results: list[FitResult] = [None] * len(queue)
         for plan, batch_results in zip(plans, per_batch):
             for i, res in zip(plan.indices, batch_results):
-                results[i] = res
+                results[kept[i]] = res
+
+        # ladder bookkeeping: a drain with a failed batch extends the
+        # streak; a clean one heals it
+        self._fail_streak = self._fail_streak + 1 if fail_batches else 0
+        telemetry.set_gauge("serve.fail_streak", self._fail_streak)
 
         n_real = sum(len(p.indices) for p in plans)
         n_members = sum(p.n_members for p in plans)
         occupancy = n_real / max(1, n_members)
         fits_per_s = n_real / max(stats["wall_s"], 1e-12)
+        if n_real:
+            self._drain_rate = (fits_per_s if self._drain_rate is None
+                                else 0.5 * self._drain_rate
+                                + 0.5 * fits_per_s)
+        statuses: dict[str, int] = {}
+        for r in results:
+            statuses[r.status] = statuses.get(r.status, 0) + 1
         telemetry.inc("serve.batches", len(plans))
         telemetry.inc("serve.batches.passthrough",
                       sum(p.kind == "passthrough" for p in plans))
@@ -322,6 +799,10 @@ class ThroughputScheduler:
             "queue_latency_s_mean": round(
                 float(np.mean([r.queue_latency_s for r in results])), 6),
             "window": self.window,
+            "statuses": statuses,
+            "failed_batches": fail_batches,
+            "degraded": self.degraded(),
+            "fail_streak": self._fail_streak,
             "batch_detail": [
                 {"kind": p.kind, "group": p.group,
                  "toa_bucket": p.toa_bucket, "real": len(p.indices),

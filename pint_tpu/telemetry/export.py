@@ -30,11 +30,12 @@ from pint_tpu.telemetry import core, host
 
 # v2 (ISSUE 4): adds record types "trace" (flight-recorder iteration
 # timelines), "program" (per-program XLA cost/memory accounting) and
-# size-capped artifact rotation. v1 consumers remain compatible: every
-# v1 record type and field is unchanged — v2 only ADDS line types, and
-# readers that dispatch on "type" (the documented contract) skip
-# unknown ones.
-SCHEMA_VERSION = 2
+# size-capped artifact rotation. v3 (ISSUE 6): adds "fault" records
+# (one per serve-layer failure event; quarantines carry the member's
+# flight-recorder trace). Old consumers remain compatible: each bump
+# only ADDS line types, and readers that dispatch on "type" (the
+# documented contract) skip unknown ones.
+SCHEMA_VERSION = 3
 
 _MAX_BUFFER = 50_000
 _FLUSH_EVERY = 500
@@ -44,6 +45,45 @@ _lock = threading.Lock()
 _buffer: list[dict] = []
 _dropped = 0
 _span_stats: dict[str, dict] = {}
+# graceful-degradation latches (ISSUE 6 satellite): an unwritable
+# export path or a failing rotation must never raise mid-fit — warn
+# ONCE through the logger, disable that facility, keep counting drops.
+# The write latch is keyed to the PATH that failed, so re-configuring
+# to a different (writable) path re-enables export
+_write_disabled_path: str | None = None
+_rotate_disabled = False
+
+
+def _write_disabled() -> bool:
+    return (_write_disabled_path is not None
+            and _write_disabled_path == core.jsonl_path())
+
+
+def _warn(msg: str) -> None:
+    """One warning line; never raises (telemetry must not take down a
+    fit even when logging itself is broken)."""
+    try:
+        from pint_tpu.logging import get_logger
+
+        get_logger("pint_tpu.telemetry").warning(msg)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _json_default(o):
+    """Serialize the numpy scalars/arrays fault records carry; a
+    non-serializable leaf must degrade to its repr, not raise mid-fit."""
+    import numpy as _np
+
+    if isinstance(o, _np.integer):
+        return int(o)
+    if isinstance(o, _np.floating):
+        return float(o)
+    if isinstance(o, _np.bool_):
+        return bool(o)
+    if isinstance(o, _np.ndarray):
+        return o.tolist()
+    return str(o)
 
 
 def _stats_for(name: str) -> dict:
@@ -87,6 +127,9 @@ def _buffer_record(rec: dict) -> None:
     global _dropped
     if core.jsonl_path() is None:
         return  # aggregates only; nothing to write later
+    if _write_disabled():
+        _dropped += 1  # path already proved unwritable: drop, counted
+        return
     if len(_buffer) >= _MAX_BUFFER:
         _dropped += 1
         return
@@ -122,22 +165,37 @@ def _rotate_locked(path: str) -> None:
     bench artifact) must not grow the jsonl unboundedly. One rotated
     generation (``<path>.1``, overwritten) keeps the recent history
     while bounding total disk at ~2x the cap; rotations are counted so
-    a rollup reveals that earlier records moved aside."""
+    a rollup reveals that earlier records moved aside.
+
+    A FAILING rotation (``os.replace`` denied while the append still
+    works) warns once and disables itself — appending past the cap
+    loses less than raising mid-fit or silently retrying every flush.
+    """
+    global _rotate_disabled
     from pint_tpu.telemetry import counters
 
+    if _rotate_disabled:
+        return
     try:
         if os.path.getsize(path) <= _max_artifact_bytes():
             return
+    except OSError:
+        return  # missing file: nothing to rotate
+    try:
         os.replace(path, path + ".1")
         counters.inc("telemetry.export.rotations")
-    except OSError:
-        pass  # missing file / unwritable dir: nothing to rotate
+    except OSError as e:
+        _rotate_disabled = True
+        counters.inc("telemetry.export.rotation_disabled")
+        _warn(f"telemetry: artifact rotation failed ({e}); rotation "
+              f"disabled for this process — {path} may exceed its size "
+              "cap")
 
 
 def _flush_locked() -> None:
-    global _dropped
+    global _dropped, _write_disabled_path
     path = core.jsonl_path()
-    if path is None or not _buffer:
+    if path is None or not _buffer or _write_disabled():
         return
     _rotate_locked(path)
     batch = [host.sample() | {"type": "host", "pid": os.getpid()}]
@@ -145,10 +203,26 @@ def _flush_locked() -> None:
     n_records = len(_buffer)
     _buffer.clear()
     try:
+        # serialize BEFORE opening: a non-serializable record must not
+        # leave a half-written line, and must never raise mid-fit
+        payload = "".join(json.dumps(r, default=_json_default) + "\n"
+                          for r in batch)
         with open(path, "a") as fh:
-            fh.write("".join(json.dumps(r) + "\n" for r in batch))
-    except OSError:  # telemetry must never take down the computation —
-        _dropped += n_records  # but drops are counted, never silent
+            fh.write(payload)
+    except OSError as e:  # telemetry must never take down the
+        _dropped += n_records  # computation — drops counted, never silent
+        # unwritable path: warn once, disable export TO THIS PATH
+        # (degrade, don't retry a doomed open on every later flush;
+        # reconfiguring to a writable path re-enables)
+        _write_disabled_path = path
+        from pint_tpu.telemetry import counters
+
+        counters.inc("telemetry.export.disabled")
+        _warn(f"telemetry: export path {path} unwritable ({e}); JSONL "
+              "export disabled for this process — further records are "
+              "dropped (counted in dropped_records)")
+    except Exception:  # noqa: BLE001 — unserializable record class
+        _dropped += n_records
 
 
 def span_stats() -> dict[str, dict]:
@@ -198,8 +272,10 @@ def write_rollup() -> dict:
 
 
 def _reset() -> None:
-    global _dropped
+    global _dropped, _write_disabled_path, _rotate_disabled
     with _lock:
         _buffer.clear()
         _span_stats.clear()
         _dropped = 0
+        _write_disabled_path = None
+        _rotate_disabled = False

@@ -19,6 +19,9 @@ written by bench.py / tools/soak.py / plain library use):
 * **throughput engine** — ``type="serve"`` records (one per scheduler
   drain: batch occupancy, fits/s, host/device overlap efficiency,
   queue latency — pint_tpu.serve);
+* **failure domains** — ``type="fault"`` records (one per serve-layer
+  failure event: status, retries, quarantine traces) plus the
+  ``serve.fault.* / serve.retry.* / serve.quarantine.*`` counters;
 * **cache hit rates** — ``cache.<name>.{hit,miss,evict}`` counters from
   the closing rollup;
 * **host-pollution windows** — spans of wall time whose ``host``
@@ -164,13 +167,56 @@ def serve_summaries(records: list[dict]) -> list[dict]:
         s = {k: r.get(k) for k in
              ("fits", "batches", "occupancy", "fits_per_s",
               "overlap_efficiency", "prep_s", "wait_s", "wall_s",
-              "queue_latency_s_mean", "window")}
+              "queue_latency_s_mean", "window", "statuses",
+              "degraded")}
         detail = r.get("batch_detail") or []
         s["passthrough"] = sum(1 for b in detail
                                if b.get("kind") == "passthrough")
         s["groups"] = len({b.get("group") for b in detail})
         out.append(s)
     return out
+
+
+def fault_summaries(records: list[dict]) -> dict:
+    """Failure-domain rollup from ``type="fault"`` records plus the
+    closing rollup's ``serve.fault.* / serve.retry.* /
+    serve.quarantine.* / serve.status.*`` counters (ISSUE 6)."""
+    by_status: dict[str, int] = {}
+    events: list[dict] = []
+    quarantined = 0
+    for r in records:
+        if r.get("type") != "fault":
+            continue
+        status = str(r.get("status", "?"))
+        by_status[status] = by_status.get(status, 0) + 1
+        if status == "quarantined":
+            quarantined += 1
+        if len(events) < 20:
+            ev = {"status": status, "tag": r.get("tag"),
+                  "group": r.get("group"),
+                  "attempts": r.get("attempts"),
+                  "injected": r.get("injected"),
+                  "error": (str(r.get("error"))[:160]
+                            if r.get("error") else None),
+                  "has_trace": "trace" in r}
+            tr = r.get("trace")
+            if isinstance(tr, dict) and tr.get("chi2"):
+                ev["trace_evals"] = len(tr["chi2"])
+                ev["trace_chi2_final"] = tr["chi2"][-1]
+            events.append(ev)
+    counters: dict = {}
+    for r in records:
+        if r.get("type") == "rollup":
+            counters = r.get("counters") or counters
+    serve_counters = {k: int(v) for k, v in counters.items()
+                      if k.startswith(("serve.fault.", "serve.retry.",
+                                       "serve.quarantine.",
+                                       "serve.status.", "serve.shed",
+                                       "serve.deadline.",
+                                       "serve.rejected"))}
+    return {"events": sum(by_status.values()), "by_status": by_status,
+            "quarantined": quarantined, "recent": events,
+            "counters": serve_counters}
 
 
 def cache_rates(records: list[dict]) -> dict[str, dict]:
@@ -345,9 +391,34 @@ def render(summary: dict) -> str:
                 f"passthrough): occupancy {s['occupancy']}, "
                 f"{s['fits_per_s']} fits/s, overlap "
                 f"{s['overlap_efficiency']}, queue latency "
-                f"{s['queue_latency_s_mean']}s")
+                f"{s['queue_latency_s_mean']}s"
+                + (f", statuses {s['statuses']}" if s.get("statuses")
+                   and set(s["statuses"]) != {"ok"} else "")
+                + (" [DEGRADED]" if s.get("degraded") else ""))
     else:
         lines.append("  (no serve records)")
+
+    lines.append("\n== failure domains ==")
+    faults = summary["faults"]
+    if faults["events"] or faults["counters"]:
+        lines.append(
+            f"  {faults['events']} fault event(s): "
+            + (", ".join(f"{k}={v}" for k, v in
+                         sorted(faults["by_status"].items())) or "none"))
+        for ev in faults["recent"]:
+            tail = ""
+            if ev.get("has_trace"):
+                tail = (f"  [trace: {ev.get('trace_evals', '?')} evals, "
+                        f"final chi2 {ev.get('trace_chi2_final')}]")
+            inj = f" injected={ev['injected']}" if ev.get("injected") \
+                else ""
+            lines.append(f"    {ev['status']:<12} tag={ev.get('tag')} "
+                         f"attempts={ev.get('attempts')}{inj}: "
+                         f"{ev.get('error') or ''}{tail}")
+        for k, v in sorted(faults["counters"].items()):
+            lines.append(f"    {k:<32} {v}")
+    else:
+        lines.append("  (no fault records — clean run)")
 
     lines.append("\n== cache hit rates ==")
     if summary["caches"]:
@@ -394,6 +465,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "traces": trace_summaries(records),
         "programs": program_summaries(records),
         "serve": serve_summaries(records),
+        "faults": fault_summaries(records),
         "caches": cache_rates(records),
         "pollution": pollution_windows(records),
     }

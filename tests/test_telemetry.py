@@ -248,10 +248,11 @@ def test_jsonl_schema_roundtrip(tmp_path):
         assert key in host_rec
 
     # the rollup line round-trips the in-memory rollup (modulo its own
-    # timestamp) and carries the schema marker (v2 since ISSUE 4: adds
-    # the "trace"/"program" record types, removes nothing from v1)
+    # timestamp) and carries the schema marker (v3 since ISSUE 6: adds
+    # the "fault" record type; v2 added "trace"/"program" — each bump
+    # only adds line types, removes nothing)
     last = lines[-1]
-    assert last["schema"] == roll["schema"] == 2
+    assert last["schema"] == roll["schema"] == 3
     assert last["counters"] == {"k": 2}
     assert last["gauges"] == {"g": 7.0}
     assert last["spans"]["s1"]["count"] == 1

@@ -205,10 +205,12 @@ def _sim_flagged_toas(model, rng, n: int, flag_rng=None):
     return dataclasses.replace(toas, flags=flags)
 
 
-def one_trial(seed: int) -> tuple[bool, str, dict]:
+def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
     """Returns (ok, failure_text, axes) — axes records which sampler
     dimensions and optional gates this trial exercised, so the committed
-    SOAK JSON makes coverage auditable (round-4 VERDICT task 4)."""
+    SOAK JSON makes coverage auditable (round-4 VERDICT task 4).
+    ``force_chaos`` (the ``--chaos`` flag) arms the fault-injection gate
+    on every trial regardless of its probability draw."""
     rng = np.random.default_rng(seed)
     par = random_par(rng)
     # device-loop/host-loop randomization (ISSUE 3): half the trials run
@@ -581,6 +583,97 @@ def one_trial(seed: int) -> tuple[bool, str, dict]:
                                - m_ref[name].value_f64) < tol, (
                         f"serve/standalone {name} mismatch ({r.tag})")
 
+        # fault-domain chaos (ISSUE 6): the trial's model mix through
+        # the throughput scheduler with seed-driven fault injection
+        # armed (pint_tpu.serve.faults) — NaN-poisoned tables,
+        # zero-weight tables, singular models, host-prep exceptions,
+        # transient device errors, slow members AND a queue flood. The
+        # contract under chaos: zero scheduler/pipeline crashes, every
+        # request resolves to a structured status, every faulted
+        # request carries diagnostics (quarantines carry their
+        # flight-recorder trace), and uninjected ok/nonconverged
+        # requests keep finite parameters. APPENDED gate, own
+        # substream; ``--chaos`` forces it on every trial.
+        if gates.random() < 0.15 or force_chaos:
+            axes["gates"].append("faults")
+            from pint_tpu.serve import (FitRequest, STATUSES,
+                                        ServeQueueFull,
+                                        ThroughputScheduler, faults)
+
+            crng = np.random.default_rng((seed, 9))
+            k_req = int(crng.integers(4, 7))
+            par_v = "\n".join(ln for ln in par.splitlines()
+                              if not ln.startswith("F1 ")) + "\n"
+            have_variant = par_v != par and "F2 " not in par
+            specs = []
+            for j in range(k_req):
+                par_j = (par_v if have_variant and j % 2 else par)
+                m_truth = get_model(par_j, allow_tcb=True)
+                t_j = _sim_flagged_toas(m_truth, crng,
+                                        int(crng.integers(50, 110)))
+                specs.append((par_j, t_j))
+
+            def _chaos_model(par_j):
+                m_j = get_model(par_j, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_j.free_params:
+                        m_j[name].add_delta(d)
+                return m_j
+
+            plan = faults.FaultPlan(
+                seed=seed, nan_toas=0.25, zero_weight=0.1,
+                singular=0.1, prep_exc=0.15, device_err=0.25,
+                slow=0.1, slow_s=0.01)
+            # max_queue == k_req - 1 so the LAST submit floods the
+            # bounded queue: backpressure must reject with actionable
+            # context, never crash or silently drop
+            sched = ThroughputScheduler(max_queue=max(2, k_req - 1),
+                                        retry_backoff_s=0.0,
+                                        member_floor=2)
+            faults.configure(plan)
+            try:
+                flooded = 0
+                handles = []
+                for j, (par_j, t_j) in enumerate(specs):
+                    try:
+                        handles.append(sched.submit(
+                            FitRequest(t_j, _chaos_model(par_j),
+                                       maxiter=12, tag=j)))
+                    except ServeQueueFull as e:
+                        flooded += 1
+                        assert e.depth >= 1 and e.max_queue >= 2, e
+                        assert e.retry_after_s is not None, \
+                            "flood reject must carry a retry-after hint"
+                chaos_res = sched.drain()
+            finally:
+                faults.configure(None)
+            statuses: dict[str, int] = {}
+            injected: dict[str, int] = {}
+            for r in chaos_res:
+                assert r.status in STATUSES, f"unknown status {r.status}"
+                statuses[r.status] = statuses.get(r.status, 0) + 1
+                if r.injected:
+                    injected[r.injected] = injected.get(r.injected, 0) + 1
+                if r.status == "quarantined":
+                    assert r.trace is not None, \
+                        "quarantine must carry its flight-recorder trace"
+                if r.status not in ("ok", "nonconverged"):
+                    assert r.error, f"{r.status} without diagnostics"
+                if r.status in ("ok", "nonconverged") and not r.injected:
+                    assert np.isfinite(r.chi2), \
+                        f"clean request {r.tag}: non-finite chi2"
+                    for name in r.request.model.free_params:
+                        assert np.isfinite(
+                            r.request.model[name].value_f64), \
+                            f"clean request {r.tag}: NaN {name}"
+            for h in handles:
+                assert h.done(), "chaos drain left an unresolved handle"
+            axes["faults"] = {
+                "requests": k_req, "flood_rejected": flooded,
+                "statuses": statuses, "injected": injected,
+                "failed_batches": sched.last_drain["failed_batches"],
+            }
+
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
         model2 = get_model(par2)
@@ -615,6 +708,10 @@ def main() -> int:
                     help="write a structured run record (seeds, pass/fail, "
                          "per-trial wall, axes, git SHA) here, updated "
                          "atomically after every trial; '' disables")
+    ap.add_argument("--chaos", action="store_true",
+                    help="force the fault-injection gate on every trial "
+                         "(ISSUE 6 chaos soak; injection stays seeded and "
+                         "reproducible)")
     args = ap.parse_args()
 
     import json
@@ -635,6 +732,7 @@ def main() -> int:
               "git_sha": _git_sha(), "jax": jax.__version__,
               "telemetry_enabled": telemetry.enabled(),
               "seed_base": args.seed, "trials_requested": args.trials,
+              "chaos": args.chaos,
               "n_pass": 0, "n_fail": 0, "fail_seeds": [], "trials": []}
 
     def save():
@@ -676,7 +774,7 @@ def main() -> int:
         counters_before = telemetry.counters_snapshot()
         t1 = time.time()
         with telemetry.profile_span("soak.trial", seed=seed):
-            ok, msg, axes = one_trial(seed)
+            ok, msg, axes = one_trial(seed, force_chaos=args.chaos)
         wall = time.time() - t1
         deltas = telemetry.counters_delta(counters_before)
         repro_path = ""
