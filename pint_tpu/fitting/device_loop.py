@@ -352,6 +352,21 @@ class InFlightFit:
         self._kind = kind
         self._result = None
 
+    def ready(self) -> bool:
+        """Is the dispatched program's result already complete?
+
+        A pure runtime-queue peek (``jax.Array.is_ready``) — never
+        blocks, never syncs — so the serve pipeline's work-stealing
+        drain can fetch finished shards ahead of FIFO order.
+        """
+        if self._result is not None:
+            return True
+        try:
+            return all(x.is_ready() for x in jax.tree.leaves(self._out)
+                       if hasattr(x, "is_ready"))
+        except Exception:  # noqa: BLE001 — readiness is advisory only
+            return True
+
     def fetch(self):
         """Block on the single device->host sync; idempotent."""
         if self._result is None:
@@ -405,11 +420,25 @@ def _dispatch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
     return InFlightFit(out, kind)
 
 
-def _launch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
-            shape):
-    """Synchronous dispatch+fetch: one launch, ONE device->host sync."""
-    return _dispatch(builder, key, deltas0, operands, hyper, kind=kind,
-                     fingerprint=fingerprint, shape=shape).fetch()
+def dispatch_damped(full, deltas0, operands, *, key, probe=None,
+                    maxiter=20, min_chi2_decrease=1e-3,
+                    max_step_halvings=8, kind="device_loop",
+                    fingerprint=None, shape=()) -> InFlightFit:
+    """Asynchronous :func:`run_damped`: enqueue the fused scalar loop
+    and return its :class:`InFlightFit` handle without blocking.
+
+    The TOA-sharded serving route's building block (ISSUE 7,
+    pint_tpu.parallel.sharded_fit.ShardedServeFitter): a big single fit
+    dispatches as one mesh-partitioned program and the scheduler's
+    pipeline overlaps the next batch's host prep with it, exactly as
+    :func:`dispatch_damped_batched` does for member batches.
+    ``handle.fetch()`` is the fit's single device->host sync.
+    """
+    return _dispatch(
+        lambda rec: build_damped_loop(full, probe, record=rec), key,
+        deltas0, operands,
+        (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
+        fingerprint=fingerprint, shape=shape)
 
 
 def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
@@ -425,11 +454,11 @@ def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
     program-reuse accounting (a ``cache.fit_program.miss`` under this
     kind is an XLA compile of the whole loop program).
     """
-    deltas, info, chi2, converged, counters = _launch(
-        lambda rec: build_damped_loop(full, probe, record=rec), key,
-        deltas0, operands,
-        (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
-        fingerprint=fingerprint, shape=shape)
+    deltas, info, chi2, converged, counters = dispatch_damped(
+        full, deltas0, operands, key=key, probe=probe, maxiter=maxiter,
+        min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings, kind=kind,
+        fingerprint=fingerprint, shape=shape).fetch()
     converged = bool(converged)
     if bool(np.asarray(info.get("diverged", False))):
         telemetry.inc("fit.diverged")
@@ -873,6 +902,9 @@ class InFlightBatchedFit:
 
     def __init__(self, inner: InFlightFit):
         self._inner = inner
+
+    def ready(self) -> bool:
+        return self._inner.ready()
 
     def fetch(self):
         deltas, info, chi2, converged, counters = self._inner.fetch()

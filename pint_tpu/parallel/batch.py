@@ -682,6 +682,14 @@ class BatchedPulsarFitter:
                 shape=toa_shape(self.toas))
         return _InFlightBatchPulsarFit(self, handle)
 
+    def device_bytes(self) -> dict[int, int]:
+        """Per-device bytes of the batch's placed tables, by device id
+        (pure sharding metadata — the serve layer's per-device
+        accounting; see parallel.mesh.per_device_bytes)."""
+        from pint_tpu.parallel.mesh import per_device_bytes
+
+        return per_device_bytes((self.toas, self.tzr))
+
     def _write_back(self, deltas, info) -> None:
         """Apply fitted deltas + uncertainties to every REAL (owner)
         model; padded dummy members' rows are discarded.
@@ -726,6 +734,9 @@ class _ResolvedBatchFit:
         self.fitter = fitter
         self._chi2 = chi2
 
+    def ready(self) -> bool:
+        return True
+
     def finish(self) -> np.ndarray:
         return self._chi2
 
@@ -739,6 +750,10 @@ class _InFlightBatchPulsarFit:
         self.fitter = fitter
         self._handle = handle
         self._chi2 = None
+
+    def ready(self) -> bool:
+        """Result complete without blocking (work-stealing drain peek)."""
+        return self._chi2 is not None or self._handle.ready()
 
     def finish(self) -> np.ndarray:
         """The fit's one device->host sync; idempotent."""

@@ -61,3 +61,44 @@ def replicate(tree, mesh: Mesh):
 
 def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
+
+
+def largest_pow2_leq(n: int) -> int:
+    """Largest power of two <= n (n >= 1): the widest aligned device
+    block the serve shard planner can allocate from an n-device pool."""
+    if n < 1:
+        raise ValueError(f"largest_pow2_leq needs n >= 1, got {n}")
+    return 1 << (n.bit_length() - 1)
+
+
+def largest_pow2_divisor(n: int) -> int:
+    """Largest power of two dividing n (n >= 1): the widest member-axis
+    shard count that splits an n-member batch evenly."""
+    if n < 1:
+        raise ValueError(f"largest_pow2_divisor needs n >= 1, got {n}")
+    return n & -n
+
+
+def per_device_bytes(tree) -> dict[int, int]:
+    """Bytes each device holds of a (sharded) pytree, by device id.
+
+    Pure metadata — per-device shard shapes from each leaf's
+    ``sharding.shard_shape``, never touching shard data (no transfer,
+    no sync), so the serve layer can account placement on the drain hot
+    path. Replicated leaves charge their full size to every device;
+    numpy / unplaced leaves are skipped.
+    """
+    out: dict[int, int] = {}
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None or not hasattr(leaf, "dtype"):
+            continue
+        try:
+            shard_shape = sharding.shard_shape(np.shape(leaf))
+            devices = sharding.device_set
+        except Exception:  # noqa: BLE001 — account what is accountable
+            continue
+        nb = int(np.prod(shard_shape, dtype=np.int64)) * leaf.dtype.itemsize
+        for d in devices:
+            out[d.id] = out.get(d.id, 0) + nb
+    return out

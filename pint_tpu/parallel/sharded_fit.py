@@ -167,6 +167,134 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
             maxiter=maxiter, min_chi2_decrease=min_chi2_decrease)
 
 
+class ShardedServeFitter:
+    """TOA-axis-sharded singleton fit with the batched dispatch surface.
+
+    The throughput scheduler's big-fit route (ISSUE 7): a batchable
+    request whose TOA bucket crosses the shard planner's threshold is
+    not worth batching on the member axis (one such fit saturates the
+    mesh by itself) — it runs as ONE fused loop program with every O(n)
+    leaf sharded over the mesh's "toa" axis instead, exactly
+    :func:`sharded_fit`'s placement. The surface mirrors
+    ``BatchedPulsarFitter``'s dispatch split so the scheduler's
+    pipeline treats both uniformly: construction is the host prep stage
+    (pad + shard + replicate — device placement happens HERE, which is
+    why the pipeline drains the target slots before prep),
+    :meth:`dispatch_fit` enqueues without blocking, and the returned
+    handle's ``finish()`` performs the fit's single device->host fetch,
+    writes fitted values back into the request's model, and exposes
+    per-member (length-1) ``converged`` / ``diverged`` arrays.
+    """
+
+    def __init__(self, toas, model, mesh):
+        self.model = model
+        self.mesh = mesh
+        self.n_real = 1
+        self.converged = np.zeros(1, dtype=bool)
+        self.diverged = np.zeros(1, dtype=bool)
+        n_shards = mesh.shape["toa"]
+        telemetry.set_gauge("fit.ntoas", len(toas))
+        padded = pad_toas(toas, bucket_size(len(toas), multiple=n_shards))
+        self.toas = shard_toas(padded, mesh)
+        del padded  # drop the unsharded copy before the fit's peak
+        self.base = replicate(model.base_dd(), mesh)
+        self.deltas0 = replicate(model.zero_deltas(), mesh)
+
+    def device_bytes(self) -> dict[int, int]:
+        """Per-device bytes of the placed table (serve accounting)."""
+        from pint_tpu.parallel.mesh import per_device_bytes
+
+        return per_device_bytes(self.toas)
+
+    def dispatch_fit(self, maxiter: int = 20,
+                     min_chi2_decrease: float = 1e-3,
+                     max_step_halvings: int = 8):
+        """Enqueue the fused sharded loop; returns the in-flight handle.
+
+        With the device loop disabled (``PINT_TPU_DEVICE_LOOP=0``) the
+        host driver cannot be suspended mid-loop, so the fit runs
+        synchronously here and the handle is already resolved.
+        """
+        from pint_tpu.bucketing import toa_shape
+
+        step = jitted_wls_step(self.model, counted=False)
+        if device_loop.enabled():
+            probe = jitted_wls_probe(self.model)
+            with self.mesh, telemetry.span("fit.sharded_serve.dispatch",
+                                           mesh=self.mesh.size):
+                handle = device_loop.dispatch_damped(
+                    lambda d, ops: step(ops[0], d, *ops[1:]),
+                    self.deltas0, (self.base, self.toas),
+                    probe=lambda d, ops: probe(ops[0], d, *ops[1:]),
+                    key=("sharded_wls", id(step), id(probe)),
+                    maxiter=maxiter,
+                    min_chi2_decrease=min_chi2_decrease,
+                    max_step_halvings=max_step_halvings,
+                    kind="device_loop_wls",
+                    fingerprint=(hash(self.model._fn_fingerprint()),),
+                    shape=toa_shape(self.toas))
+            return _InFlightShardedServeFit(self, handle)
+        with self.mesh, telemetry.span("fit.sharded_serve.host_loop"):
+            out = downhill_iterate(
+                lambda d: step(self.base, d, self.toas), self.deltas0,
+                maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+                max_step_halvings=max_step_halvings)
+        return _InFlightShardedServeFit(self, _HostLoopResult(out))
+
+    def _finish(self, deltas, info, chi2, converged) -> np.ndarray:
+        """Write-back half of the fetch (ShardedWLSFitter's contract:
+        a diverged fit is flagged and never writes NaN params back)."""
+        diverged = bool(np.asarray(info.get("diverged", False))) \
+            or not np.isfinite(float(np.asarray(chi2)))
+        self.diverged[0] = diverged
+        self.converged[0] = bool(converged) and not diverged
+        if not diverged:
+            errors = info["errors"]
+            for name, d in deltas.items():
+                p = self.model[name]
+                p.add_delta(float(np.asarray(d)))
+                p.uncertainty = float(np.asarray(errors[name]))
+        return np.asarray([float(np.asarray(chi2))])
+
+
+class _HostLoopResult:
+    """Already-resolved pseudo-handle (host-driver fallback path)."""
+
+    __slots__ = ("_out",)
+
+    def __init__(self, out):
+        self._out = out
+
+    def ready(self) -> bool:
+        return True
+
+    def fetch(self):
+        deltas, info, chi2, converged = self._out
+        return deltas, info, chi2, converged, {}
+
+
+class _InFlightShardedServeFit:
+    """A dispatched sharded fit: ``finish()`` = fetch + write-back."""
+
+    __slots__ = ("fitter", "_handle", "_chi2")
+
+    def __init__(self, fitter: ShardedServeFitter, handle):
+        self.fitter = fitter
+        self._handle = handle
+        self._chi2 = None
+
+    def ready(self) -> bool:
+        return self._chi2 is not None or self._handle.ready()
+
+    def finish(self) -> np.ndarray:
+        """The fit's one device->host sync; idempotent."""
+        if self._chi2 is None:
+            deltas, info, chi2, converged, _cnt = self._handle.fetch()
+            self._chi2 = self.fitter._finish(deltas, info, chi2,
+                                             converged)
+        return self._chi2
+
+
 class ShardedGLSFitter(Fitter):
     """TOA-sharded GLS fitter (north star; matches ``GLSFitter`` results).
 

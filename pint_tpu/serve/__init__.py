@@ -15,7 +15,7 @@ engine" and "Failure domains & degradation ladder".
 
 from pint_tpu.serve import faults  # noqa: F401
 from pint_tpu.serve.fingerprint import (  # noqa: F401
-    batchable, short_id, structure_fingerprint)
+    batchable, plan_key, short_id, structure_fingerprint)
 from pint_tpu.serve.pipeline import run_pipeline  # noqa: F401
 from pint_tpu.serve.scheduler import (  # noqa: F401
     STATUSES, BatchPlan, FitHandle, FitRequest, FitResult, ServeQueueFull,
@@ -24,6 +24,6 @@ from pint_tpu.serve.scheduler import (  # noqa: F401
 __all__ = [
     "BatchPlan", "FitHandle", "FitRequest", "FitResult", "STATUSES",
     "ServeQueueFull", "ThroughputScheduler", "batchable", "faults",
-    "run_pipeline", "short_id", "structure_fingerprint",
+    "plan_key", "run_pipeline", "short_id", "structure_fingerprint",
     "transient_error",
 ]

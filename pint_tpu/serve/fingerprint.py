@@ -69,9 +69,32 @@ def structure_fingerprint(model, toas=None) -> tuple:
     set, and (b) same-shape batches trace to one compiled loop program
     (the union's own ``_fn_fingerprint`` is determined by the members').
     Pass ``toas`` so wideband tables get a passthrough fingerprint.
+
+    The structure key deliberately carries NO placement state — device
+    count, mesh layout, shard width are properties of where a plan
+    runs, not of what a model is (a request's fingerprint must not
+    change because the device pool resized between submit and drain).
+    Placement joins at the PLAN key instead (:func:`plan_key`).
     """
     ok, _reason = batchable(model, toas)
     return (ok, model._fn_fingerprint(), _structural_state(model))
+
+
+def plan_key(fp: tuple, toa_bucket: int, hyper: tuple,
+             devices: int) -> tuple:
+    """Batch-PLAN grouping key: structure + shapes + placement.
+
+    Two requests may share one program launch iff their plan keys are
+    equal: same :func:`structure_fingerprint`, same TOA bucket (the
+    padded shape), same fit hyperparameters (traced but part of the
+    request contract), and — new with mesh-sharded serving (ISSUE 7) —
+    the same device count, because a formed batch's compiled program is
+    partitioned for a specific mesh: a batch planned for 8 devices and
+    one planned for 1 are different programs even at identical
+    structure and shapes. Device count lives HERE and not in
+    :func:`structure_fingerprint` (see there).
+    """
+    return (fp, toa_bucket, hyper, int(devices))
 
 
 def short_id(fp: tuple) -> str:

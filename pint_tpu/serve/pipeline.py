@@ -1,4 +1,4 @@
-"""Double-buffered host/device dispatch pipeline.
+"""Windowed host/device dispatch pipeline over a device-slot pool.
 
 The fused batched loop (fitting.device_loop) made a whole batch of fits
 ONE program launch and ONE fetch — but a naive driver still serializes
@@ -13,14 +13,33 @@ is deferred:
     device :         [==== batch 0 ====][==== batch 1 ====][== batch 2 ...
 
 :func:`run_pipeline` drives that schedule with a bounded in-flight
-window (default 2 = classic double buffering): the window drains to
-``window - 1`` BEFORE batch k's prep runs — prep itself device-places
-the stacked tables, so batch k's fresh buffers plus the in-flight
-batches never exceed ``window`` sets of live device buffers, the
-backpressure contract that keeps device memory bounded no matter how
-many batches a drain covers. Batch k's prep still overlaps the
-``window - 1`` batches left executing (with the default window of 2
-that is exactly prep-k+1-over-execute-k double buffering).
+window (default 2 = classic double buffering) — now generalized from
+one global window to a **per-slot window pool** (ISSUE 7): each item
+occupies a set of device slots (``slots_of``; the mesh scheduler maps
+these to the devices a plan's shard spans), and the window bound
+applies PER SLOT. Items on disjoint slots pipeline independently —
+batch k for devices 0-3 never blocks behind batch j in flight on
+devices 4-7 — while the memory contract is unchanged per device: the
+window drains to ``window - 1`` on every one of an item's slots BEFORE
+its prep runs (prep device-places the stacked tables), so each
+device's fresh buffers plus its in-flight batches never exceed
+``window`` sets of live buffers, no matter how many batches a drain
+covers or how the planner packed them.
+
+**Work-stealing drain order**: blocking fetches follow the oldest
+in-flight item on a *contended* slot, but whenever the runtime reports
+some OTHER in-flight item already complete (``ready``; jax.Array
+``is_ready`` — a pure queue peek, no sync), its fetch is stolen first:
+result write-back for finished shards proceeds while the contended
+shard still executes, instead of head-of-line blocking in global FIFO
+order. Items with an empty slot set (host-synchronous passthrough
+fits) are never windowed — they hold no device buffers beyond their
+own synchronous dispatch.
+
+``window`` must be an int; values below 1 CLAMP to 1 (the documented
+floor — a window of 1 is strict ping-pong: at most one batch's buffers
+live per slot, pinned by tests/test_serve.py), and a non-int raises
+``TypeError`` rather than silently truncating a fractional window.
 
 The pipeline is deliberately thread-free: overlap comes from the JAX
 runtime's async dispatch, not host threading, so every user-model
@@ -33,48 +52,88 @@ from __future__ import annotations
 import time
 
 
-def run_pipeline(items, *, prep, dispatch, fetch, window: int = 2):
+def run_pipeline(items, *, prep, dispatch, fetch, window: int = 2,
+                 slots_of=None, ready=None):
     """Run each item through prep -> dispatch -> fetch with overlap.
 
     ``prep(item)`` is the host stage (pack/whiten/pad); ``dispatch
     (prepped)`` enqueues device work and must NOT block on it,
     returning a handle; ``fetch(handle, item)`` blocks on the result.
+
+    ``slots_of(item) -> iterable of hashable slot ids`` declares which
+    device slots the item's buffers live on (default: one shared slot,
+    the classic single-window behavior); the ``window`` bound applies
+    per slot, and an empty slot set opts the item out of windowing
+    (host-synchronous work holding no device buffers). ``ready(handle)
+    -> bool`` (optional) reports whether a dispatched handle's result
+    is already complete without blocking; when provided, fetches steal
+    completed handles ahead of the oldest-blocking order.
+
     Returns ``(results, stats)`` with results in item order and
     ``stats = {"prep_s", "dispatch_s", "wait_s", "wall_s",
-    "overlap_efficiency"}`` — ``wait_s`` is the time the host spent
-    blocked in fetch; ``overlap_efficiency`` the fraction of the drain
-    wall during which the host was doing useful (non-blocked) work,
-    i.e. ``1 - wait_s / wall_s``.
+    "overlap_efficiency", "stolen_fetches"}`` — ``wait_s`` is the time
+    the host spent inside fetch; ``overlap_efficiency`` the fraction
+    of the drain wall during which the host was doing useful
+    (non-fetch) work, i.e. ``1 - wait_s / wall_s``;
+    ``stolen_fetches`` the number of fetches taken out of oldest-first
+    order because their result was already complete.
     """
-    window = max(1, int(window))
+    if isinstance(window, bool) or not isinstance(window, int):
+        raise TypeError(f"window must be an int >= 1, got {window!r}")
+    window = max(1, window)  # documented clamp: floor at strict ping-pong
     items = list(items)
     results = [None] * len(items)
-    inflight: list[tuple[int, object]] = []
+    # (item index, handle, slots) in dispatch order
+    inflight: list[tuple[int, object, tuple]] = []
+    load: dict = {}  # slot -> in-flight item count
     prep_s = dispatch_s = wait_s = 0.0
+    stolen = 0
     t_start = time.perf_counter()
 
-    def _fetch_oldest():
+    def _resolve(j: int) -> None:
         nonlocal wait_s
-        i, handle = inflight.pop(0)
+        i, handle, slots = inflight.pop(j)
         t0 = time.perf_counter()
         results[i] = fetch(handle, items[i])
         wait_s += time.perf_counter() - t0
+        for s in slots:
+            load[s] -= 1
+
+    def _ready_index():
+        if ready is None:
+            return None
+        return next((j for j, (_i, h, _s) in enumerate(inflight)
+                     if ready(h)), None)
 
     for i, item in enumerate(items):
-        # drain to window - 1 BEFORE prep: prep device-places batch i's
-        # stacked tables, so draining any later would let window + 1
-        # batches hold live device buffers (the documented bound is
-        # ``window``); prep still overlaps the remaining in-flight work
-        while len(inflight) >= window:
-            _fetch_oldest()
+        slots = tuple(slots_of(item)) if slots_of is not None else (0,)
+        # drain this item's slots to window - 1 BEFORE prep: prep
+        # device-places the item's stacked tables, so draining any
+        # later would let window + 1 batches hold live buffers on a
+        # device (the documented bound is ``window``); prep still
+        # overlaps every other slot's in-flight work
+        while any(load.get(s, 0) >= window for s in slots):
+            j = _ready_index()
+            if j is None:
+                # oldest in-flight item sharing a contended slot
+                j = next(k for k, (_i, _h, s2) in enumerate(inflight)
+                         if set(s2) & set(slots))
+            elif not (set(inflight[j][2]) & set(slots)):
+                stolen += 1
+            _resolve(j)
         t0 = time.perf_counter()
         prepped = prep(item)
         prep_s += time.perf_counter() - t0
         t0 = time.perf_counter()
-        inflight.append((i, dispatch(prepped)))
+        inflight.append((i, dispatch(prepped), slots))
         dispatch_s += time.perf_counter() - t0
+        for s in slots:
+            load[s] = load.get(s, 0) + 1
     while inflight:
-        _fetch_oldest()
+        j = _ready_index()
+        if j is not None and j > 0:
+            stolen += 1
+        _resolve(j if j is not None else 0)
     wall_s = time.perf_counter() - t_start
     return results, {
         "prep_s": round(prep_s, 6),
@@ -82,4 +141,5 @@ def run_pipeline(items, *, prep, dispatch, fetch, window: int = 2):
         "wait_s": round(wait_s, 6),
         "wall_s": round(wall_s, 6),
         "overlap_efficiency": round(1.0 - wait_s / max(wall_s, 1e-12), 4),
+        "stolen_fetches": stolen,
     }

@@ -54,11 +54,40 @@ exception in one batch can never tear down a drain:
 Fault injection for all of the above lives in
 :mod:`pint_tpu.serve.faults` (seed-driven, zero-cost when off).
 
+**Mesh-sharded serving (ISSUE 7).** Formed batches no longer all run
+on one device set: the planner places every plan on a slice of the
+device pool (``mesh_devices`` of ``jax.devices()``):
+
+* a **batched** plan's member axis is sharded over an aligned power-of-
+  two block of devices (width = the largest pow-2 dividing its member
+  bucket, capped at the pool) — many small fits spread across the mesh;
+* a batchable **singleton at or above ``toa_shard_min``** routes
+  through the TOA-axis-sharded path instead (one fit, every O(n) leaf
+  partitioned over the whole pool —
+  :class:`pint_tpu.parallel.sharded_fit.ShardedServeFitter`);
+* blocks are packed least-loaded-first, deterministically, so repeated
+  drains of the same plan sequence reuse their compiled (partitioned)
+  programs; the device count is part of the PLAN key
+  (:func:`pint_tpu.serve.fingerprint.plan_key`), never the structure
+  fingerprint;
+* the in-flight ``window`` applies PER DEVICE (pipeline slot pool):
+  disjoint blocks pipeline independently, with a work-stealing drain
+  order that fetches already-complete shards ahead of FIFO;
+* the PR-6 fault machinery is **shard-local**: per-device fail streaks
+  isolate a failing block (its plans become passthrough and placement
+  routes around it) without tripping the global ladder — the global
+  streak only grows on drains where EVERY batch failed, and one clean
+  drain heals everything;
+* per-device member/occupancy/bytes vectors land in the drain record's
+  ``mesh`` block plus ``serve.mesh.*`` counters, rendered by the
+  report CLI's "mesh" section (with an occupancy-skew warning).
+
 Telemetry: ``serve.*`` counters/gauges (now including ``serve.fault.*``
-/ ``serve.retry.*`` / ``serve.quarantine.*`` / ``serve.status.*``), one
-``type="serve"`` record per drain and one ``type="fault"`` record per
-failure event — rendered by ``python -m pint_tpu.telemetry.report``
-under "throughput engine" and "failure domains".
+/ ``serve.retry.*`` / ``serve.quarantine.*`` / ``serve.status.*`` /
+``serve.mesh.*`` / ``serve.pad.dummy_members``), one ``type="serve"``
+record per drain and one ``type="fault"`` record per failure event —
+rendered by ``python -m pint_tpu.telemetry.report`` under "throughput
+engine", "failure domains" and "mesh".
 """
 
 from __future__ import annotations
@@ -208,17 +237,33 @@ class FitHandle:
 
 @dataclasses.dataclass
 class BatchPlan:
-    """One planned program launch (inspectable, pure — no device work)."""
+    """One planned program launch (inspectable, pure — no device work).
 
-    kind: str                 # "batched" | "passthrough"
+    ``devices``/``slot`` are the planner's placement: the plan's
+    buffers and program span devices ``slot .. slot + devices - 1`` of
+    the scheduler's pool (``devices == 0`` for passthrough plans, which
+    are host-synchronous and hold no windowed device buffers). A
+    ``"batched"`` plan shards its MEMBER axis over the block; a
+    ``"sharded"`` plan is one big fit with its TOA axis sharded over
+    the whole pool.
+    """
+
+    kind: str                 # "batched" | "sharded" | "passthrough"
     group: str                # fingerprint short id
     indices: list[int]        # queue positions of the member requests
     toa_bucket: int
     n_members: int            # padded member count (1 for passthrough)
+    devices: int = 1          # device-block width (0 = host/passthrough)
+    slot: int = 0             # first device index of the block
 
     @property
     def occupancy(self) -> float:
         return len(self.indices) / max(1, self.n_members)
+
+    @property
+    def device_ids(self) -> tuple[int, ...]:
+        """Pool indices this plan's buffers/program span."""
+        return tuple(range(self.slot, self.slot + self.devices))
 
 
 class _FailedBatch:
@@ -238,7 +283,7 @@ class _BatchState:
     """In-flight state threaded through prep -> dispatch -> fetch."""
 
     __slots__ = ("plan", "fitter", "handle", "resolved", "trace",
-                 "attempts", "hyper")
+                 "attempts", "hyper", "device_bytes", "t_done")
 
     def __init__(self, plan, fitter=None):
         self.plan = plan
@@ -248,6 +293,8 @@ class _BatchState:
         self.trace = None     # passthrough: trace captured at fit time
         self.attempts = 1
         self.hyper = None
+        self.device_bytes = None  # per-device bytes of placed tables
+        self.t_done = None    # passthrough: completion stamped at dispatch
 
 
 def _member_trace(trace: dict | None, m: int) -> dict | None:
@@ -273,28 +320,59 @@ class ThroughputScheduler:
     Parameters: ``max_queue`` bounds :meth:`submit` (backpressure);
     ``max_batch_members`` caps one program's member count;
     ``member_floor`` floors the pow-2 member bucket (tests use it to
-    force dummy padding); ``window`` is the double-buffer depth
-    (in-flight batches); ``mesh`` is forwarded to the batched fitter.
+    force dummy padding); ``window`` is the in-flight depth PER DEVICE
+    (the pipeline's per-slot window pool).
+
+    Mesh placement (ISSUE 7): the device pool is ``jax.devices()`` —
+    or the devices of an explicit ``mesh``, kept for compatibility —
+    truncated to ``mesh_devices`` when given (tools/soak.py randomizes
+    it). Batched plans shard their member axis over aligned pow-2
+    device blocks; a batchable singleton whose TOA bucket reaches
+    ``toa_shard_min`` routes through the TOA-axis-sharded path over
+    the whole pool instead (default = the bucketing ceiling, above
+    which fits carry exact shapes and a single fit is mesh-scale
+    work). With one device every rule degenerates to the PR-5
+    single-set behavior.
 
     Fault-domain knobs: ``max_dispatch_retries`` transient re-dispatches
     per batch, ``retry_backoff_s`` the exponential backoff base (0 in
     tests), ``degrade_after`` the consecutive-failing-drain count that
-    trips the degradation ladder.
+    trips the degradation ladder — globally when whole drains fail,
+    per device block when only some shards do (see :meth:`degraded` /
+    :meth:`degraded_devices`).
     """
 
     def __init__(self, *, max_queue: int = 256,
                  max_batch_members: int = 64, member_floor: int = 1,
-                 window: int = 2, mesh=None,
+                 window: int = 2, mesh=None, mesh_devices: int | None = None,
+                 toa_shard_min: int = 16384,
                  max_dispatch_retries: int = 2,
                  retry_backoff_s: float = 0.05,
                  degrade_after: int = 2):
+        import jax
+
         if max_queue < 1 or max_batch_members < 1:
             raise ValueError("max_queue and max_batch_members must be >= 1")
         self.max_queue = max_queue
         self.max_batch_members = max_batch_members
         self.member_floor = max(1, member_floor)
+        # same contract as pipeline.run_pipeline, enforced HERE so a
+        # bad window rejects at construction instead of failing every
+        # drain: non-int raises, < 1 clamps to the documented floor
+        if isinstance(window, bool) or not isinstance(window, int):
+            raise TypeError(f"window must be an int >= 1, got {window!r}")
         self.window = max(1, window)
-        self.mesh = mesh
+        if mesh is not None:
+            devs = list(np.asarray(mesh.devices).ravel())
+        else:
+            devs = list(jax.devices())
+        if mesh_devices is not None:
+            devs = devs[:max(1, int(mesh_devices))]
+        self.devices = devs
+        self.n_devices = len(devs)
+        self._dev_index = {d.id: i for i, d in enumerate(devs)}
+        self.toa_shard_min = max(1, int(toa_shard_min))
+        self._meshes: dict = {}  # (kind-is-sharded, slot, width) -> Mesh
         self.max_dispatch_retries = max(0, max_dispatch_retries)
         self.retry_backoff_s = max(0.0, retry_backoff_s)
         self.degrade_after = max(1, degrade_after)
@@ -304,7 +382,8 @@ class ThroughputScheduler:
                                 dict]] = []
         self._seq = 0          # submit sequence (fault-injection key)
         self._drain_seq = 0
-        self._fail_streak = 0  # consecutive drains with a failed batch
+        self._fail_streak = 0  # consecutive ALL-batches-failed drains
+        self._dev_streak: dict[int, int] = {}  # device -> fail streak
         self._drain_rate: float | None = None  # EWMA fits/s
         self.last_drain: dict | None = None
 
@@ -312,10 +391,25 @@ class ThroughputScheduler:
     # degradation ladder
     # ------------------------------------------------------------------
     def degraded(self) -> bool:
-        """Ladder tripped: ``degrade_after`` consecutive drains each had
-        at least one batch exhaust its retries. While degraded, plans
-        are isolated passthroughs and capacity halves (shedding)."""
+        """GLOBAL ladder tripped: ``degrade_after`` consecutive drains
+        in which every batch that ran exhausted its retries (the whole
+        pool failing, not one shard — see :meth:`degraded_devices`).
+        While degraded, plans are isolated passthroughs and capacity
+        halves (shedding)."""
         return self._fail_streak >= self.degrade_after
+
+    def degraded_devices(self) -> set[int]:
+        """Pool indices whose per-device fail streak has tripped.
+
+        Shard-local degradation (ISSUE 7): a device accumulates one
+        streak point per drain in which a batch placed on it failed,
+        heals on a drain where it completed a batch cleanly (or on any
+        fully clean drain). The planner routes batches around degraded
+        devices; when no clean block exists for a plan's width, that
+        plan falls back to isolated passthroughs — one poisoned shard
+        degrades alone instead of tripping the global ladder."""
+        return {d for d, s in self._dev_streak.items()
+                if s >= self.degrade_after}
 
     def _retry_after_hint(self, depth: int) -> float:
         """Seconds until the queue plausibly has room: depth over the
@@ -372,55 +466,134 @@ class ThroughputScheduler:
     # batch formation
     # ------------------------------------------------------------------
     def plan(self) -> list[BatchPlan]:
-        """Group the queue into program launches (pure; queue untouched).
+        """Group the queue into placed program launches (pure; queue
+        untouched).
 
-        Group key = (structure fingerprint, TOA bucket, fit
-        hyperparameters): equal keys guarantee one union program; the
-        TOA bucket uses the fit-path policy (``bucketing.bucket_size``)
-        so unequal-length tables sharing a bucket share a batch via the
-        existing zero-weight ``pad_toas`` rows. Groups keep submission
-        order; each chunks at ``max_batch_members`` and pads to the
-        pow-2 member bucket.
+        Group key = :func:`pint_tpu.serve.fingerprint.plan_key`
+        (structure fingerprint, TOA bucket, fit hyperparameters, device
+        count): equal keys guarantee one union program partitioned for
+        this pool; the TOA bucket uses the fit-path policy
+        (``bucketing.bucket_size``) so unequal-length tables sharing a
+        bucket share a batch via the existing zero-weight ``pad_toas``
+        rows. Groups keep submission order; each chunks at
+        ``max_batch_members`` and pads to the pow-2 member bucket.
 
-        Degradation-ladder level 1: while :meth:`degraded`, EVERY plan
-        is an isolated passthrough — under suspected systemic failure
-        the blast radius of any one launch is one request.
+        Placement (the shard planner, ISSUE 7): a batchable singleton
+        whose TOA bucket reaches ``toa_shard_min`` becomes a
+        ``"sharded"`` plan — its TOA axis partitioned over the WHOLE
+        pool (one such fit is mesh-scale work by itself). Every other
+        batchable chunk becomes a ``"batched"`` plan whose MEMBER axis
+        shards over an aligned device block of width = min(largest
+        pow-2 dividing the member bucket, largest pow-2 <= pool size);
+        blocks are chosen least-loaded-first (by member-slots already
+        placed this pass, ties to the lowest slot — deterministic, so a
+        repeated plan sequence lands on the same devices and reuses its
+        compiled programs).
+
+        Degradation: while globally :meth:`degraded`, EVERY plan is an
+        isolated passthrough (blast radius one request). Shard-locally,
+        placement avoids blocks containing :meth:`degraded_devices`;
+        a plan whose every candidate block is poisoned falls back to
+        isolated passthroughs while healthy blocks keep batching.
         """
+        from pint_tpu.parallel.mesh import (largest_pow2_divisor,
+                                            largest_pow2_leq)
+
         degraded = self.degraded()
+        bad_devs = self.degraded_devices()
         groups: dict[tuple, list[int]] = {}
         order: list[tuple] = []
         for i, (req, _h, _t, fp, _m) in enumerate(self._queue):
-            key = (fp, bucketing.bucket_size(len(req.toas)),
-                   req.maxiter, req.min_chi2_decrease,
-                   req.max_step_halvings)
+            key = _fp.plan_key(fp, bucketing.bucket_size(len(req.toas)),
+                               (req.maxiter, req.min_chi2_decrease,
+                                req.max_step_halvings), self.n_devices)
             if key not in groups:
                 groups[key] = []
                 order.append(key)
             groups[key].append(i)
         plans: list[BatchPlan] = []
+        load = [0] * self.n_devices  # member-slots placed this pass
+        width_cap = largest_pow2_leq(self.n_devices)
+
+        def _passthrough(fp, idxs, bucket):
+            plans.extend(BatchPlan("passthrough", _fp.short_id(fp), [i],
+                                   bucket, 1, devices=0) for i in idxs)
+
+        def _place(width: int) -> tuple[int, bool]:
+            """(slot, clean): least-loaded aligned block of ``width``;
+            ``clean`` False when every candidate contains a degraded
+            device (placement preference keys sort degraded last)."""
+            best = None
+            for a in range(0, self.n_devices - width + 1, width):
+                blk = range(a, a + width)
+                k = (any(d in bad_devs for d in blk),
+                     max(load[d] for d in blk), a)
+                if best is None or k < best[0]:
+                    best = (k, a)
+            return best[1], not best[0][0]
+
         for key in order:
             fp, bucket = key[0], key[1]
             idxs = groups[key]
             if not fp[0] or degraded:  # unbatchable OR isolation mode
-                plans.extend(
-                    BatchPlan("passthrough", _fp.short_id(fp), [i],
-                              bucket, 1) for i in idxs)
+                _passthrough(fp, idxs, bucket)
+                continue
+            if self.n_devices > 1 and bucket >= self.toa_shard_min:
+                # big-fit route: TOA axis over the whole pool, one fit
+                # per program (it saturates the mesh alone). The block
+                # is every device, so any degraded device isolates it.
+                if bad_devs:
+                    _passthrough(fp, idxs, bucket)
+                    continue
+                for i in idxs:
+                    for d in range(self.n_devices):
+                        load[d] += 1
+                    plans.append(BatchPlan(
+                        "sharded", _fp.short_id(fp), [i], bucket, 1,
+                        devices=self.n_devices, slot=0))
                 continue
             for j in range(0, len(idxs), self.max_batch_members):
                 chunk = idxs[j:j + self.max_batch_members]
                 # the pow-2 member bucket must not round past the
                 # caller's hard cap (a 48-cap chunk padded to 64 would
                 # break the device-memory bound the cap exists for)
+                n_members = min(bucketing.member_bucket_size(
+                                    len(chunk), floor=self.member_floor),
+                                self.max_batch_members)
+                width = min(largest_pow2_divisor(n_members), width_cap)
+                slot, clean = _place(width)
+                if not clean:
+                    _passthrough(fp, chunk, bucket)
+                    continue
+                for d in range(slot, slot + width):
+                    load[d] += n_members // width
                 plans.append(BatchPlan(
                     "batched", _fp.short_id(fp), chunk, bucket,
-                    min(bucketing.member_bucket_size(
-                            len(chunk), floor=self.member_floor),
-                        self.max_batch_members)))
+                    n_members, devices=width, slot=slot))
         return plans
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
+    def _mesh_for(self, plan: BatchPlan):
+        """The plan's placement mesh over its device block (cached per
+        (kind, slot, width) — jax Mesh equality is structural, so even
+        fresh instances would hit the program caches; the dict just
+        skips rebuilding). ``"batched"`` plans get a (width, 1)
+        psr-major mesh (member axis sharded, TOA axis whole);
+        ``"sharded"`` plans a (1, width) toa-major mesh."""
+        from pint_tpu.parallel.mesh import make_mesh
+
+        sharded = plan.kind == "sharded"
+        key = (sharded, plan.slot, plan.devices)
+        m = self._meshes.get(key)
+        if m is None:
+            devs = self.devices[plan.slot:plan.slot + plan.devices]
+            m = make_mesh(devices=devs,
+                          psr_axis=1 if sharded else len(devs))
+            self._meshes[key] = m
+        return m
+
     def _passthrough_fit(self, req: FitRequest):
         """One standalone ``Fitter.auto`` fit; returns
         ``(chi2, converged, diverged, reason)``. Raises on hard errors
@@ -618,6 +791,11 @@ class ThroughputScheduler:
         live = [queue[i] for i in kept]
         plans = self._plans_for(live)
         fail_batches = 0
+        # per-plan outcome/placement for shard-local ladder accounting
+        # and the drain record's mesh block (keyed by plan sequence)
+        failed_plans: set[int] = set()
+        clean_plans: set[int] = set()
+        plan_bytes: dict[int, dict] = {}
 
         def _hyper(plan):
             req0 = live[plan.indices[0]][0]
@@ -633,15 +811,26 @@ class ThroughputScheduler:
                     plan_f.maybe_prep_fault((drain_id, plan._seq))
                 if plan.kind == "passthrough":
                     return state  # Fitter.auto built at dispatch time
-                from pint_tpu.parallel.batch import BatchedPulsarFitter
+                if plan.kind == "sharded":
+                    from pint_tpu.parallel.sharded_fit import \
+                        ShardedServeFitter
 
-                problems = [(live[i][0].toas, live[i][0].model)
-                            for i in plan.indices]
-                with telemetry.span("serve.prep",
-                                    members=plan.n_members):
-                    state.fitter = BatchedPulsarFitter(
-                        problems, mesh=self.mesh,
-                        pad_members=plan.n_members)
+                    req0 = live[plan.indices[0]][0]
+                    with telemetry.span("serve.prep",
+                                        sharded=plan.devices):
+                        state.fitter = ShardedServeFitter(
+                            req0.toas, req0.model, self._mesh_for(plan))
+                else:
+                    from pint_tpu.parallel.batch import BatchedPulsarFitter
+
+                    problems = [(live[i][0].toas, live[i][0].model)
+                                for i in plan.indices]
+                    with telemetry.span("serve.prep",
+                                        members=plan.n_members):
+                        state.fitter = BatchedPulsarFitter(
+                            problems, mesh=self._mesh_for(plan),
+                            pad_members=plan.n_members)
+                state.device_bytes = state.fitter.device_bytes()
                 return state
             except Exception as e:  # noqa: BLE001 — isolation boundary
                 telemetry.inc("serve.fault.prep")
@@ -653,7 +842,7 @@ class ThroughputScheduler:
             plan = state.plan
             while True:
                 try:
-                    if plan_f is not None and plan.kind == "batched":
+                    if plan_f is not None and plan.kind != "passthrough":
                         plan_f.maybe_device_error(
                             (drain_id, plan._seq), state.attempts - 1)
                     if plan.kind == "passthrough":
@@ -661,10 +850,15 @@ class ThroughputScheduler:
                         # mid-loop: the fit runs here, already resolved
                         # at fetch time. The trace is captured NOW —
                         # by fetch time a later batch's dispatch may
-                        # have overwritten last_trace()
+                        # have overwritten last_trace() — and so is the
+                        # completion time: the work-stealing pipeline
+                        # may defer this state's fetch past later
+                        # batches, which must not inflate the request's
+                        # queue latency or trip its deadline
                         req0 = live[plan.indices[0]][0]
                         state.resolved = self._passthrough_fit(req0)
                         state.trace = recorder.last_trace()
+                        state.t_done = time.perf_counter()
                     else:
                         state.handle = state.fitter.dispatch_fit(
                             **state.hyper)
@@ -686,8 +880,12 @@ class ThroughputScheduler:
             nonlocal fail_batches
             if isinstance(state, _FailedBatch):
                 fail_batches += 1
+                failed_plans.add(plan._seq)
                 return self._salvage(live, plan, state)
+            if state.device_bytes:
+                plan_bytes[plan._seq] = state.device_bytes
             if plan.kind == "passthrough":
+                clean_plans.add(plan._seq)
                 entry = live[plan.indices[0]]
                 chi2, conv, div, reason = state.resolved
                 if div:
@@ -695,11 +893,12 @@ class ThroughputScheduler:
                     return [self._envelope(
                         entry, status="diverged", plan=plan, chi2=chi2,
                         error=f"standalone fit diverged: {reason}",
-                        trace=state.trace,
+                        trace=state.trace, t_done=state.t_done,
                         attempts=state.attempts, passthrough=True)]
                 return [self._envelope(
                     entry, status="ok" if conv else "nonconverged",
                     plan=plan, chi2=chi2, converged=conv,
+                    t_done=state.t_done,
                     attempts=state.attempts, passthrough=True)]
             while True:
                 try:
@@ -722,9 +921,11 @@ class ThroughputScheduler:
                         continue
                     telemetry.inc("serve.fault.fetch")
                     fail_batches += 1
+                    failed_plans.add(plan._seq)
                     return self._salvage(live, plan,
                                          _FailedBatch(plan, e, "fetch",
                                                       state.attempts))
+            clean_plans.add(plan._seq)
             fitter = state.fitter
             conv = np.asarray(fitter.converged)
             div = np.asarray(fitter.diverged)
@@ -750,12 +951,26 @@ class ThroughputScheduler:
                         attempts=state.attempts, t_done=t_done))
             return out
 
+        def _ready(state) -> bool:
+            """Non-blocking completion peek for the work-stealing drain
+            (advisory: a wrong True only reorders one fetch)."""
+            if isinstance(state, _FailedBatch):
+                return True
+            if state.plan.kind == "passthrough":
+                return True  # resolved synchronously at dispatch
+            try:
+                return bool(state.handle is not None
+                            and state.handle.ready())
+            except Exception:  # noqa: BLE001
+                return True
+
         for seq, plan in enumerate(plans):
             plan._seq = seq
         try:
             per_batch, stats = run_pipeline(
                 plans, prep=_prep, dispatch=_dispatch,
-                fetch=_fetch, window=self.window)
+                fetch=_fetch, window=self.window,
+                slots_of=lambda p: p.device_ids, ready=_ready)
         except BaseException:
             # the stages above are isolation boundaries, so this fires
             # only on a scheduler bug: every request whose handle is
@@ -769,14 +984,78 @@ class ThroughputScheduler:
             for i, res in zip(plan.indices, batch_results):
                 results[kept[i]] = res
 
-        # ladder bookkeeping: a drain with a failed batch extends the
-        # streak; a clean one heals it
-        self._fail_streak = self._fail_streak + 1 if fail_batches else 0
+        # ladder bookkeeping (shard-local, ISSUE 7): the GLOBAL streak
+        # grows only when every batch that ran failed (the whole pool
+        # in trouble) and heals on a failure-free drain; a MIXED drain
+        # — some shards failing while others complete — leaves the
+        # global ladder alone and charges the failing shards' devices
+        # instead, so one poisoned shard degrades (and is routed
+        # around) without collapsing the service to passthroughs
+        if not fail_batches:
+            self._fail_streak = 0
+            self._dev_streak.clear()  # a clean drain heals every shard
+        elif not clean_plans:
+            self._fail_streak += 1
+        if fail_batches:
+            by_plan = {p._seq: p for p in plans}
+            fail_devs = {d for s in failed_plans
+                         for d in by_plan[s].device_ids}
+            clean_devs = {d for s in clean_plans
+                          for d in by_plan[s].device_ids}
+            for d in fail_devs:
+                self._dev_streak[d] = self._dev_streak.get(d, 0) + 1
+            for d in clean_devs - fail_devs:
+                self._dev_streak.pop(d, None)
         telemetry.set_gauge("serve.fail_streak", self._fail_streak)
 
         n_real = sum(len(p.indices) for p in plans)
         n_members = sum(p.n_members for p in plans)
         occupancy = n_real / max(1, n_members)
+        # pow-2 member-padding waste, visible BEFORE sharding multiplies
+        # it (ISSUE-7 satellite): dummy members replicate a real fit's
+        # work on every device their batch spans
+        dummies = n_members - n_real
+        if dummies:
+            telemetry.inc("serve.pad.dummy_members", dummies)
+
+        # per-device placement accounting for the drain record's mesh
+        # block: member-slots assigned vs real members per device (the
+        # occupancy vector) and placed table bytes, summed over the
+        # drain's plans (not a simultaneous peak — the per-device
+        # window bounds concurrency)
+        D = self.n_devices
+        dev_members = [0] * D
+        dev_slots = [0] * D
+        dev_bytes = [0] * D
+        member_sharded = toa_sharded = 0
+        for p in plans:
+            if p.kind == "batched":
+                member_sharded += p.devices > 1
+                per = p.n_members // p.devices
+                for j, d in enumerate(p.device_ids):
+                    dev_slots[d] += per
+                    dev_members[d] += max(
+                        0, min(per, len(p.indices) - j * per))
+            elif p.kind == "sharded":
+                toa_sharded += 1
+                for d in p.device_ids:
+                    dev_slots[d] += 1
+                    dev_members[d] += 1
+        for s, by_dev in plan_bytes.items():
+            for did, nb in by_dev.items():
+                idx = self._dev_index.get(did)
+                if idx is not None:
+                    dev_bytes[idx] += nb
+        occ_vec = [round(dev_members[d] / dev_slots[d], 4)
+                   if dev_slots[d] else 0.0 for d in range(D)]
+        telemetry.set_gauge("serve.mesh.devices", D)
+        if member_sharded:
+            telemetry.inc("serve.mesh.member_sharded", member_sharded)
+        if toa_sharded:
+            telemetry.inc("serve.mesh.toa_sharded", toa_sharded)
+        if stats.get("stolen_fetches"):
+            telemetry.inc("serve.mesh.stolen_fetches",
+                          stats["stolen_fetches"])
         fits_per_s = n_real / max(stats["wall_s"], 1e-12)
         if n_real:
             self._drain_rate = (fits_per_s if self._drain_rate is None
@@ -803,10 +1082,25 @@ class ThroughputScheduler:
             "failed_batches": fail_batches,
             "degraded": self.degraded(),
             "fail_streak": self._fail_streak,
+            "dummy_members": dummies,
+            "dummy_fraction": round(dummies / max(1, n_members), 4),
+            "mesh": {
+                "devices": D,
+                "per_device_members": dev_members,
+                "per_device_slots": dev_slots,
+                "per_device_occupancy": occ_vec,
+                "per_device_bytes": dev_bytes,
+                "member_sharded": member_sharded,
+                "toa_sharded": toa_sharded,
+                "shard_fail_streaks": {
+                    str(d): s
+                    for d, s in sorted(self._dev_streak.items())},
+            },
             "batch_detail": [
                 {"kind": p.kind, "group": p.group,
                  "toa_bucket": p.toa_bucket, "real": len(p.indices),
-                 "members": p.n_members,
+                 "members": p.n_members, "devices": p.devices,
+                 "slot": p.slot,
                  "occupancy": round(p.occupancy, 4)} for p in plans],
             **stats,
         }

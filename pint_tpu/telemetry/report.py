@@ -19,6 +19,10 @@ written by bench.py / tools/soak.py / plain library use):
 * **throughput engine** — ``type="serve"`` records (one per scheduler
   drain: batch occupancy, fits/s, host/device overlap efficiency,
   queue latency — pint_tpu.serve);
+* **mesh** — per-device placement rollup from the drain records' mesh
+  blocks (member/occupancy/bytes vectors, member- vs TOA-sharded batch
+  counts, work-stealing fetches) with a skew warning when the busiest
+  device's occupancy exceeds 2x the idlest working device's;
 * **failure domains** — ``type="fault"`` records (one per serve-layer
   failure event: status, retries, quarantine traces) plus the
   ``serve.fault.* / serve.retry.* / serve.quarantine.*`` counters;
@@ -175,6 +179,64 @@ def serve_summaries(records: list[dict]) -> list[dict]:
         s["groups"] = len({b.get("group") for b in detail})
         out.append(s)
     return out
+
+
+def mesh_summary(records: list[dict]) -> dict:
+    """Per-device placement rollup from the drain records' ``mesh``
+    blocks (ISSUE 7): member-slots vs real members per device (the
+    occupancy vector), placed bytes, sharded-batch counts, and a skew
+    verdict — ``skew_warning`` is True when the busiest device's
+    occupancy exceeds 2x the idlest working device's (a lopsided
+    planner or a degenerate request mix)."""
+    devices = 0
+    drains = 0
+    members: list[int] = []
+    slots: list[int] = []
+    bytes_: list[int] = []
+    member_sharded = toa_sharded = stolen = 0
+    for r in records:
+        if r.get("type") != "serve":
+            continue
+        m = r.get("mesh")
+        if not isinstance(m, dict):
+            continue
+        drains += 1
+        d = int(m.get("devices", 0))
+        if d > devices:
+            devices = d
+            members += [0] * (d - len(members))
+            slots += [0] * (d - len(slots))
+            bytes_ += [0] * (d - len(bytes_))
+        for i, v in enumerate(m.get("per_device_members") or []):
+            members[i] += int(v)
+        rec_slots = m.get("per_device_slots")
+        if rec_slots is not None:
+            for i, v in enumerate(rec_slots):
+                slots[i] += int(v)
+        else:
+            # records predating per_device_slots: reconstruct from the
+            # occupancy vector (lossy — a device holding only dummy
+            # members has occupancy 0 and its slots are unrecoverable)
+            for i, (mem, occ) in enumerate(zip(
+                    m.get("per_device_members") or [],
+                    m.get("per_device_occupancy") or [])):
+                if occ:
+                    slots[i] += round(int(mem) / float(occ))
+        for i, v in enumerate(m.get("per_device_bytes") or []):
+            bytes_[i] += int(v)
+        member_sharded += int(m.get("member_sharded", 0))
+        toa_sharded += int(m.get("toa_sharded", 0))
+        stolen += int(r.get("stolen_fetches", 0))
+    occ = [round(members[i] / slots[i], 4) if slots[i] else 0.0
+           for i in range(devices)]
+    working = [o for o in occ if o > 0]
+    skew = (round(max(working) / min(working), 2) if working else None)
+    return {"drains": drains, "devices": devices,
+            "per_device_members": members, "per_device_slots": slots,
+            "per_device_occupancy": occ, "per_device_bytes": bytes_,
+            "member_sharded": member_sharded, "toa_sharded": toa_sharded,
+            "stolen_fetches": stolen, "occupancy_skew": skew,
+            "skew_warning": bool(skew is not None and skew > 2.0)}
 
 
 def fault_summaries(records: list[dict]) -> dict:
@@ -398,6 +460,31 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no serve records)")
 
+    lines.append("\n== mesh (device placement) ==")
+    mesh = summary["mesh"]
+    if mesh["devices"] > 1 and mesh["drains"]:
+        lines.append(
+            f"  {mesh['drains']} drain(s) over {mesh['devices']} devices: "
+            f"{mesh['member_sharded']} member-sharded batch(es), "
+            f"{mesh['toa_sharded']} TOA-sharded fit(s), "
+            f"{mesh['stolen_fetches']} stolen fetch(es)")
+        for d in range(mesh["devices"]):
+            lines.append(
+                f"    device {d}: {mesh['per_device_members'][d]:>4} "
+                f"members / {mesh['per_device_slots'][d]:>4} slots  "
+                f"occupancy {mesh['per_device_occupancy'][d]:.2f}  "
+                f"{mesh['per_device_bytes'][d] / 1e6:.2f} MB placed")
+        if mesh["skew_warning"]:
+            lines.append(
+                f"    WARNING: occupancy skew {mesh['occupancy_skew']}x "
+                "between busiest and idlest working device (> 2x) — "
+                "placement or request mix is lopsided")
+        elif mesh["occupancy_skew"] is not None:
+            lines.append(f"    occupancy skew {mesh['occupancy_skew']}x "
+                         "(within the 2x balance budget)")
+    else:
+        lines.append("  (no mesh-sharded drains)")
+
     lines.append("\n== failure domains ==")
     faults = summary["faults"]
     if faults["events"] or faults["counters"]:
@@ -465,6 +552,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "traces": trace_summaries(records),
         "programs": program_summaries(records),
         "serve": serve_summaries(records),
+        "mesh": mesh_summary(records),
         "faults": fault_summaries(records),
         "caches": cache_rates(records),
         "pollution": pollution_windows(records),

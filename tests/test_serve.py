@@ -122,6 +122,77 @@ def test_pipeline_window_and_order():
     assert stats["wall_s"] >= 0 and "overlap_efficiency" in stats
 
 
+def test_pipeline_window_validation():
+    """Satellite (ISSUE 7): window < 1 CLAMPS to 1 — the documented
+    floor, with the live-buffer bound pinned at 1 — and a non-int
+    window raises instead of silently truncating."""
+    for bad in (1.5, "2", 2.0, None):
+        with pytest.raises(TypeError, match="window"):
+            run_pipeline([1], prep=lambda i: i, dispatch=lambda p: p,
+                         fetch=lambda h, i: h, window=bad)
+        # the scheduler enforces the same contract at construction, so
+        # a bad window rejects up front instead of failing every drain
+        with pytest.raises(TypeError, match="window"):
+            ThroughputScheduler(window=bad)
+    for w in (0, -3, 1):
+        outstanding, peak = [0], [0]
+
+        def dispatch(p):
+            outstanding[0] += 1
+            peak[0] = max(peak[0], outstanding[0])
+            return p
+
+        def fetch(h, item):
+            outstanding[0] -= 1
+            return h
+
+        results, _stats = run_pipeline(
+            range(4), prep=lambda i: i, dispatch=dispatch, fetch=fetch,
+            window=w)
+        assert results == [0, 1, 2, 3]
+        assert peak[0] == 1, f"window={w} must bound live buffers at 1"
+
+
+def test_pipeline_per_slot_windows():
+    """Items on disjoint device slots pipeline independently: slot b's
+    dispatch never waits for slot a's window (ISSUE 7)."""
+    log = []
+    items = [("x", ("a",)), ("y", ("b",)), ("z", ("a",))]
+
+    def fetch(h, item):
+        log.append(("fetch", h))
+        return h
+
+    results, _ = run_pipeline(
+        items, prep=lambda it: it[0], dispatch=lambda p: log.append(
+            ("dispatch", p)) or p, fetch=fetch, window=1,
+        slots_of=lambda it: it[1])
+    assert results == ["x", "y", "z"]
+    # with ONE global window=1 slot, y's dispatch would sit behind
+    # x's fetch; per-slot windows let it through
+    assert log.index(("dispatch", "y")) < log.index(("fetch", "x"))
+    # z shares slot a with x, so x must drain first
+    assert log.index(("fetch", "x")) < log.index(("dispatch", "z"))
+
+
+def test_pipeline_work_stealing_fetch_order():
+    """A completed handle on another slot is fetched (stolen) before
+    blocking on the contended slot's oldest in-flight item."""
+    log = []
+    items = [("x", ("a",)), ("y", ("b",)), ("z", ("a",))]
+
+    results, stats = run_pipeline(
+        items, prep=lambda it: it[0],
+        dispatch=lambda p: p,
+        fetch=lambda h, item: log.append(h) or h,
+        window=1, slots_of=lambda it: it[1],
+        ready=lambda h: h == "y")
+    assert results == ["x", "y", "z"]
+    # draining slot a for z: y (slot b) is ready -> stolen first
+    assert log.index("y") < log.index("x")
+    assert stats["stolen_fetches"] >= 1
+
+
 def test_plan_groups_by_structure_bucket_and_hyper(toas_a):
     """Batch formation: same structure+bucket+hyper share a batch;
     a structure variant, a different TOA bucket, and different fit
@@ -246,6 +317,24 @@ def test_one_launch_one_fetch_per_batch(padded_vs_real):
     # occupancy accounting (bucketing.note_batch_occupancy)
     assert padded_vs_real["padded"]["delta"].get("batch.members.pad") == 3
     assert padded_vs_real["padded"]["delta"].get("batch.members.real") == 1
+
+
+def test_dummy_member_padding_visible(padded_vs_real):
+    """Satellite (ISSUE 7): pow-2 member-padding waste is reported per
+    drain — a `serve.pad.dummy_members` counter plus dummy_members /
+    dummy_fraction fields in the drain record."""
+    padded = padded_vs_real["padded"]
+    assert padded["delta"].get("serve.pad.dummy_members") == 3
+    real = padded_vs_real["real"]
+    assert real["delta"].get("serve.pad.dummy_members") is None
+
+
+def test_dummy_member_drain_record(toas_a):
+    s = ThroughputScheduler(max_queue=8, member_floor=4)
+    s.submit(_request(PAR, toas_a))
+    s.drain()
+    assert s.last_drain["dummy_members"] == 3
+    assert s.last_drain["dummy_fraction"] == 0.75
 
 
 def test_program_reuse_across_batches(padded_vs_real):

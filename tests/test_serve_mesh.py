@@ -1,0 +1,322 @@
+"""Mesh-sharded serving (ISSUE 7): shard-planner placement, per-device
+accounting, the TOA-sharded big-fit route, plan-key placement state,
+and the shard-local degradation ladder.
+
+Runs on the conftest-armed 8-virtual-device XLA:CPU mesh. PAR matches
+tests/test_serve.py so batched programs are shared across files within
+one tier-1 process (bucketing + process-global jit cache).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.models import get_model
+from pint_tpu.serve import (FitRequest, ThroughputScheduler, faults,
+                            plan_key, structure_fingerprint)
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+PAR_FD = PAR + "FD1 1e-5 1\n"
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    faults._reset()
+    yield
+    faults._reset()
+    telemetry.reset()
+
+
+def _make_toas(par: str, n: int, seed: int):
+    truth = get_model(par)
+    return make_fake_toas_uniform(53000, 56000, n, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=seed)
+
+
+def _request(par: str, toas, tag=None, **hyper) -> FitRequest:
+    pert = get_model(par)
+    pert["F0"].add_delta(2e-10)
+    return FitRequest(toas, pert, tag=tag, **hyper)
+
+
+@pytest.fixture(scope="module")
+def toas_a():
+    return _make_toas(PAR, 60, seed=301)
+
+
+# ----------------------------------------------------------------------
+# shard planner: widths, slots, plan key
+# ----------------------------------------------------------------------
+
+def test_plan_places_member_shards(toas_a):
+    """A full-pool-width batch spans all 8 devices; two narrower
+    batches pack side by side on disjoint aligned blocks."""
+    s = ThroughputScheduler(max_queue=16)
+    assert s.n_devices == 8
+    for i in range(6):
+        s.submit(_request(PAR, toas_a, tag=i))
+    (p,) = s.plan()
+    # 6 members pad to the pow-2 bucket 8; width = min(8, 8) = 8
+    assert (p.kind, p.n_members, p.devices, p.slot) == ("batched", 8, 8, 0)
+    assert p.device_ids == tuple(range(8))
+
+    s2 = ThroughputScheduler(max_queue=16)
+    for i in range(2):
+        s2.submit(_request(PAR, toas_a, tag=f"a{i}"))
+    for i in range(2):
+        s2.submit(_request(PAR_FD, toas_a, tag=f"b{i}"))
+    pa, pb = s2.plan()
+    # two 2-member batches (width 2) land on DISJOINT blocks,
+    # least-loaded first: slots 0 and 2
+    assert (pa.devices, pa.slot) == (2, 0)
+    assert (pb.devices, pb.slot) == (2, 2)
+
+
+def test_mesh_devices_caps_the_pool(toas_a):
+    s = ThroughputScheduler(max_queue=8, mesh_devices=2)
+    assert s.n_devices == 2
+    for i in range(6):
+        s.submit(_request(PAR, toas_a, tag=i))
+    (p,) = s.plan()
+    assert (p.n_members, p.devices, p.slot) == (8, 2, 0)
+
+
+def test_plan_key_carries_device_count_not_the_fingerprint(toas_a):
+    """Placement state (device count) splits PLAN keys but must never
+    split structure fingerprints (a request's identity cannot change
+    when the pool resizes between submit and drain)."""
+    m = get_model(PAR)
+    fp = structure_fingerprint(m, toas_a)
+    assert fp == structure_fingerprint(get_model(PAR), toas_a)
+    hyper = (20, 1e-3, 8)
+    assert plan_key(fp, 64, hyper, 8) != plan_key(fp, 64, hyper, 1)
+    assert plan_key(fp, 64, hyper, 8) == plan_key(fp, 64, hyper, 8)
+
+
+# ----------------------------------------------------------------------
+# member-sharded drain: record + parity
+# ----------------------------------------------------------------------
+
+def test_member_sharded_drain_record_and_parity(toas_a):
+    """A drain across the mesh reports per-device occupancy/bytes and
+    every member lands on its standalone fused fit (member-diagonal
+    sharding must not change arithmetic)."""
+    from pint_tpu.fitting import device_loop
+
+    hyper = dict(maxiter=10, min_chi2_decrease=1e-7)
+    s = ThroughputScheduler(max_queue=8)
+    before = telemetry.counters_snapshot()
+    for i in range(6):
+        s.submit(_request(PAR, toas_a, tag=i, **hyper))
+    res = s.drain()
+    delta = telemetry.counters_delta(before)
+    mesh = s.last_drain["mesh"]
+    assert mesh["devices"] == 8
+    assert mesh["member_sharded"] == 1
+    assert len(mesh["per_device_occupancy"]) == 8
+    assert sum(mesh["per_device_members"]) == 6
+    # every device holds a slice of the stacked batch (bytes recorded
+    # from sharding metadata)
+    assert all(b > 0 for b in mesh["per_device_bytes"])
+    assert delta.get("serve.mesh.member_sharded") == 1
+    assert s.last_drain["batch_detail"][0]["devices"] == 8
+
+    m_ref = get_model(PAR)
+    m_ref["F0"].add_delta(2e-10)
+    _d, _i, chi2, conv, _c = device_loop.dense_wls_fit(toas_a, m_ref,
+                                                       **hyper)
+    for r in res:
+        assert r.status == "ok"
+        assert r.chi2 == pytest.approx(float(chi2), rel=1e-9)
+        assert bool(r.converged) == bool(conv)
+
+
+# ----------------------------------------------------------------------
+# big-fit route: TOA-axis sharding through the scheduler
+# ----------------------------------------------------------------------
+
+def test_toa_shard_route(toas_a):
+    """A batchable singleton at/above toa_shard_min plans as a
+    "sharded" (TOA-axis) program over the whole pool, writes fitted
+    values back, and matches the dense fused fit."""
+    from pint_tpu.fitting import device_loop
+
+    hyper = dict(maxiter=10, min_chi2_decrease=1e-7)
+    s = ThroughputScheduler(max_queue=8, toa_shard_min=64)
+    h = s.submit(_request(PAR, toas_a, tag="big", **hyper))
+    (p,) = s.plan()
+    assert (p.kind, p.devices, p.slot) == ("sharded", 8, 0)
+    (r,) = s.drain()
+    assert h.done() and r.status == "ok" and not r.passthrough
+    mesh = s.last_drain["mesh"]
+    assert mesh["toa_sharded"] == 1
+    assert all(b > 0 for b in mesh["per_device_bytes"])
+
+    m_ref = get_model(PAR)
+    m_ref["F0"].add_delta(2e-10)
+    _d, _i, chi2, conv, _c = device_loop.dense_wls_fit(toas_a, m_ref,
+                                                       **hyper)
+    assert r.chi2 == pytest.approx(float(chi2), rel=1e-9)
+    assert bool(r.converged) == bool(conv)
+    # write-back happened (uncertainties populated)
+    assert all(r.request.model[k].uncertainty is not None
+               for k in r.request.model.free_params)
+
+
+def test_toa_shard_route_diverged_flagged(toas_a):
+    """A NaN-poisoned big fit through the sharded route is flagged and
+    never writes NaN parameters back (PR-6 contract, new path)."""
+    import dataclasses
+
+    err = np.array(toas_a.error_us, dtype=np.float64)
+    err[0] = np.nan
+    toas_bad = dataclasses.replace(toas_a, error_us=err)
+    s = ThroughputScheduler(max_queue=4, toa_shard_min=64)
+    s.submit(_request(PAR, toas_bad, tag="bad", maxiter=6))
+    (r,) = s.drain()
+    # diverges on-device, retried standalone, then quarantined
+    assert r.status in ("diverged", "quarantined")
+    assert r.error
+    for k in r.request.model.free_params:
+        assert np.isfinite(r.request.model[k].value_f64), k
+
+
+# ----------------------------------------------------------------------
+# shard-local degradation ladder
+# ----------------------------------------------------------------------
+
+def test_degraded_devices_are_routed_around(toas_a):
+    """Placement avoids degraded devices when a clean block exists and
+    falls back to isolated passthroughs when none does — WITHOUT
+    tripping the global ladder."""
+    s = ThroughputScheduler(max_queue=16, degrade_after=2)
+    s._dev_streak = {0: 2, 1: 2, 2: 2, 3: 2}  # block 0-3 poisoned
+    assert s.degraded_devices() == {0, 1, 2, 3}
+    assert not s.degraded()  # global ladder untouched
+
+    for i in range(2):
+        s.submit(_request(PAR, toas_a, tag=i))
+    (p,) = s.plan()  # width-2 batch: must land on the clean half
+    assert p.kind == "batched" and p.slot >= 4
+
+    # full-width batch: every candidate block contains a poisoned
+    # device -> isolation (passthrough singletons), never a crash
+    s2 = ThroughputScheduler(max_queue=16, degrade_after=2)
+    s2._dev_streak = {0: 2}
+    for i in range(6):
+        s2.submit(_request(PAR, toas_a, tag=i))
+    plans = s2.plan()  # member bucket 8 -> width 8 -> contains device 0
+    assert [p.kind for p in plans] == ["passthrough"] * 6
+    assert not s2.degraded()
+
+
+def _prep_fault_seed(n_batches: int = 2, drains: int = 2) -> int:
+    """A FaultPlan seed whose prep_exc=0.5 draw hits batch 0 and
+    misses batch 1 in each of the first ``drains`` drains (pre-scanned
+    substream draws, the SOAK_r07B technique)."""
+    for seed in range(500):
+        p = faults.FaultPlan(seed=seed, prep_exc=0.5)
+        hits = [p._draw("prep", (d, b)) < 0.5
+                for d in range(1, drains + 1) for b in range(n_batches)]
+        if all(hits[i * n_batches] for i in range(drains)) and \
+                not any(hits[i * n_batches + 1] for i in range(drains)):
+            return seed
+    raise AssertionError("no suitable fault seed in 500")
+
+
+def test_mixed_drain_degrades_shard_not_service(toas_a):
+    """One failing shard in an otherwise-clean drain: its devices'
+    streaks trip (and placement then avoids them) while the GLOBAL
+    ladder stays untripped — the service keeps batching."""
+    seed = _prep_fault_seed()
+    faults.configure(faults.FaultPlan(seed=seed, prep_exc=0.5))
+    try:
+        s = ThroughputScheduler(max_queue=16, retry_backoff_s=0.0,
+                                degrade_after=2)
+        for _ in range(2):
+            for i in range(2):
+                s.submit(_request(PAR, toas_a, tag=f"a{i}"))
+            for i in range(2):
+                s.submit(_request(PAR_FD, toas_a, tag=f"b{i}"))
+            res = s.drain()
+            # the failed batch's members were salvaged standalone
+            assert all(r.status in ("ok", "nonconverged") for r in res)
+            assert s.last_drain["failed_batches"] == 1
+            assert not s.degraded()  # mixed drain: global ladder holds
+    finally:
+        faults.configure(None)
+    # batch A ran on slot 0 (width 2) and failed twice -> its devices
+    # tripped; the healthy batch B's devices stayed clean
+    assert s.degraded_devices() == {0, 1}
+    streaks = s.last_drain["mesh"]["shard_fail_streaks"]
+    assert streaks == {"0": 2, "1": 2}
+
+    # next plan routes every batch off the degraded block
+    for i in range(2):
+        s.submit(_request(PAR, toas_a, tag=i))
+    (p,) = s.plan()
+    assert p.kind == "batched" and p.slot >= 2
+
+    # a clean drain heals the shard streaks too
+    res = s.drain()
+    assert all(r.status == "ok" for r in res)
+    assert s.degraded_devices() == set()
+
+
+# ----------------------------------------------------------------------
+# report CLI: mesh section
+# ----------------------------------------------------------------------
+
+def test_report_mesh_section(toas_a, capsys):
+    """The drain record's mesh block rolls up into the report's mesh
+    section, including the >2x occupancy-skew warning."""
+    from pint_tpu.telemetry import report
+
+    s = ThroughputScheduler(max_queue=8)
+    for i in range(6):
+        s.submit(_request(PAR, toas_a, tag=i, maxiter=6))
+    s.drain()
+    summary = report.mesh_summary([dict(s.last_drain)])
+    assert summary["devices"] == 8 and summary["drains"] == 1
+    assert summary["member_sharded"] == 1
+    assert sum(summary["per_device_members"]) == 6
+    # slots come from the record's own vector: the 2 all-dummy devices
+    # still show their member-slot burden (8 slots total, 6 real)
+    assert summary["per_device_slots"] == [1] * 8
+    assert summary["skew_warning"] is False  # 6/8: 1.0 everywhere used
+
+    # synthetic lopsided drain: occupancy skew 4x trips the warning
+    skewed = {"type": "serve", "mesh": {
+        "devices": 2, "per_device_members": [4, 1],
+        "per_device_occupancy": [1.0, 0.25],
+        "per_device_bytes": [100, 100],
+        "member_sharded": 1, "toa_sharded": 0}}
+    lop = report.mesh_summary([skewed])
+    assert lop["skew_warning"] is True and lop["occupancy_skew"] == 4.0
+    text = report.render({
+        "sources": [], "spans": [], "traces": [], "programs": [],
+        "serve": [], "mesh": lop,
+        "faults": {"events": 0, "by_status": {}, "quarantined": 0,
+                   "recent": [], "counters": {}},
+        "caches": {}, "pollution": {"samples": 0, "polluted_samples": 0,
+                                    "windows": []}})
+    assert "WARNING: occupancy skew" in text

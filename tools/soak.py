@@ -527,10 +527,18 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
         # its own standalone tight fit. APPENDED gate, own substream.
         if gates.random() < 0.15:
             axes["gates"].append("serve")
+            import jax
+
             from pint_tpu.serve import FitRequest, ThroughputScheduler
 
             srng = np.random.default_rng((seed, 8))
             k_req = int(srng.integers(3, 6))
+            # mesh-device axis (ISSUE 7): randomize how much of the
+            # virtual pool the scheduler places across, so batch
+            # formation + shard planning fuzz every width
+            mesh_choices = [d for d in (1, 2, 4, 8)
+                            if d <= len(jax.devices())]
+            serve_mdev = int(srng.choice(mesh_choices))
             # structure variant: drop the F1 line for half the requests
             # (when present and not anchoring an F2) so the mix spans
             # two fingerprints
@@ -552,7 +560,8 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
                         m_j[name].add_delta(d)
                 return m_j
 
-            sched = ThroughputScheduler(max_queue=k_req)
+            sched = ThroughputScheduler(max_queue=k_req,
+                                        mesh_devices=serve_mdev)
             for j, (par_j, t_j) in enumerate(specs):
                 sched.submit(FitRequest(t_j, _perturbed_model(par_j),
                                         maxiter=30,
@@ -563,6 +572,7 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
                 "batches": sched.last_drain["batches"],
                 "occupancy": sched.last_drain["occupancy"],
                 "passthrough": sum(r.passthrough for r in serve_res),
+                "mesh_devices": serve_mdev,
             }
             for r in serve_res:
                 par_j, t_j = specs[r.tag]
@@ -596,12 +606,21 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
         # substream; ``--chaos`` forces it on every trial.
         if gates.random() < 0.15 or force_chaos:
             axes["gates"].append("faults")
+            import jax
+
             from pint_tpu.serve import (FitRequest, STATUSES,
                                         ServeQueueFull,
                                         ThroughputScheduler, faults)
 
             crng = np.random.default_rng((seed, 9))
             k_req = int(crng.integers(4, 7))
+            # axes.mesh_devices (ISSUE 7): chaos trials randomize the
+            # device count so fault isolation, shard-local streaks and
+            # salvage run at every placement width
+            mesh_choices = [d for d in (1, 2, 4, 8)
+                            if d <= len(jax.devices())]
+            chaos_mdev = int(crng.choice(mesh_choices))
+            axes["mesh_devices"] = chaos_mdev
             par_v = "\n".join(ln for ln in par.splitlines()
                               if not ln.startswith("F1 ")) + "\n"
             have_variant = par_v != par and "F2 " not in par
@@ -629,7 +648,8 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
             # context, never crash or silently drop
             sched = ThroughputScheduler(max_queue=max(2, k_req - 1),
                                         retry_backoff_s=0.0,
-                                        member_floor=2)
+                                        member_floor=2,
+                                        mesh_devices=chaos_mdev)
             faults.configure(plan)
             try:
                 flooded = 0
@@ -672,6 +692,7 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
                 "requests": k_req, "flood_rejected": flooded,
                 "statuses": statuses, "injected": injected,
                 "failed_batches": sched.last_drain["failed_batches"],
+                "mesh_devices": chaos_mdev,
             }
 
         # checkpoint contract: par round-trip preserves the phase model
