@@ -973,6 +973,223 @@ def bench_throughput(n_fits: int, reps: int = 3) -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def _mixed_problems(n_fits: int) -> list:
+    """The ISSUE-8 mixed-frontier workload: ``(family, par, toas)`` per
+    fit — n_fits/4 each of WLS, GLS+ECORR, GLS+red-noise and wideband,
+    with per-request free values AND per-request noise values (noise
+    values are fingerprint-invariant, so each family still forms one
+    batch). ECORR requests carry duplicated arrival pairs so epochs
+    actually quantize; TOA counts spread inside one 64-row bucket."""
+    import dataclasses
+
+    from pint_tpu.models import get_model
+    from pint_tpu.toas import Flags, merge_TOAs
+
+    base_par = _strip_par_lines(PAR, ("EFAC", "ECORR", "TNREDAMP",
+                                      "TNREDGAM", "TNREDC"))
+    rng = np.random.default_rng(12)
+    problems = []
+    for i in range(n_fits):
+        fam = ("wls", "gls_ecorr", "gls_red", "wb")[i % 4]
+        par_i = base_par.replace(
+            "61.485476554", f"{61.485476554 + 0.05 * (i // 4):.9f}")
+        if fam == "gls_ecorr":
+            # EFAC fixed (a trace constant pins the fingerprint); the
+            # ECORR weight is traced and varies per request — i // 4
+            # (like the F0 perturbation above), since i % 4 is constant
+            # within a family
+            par_i += ("EFAC -f fake 1.2\n"
+                      f"ECORR -f fake 1.{1 + (i // 4) % 4}\n")
+        elif fam == "gls_red":
+            par_i += (f"TNREDAMP -13.{5 + (i // 4) % 4}\nTNREDGAM 3.5\n"
+                      "TNREDC 6\n")
+        truth = get_model(par_i)
+        if fam == "gls_ecorr":
+            # 25-31 pairs -> 50-62 rows (bucket 64), 25-31 epochs
+            # (basis bucket 32)
+            n = int(rng.integers(25, 32))
+            k = np.arange(n) % 3
+            freqs = np.where(k == 0, 430.0,
+                             np.where(k == 1, 1400.0, 800.0))
+            toas = merge_TOAs([_sim_flagged(truth, n, freqs,
+                                            int(rng.integers(2 ** 31)))] * 2)
+            toas = dataclasses.replace(
+                toas, flags=Flags(dict(d, f="fake") for d in toas.flags))
+        else:
+            n = int(rng.integers(50, 62))
+            k = np.arange(n) % 3
+            freqs = np.where(k == 0, 430.0,
+                             np.where(k == 1, 1400.0, 800.0))
+            toas = _sim_flagged(truth, n, freqs,
+                                int(rng.integers(2 ** 31)))
+            if fam == "wb":
+                dm_true = np.asarray(truth.total_dm(toas))
+                toas = dataclasses.replace(
+                    toas, flags=Flags(
+                        dict(d, pp_dm=str(float(v)), pp_dme="1e-4")
+                        for d, v in zip(toas.flags, dm_true)))
+        problems.append((fam, par_i, toas))
+    return problems
+
+
+def _bench_fit_throughput_mixed(n_fits: int = 64, reps: int = 3) -> dict:
+    """Scheduled-vs-sequential A/B over the MIXED frontier (ISSUE 8).
+
+    The acceptance measurement: n_fits requests mixing WLS, GLS+ECORR,
+    GLS+red-noise and wideband structures through the throughput
+    scheduler — where PR 5-7 routed every noise/wideband request to a
+    per-request passthrough, they now batch — against the SAME fits run
+    one-after-another through the standalone fused loops
+    (``dense_wls_fit`` / ``dense_gls_fit`` / ``dense_wideband_fit``,
+    the per-family oracles). Reports the speedup, the passthrough rate
+    (acceptance: < 10%; with the full frontier batchable it is 0), the
+    per-batch launch/fetch counters, and per-member parity vs the
+    oracles (chi2 rel 1e-6, params within 1e-9 rel or 5% sigma).
+    """
+    from pint_tpu import telemetry
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, ThroughputScheduler
+
+    problems = _mixed_problems(n_fits)
+    hyper = dict(maxiter=20, min_chi2_decrease=1e-3)
+    oracle_of = {"wls": device_loop.dense_wls_fit,
+                 "gls_ecorr": device_loop.dense_gls_fit,
+                 "gls_red": device_loop.dense_gls_fit,
+                 "wb": device_loop.dense_wideband_fit}
+
+    def fresh_models():
+        out = []
+        for fam, par_i, toas in problems:
+            m = get_model(par_i)
+            m["F0"].add_delta(2e-10)
+            out.append((fam, toas, m))
+        return out
+
+    def run_sequential(ms):
+        res = []
+        for fam, toas, m in ms:
+            d, _info, chi2, conv, _cnt = oracle_of[fam](toas, m, **hyper)
+            res.append((chi2, conv,
+                        {k: m[k].value_f64 + float(d[k])
+                         for k in m.free_params}))
+        return res
+
+    sched_state = {}
+
+    def run_scheduled():
+        ms = fresh_models()
+        s = ThroughputScheduler(max_queue=max(n_fits, 1))
+        t0 = time.perf_counter()
+        for i, (_fam, toas, m) in enumerate(ms):
+            s.submit(FitRequest(toas, m, tag=i, **hyper))
+        res = s.drain()
+        sched_state.update(res=res, models=ms, last=s.last_drain)
+        return time.perf_counter() - t0
+
+    seq_models = fresh_models()
+    t0 = time.perf_counter()
+    seq_res = run_sequential(seq_models)
+    seq_cold = time.perf_counter() - t0
+    sched_cold = run_scheduled()
+
+    seq_walls, sched_walls = [], []
+    cache_delta = {}
+    for _ in range(reps):
+        before = telemetry.counters_snapshot()
+        sched_walls.append(run_scheduled())
+        cache_delta = telemetry.counters_delta(before)
+        t0 = time.perf_counter()
+        seq_res = run_sequential(seq_models)
+        seq_walls.append(time.perf_counter() - t0)
+
+    seq_best = float(np.min(seq_walls))
+    sched_best = float(np.min(sched_walls))
+    last = sched_state["last"]
+
+    # parity: every member vs its family's standalone fused oracle
+    n_bad, max_rel = 0, 0.0
+    by_family: dict = {}
+    for i, r in enumerate(sched_state["res"]):
+        fam = problems[i][0]
+        chi2_seq, conv_seq, vals = seq_res[i]
+        m = sched_state["models"][i][2]
+        rel = abs(r.chi2 - float(chi2_seq)) / max(abs(float(chi2_seq)),
+                                                  1e-12)
+        max_rel = max(max_rel, rel)
+        p_ok = all(
+            abs(m[k].value_f64 - vals[k])
+            <= max(1e-9 * abs(vals[k]), 0.05 * (m[k].uncertainty or 0.0))
+            for k in m.free_params)
+        bad = rel > 1e-6 or bool(r.converged) != bool(conv_seq) or not p_ok
+        n_bad += bad
+        f = by_family.setdefault(fam, {"fits": 0, "passthrough": 0,
+                                       "parity_failures": 0,
+                                       "max_chi2_rel": 0.0})
+        f["fits"] += 1
+        f["passthrough"] += bool(r.passthrough)
+        f["parity_failures"] += bad
+        f["max_chi2_rel"] = float(f"{max(f['max_chi2_rel'], rel):.3g}")
+
+    hits = int(cache_delta.get("cache.fit_program.hit", 0))
+    misses = int(cache_delta.get("cache.fit_program.miss", 0))
+    loop_compile_s = max(sched_cold - sched_best, 0.0)
+    return {
+        "n_fits": n_fits,
+        "families": sorted(by_family),
+        "hyper": dict(hyper),
+        "sequential_wall": round(seq_best, 4),
+        "scheduled_wall": round(sched_best, 4),
+        "speedup": round(seq_best / max(sched_best, 1e-12), 2),
+        "fits_per_s": round(n_fits / max(sched_best, 1e-12), 2),
+        "passthrough_rate": last["passthrough"]["rate"],
+        "passthrough_reasons": last["passthrough"]["reasons"],
+        "parity_ok": n_bad == 0,
+        "parity_failures": n_bad,
+        "parity_max_chi2_rel": float(f"{max_rel:.3g}"),
+        "by_family": by_family,
+        "batches": last["batches"],
+        "occupancy": last["occupancy"],
+        "overlap_efficiency": last["overlap_efficiency"],
+        # one launch + one fetch per BATCH (counter-pinned on the last
+        # timed drain; passthroughs, if any, launch none)
+        "launches_timed_drain": int(cache_delta.get(
+            "fit.device_loop.launches", 0)),
+        "fetches_timed_drain": int(cache_delta.get(
+            "fit.device_loop.fetches", 0)),
+        "program_cache_hit": hits,
+        "program_cache_miss": misses,
+        "program_cache_hit_rate": round(hits / max(1, hits + misses), 4),
+        "loop_compile_s": round(loop_compile_s, 3),
+        "sequential_cold_s": round(seq_cold, 3),
+        "sequential_walls": [round(t, 4) for t in seq_walls],
+        "scheduled_walls": [round(t, 4) for t in sched_walls],
+        "batch_detail": last["batch_detail"],
+    }
+
+
+def bench_throughput_mixed(n_fits: int, reps: int = 3) -> None:
+    """Standalone mixed-frontier mode (PINT_TPU_BENCH_MODE=
+    throughput_mixed); ``vs_baseline`` is the scheduled-over-sequential
+    speedup, as in the throughput mode."""
+    from pint_tpu import telemetry
+
+    metric = f"fit_throughput_mixed_{n_fits}fits_wall"
+    try:
+        with telemetry.span("bench.fit_throughput_mixed"):
+            rec = _bench_fit_throughput_mixed(n_fits=n_fits, reps=reps)
+        out = {"metric": metric, "value": rec["scheduled_wall"],
+               "unit": "s", "vs_baseline": rec["speedup"],
+               "backend": jax.default_backend(),
+               "host_cores": os.cpu_count(), "mode": "throughput_mixed",
+               "fit_throughput_mixed": rec}
+        out.update(_telemetry_fields())
+        _emit(out)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
 def _bench_fit_throughput_mesh(n_fits: int = 64, reps: int = 3) -> dict:
     """Mesh-sharded vs single-device scheduled A/B (ISSUE 7).
 
@@ -1320,6 +1537,12 @@ def _compact(record: dict, detail_name: str) -> dict:
     if isinstance(ft, dict):
         out["fit_throughput"] = {k: ft[k] for k in _THROUGHPUT_COMPACT
                                  if k in ft}
+    ftm = record.get("fit_throughput_mixed")
+    if isinstance(ftm, dict):
+        out["fit_throughput_mixed"] = {
+            k: ftm[k] for k in _THROUGHPUT_COMPACT
+            + ("passthrough_rate", "launches_timed_drain",
+               "fetches_timed_drain") if k in ftm}
     pta = record.get("pta")
     if isinstance(pta, dict):
         out["pta"] = {k: pta[k] for k in _COMPACT_KEYS if k in pta}
@@ -1336,7 +1559,8 @@ def _compact(record: dict, detail_name: str) -> dict:
     for key in ("error", "fallback_reason"):
         if not fits() and isinstance(out.get(key), str):
             out[key] = out[key][:200]
-    for key in ("pta", "fit_throughput", "fit_loop", "mfu_pct",
+    for key in ("pta", "fit_throughput", "fit_throughput_mixed",
+                "fit_loop", "mfu_pct",
                 "gflops_s", "design_matrix_ms_per_toa", "mode", "device",
                 "load1_start", "wall_median", "wall_spread_pct",
                 "fallback_reason"):
@@ -1362,7 +1586,7 @@ def _finish(record: dict) -> None:
     detail_path = os.environ.get(
         "PINT_TPU_BENCH_DETAIL",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_DETAIL_r11.json"))
+                     "BENCH_DETAIL_r12.json"))
     try:
         with open(detail_path, "w") as fh:
             json.dump(record, fh, indent=1)
@@ -1449,6 +1673,10 @@ def main() -> None:
         # parity ("skipped" only on a caller-pinned 1-device pool)
         mesh = res.get("mesh") or {}
         ok = ok and (mesh.get("ok") is True or bool(mesh.get("skipped")))
+        # mixed-frontier smoke acceptance (ISSUE 8): a GLS+ECORR batch
+        # of >= 2 members formed (passthrough rate 0) with parity
+        frontier = res.get("frontier") or {}
+        ok = ok and frontier.get("ok") is True
         if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
@@ -1655,6 +1883,76 @@ def _smoke_mesh() -> dict:
             "parity_max_chi2_rel": float(f"{max_rel:.3g}")}
 
 
+def _smoke_frontier() -> dict:
+    """CI mixed-frontier smoke (ISSUE 8): one GLS+ECORR batch of >= 2
+    members — different noise VALUES, so value-invariant grouping is
+    exercised — asserting the batch formed (passthrough rate 0), one
+    launch + one fetch, and per-member parity vs the standalone fused
+    GLS oracle at the 1e-9 chi2-rel class."""
+    import dataclasses as _dc
+
+    from pint_tpu import telemetry
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, ThroughputScheduler
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.toas import Flags, merge_TOAs
+
+    par = ("PSRJ FAKE_FRONTIER\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    hyper = dict(maxiter=8, min_chi2_decrease=1e-5)
+    reqs, standalone = [], []
+    for i in range(2):
+        # EFAC is a genuine trace constant (scale_sigma reads it at
+        # trace time) so it must match across the batch; the ECORR
+        # VALUE rides the traced statics and may differ per member
+        par_i = (par + "EFAC -f fake 1.2\n"
+                       f"ECORR -f fake 1.{1 + i}\n").replace(
+            "61.485476554", f"{61.485476554 + 1e-3 * i:.9f}")
+        truth = get_model(par_i)
+        t = make_fake_toas_uniform(53000, 56000, 12, truth, obs="@",
+                                   freq_mhz=np.array([1400.0, 430.0]),
+                                   error_us=2.0, add_noise=True,
+                                   seed=110 + i)
+        t = merge_TOAs([t, t])  # pairs -> ECORR epochs actually form
+        t = _dc.replace(t, flags=Flags(dict(d, f="fake")
+                                       for d in t.flags))
+        m = get_model(par_i)
+        m["F0"].add_delta(2e-10)
+        reqs.append(FitRequest(t, m, tag=i, **hyper))
+        m2 = get_model(par_i)
+        m2["F0"].add_delta(2e-10)
+        standalone.append((t, m2))
+    s = ThroughputScheduler(max_queue=4)
+    for r in reqs:
+        s.submit(r)
+    before = telemetry.counters_snapshot()
+    res = s.drain()
+    delta = telemetry.counters_delta(before)
+    pt = s.last_drain["passthrough"]
+    bad, max_rel = 0, 0.0
+    for r, (t, m2) in zip(res, standalone):
+        _d, _i, chi2, conv, _c = device_loop.dense_gls_fit(t, m2, **hyper)
+        rel = abs(r.chi2 - chi2) / max(abs(chi2), 1e-12)
+        max_rel = max(max_rel, rel)
+        if rel > 1e-9 or bool(r.converged) != bool(conv) or r.passthrough:
+            bad += 1
+    batch = s.last_drain["batch_detail"][0]
+    ok = (bad == 0 and pt["rate"] == 0.0
+          and batch["kind"] == "batched" and batch["real"] >= 2
+          and batch.get("basis_bucket", 0) > 0
+          and int(delta.get("fit.device_loop.launches", 0)) == 1
+          and int(delta.get("fit.device_loop.fetches", 0)) == 1)
+    return {"ok": ok, "members": batch["real"],
+            "basis_bucket": batch.get("basis_bucket", 0),
+            "passthrough_rate": pt["rate"], "parity_ok": bad == 0,
+            "parity_max_chi2_rel": float(f"{max_rel:.3g}"),
+            "launches": int(delta.get("fit.device_loop.launches", 0)),
+            "fetches": int(delta.get("fit.device_loop.fetches", 0))}
+
+
 def _smoke_chaos() -> dict:
     """CI chaos smoke (ISSUE 6): injected faults through the scheduler.
 
@@ -1774,13 +2072,17 @@ def _run_smoke() -> None:
         # mesh smoke (ISSUE 7): a member-sharded drain every CI pass
         with telemetry.span("bench.mesh_smoke"):
             mesh = _smoke_mesh()
+        # mixed-frontier smoke (ISSUE 8): a GLS+ECORR batch every pass
+        with telemetry.span("bench.frontier_smoke"):
+            frontier = _smoke_frontier()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
                "backend": jax.default_backend(),
                "chi2": round(float(chi2), 3),
                "converged": bool(f.converged),
-               "serve": serve, "chaos": chaos, "mesh": mesh}
+               "serve": serve, "chaos": chaos, "mesh": mesh,
+               "frontier": frontier}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001
@@ -1799,7 +2101,7 @@ def _main_guarded() -> None:
     reps = max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "5")))
     mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
     if mode in ("pta", "wideband", "batch", "throughput",
-                "throughput_mesh"):
+                "throughput_mesh", "throughput_mixed"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
@@ -1818,6 +2120,10 @@ def _main_guarded() -> None:
         elif mode == "throughput_mesh":
             bench_throughput_mesh(
                 int(os.environ.get("PINT_TPU_BENCH_FITS", "64")), reps)
+        elif mode == "throughput_mixed":
+            bench_throughput_mixed(
+                int(os.environ.get("PINT_TPU_BENCH_FITS", "64")),
+                max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "3"))))
         else:
             bench_batch(n_psr, max(1, n // n_psr), reps)
         return

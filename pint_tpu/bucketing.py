@@ -112,6 +112,60 @@ def member_bucket_size(b: int, *, floor: int = 1) -> int:
     return max(floor, 1 << (b - 1).bit_length())
 
 
+def basis_bucket_size(ne: int, *, floor: int = 8) -> int:
+    """Canonical ECORR epoch-column count for a noise basis of ``ne``
+    epochs (the batchable-frontier analogue of :func:`bucket_size`).
+
+    The Fourier blocks of the in-jit GLS basis are shape-static (nharm
+    comes from the model structure), so only the data-dependent ECORR
+    epoch count forces a shape split. Bucketing it to the next power of
+    two (floored at ``floor``; 0 stays 0 — no ECORR at all is its own
+    shape) lets batches over similar-but-unequal epoch counts execute
+    one compiled union program: the padded epoch columns carry zero TOA
+    support and a unit prior, which is EXACTLY inert in the segment-sum
+    Schur solve (see :func:`pad_basis_cols`). Disabled
+    (``PINT_TPU_FIT_BUCKETING=0``) it returns the exact count.
+    """
+    if ne < 0:
+        raise ValueError(f"basis_bucket_size needs ne >= 0, got {ne}")
+    if ne == 0 or not enabled():
+        return ne
+    return max(floor, 1 << (ne - 1).bit_length())
+
+
+def pad_basis_cols(ne_target: int, phi, *mats):
+    """Column-pad a noise-basis prior (and optional basis matrices) to
+    ``ne_target`` with EXACTLY inert entries.
+
+    The column-axis analogue of :func:`pad_solve_rows`: appended prior
+    entries are 1.0 [s^2] and appended basis columns are all-zero. A
+    zero basis column with finite prior is exactly inert in the
+    extended-normal-equation / Schur solve — its Gram row and gradient
+    entry are exact zeros, its segment (ECORR epoch) has no TOA support
+    so ``d = 0 + 1/phi`` and its eliminated coefficient is 0/d = 0 — so
+    the timing solution, chi2 and uncertainties of the padded system
+    are bit-comparable to the exact-shape solve while one compiled
+    program serves every epoch count in the bucket
+    (tests/test_bucketing.py pins this through ``gls_gram_seg``).
+    """
+    ne = int(np.shape(phi)[0])
+    if ne_target == ne:
+        return (phi,) + mats
+    if ne_target < ne:
+        raise ValueError(f"ne_target {ne_target} < ne {ne}")
+    k = ne_target - ne
+    out = [np.concatenate([np.asarray(phi, dtype=np.float64),
+                           np.ones(k)])]
+    for M in mats:
+        if M is None:
+            out.append(None)
+            continue
+        M = np.asarray(M)
+        out.append(np.concatenate([M, np.zeros(M.shape[:1] + (k,)
+                                               + M.shape[2:])], axis=1))
+    return tuple(out)
+
+
 def note_batch_occupancy(n_real: int, n_members: int) -> None:
     """Account one batched-fit launch's member occupancy.
 

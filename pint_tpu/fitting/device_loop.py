@@ -961,6 +961,40 @@ def dense_wls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
         shape=bucketing.toa_shape(toas_b))
 
 
+def dense_wideband_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
+                       max_step_halvings=8):
+    """Fused dense wideband fit: joint TOA+DM loop, one program/fetch.
+
+    The standalone oracle for wideband batch members (ISSUE 8): the
+    same fused-wideband step a union batch runs, at B=1 without vmap —
+    with or without correlated-noise bases. Returns ``(deltas, info,
+    chi2, converged, counters)``.
+    """
+    from pint_tpu import bucketing
+    from pint_tpu.fitting.gls_step import (build_noise_statics,
+                                           pad_noise_statics)
+    from pint_tpu.fitting.wideband import (build_wb_data, jitted_wb_probe,
+                                           jitted_wb_step)
+
+    noise, pl_specs = build_noise_statics(model, toas)
+    n_target = bucketing.bucket_size(len(toas))
+    noise = pad_noise_statics(noise, n_target)
+    dm = build_wb_data(toas, n_target)
+    toas_b = bucketing.bucket_toas(toas)
+    step = jitted_wb_step(model, pl_specs=pl_specs, counted=False)
+    probe = jitted_wb_probe(model, pl_specs=pl_specs)
+    telemetry.set_gauge("fit.ntoas", len(toas))
+    return run_damped(
+        lambda d, ops: step(ops[0], d, *ops[1:]),
+        model.zero_deltas(), (model.base_dd(), toas_b, noise, dm),
+        probe=lambda d, ops: probe(ops[0], d, *ops[1:]),
+        key=("dense_wb", id(step), id(probe)),
+        maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings, kind="device_loop_wb",
+        fingerprint=(hash(model._fn_fingerprint()), tuple(pl_specs)),
+        shape=bucketing.toa_shape(toas_b))
+
+
 def dense_gls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
                   max_step_halvings=8):
     """Fused dense GLS fit (device-side noise bases): one program/fetch."""

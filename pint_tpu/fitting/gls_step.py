@@ -73,12 +73,17 @@ class NoiseStatics(NamedTuple):
     pl_params: Array  # (n_pl, 2) [log10_amp, gamma] per PLSpec entry
 
 
-def build_noise_statics(model, toas) -> tuple[NoiseStatics, tuple[PLSpec, ...]]:
+def build_noise_statics(model, toas, *, as_numpy: bool = False
+                        ) -> tuple[NoiseStatics, tuple[PLSpec, ...]]:
     """Host-side scan of the model's noise components.
 
     Returns the (device-array) ECORR epoch assignment + power-law
     hyperparameters, plus the static specs the jitted step closes over.
-    O(n) host work — no (n, k) basis is formed.
+    O(n) host work — no (n, k) basis is formed. ``as_numpy=True`` keeps
+    the leaves numpy (the batch-prep path stacks per-member statics on
+    the host and device-places the stack ONCE; materializing jnp arrays
+    here would transfer every member's epoch vector twice — the
+    ``stack_toas`` lesson).
     """
     n = len(toas)
     epoch_idx = None
@@ -102,20 +107,71 @@ def build_noise_statics(model, toas) -> tuple[NoiseStatics, tuple[PLSpec, ...]]:
 
     telemetry.set_gauge("noise.ecorr_epochs", len(phi_e))
     telemetry.set_gauge("noise.pl_components", len(specs))
+    if as_numpy:
+        return (NoiseStatics(
+            np.asarray(epoch_idx, dtype=np.int32),
+            np.asarray(phi_e, dtype=np.float64),
+            np.asarray(pl_params,
+                       dtype=np.float64).reshape(len(specs), 2)),
+            tuple(specs))
     return (NoiseStatics(jnp.asarray(epoch_idx), jnp.asarray(phi_e),
                          jnp.asarray(pl_params).reshape(len(specs), 2)),
             tuple(specs))
 
 
-def pad_noise_statics(noise: NoiseStatics, n_target: int) -> NoiseStatics:
-    """Extend epoch_idx to `n_target` rows pointing at the dummy segment."""
+def pad_noise_statics(noise: NoiseStatics, n_target: int,
+                      ne_target: int | None = None) -> NoiseStatics:
+    """Extend epoch_idx to `n_target` rows pointing at the dummy segment.
+
+    ``ne_target`` (the batchable-frontier basis bucket,
+    :func:`pint_tpu.bucketing.basis_bucket_size`) additionally pads the
+    ECORR epoch axis: the dummy segment index moves from ``ne`` to
+    ``ne_target`` and the appended prior entries are 1.0 s^2 with zero
+    TOA support — exactly inert in the segment-sum Schur solve (see
+    :func:`pint_tpu.bucketing.pad_basis_cols`), so batches over
+    different epoch counts share one compiled program.
+    """
+    # array-namespace-agnostic: numpy statics (the batch-prep path —
+    # one device transfer at shard time) pad in numpy, device statics
+    # pad on-device
+    xp = np if isinstance(noise.epoch_idx, np.ndarray) else jnp
     n = int(np.shape(noise.epoch_idx)[0])
-    if n_target == n:
-        return noise
     ne = int(np.shape(noise.ecorr_phi)[0])
-    pad = jnp.full(n_target - n, ne, dtype=jnp.int32)
-    return NoiseStatics(jnp.concatenate([noise.epoch_idx, pad]),
-                        noise.ecorr_phi, noise.pl_params)
+    epoch_idx, phi = noise.epoch_idx, noise.ecorr_phi
+    if ne_target is not None and ne_target != ne:
+        from pint_tpu.bucketing import pad_basis_cols
+
+        # remap the dummy segment (== ne) to the padded dummy slot;
+        # real epochs 0..ne-1 are unchanged
+        epoch_idx = xp.where(xp.asarray(epoch_idx) == ne,
+                             xp.int32(ne_target),
+                             xp.asarray(epoch_idx, xp.int32))
+        (phi,) = pad_basis_cols(ne_target, phi)
+        phi = xp.asarray(phi)
+        ne = ne_target
+    if n_target != n:
+        pad = xp.full(n_target - n, ne, dtype=xp.int32)
+        epoch_idx = xp.concatenate([xp.asarray(epoch_idx, xp.int32),
+                                    pad])
+    if epoch_idx is noise.epoch_idx and phi is noise.ecorr_phi:
+        return noise
+    return NoiseStatics(epoch_idx, phi, noise.pl_params)
+
+
+def stack_noise_statics(statics: list[NoiseStatics], n_target: int,
+                        ne_target: int) -> NoiseStatics:
+    """Stack per-member statics along a leading batch axis.
+
+    Every member is padded to (``n_target`` rows, ``ne_target`` epoch
+    columns) first — the batched GLS/wideband steps vmap over the
+    result: epoch_idx (B, n), ecorr_phi (B, ne), pl_params (B, n_pl, 2).
+    Numpy leaves (the caller device-places them with the batch mesh).
+    """
+    padded = [pad_noise_statics(s, n_target, ne_target) for s in statics]
+    return NoiseStatics(
+        np.stack([np.asarray(s.epoch_idx) for s in padded]),
+        np.stack([np.asarray(s.ecorr_phi) for s in padded]),
+        np.stack([np.asarray(s.pl_params) for s in padded]))
 
 
 def fourier_design(t_s: Array, nharm: int, t_ref=None, tspan=None
@@ -388,8 +444,11 @@ def gls_solve_seg(M: Array, r: Array, sigma: Array,
 
 
 def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
-                  pl_specs: tuple[PLSpec, ...] = ()):
-    """Build ``step(base, deltas, toas, noise) -> (new_deltas, info)``.
+                  pl_specs: tuple[PLSpec, ...] = (),
+                  masked: bool = False, params: list[str] | None = None,
+                  traced_tzr: bool = False):
+    """Build ``step(base, deltas, toas, noise[, mask][, tzr]) ->
+    (new_deltas, info)``.
 
     The GLS analogue of ``pint_tpu.fitting.step.make_wls_step``: one call
     is a full Gauss-Newton GLS iteration — residuals, jacfwd design
@@ -398,21 +457,36 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
     table and noise statics. ``info`` carries the GLS chi2 at the
     solution (the linearized post-fit value, the reference GLSFitter's
     convention) and per-parameter uncertainties.
+
+    ``masked`` / ``params`` / ``traced_tzr`` mirror
+    :func:`pint_tpu.fitting.step.make_wls_step` exactly — they are what
+    lets the throughput scheduler's union batches carry GLS members
+    (ISSUE 8): ``mask`` zeroes design-matrix columns of parameters a
+    member does not fit (a zero column is exactly inert: its normalized
+    Gram row reduces to the diagonal jitter and its gradient entry is
+    0, so it solves to a zero delta), and ``traced_tzr`` anchors each
+    vmapped member at its own stacked one-row TZR table.
     """
-    if tzr is None and abs_phase:
+    from pint_tpu.fitting.step import _circular_recenter
+
+    if tzr is None and abs_phase and not traced_tzr:
         tzr = model.get_tzr_toas()
-    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase)
-    names = model.free_params
+    anchorless = tzr is None and not traced_tzr
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase,
+                                   traced_tzr=traced_tzr)
+    names = params if params is not None else model.free_params
     # explicit PHOFF replaces the implicit offset column + mean
     # subtraction (see TimingModel.designmatrix)
     has_phoff = model.has_component("PhaseOffset")
     off = 0 if has_phoff else 1
 
-    def step(base, deltas, toas, noise: NoiseStatics):
+    def step(base, deltas, toas, noise: NoiseStatics, mask=None,
+             tzr_toas=None):
         f0 = base["F0"].hi + base["F0"].lo
 
         def total_phase(d):
-            ph = phase_fn(base, d, toas)
+            ph = (phase_fn(base, d, toas, tzr_toas) if traced_tzr
+                  else phase_fn(base, d, toas))
             # one DD pipeline trace serves residual + jacobian via
             # has_aux (guarded primal keeps the residual bitwise — see
             # make_whiten_stage1); a separate residual evaluation
@@ -424,12 +498,18 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
         w = 1.0 / jnp.square(err)
 
         J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
+        if anchorless:
+            resid_turns = _circular_recenter(resid_turns, w)
         if not has_phoff:
             resid_turns = resid_turns - jnp.sum(resid_turns * w) / jnp.sum(w)
         r = resid_turns / f0
 
-        cols = ([] if has_phoff else [jnp.ones_like(r) / f0]) \
-            + [-J[k] / f0 for k in names]
+        cols = [] if has_phoff else [jnp.ones_like(r) / f0]
+        for k in names:
+            col = -J[k] / f0
+            if mask is not None:
+                col = col * mask[k]
+            cols.append(col)
         M = jnp.stack(cols, axis=1)
 
         F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
@@ -446,10 +526,26 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
                             "fourier_coeffs": sol["fourier_coeffs"],
                             "ecorr_coeffs": sol["ecorr_coeffs"]}
 
+    # fixed positional signatures per config (vmap in_axes need exact
+    # arity; mirrors make_wls_step's wrapper convention)
+    if not masked:
+        if traced_tzr:
+            def step_unmasked_tzr(base, deltas, toas, noise, tzr_toas):
+                return step(base, deltas, toas, noise, None, tzr_toas)
+
+            return step_unmasked_tzr
+
+        def step_unmasked(base, deltas, toas, noise):
+            return step(base, deltas, toas, noise)
+
+        return step_unmasked
     return step
 
 
 def jitted_gls_step(model, *, pl_specs: tuple[PLSpec, ...] = (),
+                    abs_phase: bool = True, masked: bool = False,
+                    params: list[str] | None = None,
+                    vmapped: bool = False, traced_tzr: bool = False,
                     counted: bool = True):
     """Jitted :func:`make_gls_step`, shared across fitter instances.
 
@@ -458,22 +554,37 @@ def jitted_gls_step(model, *, pl_specs: tuple[PLSpec, ...] = (),
     so every new sharded/hybrid fitter over the same model structure
     repays the full XLA compile. Routed through
     ``TimingModel._cached_jit`` instead — one program per (structure
-    fingerprint, pl_specs); values flow through the traced ``base``.
+    fingerprint, pl_specs, step config); values flow through the traced
+    ``base`` and the traced ``NoiseStatics``. ``vmapped`` builds the
+    batched (pulsar-axis) variant the union batches run — every
+    argument, the noise statics included, gains a leading (B,) axis.
     ``counted=False`` skips the execution-counter wrapper (device-loop
     callers trace the step into a larger program).
     """
     from pint_tpu.fitting.step import _counted_step
 
-    key = ("gls_step", pl_specs)
-    cached = model._cached_jit(
-        key, lambda owner: make_gls_step(owner, pl_specs=pl_specs))
+    key = ("gls_step", pl_specs, abs_phase, masked,
+           tuple(params) if params is not None else None, vmapped,
+           traced_tzr)
+
+    def build(owner):
+        fn = make_gls_step(owner, pl_specs=pl_specs, abs_phase=abs_phase,
+                           masked=masked, params=params,
+                           traced_tzr=traced_tzr)
+        if not vmapped:
+            return fn
+        n_args = 4 + (1 if masked else 0) + (1 if traced_tzr else 0)
+        return jax.vmap(fn, in_axes=(0,) * n_args)
+
+    cached = model._cached_jit(key, build)
     if not counted:
         return cached
     return _counted_step(cached, key, model)
 
 
 def make_gls_probe(model, tzr=None, *, abs_phase: bool = True,
-                   pl_specs: tuple[PLSpec, ...] = ()):
+                   pl_specs: tuple[PLSpec, ...] = (),
+                   traced_tzr: bool = False):
     """Build ``probe(base, deltas, toas, noise) -> chi2`` — the
     noise-marginal GLS chi2 at ``deltas`` WITHOUT a design matrix.
 
@@ -489,7 +600,18 @@ def make_gls_probe(model, tzr=None, *, abs_phase: bool = True,
     """
     from pint_tpu.fitting.step import make_resid_fn
 
-    resid = make_resid_fn(model, tzr, abs_phase=abs_phase)
+    resid = make_resid_fn(model, tzr, abs_phase=abs_phase,
+                          traced_tzr=traced_tzr)
+
+    if traced_tzr:
+        def probe_tzr(base, deltas, toas, noise, tzr_toas):
+            r, err, _w = resid(base, deltas, toas, tzr_toas)
+            F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
+            parts = gls_gram_seg(jnp.zeros((r.shape[0], 0)), r, err, F,
+                                 phi_F, noise.epoch_idx, noise.ecorr_phi)
+            return noise_marginal_chi2(parts, 0)
+
+        return probe_tzr
 
     def probe(base, deltas, toas, noise: NoiseStatics):
         r, err, _w = resid(base, deltas, toas)
@@ -501,9 +623,18 @@ def make_gls_probe(model, tzr=None, *, abs_phase: bool = True,
     return probe
 
 
-def jitted_gls_probe(model, *, pl_specs: tuple[PLSpec, ...] = ()):
+def jitted_gls_probe(model, *, pl_specs: tuple[PLSpec, ...] = (),
+                     abs_phase: bool = True, traced_tzr: bool = False,
+                     vmapped: bool = False):
     """Model-cache-shared :func:`make_gls_probe` (uncounted; traced into
     the fused device loop, never dispatched on its own)."""
-    key = ("gls_probe", pl_specs)
-    return model._cached_jit(
-        key, lambda owner: make_gls_probe(owner, pl_specs=pl_specs))
+    key = ("gls_probe", pl_specs, abs_phase, traced_tzr, vmapped)
+
+    def build(owner):
+        fn = make_gls_probe(owner, pl_specs=pl_specs,
+                            abs_phase=abs_phase, traced_tzr=traced_tzr)
+        if not vmapped:
+            return fn
+        return jax.vmap(fn, in_axes=(0,) * (4 + (1 if traced_tzr else 0)))
+
+    return model._cached_jit(key, build)

@@ -17,6 +17,7 @@ zero-padded over the DM block.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -24,7 +25,14 @@ from pint_tpu.fitting.fitter import Fitter, wls_solve
 from pint_tpu.fitting.gls import _DownhillMixin, gls_solve
 from pint_tpu.residuals import Residuals
 
-__all__ = ["WidebandTOAResiduals", "WidebandTOAFitter", "WidebandDownhillFitter"]
+__all__ = ["WidebandTOAResiduals", "WidebandTOAFitter",
+           "WidebandDownhillFitter", "build_wb_data", "make_wb_step",
+           "jitted_wb_step", "make_wb_probe", "jitted_wb_probe"]
+
+# padded wideband DM rows carry this uncertainty [pc/cm^3] -> weight
+# ~1e-32 of a real DM measurement (the DM-block analogue of
+# bucketing.PAD_ERROR_US)
+DM_PAD_ERROR = 1e12
 
 
 class WidebandTOAResiduals:
@@ -136,6 +144,266 @@ class WidebandTOAFitter(Fitter):
         base = super().get_summary(nodmx=nodmx)
         dm_rms = float(jnp.sqrt(jnp.mean(jnp.square(self.resids.dm_resids))))
         return base + f"\n  DM rms: {dm_rms:.3e} pc/cm3"
+
+
+# ----------------------------------------------------------------------
+# fused wideband step (ISSUE 8): the joint TOA+DM iteration as one pure
+# traced function — vmappable, so wideband fits are first-class members
+# of the throughput scheduler's union batches
+# ----------------------------------------------------------------------
+
+def build_wb_data(toas, n_target: int | None = None) -> dict:
+    """Materialize the wideband DM block as TRACED arrays.
+
+    The ``-pp_dm`` / ``-pp_dme`` measurements live on the static flag
+    dicts, which batch stacking strips (``parallel.batch._strip_static``)
+    — so the fused step takes them as a data operand ``{"vals": (n,),
+    "errs": (n,)}`` instead. ``n_target`` pads with inert rows: values
+    replicate the last measurement, uncertainties are ``DM_PAD_ERROR``
+    (zero weight), the exact policy of ``bucketing.pad_toas``.
+    """
+    vals = np.asarray(toas.get_dm_values(), dtype=np.float64)
+    errs = np.asarray(toas.get_dm_errors(), dtype=np.float64)
+    if not np.all(np.isfinite(vals)):
+        raise ValueError("wideband fit requires -pp_dm on every TOA")
+    if not np.all(np.isfinite(errs) & (errs > 0)):
+        bad = int(np.sum(~(np.isfinite(errs) & (errs > 0))))
+        raise ValueError(
+            f"{bad} TOA(s) have missing or non-positive -pp_dme DM "
+            f"uncertainties; the whitened wideband solve would be NaN")
+    if n_target is not None and n_target != len(vals):
+        if n_target < len(vals):
+            raise ValueError(f"n_target {n_target} < n {len(vals)}")
+        k = n_target - len(vals)
+        vals = np.concatenate([vals, np.repeat(vals[-1:], k)])
+        errs = np.concatenate([errs, np.full(k, DM_PAD_ERROR)])
+    return {"vals": vals, "errs": errs}
+
+
+def make_wb_step(model, tzr=None, *, abs_phase: bool = True,
+                 pl_specs=(), masked: bool = False,
+                 params: list[str] | None = None,
+                 traced_tzr: bool = False):
+    """Build ``step(base, deltas, toas, noise, dm[, mask][, tzr]) ->
+    (new_deltas, info)`` — one fused wideband Gauss-Newton iteration.
+
+    The stacked system of :class:`WidebandTOAFitter` as a single pure
+    function: TOA rows (phase residuals, jacfwd design matrix) on top
+    of DM rows (``dm_data - model DM``, d(DM)/d(param) columns), solved
+    through the segment-sum GLS machinery of
+    :mod:`pint_tpu.fitting.gls_step` — correlated-noise bases extend
+    the TOA block only (Fourier blocks zero-padded over the DM rows,
+    ECORR epoch indices pointing every DM row at the dummy segment),
+    exactly the dense fitter's convention. With no noise basis the
+    solve degenerates to the joint WLS. ``info["chi2_at_input"]`` is
+    the stacked r^T C^-1 r the damped loop judges trials by (=
+    ``WidebandDownhillFitter._fit_chi2``'s objective).
+
+    ``masked`` / ``params`` / ``traced_tzr`` mirror ``make_wls_step``
+    (the union-batch machinery); ``dm`` is :func:`build_wb_data`'s
+    traced block.
+    """
+    from pint_tpu.fitting.gls_step import (gls_finalize_seg, gls_gram_seg,
+                                           noise_marginal_chi2, pl_bases)
+    from pint_tpu.fitting.step import _circular_recenter
+
+    if tzr is None and abs_phase and not traced_tzr:
+        tzr = model.get_tzr_toas()
+    anchorless = tzr is None and not traced_tzr
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=abs_phase,
+                                   traced_tzr=traced_tzr)
+    names = params if params is not None else model.free_params
+    has_phoff = model.has_component("PhaseOffset")
+    off = 0 if has_phoff else 1
+    dm_comps = [c for c in model.components if hasattr(c, "dm_value")]
+    dm_scale_comps = [c for c in model.components
+                      if hasattr(c, "scale_dm_sigma")]
+
+    def step(base, deltas, toas, noise, dm, mask=None, tzr_toas=None):
+        f0 = base["F0"].hi + base["F0"].lo
+
+        def joint(d):
+            ph = (phase_fn(base, d, toas, tzr_toas) if traced_tzr
+                  else phase_fn(base, d, toas))
+            p = model.resolve(base, d)
+            dm_m = jnp.zeros(np.shape(toas.freq_mhz)[-1])
+            for c in dm_comps:
+                dm_m = dm_m + c.dm_value(p, toas)
+            # aux carries the wrapped fractional phase AND the DM primal
+            # from the SAME evaluation (one DD pipeline trace serves
+            # residual + jacobian; see make_wls_step)
+            return ((ph.int_part + (ph.frac.hi + ph.frac.lo), dm_m),
+                    (ph.frac.hi + ph.frac.lo, dm_m))
+
+        err_t = model.scaled_toa_uncertainty(toas)
+        w_t = 1.0 / jnp.square(err_t)
+
+        (J_ph, J_dm), (resid_turns, dm_m) = \
+            jax.jacfwd(joint, has_aux=True)(deltas)
+        if anchorless:
+            resid_turns = _circular_recenter(resid_turns, w_t)
+        if not has_phoff:
+            resid_turns = resid_turns \
+                - jnp.sum(resid_turns * w_t) / jnp.sum(w_t)
+        r_t = resid_turns / f0
+        r_dm = dm["vals"] - dm_m
+        err_dm = dm["errs"]
+        for c in dm_scale_comps:
+            err_dm = c.scale_dm_sigma(err_dm, toas)
+
+        # stacked design matrix: the Offset column moves no DM
+        # measurement (zeros over the DM rows), parameter columns are
+        # [-dphase/dp / f0 ; -d(resid_dm)/dp] = [-J_ph/f0 ; +J_dm]
+        zeros = jnp.zeros_like(r_t)
+        cols_t = [] if has_phoff else [jnp.ones_like(r_t) / f0]
+        cols_dm = [] if has_phoff else [zeros]
+        for k in names:
+            col_t = -J_ph[k] / f0
+            col_dm = J_dm[k]
+            if mask is not None:
+                col_t = col_t * mask[k]
+                col_dm = col_dm * mask[k]
+            cols_t.append(col_t)
+            cols_dm.append(col_dm)
+        M = jnp.concatenate([jnp.stack(cols_t, axis=1),
+                             jnp.stack(cols_dm, axis=1)], axis=0)
+        r = jnp.concatenate([r_t, r_dm])
+        err = jnp.concatenate([err_t, err_dm])
+
+        # noise bases cover the TOA rows only: Fourier blocks zero over
+        # the DM rows, every DM row in the ECORR dummy segment
+        F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
+        if F is not None:
+            F = jnp.concatenate([F, jnp.zeros_like(F)], axis=0)
+        ne = noise.ecorr_phi.shape[-1]
+        epoch_idx = jnp.concatenate(
+            [noise.epoch_idx,
+             jnp.full(r_t.shape[0], ne, dtype=jnp.int32)])
+
+        parts = gls_gram_seg(M, r, err, F, phi_F, epoch_idx,
+                             noise.ecorr_phi)
+        sol = gls_finalize_seg(parts, M.shape[1])
+        new_deltas = {k: deltas[k] + sol["x"][i + off]
+                      for i, k in enumerate(names)}
+        sig = jnp.sqrt(jnp.diagonal(sol["cov"]))
+        errors = {k: sig[i + off] for i, k in enumerate(names)}
+        return new_deltas, {"chi2": sol["chi2"], "errors": errors,
+                            "chi2_at_input":
+                                noise_marginal_chi2(parts, M.shape[1]),
+                            "fourier_coeffs": sol["fourier_coeffs"],
+                            "ecorr_coeffs": sol["ecorr_coeffs"]}
+
+    if not masked:
+        if traced_tzr:
+            def step_unmasked_tzr(base, deltas, toas, noise, dm,
+                                  tzr_toas):
+                return step(base, deltas, toas, noise, dm, None, tzr_toas)
+
+            return step_unmasked_tzr
+
+        def step_unmasked(base, deltas, toas, noise, dm):
+            return step(base, deltas, toas, noise, dm)
+
+        return step_unmasked
+    return step
+
+
+def jitted_wb_step(model, *, pl_specs=(), abs_phase: bool = True,
+                   masked: bool = False,
+                   params: list[str] | None = None,
+                   vmapped: bool = False, traced_tzr: bool = False,
+                   counted: bool = True):
+    """Model-cache-shared :func:`make_wb_step` (the ``jitted_wls_step``
+    convention: one compiled program per structure + step config, free
+    values through the traced ``base``, noise values through the traced
+    ``NoiseStatics``, DM data through the traced ``dm`` block)."""
+    from pint_tpu.fitting.step import _counted_step
+
+    key = ("wb_step", tuple(pl_specs), abs_phase, masked,
+           tuple(params) if params is not None else None, vmapped,
+           traced_tzr)
+
+    def build(owner):
+        fn = make_wb_step(owner, pl_specs=pl_specs, abs_phase=abs_phase,
+                          masked=masked, params=params,
+                          traced_tzr=traced_tzr)
+        if not vmapped:
+            return fn
+        n_args = 5 + (1 if masked else 0) + (1 if traced_tzr else 0)
+        return jax.vmap(fn, in_axes=(0,) * n_args)
+
+    cached = model._cached_jit(key, build)
+    if not counted:
+        return cached
+    return _counted_step(cached, key, model)
+
+
+def make_wb_probe(model, tzr=None, *, abs_phase: bool = True,
+                  pl_specs=(), traced_tzr: bool = False):
+    """Build ``probe(base, deltas, toas, noise, dm[, tzr]) -> chi2`` —
+    the stacked wideband chi2 at ``deltas`` without a design matrix
+    (one phase pass + one DM pass; the residual-only trial judge of the
+    fused damped loop, computing exactly the step's ``chi2_at_input``
+    expression through the zero-column Schur system)."""
+    from pint_tpu.fitting.gls_step import (gls_gram_seg,
+                                           noise_marginal_chi2, pl_bases)
+    from pint_tpu.fitting.step import make_resid_fn
+
+    resid = make_resid_fn(model, tzr, abs_phase=abs_phase,
+                          traced_tzr=traced_tzr)
+    dm_comps = [c for c in model.components if hasattr(c, "dm_value")]
+    dm_scale_comps = [c for c in model.components
+                      if hasattr(c, "scale_dm_sigma")]
+
+    def probe(base, deltas, toas, noise, dm, tzr_toas=None):
+        r_t, err_t, _w = (resid(base, deltas, toas, tzr_toas) if traced_tzr
+                          else resid(base, deltas, toas))
+        p = model.resolve(base, deltas)
+        dm_m = jnp.zeros(np.shape(toas.freq_mhz)[-1])
+        for c in dm_comps:
+            dm_m = dm_m + c.dm_value(p, toas)
+        err_dm = dm["errs"]
+        for c in dm_scale_comps:
+            err_dm = c.scale_dm_sigma(err_dm, toas)
+        r = jnp.concatenate([r_t, dm["vals"] - dm_m])
+        err = jnp.concatenate([err_t, err_dm])
+        F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
+        if F is not None:
+            F = jnp.concatenate([F, jnp.zeros_like(F)], axis=0)
+        ne = noise.ecorr_phi.shape[-1]
+        epoch_idx = jnp.concatenate(
+            [noise.epoch_idx,
+             jnp.full(r_t.shape[0], ne, dtype=jnp.int32)])
+        parts = gls_gram_seg(jnp.zeros((r.shape[0], 0)), r, err, F,
+                             phi_F, epoch_idx, noise.ecorr_phi)
+        return noise_marginal_chi2(parts, 0)
+
+    if traced_tzr:
+        def probe_tzr(base, deltas, toas, noise, dm, tzr_toas):
+            return probe(base, deltas, toas, noise, dm, tzr_toas)
+
+        return probe_tzr
+
+    def probe_plain(base, deltas, toas, noise, dm):
+        return probe(base, deltas, toas, noise, dm)
+
+    return probe_plain
+
+
+def jitted_wb_probe(model, *, pl_specs=(), abs_phase: bool = True,
+                    traced_tzr: bool = False, vmapped: bool = False):
+    """Model-cache-shared :func:`make_wb_probe` (uncounted; traced into
+    the fused device loop, never dispatched on its own)."""
+    key = ("wb_probe", tuple(pl_specs), abs_phase, traced_tzr, vmapped)
+
+    def build(owner):
+        fn = make_wb_probe(owner, pl_specs=pl_specs, abs_phase=abs_phase,
+                           traced_tzr=traced_tzr)
+        if not vmapped:
+            return fn
+        return jax.vmap(fn, in_axes=(0,) * (5 + (1 if traced_tzr else 0)))
+
+    return model._cached_jit(key, build)
 
 
 class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):

@@ -364,9 +364,19 @@ class TimingModel:
     # ------------------------------------------------------------------
     # reference-API conveniences (host entry points)
     # ------------------------------------------------------------------
-    def _fn_fingerprint(self):
+    def _fn_fingerprint(self, *, value_traced: frozenset = frozenset()):
         """Hashable identity of everything the jitted host entry points
         close over (vs. receive as traced arguments).
+
+        ``value_traced`` names parameters whose VALUES should be treated
+        as traced inputs rather than pinned constants — the serve-layer
+        batching fingerprint passes the noise-basis hyperparameters
+        (ECORR weights, power-law amp/gamma) here because the batched
+        GLS/wideband steps feed them through the traced ``NoiseStatics``
+        operand, so "same noise structure, different noise values" must
+        hash equal exactly like free fittable values do. The parameter's
+        name and selector stay pinned; only the value is replaced by a
+        marker. Default empty: the audited conservative identity.
 
         FREE numeric values flow through ``base_dd`` as jit inputs, so
         a model and its deepcopy — or any models parsed from the same
@@ -393,7 +403,9 @@ class TimingModel:
             (tuple((type(c).__name__, c.trace_facts())
                    for c in self.components),
              tuple((p.name,
-                    p.value if (p.frozen or not p.fittable) else None,
+                    "__traced__" if p.name in value_traced
+                    else (p.value if (p.frozen or not p.fittable)
+                          else None),
                     getattr(p, "selector", None))
                    for p in self.params.values()),
              tuple((k, str(header[k])) for k in

@@ -24,8 +24,26 @@ Heterogeneous models (VERDICT round-1 task 4) are batched through a
 
 Limitations (documented, checked): one binary class per batch (two
 binary models would collide on PB/A1/... names — batch per binary family
-instead), and no correlated-noise bases (use PTAGLSFitter, which is
-already heterogeneous, for ECORR/red-noise fits).
+instead).
+
+**Batchable frontier (ISSUE 8).** Correlated-noise bases and wideband
+tables are first-class batch members:
+
+* noise-basis components (ECORR / PLRedNoise / PLDMNoise / PLChromNoise)
+  merge by class into the union with their value-bearing
+  hyperparameters NORMALIZED to canonical constants — the batched GLS
+  step never reads them from the model (per-member values ride the
+  traced ``NoiseStatics``: stacked (B, n) epoch indices, (B, ne) ECORR
+  priors padded to the pow-2 basis bucket, (B, n_pl, 2) power-law
+  params), so the union's compiled program — and its fingerprint — is
+  independent of the members' noise values;
+* wideband members additionally carry a traced DM block
+  ({"vals", "errs"}, (B, n) each — the flag-borne measurements
+  materialized as data before static stripping) through the fused
+  wideband step (pint_tpu.fitting.wideband.make_wb_step);
+* the per-member damped state machines of the fused batched loop are
+  UNCHANGED — only the step/probe pair and the operand tail differ per
+  family ("wls" | "gls" | "wb").
 """
 
 from __future__ import annotations
@@ -85,6 +103,54 @@ def _structural_state(c) -> tuple:
     return tuple(out)
 
 
+def _normalized_noise_basis(c):
+    """Deepcopy of a noise-basis component with value-bearing
+    hyperparameters pinned to canonical constants.
+
+    The union's compiled GLS/wideband step reads noise VALUES from the
+    traced ``NoiseStatics`` operand, never from the union model — but
+    the union's ``_fn_fingerprint`` (the program-cache key) pins frozen
+    parameter values. Normalizing them here makes two batches that
+    differ only in noise values share one union fingerprint, hence one
+    compiled loop program. The harmonic-count parameter (``_c_name``:
+    TNREDC/TNDMC/TNCHROMC) is shape-static and KEPT — a different
+    nharm is a different program.
+    """
+    import copy as _copy
+
+    cc = _copy.deepcopy(c)
+    keep = getattr(cc, "_c_name", None)
+    for p in cc.params:
+        if p.is_numeric and p.name != keep:
+            p.value = (1.0, 0.0)
+        p.frozen = True
+    return cc
+
+
+def _check_noise_merge(prev, c, name: str) -> None:
+    """Noise-basis components merged by class must agree on everything
+    shape-static: parameter sets, structural state, harmonic count and
+    chromatic index (per-member VALUES ride the traced statics)."""
+    if [p.name for p in prev.params] != [p.name for p in c.params]:
+        raise ValueError(
+            f"noise component {name} has different parameter sets "
+            "across the batch; split the batch")
+    if _structural_state(prev) != _structural_state(c):
+        raise ValueError(
+            f"noise component {name} has different non-parameter state "
+            "across the batch; split the batch")
+    if hasattr(prev, "nharm") and prev.nharm() != c.nharm():
+        raise ValueError(
+            f"noise component {name} has different harmonic counts "
+            f"({prev.nharm()} vs {c.nharm()}) across the batch — the "
+            "Fourier block shape is static; split the batch")
+    if (hasattr(prev, "basis_alpha")
+            and prev.basis_alpha() != c.basis_alpha()):
+        raise ValueError(
+            f"noise component {name} has different chromatic indices "
+            "across the batch; split the batch")
+
+
 def build_union_model(models) -> tuple[TimingModel, dict[str, dict[int, tuple]]]:
     """Union of the models' components for batched fitting.
 
@@ -124,12 +190,30 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, dict[int, tuple]]]
             by_key[key].frozen = False
         return True
 
+    noise_basis: dict[str, tuple] = {}  # class -> (normalized, exemplar)
     for i, m in enumerate(models):
         for c in m.components:
             if getattr(c, "is_noise_basis", False):
-                raise ValueError(
-                    "batched fitting is white-noise WLS; use PTAGLSFitter "
-                    "for correlated-noise (ECORR/red-noise) pulsar sets")
+                name = type(c).__name__
+                # a FREE hyperparameter would be silently frozen by the
+                # union normalization (its masked design column has an
+                # identically-zero phase derivative -> zero delta,
+                # bogus uncertainty) — reject, mirroring the serve
+                # layer's free_noise_param passthrough routing
+                free = [p.name for p in c.params
+                        if p.is_numeric and not p.frozen]
+                if free:
+                    raise ValueError(
+                        f"noise component {name} has free "
+                        f"hyperparameters {free}; batched fitting "
+                        "treats noise values as fixed per-member "
+                        "statics — freeze them or fit standalone")
+                prev = noise_basis.get(name)
+                if prev is None:
+                    noise_basis[name] = (_normalized_noise_basis(c), c)
+                else:
+                    _check_noise_merge(prev[1], c, name)
+                continue
             if isinstance(c, ScaleToaError):
                 for p in c.params:
                     kind = p.name.rstrip("0123456789")
@@ -195,6 +279,7 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, dict[int, tuple]]]
             else:
                 plain[name] = c
     comps = list(plain.values())
+    comps.extend(norm for norm, _ in noise_basis.values())
     if scale.params:
         comps.append(scale)
     if jump.params:
@@ -331,7 +416,8 @@ class BatchedPulsarFitter:
 
     def __init__(self, problems: list[tuple[TOAs, object]], mesh=None,
                  psr_axis: int | None = None,
-                 pad_members: int | None = None):
+                 pad_members: int | None = None,
+                 basis_bucket: int | None = None):
         if not problems:
             raise ValueError("no problems given")
         self.n_real = len(problems)
@@ -344,6 +430,19 @@ class BatchedPulsarFitter:
                 for _ in range(pad_members - len(problems))]
         self.toas_list = [t for t, _ in problems]
         self.models = [m for _, m in problems]
+        # batch family (ISSUE 8): wideband tables run the fused joint
+        # TOA+DM step, noise-basis models the fused GLS step, everything
+        # else the original WLS union path — the per-member damped state
+        # machines are identical across families
+        wb_flags = [bool(getattr(t, "is_wideband", lambda: False)())
+                    for t in self.toas_list]
+        if any(wb_flags) and not all(wb_flags):
+            raise ValueError("cannot batch wideband and narrowband "
+                             "tables together; split the batch")
+        has_noise = any(getattr(c, "is_noise_basis", False)
+                        for m in self.models for c in m.components)
+        self.family = ("wb" if wb_flags and all(wb_flags)
+                       else "gls" if has_noise else "wls")
         # per-real-member flags; fit_toas / finish() overwrite
         self.converged = np.zeros(self.n_real, dtype=bool)
         self.diverged = np.zeros(self.n_real, dtype=bool)
@@ -445,6 +544,55 @@ class BatchedPulsarFitter:
         ]
         self.toas = shard_toas(stack_toas(prepped, n_max), self.mesh,
                                batched=True)
+        # noise statics + wideband DM block (the batchable frontier):
+        # per-member values as TRACED stacked operands. Statics are
+        # built on each member's RAW table — padding rows therefore
+        # cannot form phantom ECORR epochs by construction (the PR-2
+        # bug class; regression-pinned through this path in
+        # tests/test_serve_frontier.py) — then padded to the TOA bucket
+        # and the pow-2 basis bucket (inert columns; bucketing
+        # .pad_basis_cols) and stacked (B, ...).
+        self.noise = None
+        self.dm = None
+        self.pl_specs = ()
+        self.basis_bucket = 0
+        if self.family != "wls":
+            from pint_tpu.bucketing import basis_bucket_size
+            from pint_tpu.fitting.gls_step import (build_noise_statics,
+                                                   stack_noise_statics)
+
+            statics, specs_list = [], []
+            for t, m in zip(self.toas_list, self.models):
+                # numpy leaves: the stacked statics are device-placed
+                # ONCE below (jnp here would transfer every member's
+                # epoch vector twice — the stack_toas lesson)
+                s, specs = build_noise_statics(m, t, as_numpy=True)
+                statics.append(s)
+                specs_list.append(specs)
+            if any(sp != specs_list[0] for sp in specs_list[1:]):
+                raise ValueError(
+                    "noise-basis specs differ across the batch "
+                    "(component set / harmonic counts / chromatic "
+                    "index); split the batch")
+            self.pl_specs = specs_list[0]
+            ne_max = max(int(np.shape(s.ecorr_phi)[0]) for s in statics)
+            ne_target = (basis_bucket if basis_bucket is not None
+                         else basis_bucket_size(ne_max))
+            if ne_target < ne_max:
+                raise ValueError(
+                    f"basis_bucket {ne_target} < largest member epoch "
+                    f"count {ne_max}")
+            self.basis_bucket = ne_target
+            self.noise = _shard_psr_only(
+                stack_noise_statics(statics, n_max, ne_target), self.mesh)
+            if self.family == "wb":
+                from pint_tpu.fitting.wideband import build_wb_data
+
+                blocks = [build_wb_data(t, n_max) for t in self.toas_list]
+                self.dm = _shard_psr_only(
+                    {"vals": np.stack([b["vals"] for b in blocks]),
+                     "errs": np.stack([b["errs"] for b in blocks])},
+                    self.mesh)
         # TZR anchoring: when every member carries an AbsPhase (TZRMJD),
         # the one-row TZR tables are stacked and traced through the step
         # so each member computes the exact DENSE anchored convention —
@@ -467,16 +615,85 @@ class BatchedPulsarFitter:
         # params= is the fitter's free-param union — a parameter frozen in
         # the model that contributed the union component may still be free
         # in another pulsar (its column is masked per pulsar).
-        self.step = jitted_wls_step(self.union,
-                                    abs_phase=self.tzr is not None,
-                                    traced_tzr=self.tzr is not None,
-                                    masked=True, params=self.free_params,
-                                    vmapped=True)
+        anchored = self.tzr is not None
+        if self.family == "wls":
+            self.step = jitted_wls_step(self.union, abs_phase=anchored,
+                                        traced_tzr=anchored, masked=True,
+                                        params=self.free_params,
+                                        vmapped=True)
+        elif self.family == "gls":
+            from pint_tpu.fitting.gls_step import jitted_gls_step
+
+            self.step = jitted_gls_step(
+                self.union, pl_specs=self.pl_specs, abs_phase=anchored,
+                traced_tzr=anchored, masked=True,
+                params=self.free_params, vmapped=True)
+        else:
+            from pint_tpu.fitting.wideband import jitted_wb_step
+
+            self.step = jitted_wb_step(
+                self.union, pl_specs=self.pl_specs, abs_phase=anchored,
+                traced_tzr=anchored, masked=True,
+                params=self.free_params, vmapped=True)
         # the union is never mutated after construction (fit results
         # write back to the MEMBER models), so its fingerprint hash is
         # stable — dispatch_fit reuses it instead of re-hashing the
         # whole component stack per launch
         self._union_fp_hash = hash(self.union._fn_fingerprint())
+
+    def _family_args(self) -> tuple:
+        """Per-family operand tail between the TOA table and the mask:
+        ``()`` (wls) / ``(noise,)`` (gls) / ``(noise, dm)`` (wb)."""
+        if self.family == "gls":
+            return (self.noise,)
+        if self.family == "wb":
+            return (self.noise, self.dm)
+        return ()
+
+    def _probe_step(self):
+        """The family's vmapped residual-only probe (shared program
+        cache; traced into the fused loop)."""
+        from pint_tpu.fitting.step import jitted_wls_probe
+
+        anchored = self.tzr is not None
+        if self.family == "gls":
+            from pint_tpu.fitting.gls_step import jitted_gls_probe
+
+            return jitted_gls_probe(
+                self.union, pl_specs=self.pl_specs, abs_phase=anchored,
+                traced_tzr=anchored, vmapped=True)
+        if self.family == "wb":
+            from pint_tpu.fitting.wideband import jitted_wb_probe
+
+            return jitted_wb_probe(
+                self.union, pl_specs=self.pl_specs, abs_phase=anchored,
+                traced_tzr=anchored, vmapped=True)
+        return jitted_wls_probe(self.union, abs_phase=anchored,
+                                traced_tzr=anchored, vmapped=True)
+
+    def _step_uncounted(self):
+        """The family's vmapped full step WITHOUT the execution-counter
+        wrapper (device-loop callers trace it into the loop program)."""
+        anchored = self.tzr is not None
+        if self.family == "gls":
+            from pint_tpu.fitting.gls_step import jitted_gls_step
+
+            return jitted_gls_step(
+                self.union, pl_specs=self.pl_specs, abs_phase=anchored,
+                traced_tzr=anchored, masked=True,
+                params=self.free_params, vmapped=True, counted=False)
+        if self.family == "wb":
+            from pint_tpu.fitting.wideband import jitted_wb_step
+
+            return jitted_wb_step(
+                self.union, pl_specs=self.pl_specs, abs_phase=anchored,
+                traced_tzr=anchored, masked=True,
+                params=self.free_params, vmapped=True, counted=False)
+        from pint_tpu.fitting.step import jitted_wls_step as _wls
+
+        return _wls(self.union, abs_phase=anchored, traced_tzr=anchored,
+                    masked=True, params=self.free_params, vmapped=True,
+                    counted=False)
 
     def fit_toas(self, maxiter: int = 20,
                  min_chi2_decrease: float = 1e-3,
@@ -514,23 +731,21 @@ class BatchedPulsarFitter:
         base = replicate(self.base, self.mesh)
         mask = replicate(self.param_mask, self.mesh)
 
-        from pint_tpu.fitting.step import jitted_wls_probe
-
         anchored = self.tzr is not None
-        probe_step = jitted_wls_probe(
-            self.union, abs_phase=anchored, traced_tzr=anchored,
-            vmapped=True)
+        probe_step = self._probe_step()
+        extra = self._family_args()
 
         def run(d):
             if anchored:
-                return self.step(base, d, self.toas, mask, self.tzr)
-            return self.step(base, d, self.toas, mask)
+                return self.step(base, d, self.toas, *extra, mask,
+                                 self.tzr)
+            return self.step(base, d, self.toas, *extra, mask)
 
         def run_probe(d):
             if anchored:
-                return np.asarray(probe_step(base, d, self.toas,
+                return np.asarray(probe_step(base, d, self.toas, *extra,
                                              self.tzr))
-            return np.asarray(probe_step(base, d, self.toas))
+            return np.asarray(probe_step(base, d, self.toas, *extra))
 
         # the reference transcription of the fused batched loop (see
         # device_loop._build_batched_probe_loop): full evaluations judge
@@ -640,45 +855,51 @@ class BatchedPulsarFitter:
             return _ResolvedBatchFit(self, chi2)
 
         from pint_tpu.bucketing import toa_shape
-        from pint_tpu.fitting.step import jitted_wls_probe, jitted_wls_step
 
         B = len(self.models)
         anchored = self.tzr is not None
         deltas = {k: np.zeros(B) for k in self.free_params}
         base = replicate(self.base, self.mesh)
         mask = replicate(self.param_mask, self.mesh)
-        step_raw = jitted_wls_step(
-            self.union, abs_phase=anchored, traced_tzr=anchored,
-            masked=True, params=self.free_params, vmapped=True,
-            counted=False)
+        step_raw = self._step_uncounted()
         # halved trials are judged by the residual-only probe — the
         # chi2 doesn't read the design matrix, so the probe takes no
-        # mask — and re-checked by the authoritative full step
-        probe_raw = jitted_wls_probe(
-            self.union, abs_phase=anchored, traced_tzr=anchored,
-            vmapped=True)
+        # mask — and re-checked by the authoritative full step. The
+        # operand layout is (base, toas, family-extra tuple, mask
+        # [, tzr]) — the extra tuple is empty for WLS, (noise,) for
+        # GLS, (noise, dm) for wideband.
+        probe_raw = self._probe_step()
+        extra = self._family_args()
         if anchored:
-            operands = (base, self.toas, mask, self.tzr)
+            operands = (base, self.toas, extra, mask, self.tzr)
+
+            def run_ops(d, ops):
+                return step_raw(ops[0], d, ops[1], *ops[2], ops[3],
+                                ops[4])
 
             def probe_ops(d, ops):
-                return probe_raw(ops[0], d, ops[1], ops[3])
+                return probe_raw(ops[0], d, ops[1], *ops[2], ops[4])
         else:
-            operands = (base, self.toas, mask)
+            operands = (base, self.toas, extra, mask)
+
+            def run_ops(d, ops):
+                return step_raw(ops[0], d, ops[1], *ops[2], ops[3])
 
             def probe_ops(d, ops):
-                return probe_raw(ops[0], d, ops[1])
+                return probe_raw(ops[0], d, ops[1], *ops[2])
         with self.mesh, telemetry.span("fit.batched.dispatch",
                                        n_pulsars=B):
             handle = device_loop.dispatch_damped_batched(
-                lambda d, ops: step_raw(ops[0], d, *ops[1:]),
-                deltas, operands, probe=probe_ops,
+                run_ops, deltas, operands, probe=probe_ops,
                 key=("batched", id(step_raw), id(probe_raw)),
                 maxiter=maxiter,
                 min_chi2_decrease=min_chi2_decrease,
                 max_step_halvings=max_step_halvings,
                 kind="device_loop_batched",
                 fingerprint=(self._union_fp_hash,
-                             tuple(self.free_params), anchored),
+                             tuple(self.free_params), anchored,
+                             self.family, self.pl_specs,
+                             self.basis_bucket),
                 shape=toa_shape(self.toas))
         return _InFlightBatchPulsarFit(self, handle)
 
@@ -688,7 +909,8 @@ class BatchedPulsarFitter:
         accounting; see parallel.mesh.per_device_bytes)."""
         from pint_tpu.parallel.mesh import per_device_bytes
 
-        return per_device_bytes((self.toas, self.tzr))
+        return per_device_bytes((self.toas, self.tzr, self.noise,
+                                 self.dm))
 
     def _write_back(self, deltas, info) -> None:
         """Apply fitted deltas + uncertainties to every REAL (owner)

@@ -15,7 +15,8 @@ engine" and "Failure domains & degradation ladder".
 
 from pint_tpu.serve import faults  # noqa: F401
 from pint_tpu.serve.fingerprint import (  # noqa: F401
-    batchable, plan_key, short_id, structure_fingerprint)
+    basis_bucket, batchable, family, noise_batch_enabled, plan_key,
+    short_id, structure_fingerprint)
 from pint_tpu.serve.pipeline import run_pipeline  # noqa: F401
 from pint_tpu.serve.scheduler import (  # noqa: F401
     STATUSES, BatchPlan, FitHandle, FitRequest, FitResult, ServeQueueFull,
@@ -23,7 +24,8 @@ from pint_tpu.serve.scheduler import (  # noqa: F401
 
 __all__ = [
     "BatchPlan", "FitHandle", "FitRequest", "FitResult", "STATUSES",
-    "ServeQueueFull", "ThroughputScheduler", "batchable", "faults",
-    "plan_key", "run_pipeline", "short_id", "structure_fingerprint",
+    "ServeQueueFull", "ThroughputScheduler", "basis_bucket", "batchable",
+    "faults", "family", "noise_batch_enabled", "plan_key",
+    "run_pipeline", "short_id", "structure_fingerprint",
     "transient_error",
 ]

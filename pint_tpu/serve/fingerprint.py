@@ -8,11 +8,11 @@ arguments. The canonical key for that is the model's own
 entry points close over: component stack + trace facts, frozen /
 unfittable parameter values, selectors, backend-relevant header keys —
 FREE fittable values are excluded because they ride the traced
-``base_dd``). "Same structure, different parameter values" therefore
-hashes equal by construction, which is exactly the reuse the issue
-asks to extend beyond hand-built homogeneous batches.
+``base_dd``). "Same structure, different values" therefore hashes equal
+by construction, which is exactly the reuse the issue asks to extend
+beyond hand-built homogeneous batches.
 
-Two additions on top of ``_fn_fingerprint``:
+Additions on top of ``_fn_fingerprint``:
 
 * **structural state** (DMX MJD windows, IFunc node epochs, glitch
   indices) is pinned explicitly — ``build_union_model`` refuses to
@@ -20,13 +20,37 @@ Two additions on top of ``_fn_fingerprint``:
   must split them even if a component's ``trace_facts`` hook happens
   not to cover some attribute (belt and braces: equal fingerprint must
   imply the union build succeeds);
-* **batchability**: models the vmapped WLS union cannot express at all
-  (correlated-noise bases, delay-side jumps, wideband tables) get
-  ``batchable=False`` and are routed through the per-request
-  passthrough path instead of a batch.
+* **family** ("wls" | "gls" | "wb"): which fused step a batch of this
+  structure runs. Wideband-ness lives on the TOAs and noise bases on
+  the model; both are *fingerprint splits* now (ISSUE 8), not
+  passthrough routes — a GLS+ECORR group runs the vmapped GLS union
+  step, a wideband group the joint TOA+DM step;
+* **noise-value invariance**: the batched GLS/wideband steps feed the
+  noise hyperparameter VALUES (ECORR weights, power-law amp/gamma)
+  through the traced ``NoiseStatics`` operand, so the fingerprint
+  treats them like free fittable values (``_fn_fingerprint(
+  value_traced=...)``) — "same noise structure, different noise
+  values" batches. Shape-static noise facts (harmonic counts,
+  chromatic index, selectors, component classes) stay pinned;
+* **batchability**: the residue of models the union still cannot
+  express (delay-side jumps, multiple ECORR components, free noise
+  hyperparameters — or ANY noise/wideband structure under the
+  ``PINT_TPU_BATCH_NOISE=0`` kill switch, which restores the PR-5
+  passthrough routing) gets ``batchable=False`` with a stable
+  snake_case reason token, routed through the per-request passthrough
+  path and counted under ``serve.passthrough.reason.<token>``.
 """
 
 from __future__ import annotations
+
+import os
+
+
+def noise_batch_enabled() -> bool:
+    """Batchable-frontier gate (read per call so tests can flip it):
+    ``PINT_TPU_BATCH_NOISE=0`` restores the PR-5 routing in which every
+    correlated-noise / wideband request is a per-request passthrough."""
+    return os.environ.get("PINT_TPU_BATCH_NOISE", "") != "0"
 
 
 def _structural_state(model) -> tuple:
@@ -40,61 +64,133 @@ def _structural_state(model) -> tuple:
                  for c in model.components)
 
 
-def batchable(model, toas=None) -> tuple[bool, str]:
-    """(ok, reason): can this fit be a vmapped WLS batch member?
+def family(model, toas=None) -> str:
+    """Which fused step serves this structure: ``"wb"`` (wideband TOAs
+    — the joint TOA+DM step, with or without noise bases), ``"gls"``
+    (correlated-noise bases on a narrowband table), ``"wls"``."""
+    if toas is not None and getattr(toas, "is_wideband", lambda: False)():
+        return "wb"
+    if any(getattr(c, "is_noise_basis", False) for c in model.components):
+        return "gls"
+    return "wls"
 
-    The model rejections mirror ``parallel.batch.build_union_model``;
-    wideband-ness lives on the TOAs (``toas.is_wideband()`` — the same
-    dispatch ``Fitter.auto`` uses), so pass the request's table to
-    route wideband fits too. A fit failing here is served through the
-    scheduler's passthrough path (a normal per-request fit), never an
-    error.
+
+def _noise_value_params(model) -> frozenset:
+    """Names of noise-basis hyperparameters whose VALUES ride the traced
+    ``NoiseStatics`` operand of the batched GLS/wideband steps — the
+    harmonic-count parameter (shape-static) stays pinned."""
+    out = set()
+    for c in model.components:
+        if not getattr(c, "is_noise_basis", False):
+            continue
+        keep = getattr(c, "_c_name", None)
+        out.update(p.name for p in c.params
+                   if p.is_numeric and p.name != keep)
+    return frozenset(out)
+
+
+def batchable(model, toas=None) -> tuple[bool, str]:
+    """(ok, reason): can this fit be a vmapped union batch member?
+
+    ``reason`` is a stable snake_case token (it becomes the
+    ``serve.passthrough.reason.<token>`` counter suffix and the drain
+    record's breakdown key). The rejections mirror
+    ``parallel.batch.build_union_model``; wideband-ness lives on the
+    TOAs (``toas.is_wideband()`` — the same dispatch ``Fitter.auto``
+    uses), so pass the request's table. A fit failing here is served
+    through the scheduler's passthrough path (a normal per-request
+    fit), never an error.
     """
     from pint_tpu.models.jump import PhaseJump
 
-    if toas is not None and getattr(toas, "is_wideband", lambda: False)():
-        return False, "wideband TOAs"
+    fam = family(model, toas)
+    for c in model.components:
+        if isinstance(c, PhaseJump) and type(c) is not PhaseJump:
+            return False, "delay_side_jump"
+    if fam == "wls":
+        return True, ""
+    if not noise_batch_enabled():
+        return False, ("wideband_kill_switch" if fam == "wb"
+                       else "noise_kill_switch")
+    if fam == "wb":
+        import numpy as np
+
+        errs = np.asarray(toas.get_dm_errors())
+        if not np.all(np.isfinite(errs) & (errs > 0)):
+            # the joint solve would be NaN; the passthrough fitter's
+            # constructor raises the actionable error FAIL-FAST
+            # (attempts=1), instead of a batch prep failure + salvage
+            return False, "invalid_dm_errors"
+    n_ecorr = sum(hasattr(c, "epoch_indices") for c in model.components)
+    if n_ecorr > 1:
+        return False, "multiple_ecorr"
     for c in model.components:
         if getattr(c, "is_noise_basis", False):
-            return False, f"correlated-noise basis {type(c).__name__}"
-        if isinstance(c, PhaseJump) and type(c) is not PhaseJump:
-            return False, f"delay-side jump {type(c).__name__}"
+            if any(not p.frozen for p in c.params if p.is_numeric):
+                # an unfrozen hyperparameter is read host-side by the
+                # standalone fitters' basis builders mid-fit; the union
+                # statics are built once at batch prep
+                return False, "free_noise_param"
     return True, ""
 
 
 def structure_fingerprint(model, toas=None) -> tuple:
     """Hashable batch-group identity of a fit's structure.
 
-    Equal fingerprints guarantee (a) ``build_union_model`` accepts the
-    set, and (b) same-shape batches trace to one compiled loop program
-    (the union's own ``_fn_fingerprint`` is determined by the members').
-    Pass ``toas`` so wideband tables get a passthrough fingerprint.
+    ``(batchable, family, fn_fingerprint, structural_state)`` — equal
+    fingerprints guarantee (a) ``build_union_model`` accepts the set,
+    and (b) same-shape batches trace to one compiled loop program (the
+    union's own ``_fn_fingerprint`` is determined by the members', with
+    noise values normalized on both sides). Pass ``toas`` so wideband
+    tables split into their own ("wb") groups.
 
     The structure key deliberately carries NO placement state — device
     count, mesh layout, shard width are properties of where a plan
     runs, not of what a model is (a request's fingerprint must not
-    change because the device pool resized between submit and drain).
-    Placement joins at the PLAN key instead (:func:`plan_key`).
+    change because the device pool resized between submit and drain) —
+    and no data-dependent shapes: the TOA bucket and the ECORR basis
+    bucket join at the PLAN key instead (:func:`plan_key`).
     """
     ok, _reason = batchable(model, toas)
-    return (ok, model._fn_fingerprint(), _structural_state(model))
+    fam = family(model, toas)
+    traced = _noise_value_params(model) if fam != "wls" else frozenset()
+    return (ok, fam, model._fn_fingerprint(value_traced=traced),
+            _structural_state(model))
+
+
+def basis_bucket(model, toas) -> int:
+    """The request's pow-2 ECORR basis bucket (0 = no ECORR epochs).
+
+    Data-dependent like the TOA bucket — the epoch count comes from
+    quantizing THIS table — so it joins the plan key, not the structure
+    fingerprint. Batch prep pads every member's epoch columns to this
+    bucket with exactly-inert columns
+    (:func:`pint_tpu.bucketing.pad_basis_cols`).
+    """
+    from pint_tpu.bucketing import basis_bucket_size
+
+    for c in model.components:
+        if hasattr(c, "epoch_indices"):
+            _idx, phi = c.epoch_indices(toas)
+            return basis_bucket_size(len(phi))
+    return 0
 
 
 def plan_key(fp: tuple, toa_bucket: int, hyper: tuple,
-             devices: int) -> tuple:
+             devices: int, basis_bucket: int = 0) -> tuple:
     """Batch-PLAN grouping key: structure + shapes + placement.
 
     Two requests may share one program launch iff their plan keys are
     equal: same :func:`structure_fingerprint`, same TOA bucket (the
-    padded shape), same fit hyperparameters (traced but part of the
-    request contract), and — new with mesh-sharded serving (ISSUE 7) —
-    the same device count, because a formed batch's compiled program is
-    partitioned for a specific mesh: a batch planned for 8 devices and
-    one planned for 1 are different programs even at identical
-    structure and shapes. Device count lives HERE and not in
+    padded shape), same ECORR basis bucket (the padded epoch-column
+    shape, ISSUE 8 — new member shape next to the TOA bucket), same fit
+    hyperparameters (traced but part of the request contract), and —
+    with mesh-sharded serving (ISSUE 7) — the same device count,
+    because a formed batch's compiled program is partitioned for a
+    specific mesh. Placement and shapes live HERE and not in
     :func:`structure_fingerprint` (see there).
     """
-    return (fp, toa_bucket, hyper, int(devices))
+    return (fp, toa_bucket, hyper, int(devices), int(basis_bucket))
 
 
 def short_id(fp: tuple) -> str:

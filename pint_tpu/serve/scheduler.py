@@ -24,10 +24,20 @@ double-buffered dispatch):
    while batch k executes on-device, the host packs/whitens/pads batch
    k+1; a bounded in-flight window keeps device memory bounded.
 
-Models the vmapped WLS union cannot express (correlated-noise bases,
-delay-side jumps, wideband) are served through a **passthrough** path —
-a per-request ``Fitter.auto`` fit in its own singleton batch — so the
-scheduler accepts any model the library can fit.
+**Batchable frontier (ISSUE 8).** Correlated-noise (GLS) and wideband
+fits are first-class batch members: noise-basis stacks and wideband-
+ness split the structure fingerprint (and the ECORR epoch-column
+bucket joins the plan key next to the TOA bucket) instead of forcing a
+passthrough, so the heaviest production models batch through the same
+fused union loop. The residue the union still cannot express
+(delay-side jumps, multiple ECORR components, free noise
+hyperparameters — or everything noise/wideband under the
+``PINT_TPU_BATCH_NOISE=0`` kill switch) is served through the
+**passthrough** path — a per-request ``Fitter.auto`` fit in its own
+singleton batch — so the scheduler accepts any model the library can
+fit; every passthrough records WHY via
+``serve.passthrough.reason.<token>`` counters and the drain record's
+``passthrough`` breakdown.
 
 **Failure domains (ISSUE 6).** Every submitted request resolves to a
 :class:`FitResult` with a ``status`` — one of :data:`STATUSES` — and an
@@ -255,6 +265,8 @@ class BatchPlan:
     n_members: int            # padded member count (1 for passthrough)
     devices: int = 1          # device-block width (0 = host/passthrough)
     slot: int = 0             # first device index of the block
+    basis_bucket: int = 0     # padded ECORR epoch columns (ISSUE 8)
+    reason: str = ""          # passthrough reason token (ISSUE 8)
 
     @property
     def occupancy(self) -> float:
@@ -453,9 +465,17 @@ class ThroughputScheduler:
                                               model=model)
                 telemetry.inc(f"serve.fault.injected.{injected}")
         handle = FitHandle()
+        ok, reason = _fp.batchable(request.model, request.toas)
         fp = _fp.structure_fingerprint(request.model, request.toas)
+        # the ECORR basis bucket is a member SHAPE (like the TOA
+        # bucket): computed once on the enqueue path, it joins the plan
+        # key so equal groups share one padded-epoch-column program
+        bb = (_fp.basis_bucket(request.model, request.toas)
+              if ok and fp[1] != "wls" else 0)
         self._queue.append((request, handle, time.perf_counter(), fp,
-                            {"seq": seq, "injected": injected}))
+                            {"seq": seq, "injected": injected,
+                             "basis_bucket": bb,
+                             "pt_reason": reason if not ok else ""}))
         telemetry.inc("serve.requests")
         return handle
 
@@ -503,10 +523,11 @@ class ThroughputScheduler:
         bad_devs = self.degraded_devices()
         groups: dict[tuple, list[int]] = {}
         order: list[tuple] = []
-        for i, (req, _h, _t, fp, _m) in enumerate(self._queue):
+        for i, (req, _h, _t, fp, m) in enumerate(self._queue):
             key = _fp.plan_key(fp, bucketing.bucket_size(len(req.toas)),
                                (req.maxiter, req.min_chi2_decrease,
-                                req.max_step_halvings), self.n_devices)
+                                req.max_step_halvings), self.n_devices,
+                               m.get("basis_bucket", 0))
             if key not in groups:
                 groups[key] = []
                 order.append(key)
@@ -515,9 +536,15 @@ class ThroughputScheduler:
         load = [0] * self.n_devices  # member-slots placed this pass
         width_cap = largest_pow2_leq(self.n_devices)
 
-        def _passthrough(fp, idxs, bucket):
-            plans.extend(BatchPlan("passthrough", _fp.short_id(fp), [i],
-                                   bucket, 1, devices=0) for i in idxs)
+        def _passthrough(fp, idxs, bucket, reason):
+            """One singleton passthrough plan per request; ``reason`` is
+            the token the drain counts (per-request batchable reasons
+            take precedence over the group-level cause)."""
+            plans.extend(BatchPlan(
+                "passthrough", _fp.short_id(fp), [i], bucket, 1,
+                devices=0,
+                reason=self._queue[i][4].get("pt_reason") or reason)
+                for i in idxs)
 
         def _place(width: int) -> tuple[int, bool]:
             """(slot, clean): least-loaded aligned block of ``width``;
@@ -533,17 +560,22 @@ class ThroughputScheduler:
             return best[1], not best[0][0]
 
         for key in order:
-            fp, bucket = key[0], key[1]
+            fp, bucket, bb = key[0], key[1], key[4]
             idxs = groups[key]
             if not fp[0] or degraded:  # unbatchable OR isolation mode
-                _passthrough(fp, idxs, bucket)
+                _passthrough(fp, idxs, bucket,
+                             "unbatchable" if not fp[0] else "degraded")
                 continue
-            if self.n_devices > 1 and bucket >= self.toa_shard_min:
+            if (self.n_devices > 1 and bucket >= self.toa_shard_min
+                    and fp[1] == "wls"):
                 # big-fit route: TOA axis over the whole pool, one fit
-                # per program (it saturates the mesh alone). The block
-                # is every device, so any degraded device isolates it.
+                # per program (it saturates the mesh alone; WLS only —
+                # ShardedServeFitter has no noise/wideband step, so
+                # big GLS/wideband singletons stay batched plans). The
+                # block is every device, so any degraded device
+                # isolates it.
                 if bad_devs:
-                    _passthrough(fp, idxs, bucket)
+                    _passthrough(fp, idxs, bucket, "degraded_devices")
                     continue
                 for i in idxs:
                     for d in range(self.n_devices):
@@ -563,13 +595,14 @@ class ThroughputScheduler:
                 width = min(largest_pow2_divisor(n_members), width_cap)
                 slot, clean = _place(width)
                 if not clean:
-                    _passthrough(fp, chunk, bucket)
+                    _passthrough(fp, chunk, bucket, "degraded_devices")
                     continue
                 for d in range(slot, slot + width):
                     load[d] += n_members // width
                 plans.append(BatchPlan(
                     "batched", _fp.short_id(fp), chunk, bucket,
-                    n_members, devices=width, slot=slot))
+                    n_members, devices=width, slot=slot,
+                    basis_bucket=bb))
         return plans
 
     # ------------------------------------------------------------------
@@ -829,7 +862,8 @@ class ThroughputScheduler:
                                         members=plan.n_members):
                         state.fitter = BatchedPulsarFitter(
                             problems, mesh=self._mesh_for(plan),
-                            pad_members=plan.n_members)
+                            pad_members=plan.n_members,
+                            basis_bucket=plan.basis_bucket)
                 state.device_bytes = state.fitter.device_bytes()
                 return state
             except Exception as e:  # noqa: BLE001 — isolation boundary
@@ -1011,6 +1045,23 @@ class ThroughputScheduler:
         n_real = sum(len(p.indices) for p in plans)
         n_members = sum(p.n_members for p in plans)
         occupancy = n_real / max(1, n_members)
+
+        # passthrough accounting (ISSUE 8 satellite): WHY a request
+        # skipped the batched path, as stable reason tokens — counters
+        # plus a per-drain breakdown so frontier regressions (a model
+        # class silently falling off the batchable set) are visible
+        # from committed artifacts via the report CLI
+        pt_reasons: dict[str, int] = {}
+        n_pt_req = 0
+        for p in plans:
+            if p.kind != "passthrough":
+                continue
+            n_pt_req += len(p.indices)
+            token = p.reason or "unbatchable"
+            pt_reasons[token] = pt_reasons.get(token, 0) + len(p.indices)
+            telemetry.inc(f"serve.passthrough.reason.{token}",
+                          len(p.indices))
+        pt_rate = n_pt_req / max(1, n_real)
         # pow-2 member-padding waste, visible BEFORE sharding multiplies
         # it (ISSUE-7 satellite): dummy members replicate a real fit's
         # work on every device their batch spans
@@ -1084,6 +1135,12 @@ class ThroughputScheduler:
             "fail_streak": self._fail_streak,
             "dummy_members": dummies,
             "dummy_fraction": round(dummies / max(1, n_members), 4),
+            "passthrough": {
+                "requests": n_pt_req,
+                "rate": round(pt_rate, 4),
+                "reasons": dict(sorted(pt_reasons.items(),
+                                       key=lambda kv: -kv[1])),
+            },
             "mesh": {
                 "devices": D,
                 "per_device_members": dev_members,
@@ -1101,7 +1158,11 @@ class ThroughputScheduler:
                  "toa_bucket": p.toa_bucket, "real": len(p.indices),
                  "members": p.n_members, "devices": p.devices,
                  "slot": p.slot,
-                 "occupancy": round(p.occupancy, 4)} for p in plans],
+                 "occupancy": round(p.occupancy, 4),
+                 **({"basis_bucket": p.basis_bucket}
+                    if p.basis_bucket else {}),
+                 **({"reason": p.reason} if p.reason else {})}
+                for p in plans],
             **stats,
         }
         telemetry.add_record(dict(self.last_drain))

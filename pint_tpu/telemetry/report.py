@@ -177,8 +177,44 @@ def serve_summaries(records: list[dict]) -> list[dict]:
         s["passthrough"] = sum(1 for b in detail
                                if b.get("kind") == "passthrough")
         s["groups"] = len({b.get("group") for b in detail})
+        # passthrough breakdown (ISSUE 8): rate + reason tokens from
+        # the drain record's passthrough block; reconstruct rate from
+        # batch_detail for records predating it (reasons unknown there)
+        pt = r.get("passthrough")
+        if isinstance(pt, dict):
+            s["passthrough_rate"] = pt.get("rate")
+            s["passthrough_reasons"] = pt.get("reasons") or {}
+        else:
+            fits = r.get("fits") or 0
+            s["passthrough_rate"] = (round(s["passthrough"] / fits, 4)
+                                     if fits else 0.0)
+            s["passthrough_reasons"] = {}
         out.append(s)
     return out
+
+
+def passthrough_rollup(records: list[dict]) -> dict:
+    """Cross-drain passthrough rollup: total rate + top reason tokens
+    (the batchable-frontier regression signal — a model class silently
+    falling off the batchable set shows up here first)."""
+    fits = pt = 0
+    reasons: dict[str, int] = {}
+    for r in records:
+        if r.get("type") != "serve":
+            continue
+        fits += int(r.get("fits") or 0)
+        blk = r.get("passthrough")
+        if isinstance(blk, dict):
+            pt += int(blk.get("requests") or 0)
+            for k, v in (blk.get("reasons") or {}).items():
+                reasons[k] = reasons.get(k, 0) + int(v)
+        else:
+            pt += sum(1 for b in (r.get("batch_detail") or [])
+                      if b.get("kind") == "passthrough")
+    return {"fits": fits, "passthrough_requests": pt,
+            "rate": round(pt / fits, 4) if fits else 0.0,
+            "top_reasons": dict(sorted(reasons.items(),
+                                       key=lambda kv: -kv[1])[:8])}
 
 
 def mesh_summary(records: list[dict]) -> dict:
@@ -457,6 +493,15 @@ def render(summary: dict) -> str:
                 + (f", statuses {s['statuses']}" if s.get("statuses")
                    and set(s["statuses"]) != {"ok"} else "")
                 + (" [DEGRADED]" if s.get("degraded") else ""))
+        # passthrough breakdown (ISSUE 8): the batchable-frontier
+        # regression signal — rate plus the top reason tokens
+        pt = summary["passthrough"]
+        lines.append(
+            f"  passthrough: {pt['passthrough_requests']}/{pt['fits']} "
+            f"request(s) (rate {pt['rate']})")
+        if pt["top_reasons"]:
+            lines.append("    top reasons: " + ", ".join(
+                f"{k}={v}" for k, v in pt["top_reasons"].items()))
     else:
         lines.append("  (no serve records)")
 
@@ -552,6 +597,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "traces": trace_summaries(records),
         "programs": program_summaries(records),
         "serve": serve_summaries(records),
+        "passthrough": passthrough_rollup(records),
         "mesh": mesh_summary(records),
         "faults": fault_summaries(records),
         "caches": cache_rates(records),

@@ -109,13 +109,30 @@ class TOAs:
 
     # -- wideband DM data (reference: pint.toa wideband "-pp_dm"/"-pp_dme"
     # flags consumed by WidebandTOAResiduals) --------------------------
+    def _dm_flag_memo(self, flag: str) -> np.ndarray:
+        """Per-instance memo of an O(n) per-flag float parse. The serve
+        submit path consults the wideband data several times per request
+        (routing, fingerprint family, the traced DM block); flags are
+        treated as immutable after construction (mutation goes through
+        ``dataclasses.replace``, which drops the memo), so the cache
+        cannot go stale — same contract as ``_bucket_pad_memo``."""
+        cache = getattr(self, "_dm_flag_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_dm_flag_cache", cache)
+        out = cache.get(flag)
+        if out is None:
+            out = cache[flag] = np.asarray(
+                [float(f.get(flag, "nan")) for f in self.flags])
+        return out
+
     def get_dm_values(self) -> np.ndarray:
         """Wideband DM measurements [pc/cm^3] from -pp_dm flags (nan absent)."""
-        return np.asarray([float(f.get("pp_dm", "nan")) for f in self.flags])
+        return self._dm_flag_memo("pp_dm")
 
     def get_dm_errors(self) -> np.ndarray:
         """Wideband DM uncertainties [pc/cm^3] from -pp_dme flags."""
-        return np.asarray([float(f.get("pp_dme", "nan")) for f in self.flags])
+        return self._dm_flag_memo("pp_dme")
 
     def is_wideband(self) -> bool:
         """True when every TOA carries a wideband DM measurement."""

@@ -110,6 +110,92 @@ def test_pad_solve_rows_exact():
                                rtol=1e-12)
 
 
+def test_basis_bucket_size_policy():
+    """Pow-2 ECORR epoch buckets (ISSUE 8): floor 8, 0 stays 0 (no
+    ECORR is its own shape), kill switch returns exact counts."""
+    assert bucketing.basis_bucket_size(0) == 0
+    assert bucketing.basis_bucket_size(1) == 8
+    assert bucketing.basis_bucket_size(8) == 8
+    assert bucketing.basis_bucket_size(9) == 16
+    assert bucketing.basis_bucket_size(30) == 32
+    with pytest.raises(ValueError):
+        bucketing.basis_bucket_size(-1)
+
+
+def test_basis_bucket_kill_switch(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_FIT_BUCKETING", "0")
+    assert bucketing.basis_bucket_size(9) == 9
+    assert bucketing.basis_bucket_size(0) == 0
+
+
+def test_pad_basis_cols_bit_comparable():
+    """Satellite (ISSUE 8): zero-padded basis columns with unit priors
+    leave the GLS solution, chi2, AND uncertainties bit-comparable to
+    the exact-shape solve, through the segment-sum Schur path the
+    batched members run (gls_gram_seg + gls_finalize_seg). The padded
+    epochs have zero TOA support, so every Gram/rhs/chi2 contribution
+    is an exact float zero."""
+    from pint_tpu.fitting.gls_step import gls_finalize_seg, gls_gram_seg
+
+    rng = np.random.default_rng(3)
+    n, p, ne = 40, 3, 5
+    M = jnp.asarray(rng.normal(size=(n, p)))
+    r = jnp.asarray(rng.normal(size=n))
+    sigma = jnp.asarray(rng.uniform(0.5, 2.0, n))
+    phi = rng.uniform(0.1, 1.0, ne)
+    idx = rng.integers(0, ne + 1, size=n)  # ne = dummy segment
+
+    def solve(phi_e, epoch_idx):
+        parts = gls_gram_seg(M, r, sigma, None, None,
+                             jnp.asarray(epoch_idx, jnp.int32),
+                             jnp.asarray(phi_e))
+        return gls_finalize_seg(parts, p)
+
+    exact = solve(phi, idx)
+    # pad 5 -> 8 epoch columns; remap the dummy segment to slot 8
+    (phi_pad,) = bucketing.pad_basis_cols(8, phi)
+    np.testing.assert_array_equal(phi_pad[ne:], 1.0)
+    idx_pad = np.where(idx == ne, 8, idx)
+    padded = solve(phi_pad, idx_pad)
+    # every padded-epoch contribution is an EXACT zero in the Schur
+    # system (zero TOA support -> zero segment sums)...
+    parts = gls_gram_seg(M, r, sigma, None, None,
+                         jnp.asarray(idx_pad, jnp.int32),
+                         jnp.asarray(phi_pad))
+    np.testing.assert_array_equal(np.asarray(parts["C"])[ne:], 0.0)
+    np.testing.assert_array_equal(np.asarray(parts["c_e"])[ne:], 0.0)
+    np.testing.assert_array_equal(np.asarray(parts["d"])[ne:], 1.0)
+    np.testing.assert_array_equal(np.asarray(padded["ecorr_coeffs"])[ne:],
+                                  0.0)
+    # ...so the solution/chi2/uncertainties are bit-comparable: the
+    # only freedom left is XLA's reduction-tree split for the larger
+    # contraction (observed <= 1 ulp; the pad_solve_rows class)
+    for key in ("x", "chi2"):
+        np.testing.assert_allclose(np.asarray(exact[key]),
+                                   np.asarray(padded[key]),
+                                   rtol=1e-14, atol=0, err_msg=key)
+    np.testing.assert_allclose(
+        np.sqrt(np.diagonal(np.asarray(exact["cov"]))),
+        np.sqrt(np.diagonal(np.asarray(padded["cov"]))), rtol=1e-13)
+    # validation: shrinking is an error, None passes through
+    with pytest.raises(ValueError):
+        bucketing.pad_basis_cols(3, phi)
+    phi2, none_mat = bucketing.pad_basis_cols(8, phi, None)
+    assert none_mat is None and phi2.shape == (8,)
+
+
+def test_pad_basis_cols_matrix_axis():
+    """Basis matrices column-pad with exact zeros (the dense-T (n, ne)
+    shape analogue; axis 1 is the epoch-column axis)."""
+    rng = np.random.default_rng(4)
+    T = rng.normal(size=(10, 5))
+    phi = rng.uniform(0.1, 1.0, 5)
+    phi_p, T_p = bucketing.pad_basis_cols(8, phi, T)
+    assert T_p.shape == (10, 8)
+    np.testing.assert_array_equal(T_p[:, :5], T)
+    np.testing.assert_array_equal(T_p[:, 5:], 0.0)
+
+
 def test_cross_size_dense_fit_compiles_once():
     """ISSUE-2 acceptance: two different-n datasets, one process, one
     compile — the second DownhillWLSFitter fit's counter delta shows

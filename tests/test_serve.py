@@ -386,10 +386,13 @@ def test_unresolved_handle_raises(toas_a):
 # passthrough: models the vmapped WLS union cannot express
 # ----------------------------------------------------------------------
 
-def test_noise_model_passthrough(toas_a):
-    """A correlated-noise request is served (singleton passthrough) and
-    matches the standalone Fitter.auto fit; a WLS request in the same
-    drain still batches."""
+def test_noise_model_batches(toas_a):
+    """ISSUE 8: a correlated-noise request is a first-class BATCH
+    member (its own fingerprint group — the noise basis splits the
+    structure key, never the route) and matches the standalone
+    Fitter.auto fit; a WLS request in the same drain batches
+    separately. The PR-5 passthrough routing is pinned under the kill
+    switch in tests/test_serve_frontier.py."""
     from pint_tpu.fitting.fitter import Fitter
 
     par_n = PAR + NOISE
@@ -399,23 +402,28 @@ def test_noise_model_passthrough(toas_a):
     s.submit(_request(par_n, toas_n, tag="noise", maxiter=6))
     s.submit(_request(PAR, toas_a, tag="wls", maxiter=6))
     plans = s.plan()
-    assert sorted(p.kind for p in plans) == ["batched", "passthrough"]
+    assert [p.kind for p in plans] == ["batched", "batched"]
+    assert plans[0].group != plans[1].group  # noise splits the group
     res = {r.tag: r for r in s.drain()}
-    assert res["noise"].passthrough and not res["wls"].passthrough
+    assert not res["noise"].passthrough and not res["wls"].passthrough
+    assert s.last_drain["passthrough"]["requests"] == 0
     assert np.isfinite(res["noise"].chi2)
 
     ref = get_model(par_n)
     ref["F0"].add_delta(2e-10)
     f = Fitter.auto(toas_n, ref)
+    assert type(f).__name__ == "DownhillGLSFitter"
     chi2_ref = f.fit_toas(maxiter=6)
-    assert res["noise"].chi2 == pytest.approx(chi2_ref, rel=1e-9)
+    assert res["noise"].chi2 == pytest.approx(chi2_ref, rel=1e-8)
     assert res["noise"].converged == bool(f.converged)
 
 
-def test_wideband_passthrough(toas_a):
+def test_wideband_batches(toas_a):
     """Wideband-ness lives on the TOAs, not the model: the SAME model
-    with a wideband table must route passthrough (Fitter.auto picks the
-    wideband fitter there) while its narrowband twin batches."""
+    with a wideband table batches in its own ("wb" family) group —
+    running the fused joint TOA+DM step — while its narrowband twin
+    batches separately, and the result matches the standalone
+    WidebandDownhillFitter."""
     from pint_tpu.fitting.fitter import Fitter
 
     truth = get_model(PAR)
@@ -428,16 +436,17 @@ def test_wideband_passthrough(toas_a):
     s.submit(_request(PAR, toas_wb, tag="wb", maxiter=6))
     s.submit(_request(PAR, toas_a, tag="nb", maxiter=6))
     plans = s.plan()
-    assert sorted(p.kind for p in plans) == ["batched", "passthrough"]
+    assert [p.kind for p in plans] == ["batched", "batched"]
+    assert plans[0].group != plans[1].group  # wideband bit splits
     res = {r.tag: r for r in s.drain()}
-    assert res["wb"].passthrough and not res["nb"].passthrough
+    assert not res["wb"].passthrough and not res["nb"].passthrough
 
     ref = get_model(PAR)
     ref["F0"].add_delta(2e-10)
     f = Fitter.auto(toas_wb, ref)
     assert type(f).__name__ == "WidebandDownhillFitter"
     chi2_ref = f.fit_toas(maxiter=6)
-    assert res["wb"].chi2 == pytest.approx(chi2_ref, rel=1e-9)
+    assert res["wb"].chi2 == pytest.approx(chi2_ref, rel=1e-8)
     assert res["wb"].converged == bool(f.converged)
 
 

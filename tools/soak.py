@@ -545,9 +545,17 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
             par_v = "\n".join(ln for ln in par.splitlines()
                               if not ln.startswith("F1 ")) + "\n"
             have_variant = par_v != par and "F2 " not in par
+            # noise_batch axis (ISSUE 8): half the serve trials inject
+            # a correlated-noise basis into part of the mix, so GLS
+            # members land INSIDE batches (their own fingerprint
+            # group), not just as whole-trial noise structures
+            noise_batch = bool(srng.random() < 0.5)
             specs = []
             for j in range(k_req):
                 par_j = (par_v if have_variant and j % 2 else par)
+                if noise_batch and j % 2 == 0 and "ECORR" not in par_j:
+                    par_j = (par_j + "ECORR -fe L-wide "
+                             f"{srng.uniform(0.5, 1.5):.3f}\n")
                 m_truth = get_model(par_j, allow_tcb=True)
                 t_j = _sim_flagged_toas(m_truth, srng,
                                         int(srng.integers(60, 140)))
@@ -573,7 +581,15 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
                 "occupancy": sched.last_drain["occupancy"],
                 "passthrough": sum(r.passthrough for r in serve_res),
                 "mesh_devices": serve_mdev,
+                "noise_batch": noise_batch,
             }
+            if noise_batch:
+                # the injected GLS members must actually batch (the
+                # widened frontier, not the passthrough route)
+                assert not any(
+                    r.passthrough for r in serve_res
+                    if "ECORR" in specs[r.tag][0]), (
+                    "noise-basis member routed passthrough")
             for r in serve_res:
                 par_j, t_j = specs[r.tag]
                 assert np.isfinite(r.chi2), f"serve chi2 not finite ({r.tag})"
@@ -624,9 +640,16 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
             par_v = "\n".join(ln for ln in par.splitlines()
                               if not ln.startswith("F1 ")) + "\n"
             have_variant = par_v != par and "F2 " not in par
+            # noise_batch axis (ISSUE 8): chaos also randomizes noise-
+            # basis members INTO batches, so fault isolation/salvage/
+            # quarantine run against the GLS union path too
+            noise_batch = bool(crng.random() < 0.5)
             specs = []
             for j in range(k_req):
                 par_j = (par_v if have_variant and j % 2 else par)
+                if noise_batch and j % 2 == 0 and "ECORR" not in par_j:
+                    par_j = (par_j + "ECORR -fe L-wide "
+                             f"{crng.uniform(0.5, 1.5):.3f}\n")
                 m_truth = get_model(par_j, allow_tcb=True)
                 t_j = _sim_flagged_toas(m_truth, crng,
                                         int(crng.integers(50, 110)))
@@ -693,6 +716,7 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
                 "statuses": statuses, "injected": injected,
                 "failed_batches": sched.last_drain["failed_batches"],
                 "mesh_devices": chaos_mdev,
+                "noise_batch": noise_batch,
             }
 
         # checkpoint contract: par round-trip preserves the phase model
