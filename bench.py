@@ -1404,6 +1404,175 @@ def bench_throughput_mesh(n_fits: int, reps: int = 3) -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def _bench_fit_throughput_incremental(n: int = 100_000, k_append: int = 8,
+                                      reps: int = 8) -> dict:
+    """The ISSUE-10 acceptance A/B: appending ``k_append`` TOAs to a
+    converged ``n``-TOA WLS solution, sessionful rank-k incremental
+    update vs the cold fused fit over the same accumulated table.
+
+    Both sides start from the SAME converged parameter values (the
+    honest comparator: without the session layer the best a service can
+    do is a warm-started full fused fit — its Gram/residual reduction
+    still walks all n rows per evaluation, the incremental path only
+    the append bucket). Reported: p50/p95 update latency (submit +
+    drain through the scheduler, the service-level number), the cold
+    side's p50 over ``reps`` warmed fits, the speedup (acceptance:
+    >= 10x), the measured chi2 drift vs the full refit (must sit inside
+    the documented :data:`pint_tpu.serve.session.DRIFT_CHI2_REL` gate),
+    and the one-launch/one-fetch counter pin per update.
+    """
+    import copy
+
+    from pint_tpu import telemetry
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import (DRIFT_CHI2_REL, FitRequest,
+                                ThroughputScheduler)
+    from pint_tpu.toas import merge_TOAs
+
+    par = _strip_par_lines(PAR, ("EFAC", "ECORR", "TNREDAMP",
+                                 "TNREDGAM", "TNREDC"))
+    rng = np.random.default_rng(13)
+    truth = get_model(par)
+    with telemetry.span("bench.build_problem", n=n):
+        toas = _sim_toas(truth, n, rng)
+    appends = []
+    for i in range(reps + 1):
+        mjds = np.sort(rng.uniform(58010 + 20 * i, 58025 + 20 * i,
+                                   size=k_append))
+        from pint_tpu.ops.dd import DD
+        from pint_tpu.simulation import make_fake_toas_from_arrays
+
+        appends.append(make_fake_toas_from_arrays(
+            DD(np.asarray(mjds), np.zeros(k_append)), truth,
+            freq_mhz=np.full(k_append, 1400.0), error_us=1.0, obs="gbt",
+            add_noise=True, seed=int(rng.integers(2 ** 31)), niter=2))
+
+    hyper = dict(maxiter=20, min_chi2_decrease=1e-3)
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    s = ThroughputScheduler(max_queue=8)
+    t0 = time.perf_counter()
+    s.submit(FitRequest(toas, m, tag="populate", session_id="bench",
+                        **hyper))
+    res0 = s.drain()
+    populate_s = time.perf_counter() - t0
+    assert res0[0].status == "ok", res0[0].error
+    entry = s.sessions.entries[s.sessions._by_sid["bench"]]
+    m_conv = copy.deepcopy(entry.model)  # converged values, pre-append
+
+    # warm the incremental program on append 0, then time reps appends
+    def one_append(app):
+        t0 = time.perf_counter()
+        s.submit(FitRequest(app, None, session_id="bench", **hyper))
+        out = s.drain()
+        return time.perf_counter() - t0, out[0]
+
+    cold_update_s, r0 = one_append(appends[0])
+    assert r0.session == "incremental", (r0.session, r0.error)
+    walls, launches, fetches = [], 0, 0
+    for app in appends[1:]:
+        before = telemetry.counters_snapshot()
+        w, r = one_append(app)
+        delta = telemetry.counters_delta(before)
+        assert r.session == "incremental", (r.session, r.error)
+        walls.append(w)
+        launches += int(delta.get("fit.device_loop.launches", 0))
+        fetches += int(delta.get("fit.device_loop.fetches", 0))
+    p50 = float(np.percentile(walls, 50))
+    p95 = float(np.percentile(walls, 95))
+
+    # cold fused comparator (the acceptance's baseline): the full fused
+    # fit a STATELESS service runs for this append — the request's own
+    # perturbed model over the accumulated (n + k) rows, full damped
+    # chain. Warmed once (program compile excluded), then timed. The
+    # warm-started refit (same fit from the session's converged values
+    # — what the session layer itself runs on a gate trip) is reported
+    # alongside as the conservative secondary comparator.
+    merged0 = merge_TOAs([toas, appends[0]])
+    cold_walls, warm_walls = [], []
+    chi2_cold = conv_cold = None
+    for i in range(max(3, min(reps, 5)) + 1):
+        m_cold = get_model(par)
+        m_cold["F0"].add_delta(2e-10)
+        t0 = time.perf_counter()
+        _d, _info, chi2_cold, conv_cold, _ = device_loop.dense_wls_fit(
+            merged0, m_cold, **hyper)
+        if i:  # first pass carries the exact-shape compile
+            cold_walls.append(time.perf_counter() - t0)
+        m_warm = copy.deepcopy(m_conv)
+        t0 = time.perf_counter()
+        _d2, _i2, chi2_warm, _c2, _ = device_loop.dense_wls_fit(
+            merged0, m_warm, **hyper)
+        if i:
+            warm_walls.append(time.perf_counter() - t0)
+    cold_p50 = float(np.percentile(cold_walls, 50))
+    warm_p50 = float(np.percentile(warm_walls, 50))
+
+    # drift vs the full refit at the first append point: the session's
+    # quadratic-model chi2 for append 0 against the exact warm-started
+    # refit chi2 (the session layer's own gate-trip path)
+    drift_rel = abs(float(r0.chi2) - float(chi2_warm)) \
+        / max(abs(float(chi2_warm)), 1e-12)
+    blk = s.last_drain.get("sessions") or {}
+    return {
+        "n_toas": n,
+        "k_append": k_append,
+        "reps": len(walls),
+        "hyper": dict(hyper),
+        "populate_s": round(populate_s, 3),
+        "incremental_cold_s": round(cold_update_s, 3),
+        "p50_update_s": round(p50, 6),
+        "p95_update_s": round(p95, 6),
+        "cold_fused_p50_s": round(cold_p50, 4),
+        "cold_fused_walls": [round(t, 4) for t in cold_walls],
+        "warm_refit_p50_s": round(warm_p50, 4),
+        "warm_refit_walls": [round(t, 4) for t in warm_walls],
+        "update_walls": [round(t, 6) for t in walls],
+        "speedup_p50": round(cold_p50 / max(p50, 1e-12), 1),
+        "speedup_vs_warm_refit": round(warm_p50 / max(p50, 1e-12), 1),
+        "target_speedup": 10.0,
+        "speedup_ok": bool(cold_p50 / max(p50, 1e-12) >= 10.0),
+        "chi2_incremental": round(float(r0.chi2), 6),
+        "chi2_full_refit": round(float(chi2_warm), 6),
+        "chi2_cold_fit": round(float(chi2_cold), 6),
+        "chi2_drift_rel": float(f"{drift_rel:.3g}"),
+        "drift_gate_rel": DRIFT_CHI2_REL,
+        "drift_ok": bool(drift_rel < DRIFT_CHI2_REL),
+        "cold_converged": bool(conv_cold),
+        # the rank-k counter pin: ONE launch + ONE fetch per update
+        "launches_per_update": launches / max(1, len(walls)),
+        "fetches_per_update": fetches / max(1, len(walls)),
+        "sessions_drain_block": blk,
+    }
+
+
+def bench_throughput_incremental(n: int, reps: int = 8) -> None:
+    """Standalone incremental-session mode
+    (``PINT_TPU_BENCH_MODE=throughput_incremental``; ISSUE 10).
+
+    ``vs_baseline`` is the cold-fused-over-incremental p50 speedup —
+    the >= 10x acceptance reads directly off the compact line.
+    """
+    from pint_tpu import telemetry
+
+    metric = f"fit_incremental_{n}toas_p50_update_wall"
+    try:
+        with telemetry.span("bench.fit_throughput_incremental"):
+            rec = _bench_fit_throughput_incremental(n=n, reps=reps)
+        out = {"metric": metric, "value": rec["p50_update_s"],
+               "unit": "s", "vs_baseline": rec["speedup_p50"],
+               "backend": jax.default_backend(),
+               "host_cores": os.cpu_count(),
+               "mode": "throughput_incremental",
+               "fit_incremental": rec}
+        out.update(_telemetry_fields())
+        _emit(out)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
 def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
                  backend: str, device: str, dd_ok_accel: bool) -> None:
     """GLS iteration with the CPU-DD -> accelerator-solve split.
@@ -1543,6 +1712,15 @@ def _compact(record: dict, detail_name: str) -> dict:
             k: ftm[k] for k in _THROUGHPUT_COMPACT
             + ("passthrough_rate", "launches_timed_drain",
                "fetches_timed_drain") if k in ftm}
+    fi = record.get("fit_incremental")
+    if isinstance(fi, dict):
+        out["fit_incremental"] = {
+            k: fi[k] for k in
+            ("n_toas", "k_append", "p50_update_s", "p95_update_s",
+             "cold_fused_p50_s", "warm_refit_p50_s", "speedup_p50",
+             "speedup_vs_warm_refit", "speedup_ok", "chi2_drift_rel",
+             "drift_ok", "launches_per_update", "fetches_per_update")
+            if k in fi}
     pta = record.get("pta")
     if isinstance(pta, dict):
         out["pta"] = {k: pta[k] for k in _COMPACT_KEYS if k in pta}
@@ -1560,7 +1738,7 @@ def _compact(record: dict, detail_name: str) -> dict:
         if not fits() and isinstance(out.get(key), str):
             out[key] = out[key][:200]
     for key in ("pta", "fit_throughput", "fit_throughput_mixed",
-                "fit_loop", "mfu_pct",
+                "fit_incremental", "fit_loop", "mfu_pct",
                 "gflops_s", "design_matrix_ms_per_toa", "mode", "device",
                 "load1_start", "wall_median", "wall_spread_pct",
                 "fallback_reason"):
@@ -1677,6 +1855,10 @@ def main() -> None:
         # of >= 2 members formed (passthrough rate 0) with parity
         frontier = res.get("frontier") or {}
         ok = ok and frontier.get("ok") is True
+        # incremental-session smoke acceptance (ISSUE 10): rank-k
+        # append path taken, drift inside the gate, one launch/update
+        incremental = res.get("incremental") or {}
+        ok = ok and incremental.get("ok") is True
         if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
@@ -1905,10 +2087,10 @@ def _smoke_frontier() -> dict:
     hyper = dict(maxiter=8, min_chi2_decrease=1e-5)
     reqs, standalone = [], []
     for i in range(2):
-        # EFAC is a genuine trace constant (scale_sigma reads it at
-        # trace time) so it must match across the batch; the ECORR
-        # VALUE rides the traced statics and may differ per member
-        par_i = (par + "EFAC -f fake 1.2\n"
+        # EFAC and ECORR VALUES both ride the traced statics (ISSUE 10
+        # satellite: per-TOA scaled sigmas + ECORR priors), so they
+        # differ per member — one batch, one compiled program
+        par_i = (par + f"EFAC -f fake 1.{2 + i}\n"
                        f"ECORR -f fake 1.{1 + i}\n").replace(
             "61.485476554", f"{61.485476554 + 1e-3 * i:.9f}")
         truth = get_model(par_i)
@@ -2036,6 +2218,79 @@ def _smoke_chaos() -> dict:
                 if chaos_res[3].trace else 0)}
 
 
+def _smoke_incremental() -> dict:
+    """CI incremental-session smoke (ISSUE 10): populate a session,
+    append twice — asserting the rank-k path is taken (route token +
+    ONE fused launch/fetch per update), the chi2 drift vs a full fused
+    refit over the accumulated table sits inside the documented gate,
+    and the drain record carries the sessions block."""
+    import copy as _copy
+
+    from pint_tpu import telemetry
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import (DRIFT_CHI2_REL, FitRequest,
+                                ThroughputScheduler)
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.toas import merge_TOAs
+
+    par = ("PSRJ FAKE_SESSION\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    hyper = dict(maxiter=8, min_chi2_decrease=1e-5)
+    truth = get_model(par)
+    toas = make_fake_toas_uniform(53000, 56000, 40, truth, obs="@",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=2.0, add_noise=True, seed=120)
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    s = ThroughputScheduler(max_queue=4)
+    s.submit(FitRequest(toas, m, session_id="smoke", **hyper))
+    r0 = s.drain()[0]
+    entry = s.sessions.entries[s.sessions._by_sid["smoke"]]
+    m_conv = _copy.deepcopy(entry.model)
+    tables = [toas]
+    launches = fetches = 0
+    routes = []
+    last = None
+    for i in range(2):
+        app = make_fake_toas_uniform(56010 + 30 * i, 56030 + 30 * i, 3,
+                                     truth, obs="@", freq_mhz=1400.0,
+                                     error_us=2.0, add_noise=True,
+                                     seed=130 + i)
+        tables.append(app)
+        before = telemetry.counters_snapshot()
+        s.submit(FitRequest(app, None, session_id="smoke", **hyper))
+        last = s.drain()[0]
+        delta = telemetry.counters_delta(before)
+        launches += int(delta.get("fit.device_loop.launches", 0))
+        fetches += int(delta.get("fit.device_loop.fetches", 0))
+        routes.append(last.session)
+    # parity pin: full fused refit over the accumulated table from the
+    # converged pre-append values
+    merged = merge_TOAs(tables)
+    _d, _i2, chi2_full, _c, _cnt = device_loop.dense_wls_fit(
+        merged, _copy.deepcopy(m_conv), **hyper)
+    drift = abs(last.chi2 - float(chi2_full)) \
+        / max(abs(float(chi2_full)), 1e-12)
+    blk = (s.last_drain or {}).get("sessions") or {}
+    ok = (r0.status == "ok" and r0.session == "populate"
+          and routes == ["incremental", "incremental"]
+          and last.status == "ok"
+          and launches == 2 and fetches == 2
+          and drift < DRIFT_CHI2_REL
+          and blk.get("routes", {}).get("incremental") == 1
+          and blk.get("p50_update_s") is not None)
+    return {"ok": ok, "routes": routes,
+            "chi2_incremental": round(float(last.chi2), 6),
+            "chi2_full_refit": round(float(chi2_full), 6),
+            "chi2_drift_rel": float(f"{drift:.3g}"),
+            "drift_gate_rel": DRIFT_CHI2_REL,
+            "launches": launches, "fetches": fetches,
+            "p50_update_s": blk.get("p50_update_s")}
+
+
 def _run_smoke() -> None:
     """CI smoke: one tiny CPU fit proving the telemetry pipeline end-to-end.
 
@@ -2075,6 +2330,10 @@ def _run_smoke() -> None:
         # mixed-frontier smoke (ISSUE 8): a GLS+ECORR batch every pass
         with telemetry.span("bench.frontier_smoke"):
             frontier = _smoke_frontier()
+        # incremental-session smoke (ISSUE 10): the rank-k append path
+        # + drift gate parity every CI pass
+        with telemetry.span("bench.incremental_smoke"):
+            incremental = _smoke_incremental()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
@@ -2082,7 +2341,7 @@ def _run_smoke() -> None:
                "chi2": round(float(chi2), 3),
                "converged": bool(f.converged),
                "serve": serve, "chaos": chaos, "mesh": mesh,
-               "frontier": frontier}
+               "frontier": frontier, "incremental": incremental}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001
@@ -2101,7 +2360,8 @@ def _main_guarded() -> None:
     reps = max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "5")))
     mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
     if mode in ("pta", "wideband", "batch", "throughput",
-                "throughput_mesh", "throughput_mixed"):
+                "throughput_mesh", "throughput_mixed",
+                "throughput_incremental"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
@@ -2124,6 +2384,10 @@ def _main_guarded() -> None:
             bench_throughput_mixed(
                 int(os.environ.get("PINT_TPU_BENCH_FITS", "64")),
                 max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "3"))))
+        elif mode == "throughput_incremental":
+            bench_throughput_incremental(
+                n, max(5, int(os.environ.get("PINT_TPU_BENCH_REPS",
+                                             "8"))))
         else:
             bench_batch(n_psr, max(1, n // n_psr), reps)
         return

@@ -112,6 +112,25 @@ def member_bucket_size(b: int, *, floor: int = 1) -> int:
     return max(floor, 1 << (b - 1).bit_length())
 
 
+def append_bucket_size(k: int, *, floor: int = 8) -> int:
+    """Canonical TOA count for a sessionful APPEND table of ``k`` rows.
+
+    The incremental-refit analogue of :func:`bucket_size` (ISSUE 10):
+    an append of 1..8 new TOAs pads to one pow-2 bucket with the
+    standard zero-weight rows, so "+5 TOAs" and "+8 TOAs" execute ONE
+    compiled rank-k update program per model structure instead of one
+    per append size. The floor is small (appends are small by
+    definition) and there is no ceiling: a pathological giant "append"
+    is routed to a full refit by the session layer before it gets here.
+    Disabled (``PINT_TPU_FIT_BUCKETING=0``) it returns the exact count.
+    """
+    if k <= 0:
+        raise ValueError(f"append_bucket_size needs k >= 1, got {k}")
+    if not enabled():
+        return k
+    return max(floor, 1 << (k - 1).bit_length())
+
+
 def basis_bucket_size(ne: int, *, floor: int = 8) -> int:
     """Canonical ECORR epoch-column count for a noise basis of ``ne``
     epochs (the batchable-frontier analogue of :func:`bucket_size`).

@@ -386,21 +386,43 @@ class InFlightFit:
         return self._result
 
 
+def _donate_operands() -> bool:
+    """Donate the operand pytree to the loop program? Accelerators only:
+    this jaxlib's XLA:CPU has no input-output aliasing (donation there
+    warns and no-ops — the PR-2 / hybrid ``stage2_donate_argnums``
+    rule)."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 — donation is an optimization only
+        return False
+
+
 def _dispatch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
-              shape) -> InFlightFit:
+              shape, donate_state=False) -> InFlightFit:
     """Shared launch head of the runners: one cached-program lookup, one
     launch, NO host sync — the returned handle's :meth:`InFlightFit
-    .fetch` is the fit's single device->host sync."""
+    .fetch` is the fit's single device->host sync.
+
+    ``donate_state=True`` donates the operand pytree (argument 1) to
+    the compiled program on accelerator backends — the sessionful
+    incremental update's cached buffers are replaced by the update, so
+    XLA may alias their memory for the new factor (ISSUE 10)."""
     from pint_tpu.bucketing import note_program
 
     # the recorder changes the carry (hence the compiled program), so
-    # it is part of the cache key; ditto the ring capacity
+    # it is part of the cache key; ditto the ring capacity and the
+    # donation flag (donated programs have a different buffer contract)
     rec_on = recorder.active()
-    cache_key = (key, rec_on, recorder.trace_len() if rec_on else 0)
+    donate = bool(donate_state) and _donate_operands()
+    cache_key = (key, rec_on, recorder.trace_len() if rec_on else 0,
+                 donate)
     entry = _LOOP_CACHE.get_lru(cache_key)
     if entry is None:
         entry = _LOOP_CACHE.put_lru(
-            cache_key, {"jit": jax.jit(builder(rec_on)), "aot": {}})
+            cache_key,
+            {"jit": jax.jit(builder(rec_on),
+                            donate_argnums=(1,) if donate else ()),
+             "aot": {}})
     prog, fresh, sig = _resolve_program(entry, deltas0, operands, hyper)
     note_program(kind, fingerprint, tuple(shape), compiled=fresh)
     telemetry.inc("fit.device_loop.launches")
@@ -423,7 +445,8 @@ def _dispatch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
 def dispatch_damped(full, deltas0, operands, *, key, probe=None,
                     maxiter=20, min_chi2_decrease=1e-3,
                     max_step_halvings=8, kind="device_loop",
-                    fingerprint=None, shape=()) -> InFlightFit:
+                    fingerprint=None, shape=(),
+                    donate_state=False) -> InFlightFit:
     """Asynchronous :func:`run_damped`: enqueue the fused scalar loop
     and return its :class:`InFlightFit` handle without blocking.
 
@@ -438,7 +461,7 @@ def dispatch_damped(full, deltas0, operands, *, key, probe=None,
         lambda rec: build_damped_loop(full, probe, record=rec), key,
         deltas0, operands,
         (maxiter, min_chi2_decrease, max_step_halvings), kind=kind,
-        fingerprint=fingerprint, shape=shape)
+        fingerprint=fingerprint, shape=shape, donate_state=donate_state)
 
 
 def run_damped(full, deltas0, operands, *, key, probe=None, maxiter=20,
@@ -936,6 +959,22 @@ def dispatch_damped_batched(run, deltas0, operands, *, key, probe=None,
 # dense (single-device, bucketed) convenience entry points
 # ----------------------------------------------------------------------
 
+def _maybe_trace_sigma(noise, model, toas, n_target):
+    """Attach the traced scaled-sigma vector to dense-fit statics when
+    the EFAC-tracing frontier is on (ISSUE 10 satellite) — the
+    standalone oracles then run the exact arithmetic the batched traced
+    path runs, and one compiled dense program serves every white-noise
+    value set of a structure."""
+    from pint_tpu.fitting.gls_step import (scaled_sigma_np,
+                                           sigma_traceable,
+                                           trace_efac_enabled)
+
+    if not (trace_efac_enabled() and sigma_traceable(model)):
+        return noise
+    return noise._replace(
+        sigma=jnp.asarray(scaled_sigma_np(model, toas, n_target)))
+
+
 def dense_wls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
                   max_step_halvings=8):
     """Fused dense WLS fit: bucketed table, one program, one fetch.
@@ -979,6 +1018,7 @@ def dense_wideband_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
     noise, pl_specs = build_noise_statics(model, toas)
     n_target = bucketing.bucket_size(len(toas))
     noise = pad_noise_statics(noise, n_target)
+    noise = _maybe_trace_sigma(noise, model, toas, n_target)
     dm = build_wb_data(toas, n_target)
     toas_b = bucketing.bucket_toas(toas)
     step = jitted_wb_step(model, pl_specs=pl_specs, counted=False)
@@ -1007,6 +1047,7 @@ def dense_gls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
     noise, pl_specs = build_noise_statics(model, toas)
     n_target = bucketing.bucket_size(len(toas))
     noise = pad_noise_statics(noise, n_target)
+    noise = _maybe_trace_sigma(noise, model, toas, n_target)
     toas_b = bucketing.bucket_toas(toas)
     step = jitted_gls_step(model, pl_specs=pl_specs, counted=False)
     probe = jitted_gls_probe(model, pl_specs=pl_specs)

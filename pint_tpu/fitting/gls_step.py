@@ -66,11 +66,90 @@ class NoiseStatics(NamedTuple):
     ``epoch_idx`` rides the TOA axis (shard it with the table);
     ``ecorr_phi``/``pl_params`` are tiny and replicated. A pulsar-batched
     (B, n) / (B, ne) version works under ``vmap`` unchanged.
+
+    ``sigma`` (ISSUE 10 satellite, the PR-8 residue) optionally carries
+    the EFAC/EQUAD-scaled per-TOA uncertainties [s] as a TRACED (n,)
+    operand: when present, the GLS/wideband steps read it instead of
+    ``model.scaled_toa_uncertainty`` — whose EFAC/EQUAD values are
+    host-side trace constants that would otherwise split compiled
+    programs per white-noise value set. ``None`` (the default, and the
+    only value under the ``PINT_TPU_TRACE_EFAC=0`` kill switch) keeps
+    the pinned-constant behavior bit-for-bit.
     """
 
     epoch_idx: Array  # (n,) int32 in [0, ne]; ne = "no epoch" dummy
     ecorr_phi: Array  # (ne,) prior variances [s^2]
     pl_params: Array  # (n_pl, 2) [log10_amp, gamma] per PLSpec entry
+    sigma: Array | None = None  # (n,) scaled uncertainties [s], or None
+
+
+def trace_efac_enabled() -> bool:
+    """EFAC/EQUAD-tracing gate (read per call so tests can flip it):
+    ``PINT_TPU_TRACE_EFAC=0`` pins white-noise values as trace
+    constants again (the PR-8 behavior, in which mixed-EFAC traffic
+    splits compiled programs and serve batches)."""
+    import os
+
+    return os.environ.get("PINT_TPU_TRACE_EFAC", "") != "0"
+
+
+def scaled_sigma_np(model, toas, n_target: int | None = None) -> np.ndarray:
+    """Numpy mirror of ``model.scaled_toa_uncertainty`` (+ padding).
+
+    The batch-prep path computes one (n,) scaled-uncertainty vector per
+    member on the host — eager jnp ops here would cost an XLA dispatch
+    per selector per member (the ``stack_toas`` lesson), so the
+    EFAC/EQUAD formula (``scale * sqrt(sigma^2 + equad^2)``, the
+    reference convention) is applied in numpy. ``n_target`` extends the
+    result the way ``bucketing.pad_toas`` + in-trace scaling would:
+    padding rows replicate the LAST row's selector masks with
+    ``PAD_ERROR_US`` uncertainty, so the traced vector is elementwise
+    what the pinned path computes on the padded table.
+    """
+    from pint_tpu.bucketing import PAD_ERROR_US
+    from pint_tpu.models.parameter import toa_mask
+
+    sigma = np.asarray(toas.error_us, dtype=np.float64) * 1e-6
+    k = 0 if n_target is None else n_target - len(sigma)
+    if k < 0:
+        raise ValueError(f"n_target {n_target} < ntoas {len(sigma)}")
+    if k:
+        sigma = np.concatenate([sigma, np.full(k, PAD_ERROR_US * 1e-6)])
+
+    def mask_of(selector):
+        m = np.asarray(toa_mask(selector, toas), dtype=np.float64)
+        if k:
+            m = np.concatenate([m, np.full(k, m[-1])])
+        return m
+
+    var = np.square(sigma)
+    scale = np.ones_like(sigma)
+    for c in model.components:
+        if not getattr(c, "is_noise_scale", False):
+            continue
+        for name in getattr(c, "equad_names", ()):
+            p = c.param(name)
+            var = var + mask_of(p.selector) * (p.value_f64 * 1e-6) ** 2
+        for name in getattr(c, "tneq_names", ()):
+            p = c.param(name)
+            var = var + mask_of(p.selector) * 10.0 ** (2.0 * p.value_f64)
+        for name in getattr(c, "efac_names", ()):
+            p = c.param(name)
+            scale = np.where(mask_of(p.selector) != 0.0, p.value_f64,
+                             scale)
+    return scale * np.sqrt(var)
+
+
+def sigma_traceable(model) -> bool:
+    """Can this model's white-noise scaling ride the traced ``sigma``?
+
+    Exactly one noise-scale component: with several, the reference
+    applies them SEQUENTIALLY (each rescales the previous output) and
+    the one-shot numpy mirror above would reassociate the chain. Zero
+    components need no tracing at all (the raw errors are already a
+    traced table leaf)."""
+    return sum(1 for c in model.components
+               if getattr(c, "is_noise_scale", False)) == 1
 
 
 def build_noise_statics(model, toas, *, as_numpy: bool = False
@@ -149,13 +228,24 @@ def pad_noise_statics(noise: NoiseStatics, n_target: int,
         (phi,) = pad_basis_cols(ne_target, phi)
         phi = xp.asarray(phi)
         ne = ne_target
+    sigma = noise.sigma
     if n_target != n:
         pad = xp.full(n_target - n, ne, dtype=xp.int32)
         epoch_idx = xp.concatenate([xp.asarray(epoch_idx, xp.int32),
                                     pad])
-    if epoch_idx is noise.epoch_idx and phi is noise.ecorr_phi:
+        if sigma is not None and int(np.shape(sigma)[0]) == n:
+            # zero-weight padding rows: the pinned path would scale the
+            # PAD sigma by the last row's EFAC, a 1e-24-relative weight
+            # detail already inside the padding contract's round-off
+            from pint_tpu.bucketing import PAD_ERROR_US
+
+            sigma = xp.concatenate([
+                xp.asarray(sigma),
+                xp.full(n_target - n, PAD_ERROR_US * 1e-6)])
+    if (epoch_idx is noise.epoch_idx and phi is noise.ecorr_phi
+            and sigma is noise.sigma):
         return noise
-    return NoiseStatics(epoch_idx, phi, noise.pl_params)
+    return NoiseStatics(epoch_idx, phi, noise.pl_params, sigma)
 
 
 def stack_noise_statics(statics: list[NoiseStatics], n_target: int,
@@ -168,10 +258,16 @@ def stack_noise_statics(statics: list[NoiseStatics], n_target: int,
     Numpy leaves (the caller device-places them with the batch mesh).
     """
     padded = [pad_noise_statics(s, n_target, ne_target) for s in statics]
+    if any(s.sigma is not None for s in padded) \
+            and not all(s.sigma is not None for s in padded):
+        raise ValueError("mixed traced/pinned sigma across a batch; "
+                         "attach sigma to every member or none")
     return NoiseStatics(
         np.stack([np.asarray(s.epoch_idx) for s in padded]),
         np.stack([np.asarray(s.ecorr_phi) for s in padded]),
-        np.stack([np.asarray(s.pl_params) for s in padded]))
+        np.stack([np.asarray(s.pl_params) for s in padded]),
+        (np.stack([np.asarray(s.sigma) for s in padded])
+         if padded and padded[0].sigma is not None else None))
 
 
 def fourier_design(t_s: Array, nharm: int, t_ref=None, tspan=None
@@ -494,7 +590,11 @@ def make_gls_step(model, tzr=None, *, abs_phase: bool = True,
             return (ph.int_part + (ph.frac.hi + ph.frac.lo),
                     ph.frac.hi + ph.frac.lo)
 
-        err = model.scaled_toa_uncertainty(toas)
+        # traced white-noise scaling (ISSUE 10 satellite): when the
+        # statics carry per-TOA scaled sigmas, EFAC/EQUAD values never
+        # enter the trace — mixed-value traffic shares one program
+        err = (noise.sigma if noise.sigma is not None
+               else model.scaled_toa_uncertainty(toas))
         w = 1.0 / jnp.square(err)
 
         J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
@@ -605,7 +705,8 @@ def make_gls_probe(model, tzr=None, *, abs_phase: bool = True,
 
     if traced_tzr:
         def probe_tzr(base, deltas, toas, noise, tzr_toas):
-            r, err, _w = resid(base, deltas, toas, tzr_toas)
+            r, err, _w = resid(base, deltas, toas, tzr_toas,
+                               err=noise.sigma)
             F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
             parts = gls_gram_seg(jnp.zeros((r.shape[0], 0)), r, err, F,
                                  phi_F, noise.epoch_idx, noise.ecorr_phi)
@@ -614,7 +715,7 @@ def make_gls_probe(model, tzr=None, *, abs_phase: bool = True,
         return probe_tzr
 
     def probe(base, deltas, toas, noise: NoiseStatics):
-        r, err, _w = resid(base, deltas, toas)
+        r, err, _w = resid(base, deltas, toas, err=noise.sigma)
         F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)
         parts = gls_gram_seg(jnp.zeros((r.shape[0], 0)), r, err, F, phi_F,
                              noise.epoch_idx, noise.ecorr_phi)

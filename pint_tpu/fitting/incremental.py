@@ -1,0 +1,373 @@
+"""Fused incremental WLS refit: rank-k Gram updates over cached fit state.
+
+Production traffic (ROADMAP item 3) is not cold fits — it is a session
+appending a handful of TOAs to an already-converged solution. The
+damped loop's judged object is the weighted Gauss-Newton system, and at
+a converged point the old data is fully summarized by three cached
+quantities: the (column-normalized) Cholesky factor ``L`` of the Gram
+matrix, the converged chi2, and the absorbed weighted-mean phase
+offset. An append of ``k`` TOAs then never has to touch the old table:
+
+* the old rows' chi2 as a function of a parameter move ``u`` from the
+  converged point is the quadratic ``chi2_0 + ||L^T D u||^2`` (``D`` =
+  the cached column norms; the gradient is ~0 at convergence — what
+  "converged" means);
+* the k new rows are evaluated EXACTLY (phase + jacfwd over the tiny
+  append bucket — :func:`pint_tpu.bucketing.append_bucket_size` pads
+  them with standard zero-weight rows so every append size shares one
+  compiled program);
+* the combined Gauss-Newton factor is the **rank-k Cholesky update**
+  ``L' L'^T = L L^T + A_k^T W A_k``, computed as the R factor of a QR
+  over ``[L^T; sqrt(W) A_k]`` (the numerically-stable classic form —
+  O((q+k) q^2) instead of the O(n q^2) full re-reduction);
+* the whole accept/halve/converge walk runs through the SAME fused
+  damped loop as a cold fit (``fitting.device_loop.dispatch_damped``):
+  warm-started at ``u = 0`` (the cached solution), flight recorder
+  riding the carry, ONE launch and ONE fetch per update.
+
+The updated factor of the last *adopted* evaluation rides the loop's
+``info`` carry, so the session layer (pint_tpu.serve.session) commits
+the refreshed state from the same single fetch. Exactness: for a linear
+model this is recursive least squares (exact); the nonlinear phase
+model makes the quadratic summary drift as parameters move, which is
+why the session layer pins correctness with a chi2-drift gate against
+periodic full refits (see docs/ARCHITECTURE.md "Sessionful serving").
+
+The state vector ``u`` is a flat (q,) array over [Offset?] + free
+params: the implicit phase-offset column of the WLS step is an explicit
+coordinate here (the old fit's mean subtraction profiled it out; the
+incremental objective keeps its correlations through the cached Gram)
+and its solved value folds back into the cached mean at commit time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import telemetry
+
+Array = jax.Array
+
+#: state-dict leaves cached per session (device arrays; see snapshot_state)
+STATE_FIELDS = ("L", "norm", "mu", "chi2")
+
+
+def rank_k_chol_update(L: Array, Aw: Array) -> Array:
+    """Lower Cholesky factor of ``L L^T + Aw^T Aw`` via QR.
+
+    ``Aw`` is (k, q) — the k update rows already weighted (each row
+    ``sqrt(w_i) a_i``). The R factor of ``qr([L^T; Aw])`` satisfies
+    ``R^T R = L L^T + Aw^T Aw`` by construction; a sign fix makes the
+    diagonal positive so the result is a true Cholesky factor. This is
+    the standard stable rank-k update (no downdates here — appended
+    rows only ever ADD information).
+    """
+    R = jnp.linalg.qr(jnp.concatenate([L.T, Aw], axis=0), mode="r")
+    s = jnp.sign(jnp.diagonal(R))
+    s = jnp.where(s == 0.0, 1.0, s)
+    return (R * s[:, None]).T
+
+
+def _state_names(model, params=None) -> tuple[list[str], int]:
+    """(free-param order, offset-coordinate count) of the state vector."""
+    names = list(params) if params is not None else list(model.free_params)
+    off = 0 if model.has_component("PhaseOffset") else 1
+    return names, off
+
+
+def make_incr_rows(model, params=None):
+    """Build ``rows(base, deltas_dict, toas) -> (M, resid_turns, w)``.
+
+    The append-row evaluator shared by the incremental step, probe and
+    gram snapshot: design matrix M (n, q) in the WLS step's exact
+    column convention ([ones/f0?] + [-J/f0]), RAW anchored residual
+    turns (no mean subtraction — the caller centers on the cached
+    mean), and the EFAC/EQUAD weights. The model must carry a TZR
+    anchor (the session layer routes anchorless models to full refits:
+    a wrapped anchorless residual has an arbitrary per-evaluation
+    offset that cannot be compared against a cached mean).
+    """
+    tzr = model.get_tzr_toas()
+    if tzr is None:
+        raise ValueError("incremental refit requires a TZR-anchored "
+                         "model (no AbsPhase: use a full refit)")
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=True)
+    names, off = _state_names(model, params)
+
+    def rows(base, deltas, toas):
+        f0 = base["F0"].hi + base["F0"].lo
+
+        def total_phase(d):
+            ph = phase_fn(base, d, toas)
+            return (ph.int_part + (ph.frac.hi + ph.frac.lo),
+                    ph.frac.hi + ph.frac.lo)
+
+        err = model.scaled_toa_uncertainty(toas)
+        w = 1.0 / jnp.square(err)
+        J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
+        r = resid_turns
+        cols = [] if not off else [jnp.ones_like(r)]
+        for k in names:
+            cols.append(-J[k])
+        M = jnp.stack(cols, axis=1) / f0
+        return M, r, w
+
+    return rows
+
+
+def make_incr_step(model, params=None):
+    """Build the fused incremental full step ``full(u, operands)``.
+
+    ``operands = (base, toas_k, state)`` with ``state`` the cached
+    session dict (:data:`STATE_FIELDS`). One evaluation: append rows at
+    the trial point, rank-k factor update, Gauss-Newton re-solve
+    against [cached quadratic + exact new rows], same ``(new_u, info)``
+    contract as the WLS step so :func:`pint_tpu.fitting.device_loop
+    .build_damped_loop` drives it unchanged. ``info`` additionally
+    carries ``L`` — the UPDATED factor at this evaluation's point —
+    which the loop's adopt-select keeps at the last accepted point, so
+    the refreshed session state arrives in the fit's single fetch.
+    """
+    rows = make_incr_rows(model, params)
+    names, off = _state_names(model, params)
+
+    def full(u, ops):
+        base, toas_k, state = ops
+        f0 = base["F0"].hi + base["F0"].lo
+        d = {k: u[off + i] for i, k in enumerate(names)}
+        M, resid_turns, w = rows(base, d, toas_k)
+        # center on the cached absorbed mean [turns]; the offset state
+        # coordinate u[0] (turns) applies linearly — named params are
+        # already exact in resid_turns via the phase evaluation
+        rc = resid_turns - state["mu"]
+        if off:
+            rc = rc - u[0]
+        r_eff = rc / f0
+        norm = state["norm"]
+        A = M / norm
+        un = norm * u
+        Lu = state["L"].T @ un
+        quad = jnp.sum(jnp.square(Lu))
+        chi2_new = jnp.sum(jnp.square(r_eff) * w)
+        chi2_in = state["chi2"] + quad + chi2_new
+        # rank-k update of the normalized Gram factor, then the GN
+        # normal equations in normalized coordinates:
+        #   (G + A^T W A) v = A^T W r_eff - G u      (all normalized)
+        L_new = rank_k_chol_update(state["L"], A * jnp.sqrt(w)[:, None])
+        g = A.T @ (r_eff * w) - state["L"] @ Lu
+        vn = jax.scipy.linalg.cho_solve((L_new, True), g)
+        cov = jax.scipy.linalg.cho_solve((L_new, True),
+                                         jnp.eye(norm.shape[0]))
+        new_u = u + vn / norm
+        sig = jnp.sqrt(jnp.diagonal(cov)) / norm
+        errors = {k: sig[off + i] for i, k in enumerate(names)}
+        # the REPLACEMENT session state rides info (adopt-selected by
+        # the loop, so the fetched value is the last accepted point's):
+        # updated factor, folded-in offset, pass-through norms. It must
+        # be computed IN-program — the input state buffers are donated
+        # on accelerators, so nothing may touch them after dispatch.
+        mu_new = state["mu"] + u[0] if off else state["mu"]
+        return new_u, {"chi2": chi2_in - vn @ g, "errors": errors,
+                       "chi2_at_input": chi2_in, "L": L_new,
+                       "mu": mu_new, "norm": norm}
+
+    return full
+
+
+def make_incr_probe(model, params=None):
+    """Residual-only judge ``probe(u, operands) -> chi2`` — one phase
+    pass over the append bucket plus the cached quadratic; computes
+    exactly the step's ``chi2_at_input`` expression (no jacfwd, no
+    factor update), the fused loop's cheap halved-trial evaluator."""
+    tzr = model.get_tzr_toas()
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=True)
+    names, off = _state_names(model, params)
+
+    def probe(u, ops):
+        base, toas_k, state = ops
+        f0 = base["F0"].hi + base["F0"].lo
+        d = {k: u[off + i] for i, k in enumerate(names)}
+        ph = phase_fn(base, d, toas_k)
+        err = model.scaled_toa_uncertainty(toas_k)
+        w = 1.0 / jnp.square(err)
+        rc = (ph.frac.hi + ph.frac.lo) - state["mu"]
+        if off:
+            rc = rc - u[0]
+        r_eff = rc / f0
+        un = state["norm"] * u
+        quad = jnp.sum(jnp.square(state["L"].T @ un))
+        return state["chi2"] + quad + jnp.sum(jnp.square(r_eff) * w)
+
+    return probe
+
+
+def make_gram_snapshot(model, params=None):
+    """Build ``snapshot(base, toas) -> state`` — the cached-state
+    factory: one O(n q) pass over the FULL table at the model's current
+    values (deltas = 0, i.e. immediately after a converged fit wrote
+    back), producing the column norms, the normalized Gram's Cholesky
+    factor (same Tikhonov floor as ``wls_solve_gram``), the absorbed
+    weighted-mean offset [turns] and the converged chi2. Jitted per
+    model structure via :func:`jitted_gram_snapshot`."""
+    rows = make_incr_rows(model, params)
+    names, off = _state_names(model, params)
+
+    def snapshot(base, toas):
+        f0 = base["F0"].hi + base["F0"].lo
+        d = {k: jnp.zeros((), jnp.float64) for k in names}
+        M, resid_turns, w = rows(base, d, toas)
+        if off:
+            mu = jnp.sum(resid_turns * w) / jnp.sum(w)
+        else:
+            mu = jnp.zeros((), jnp.float64)
+        r = (resid_turns - mu) / f0
+        norm = jnp.sqrt(jnp.sum(jnp.square(M) * w[:, None], axis=0))
+        norm = jnp.where(norm == 0.0, 1.0, norm)
+        A = M / norm
+        G = A.T @ (A * w[:, None])
+        G = G + jnp.eye(G.shape[0]) * (jnp.finfo(jnp.float64).eps
+                                       * jnp.trace(G))
+        L = jnp.linalg.cholesky(G)
+        chi2 = jnp.sum(jnp.square(r) * w)
+        return {"L": L, "norm": norm, "mu": mu, "chi2": chi2}
+
+    return snapshot
+
+
+def jitted_incr_step(model, params: tuple):
+    """Model-cache-shared :func:`make_incr_step` (the ``jitted_wls_step``
+    convention: one traced program per structure, values through the
+    traced ``base``; uncounted — traced into the fused loop)."""
+    return model._cached_jit(("incr_step", tuple(params)),
+                             lambda owner: make_incr_step(owner, params))
+
+
+def jitted_incr_probe(model, params: tuple):
+    """Model-cache-shared :func:`make_incr_probe`."""
+    return model._cached_jit(("incr_probe", tuple(params)),
+                             lambda owner: make_incr_probe(owner, params))
+
+
+def jitted_gram_snapshot(model, params: tuple):
+    """Model-cache-shared, jitted :func:`make_gram_snapshot`."""
+    return model._cached_jit(
+        ("incr_snapshot", tuple(params)),
+        lambda owner: jax.jit(make_gram_snapshot(owner, params)))
+
+
+def snapshot_state(model, toas) -> dict:
+    """Compute + fetch-free cached state over the (bucketed) full table.
+
+    Returns the device-array state dict (leaves stay on device — they
+    are the session cache's donated working set) plus host metadata the
+    session layer needs (``names``/``off``/``q``). One program launch;
+    accounted as ``incr_snapshot`` in the program-reuse counters.
+    """
+    from pint_tpu import bucketing
+
+    names, off = _state_names(model)
+    toas_b = bucketing.bucket_toas(toas)
+    snap = jitted_gram_snapshot(model, tuple(names))
+    bucketing.note_program("incr_snapshot",
+                           hash(model._fn_fingerprint()),
+                           bucketing.toa_shape(toas_b))
+    with telemetry.jit_span("incr.snapshot"):
+        state = snap(model.base_dd(), toas_b)
+    q = len(names) + off
+    return {"state": state, "names": names, "off": off, "q": q,
+            "bytes": state_bytes(state)}
+
+
+def state_bytes(state: dict) -> int:
+    """Device bytes of one session's cached state."""
+    return int(sum(np.dtype(np.float64).itemsize * int(np.prod(np.shape(x)))
+                   for x in jax.tree.leaves(state)))
+
+
+class InFlightIncrUpdate:
+    """A dispatched incremental update; one fetch, state kept on-device.
+
+    Wraps the loop's :class:`pint_tpu.fitting.device_loop.InFlightFit`:
+    before the host fetch, the replacement session state — the rank-k
+    updated factor, folded mean, pass-through norms and the kept-point
+    chi2, all adopt-selected inside the program — is captured as DEVICE
+    arrays (:attr:`new_state`), so the session cache's working set
+    never round-trips through the host between appends.
+    """
+
+    __slots__ = ("_inner", "_new_state", "_result")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._new_state = None
+        self._result = None
+
+    def ready(self) -> bool:
+        return self._inner.ready()
+
+    def fetch(self):
+        """The update's single device->host sync; idempotent."""
+        if self._result is None:
+            out = self._inner._out  # (deltas, info, chi2, conv, cnt, tr)
+            if out is not None:
+                info_dev = out[1]
+                self._new_state = {
+                    "L": info_dev["L"], "norm": info_dev["norm"],
+                    "mu": info_dev["mu"],
+                    "chi2": info_dev["chi2_at_input"]}
+            self._result = self._inner.fetch()
+        return self._result
+
+    @property
+    def new_state(self) -> dict:
+        """Replacement cached state (device arrays); fetch() first."""
+        if self._result is None:
+            raise RuntimeError("fetch() the update before reading state")
+        return self._new_state
+
+
+def dispatch_incremental(model, toas_append, state, *, names, maxiter=20,
+                         min_chi2_decrease=1e-3, max_step_halvings=8):
+    """Enqueue one fused incremental update; returns the
+    :class:`pint_tpu.fitting.device_loop.InFlightFit` handle.
+
+    ONE launch: append-bucket padding is host-side numpy-free
+    (``bucketing.pad_toas``), the loop program is the same damped state
+    machine every cold fit runs (flight recorder and counters
+    included), and ``handle.fetch()`` is the update's single
+    device->host sync carrying the solution, uncertainties, the
+    rank-k-updated factor and the trace. The cached-state operand is
+    DONATED on accelerator backends (the update replaces it; XLA:CPU
+    has no input aliasing and skips donation — the PR-2 rule).
+    """
+    from pint_tpu import bucketing
+    from pint_tpu.fitting import device_loop
+
+    names = tuple(names)
+    _names, off = _state_names(model, names)
+    step = jitted_incr_step(model, names)
+    probe = jitted_incr_probe(model, names)
+    k_target = bucketing.append_bucket_size(len(toas_append))
+    toas_k = bucketing.pad_toas(toas_append, k_target) \
+        if k_target != len(toas_append) else toas_append
+    if device_loop._donate_operands():
+        # donation consumes EVERY operand buffer. The cached state is
+        # replaced (that is the point) and base_dd is rebuilt per call,
+        # but an exact-bucket append passes the caller's own table —
+        # whose buffers the session keeps alive in entry.pending for
+        # the next full refit — so donate a private copy instead
+        # (O(append bucket) bytes; accelerator backends only)
+        toas_k = jax.tree.map(jnp.array, toas_k)
+    u0 = jnp.zeros(len(names) + off, jnp.float64)
+    telemetry.inc("fit.incremental.dispatched")
+    return InFlightIncrUpdate(device_loop.dispatch_damped(
+        lambda u, ops: step(u, ops), u0,
+        (model.base_dd(), toas_k, state),
+        probe=lambda u, ops: probe(u, ops),
+        key=("incr", id(step), id(probe)),
+        maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings, kind="device_loop_incr",
+        fingerprint=(hash(model._fn_fingerprint()), names),
+        shape=(k_target, len(names) + off), donate_state=True))

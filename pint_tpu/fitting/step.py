@@ -201,12 +201,17 @@ def make_resid_fn(model, tzr=None, *, abs_phase: bool = True,
                                    traced_tzr=traced_tzr)
     has_phoff = model.has_component("PhaseOffset")
 
-    def resid(base, deltas, toas, tzr_toas=None):
+    def resid(base, deltas, toas, tzr_toas=None, err=None):
         f0 = base["F0"].hi + base["F0"].lo
         ph = (phase_fn(base, deltas, toas, tzr_toas) if traced_tzr
               else phase_fn(base, deltas, toas))
         res = ph.frac.hi + ph.frac.lo
-        err = model.scaled_toa_uncertainty(toas)
+        # ``err`` (trace-time override): the GLS/wideband probes pass
+        # the statics-carried scaled sigmas so the probe's weights —
+        # the mean subtraction included — match the full step's traced
+        # EFAC/EQUAD path exactly (ISSUE 10 satellite)
+        if err is None:
+            err = model.scaled_toa_uncertainty(toas)
         w = 1.0 / jnp.square(err)
         if anchorless:
             # same circular re-centering as make_wls_step, so the probe

@@ -235,7 +235,11 @@ def make_wb_step(model, tzr=None, *, abs_phase: bool = True,
             return ((ph.int_part + (ph.frac.hi + ph.frac.lo), dm_m),
                     (ph.frac.hi + ph.frac.lo, dm_m))
 
-        err_t = model.scaled_toa_uncertainty(toas)
+        # traced white-noise scaling (ISSUE 10 satellite): statics-
+        # carried scaled sigmas keep EFAC/EQUAD values out of the trace
+        # (DMEFAC/DMEQUAD stay pinned constants — documented residue)
+        err_t = (noise.sigma if noise.sigma is not None
+                 else model.scaled_toa_uncertainty(toas))
         w_t = 1.0 / jnp.square(err_t)
 
         (J_ph, J_dm), (resid_turns, dm_m) = \
@@ -356,8 +360,10 @@ def make_wb_probe(model, tzr=None, *, abs_phase: bool = True,
                       if hasattr(c, "scale_dm_sigma")]
 
     def probe(base, deltas, toas, noise, dm, tzr_toas=None):
-        r_t, err_t, _w = (resid(base, deltas, toas, tzr_toas) if traced_tzr
-                          else resid(base, deltas, toas))
+        r_t, err_t, _w = (resid(base, deltas, toas, tzr_toas,
+                                err=noise.sigma) if traced_tzr
+                          else resid(base, deltas, toas,
+                                     err=noise.sigma))
         p = model.resolve(base, deltas)
         dm_m = jnp.zeros(np.shape(toas.freq_mhz)[-1])
         for c in dm_comps:

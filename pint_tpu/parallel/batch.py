@@ -151,8 +151,18 @@ def _check_noise_merge(prev, c, name: str) -> None:
             "across the batch; split the batch")
 
 
-def build_union_model(models) -> tuple[TimingModel, dict[str, dict[int, tuple]]]:
+def build_union_model(models, drop_noise_scale: bool = False
+                      ) -> tuple[TimingModel, dict[str, dict[int, tuple]]]:
     """Union of the models' components for batched fitting.
+
+    ``drop_noise_scale=True`` (the traced-EFAC frontier, ISSUE 10
+    satellite) omits every ``ScaleToaError`` from the union entirely:
+    the batched GLS/wideband steps then read the per-member scaled
+    sigmas from the traced ``NoiseStatics.sigma`` operand, so the union
+    model — and its fingerprint, the compiled-program key — carries no
+    white-noise values at all. Only valid for noise/wideband batches
+    whose step consumes statics (the WLS union step has no statics
+    operand and keeps the merged-scale machinery below).
 
     Returns (union_model, owners) where ``owners`` maps each merged
     mask-parameter's synthetic selector key to a per-member dict
@@ -215,6 +225,8 @@ def build_union_model(models) -> tuple[TimingModel, dict[str, dict[int, tuple]]]
                     _check_noise_merge(prev[1], c, name)
                 continue
             if isinstance(c, ScaleToaError):
+                if drop_noise_scale:
+                    continue  # scaling rides NoiseStatics.sigma
                 for p in c.params:
                     kind = p.name.rstrip("0123456789")
                     dk = (("scale", kind, p.selector, p.value_f64)
@@ -449,7 +461,26 @@ class BatchedPulsarFitter:
         from pint_tpu.bucketing import note_batch_occupancy
 
         note_batch_occupancy(self.n_real, len(self.models))
-        self.union, owners = build_union_model(self.models)
+        # traced-EFAC frontier (ISSUE 10 satellite): noise/wideband
+        # batches whose every scaled member's white-noise chain is
+        # expressible as one per-TOA sigma vector ride it as a traced
+        # statics leaf — the union then needs (and gets) no scale
+        # component, so one compiled program serves every EFAC/EQUAD
+        # value mix. PINT_TPU_TRACE_EFAC=0 restores the PR-8 path.
+        from pint_tpu.fitting.gls_step import (sigma_traceable,
+                                               trace_efac_enabled)
+
+        def _has_scale(m):
+            return any(getattr(c, "is_noise_scale", False)
+                       for c in m.components)
+
+        self._trace_sigma = (
+            self.family != "wls" and trace_efac_enabled()
+            and any(_has_scale(m) for m in self.models)
+            and all(sigma_traceable(m) for m in self.models
+                    if _has_scale(m)))
+        self.union, owners = build_union_model(
+            self.models, drop_noise_scale=self._trace_sigma)
 
         # free-parameter union + per-pulsar 0/1 masks. Mask params that
         # were merged (JUMP/EFAC family) are fitted under their synthetic
@@ -567,6 +598,14 @@ class BatchedPulsarFitter:
                 # ONCE below (jnp here would transfer every member's
                 # epoch vector twice — the stack_toas lesson)
                 s, specs = build_noise_statics(m, t, as_numpy=True)
+                if self._trace_sigma:
+                    from pint_tpu.fitting.gls_step import scaled_sigma_np
+
+                    # per-member scaled sigmas over the PADDED length
+                    # (pad rows replicate the last row's selector masks
+                    # at PAD_ERROR weight — elementwise what the pinned
+                    # path computes on the padded stacked table)
+                    s = s._replace(sigma=scaled_sigma_np(m, t, n_max))
                 statics.append(s)
                 specs_list.append(specs)
             if any(sp != specs_list[0] for sp in specs_list[1:]):

@@ -9,11 +9,17 @@ packing with device execution. Every request resolves to a structured
 status (never an exception tearing down a drain): per-request
 isolation, deadlines, transient-error retries, quarantine and a
 degradation ladder, with seed-driven chaos in
-:mod:`pint_tpu.serve.faults`. See docs/ARCHITECTURE.md "Throughput
-engine" and "Failure domains & degradation ladder".
+:mod:`pint_tpu.serve.faults`. Sessionful requests
+(``FitRequest.session_id``; :mod:`pint_tpu.serve.session`) append TOAs
+to a cached converged solution via fused rank-k incremental updates
+instead of paying a cold fit. See docs/ARCHITECTURE.md "Throughput
+engine", "Failure domains & degradation ladder" and "Sessionful
+serving".
 """
 
 from pint_tpu.serve import faults  # noqa: F401
+from pint_tpu.serve.session import (  # noqa: F401
+    DRIFT_CHI2_REL, SessionCache, SessionCacheFull)
 from pint_tpu.serve.fingerprint import (  # noqa: F401
     basis_bucket, batchable, family, noise_batch_enabled, plan_key,
     short_id, structure_fingerprint)
@@ -23,9 +29,10 @@ from pint_tpu.serve.scheduler import (  # noqa: F401
     ThroughputScheduler, transient_error)
 
 __all__ = [
-    "BatchPlan", "FitHandle", "FitRequest", "FitResult", "STATUSES",
-    "ServeQueueFull", "ThroughputScheduler", "basis_bucket", "batchable",
-    "faults", "family", "noise_batch_enabled", "plan_key",
+    "BatchPlan", "DRIFT_CHI2_REL", "FitHandle", "FitRequest",
+    "FitResult", "STATUSES", "ServeQueueFull", "SessionCache",
+    "SessionCacheFull", "ThroughputScheduler", "basis_bucket",
+    "batchable", "faults", "family", "noise_batch_enabled", "plan_key",
     "run_pipeline", "short_id", "structure_fingerprint",
     "transient_error",
 ]

@@ -76,16 +76,31 @@ def family(model, toas=None) -> str:
 
 
 def _noise_value_params(model) -> frozenset:
-    """Names of noise-basis hyperparameters whose VALUES ride the traced
+    """Names of noise hyperparameters whose VALUES ride the traced
     ``NoiseStatics`` operand of the batched GLS/wideband steps — the
-    harmonic-count parameter (shape-static) stays pinned."""
+    harmonic-count parameter (shape-static) stays pinned.
+
+    With EFAC/EQUAD tracing on (``pint_tpu.fitting.gls_step
+    .trace_efac_enabled``, ISSUE 10 satellite), the white-noise scale
+    values join too: the steps read the per-TOA scaled sigmas from the
+    statics, so "same selectors, different EFAC/EQUAD values" must
+    hash equal — mixed-EFAC traffic then shares batches AND compiled
+    programs. Selectors stay pinned (they are structure), and models
+    whose scaling cannot ride the traced vector (several chained
+    noise-scale components — see ``sigma_traceable``) keep their
+    values pinned."""
+    from pint_tpu.fitting.gls_step import (sigma_traceable,
+                                           trace_efac_enabled)
+
     out = set()
+    trace_scale = trace_efac_enabled() and sigma_traceable(model)
     for c in model.components:
-        if not getattr(c, "is_noise_basis", False):
-            continue
-        keep = getattr(c, "_c_name", None)
-        out.update(p.name for p in c.params
-                   if p.is_numeric and p.name != keep)
+        if getattr(c, "is_noise_basis", False):
+            keep = getattr(c, "_c_name", None)
+            out.update(p.name for p in c.params
+                       if p.is_numeric and p.name != keep)
+        elif trace_scale and getattr(c, "is_noise_scale", False):
+            out.update(p.name for p in c.params if p.is_numeric)
     return frozenset(out)
 
 

@@ -173,6 +173,16 @@ class FitRequest:
     from submit: expired before formation -> resolved ``timed_out``
     without running; expired when the result lands -> the fit is
     attached but the status reports the SLA miss.
+
+    ``session_id`` (ISSUE 10) opts the request into the sessionful
+    layer (:mod:`pint_tpu.serve.session`): the FIRST request of a
+    ``(session_id, model structure)`` pair is a normal full fit whose
+    state is committed to the session cache; every LATER request is an
+    **append** — ``toas`` then carries ONLY the new TOAs (``model``
+    may be None: the session's own fitted model is authoritative) and
+    is folded in via the fused rank-k incremental update, falling back
+    to a warm-started full refit outside the incremental path's domain
+    or when a drift gate trips.
     """
 
     toas: Any
@@ -182,6 +192,7 @@ class FitRequest:
     max_step_halvings: int = 8
     tag: Any = None
     deadline_s: float | None = None
+    session_id: Any = None
 
 
 @dataclasses.dataclass
@@ -213,6 +224,7 @@ class FitResult:
     trace: dict | None = None
     retry_after_s: float | None = None
     injected: str | None = None
+    session: str | None = None  # session route token (ISSUE 10)
 
     @property
     def fitted(self) -> bool:
@@ -259,6 +271,10 @@ class BatchPlan:
     """
 
     kind: str                 # "batched" | "sharded" | "passthrough"
+    #                           | "session" (ISSUE 10: sessionful fits —
+    #                           host-routed singletons like passthrough,
+    #                           but the incremental route dispatches one
+    #                           fused async program)
     group: str                # fingerprint short id
     indices: list[int]        # queue positions of the member requests
     toa_bucket: int
@@ -360,7 +376,7 @@ class ThroughputScheduler:
                  toa_shard_min: int = 16384,
                  max_dispatch_retries: int = 2,
                  retry_backoff_s: float = 0.05,
-                 degrade_after: int = 2):
+                 degrade_after: int = 2, session_cache=None):
         import jax
 
         if max_queue < 1 or max_batch_members < 1:
@@ -398,6 +414,12 @@ class ThroughputScheduler:
         self._dev_streak: dict[int, int] = {}  # device -> fail streak
         self._drain_rate: float | None = None  # EWMA fits/s
         self.last_drain: dict | None = None
+        # sessionful layer (ISSUE 10): per-(session, fingerprint) fit
+        # state; shareable across schedulers via the ctor kwarg
+        from pint_tpu.serve.session import SessionCache
+
+        self.sessions = (session_cache if session_cache is not None
+                         else SessionCache())
 
     # ------------------------------------------------------------------
     # degradation ladder
@@ -457,13 +479,44 @@ class ThroughputScheduler:
         self._seq += 1
         injected = None
         plan_f = _faults.active()
-        if plan_f is not None:
+        if plan_f is not None and request.model is not None:
             toas, model, injected = plan_f.corrupt_request(
                 seq, request.toas, request.model)
             if injected is not None:
                 request = dataclasses.replace(request, toas=toas,
                                               model=model)
                 telemetry.inc(f"serve.fault.injected.{injected}")
+        if request.session_id is not None:
+            # sessionful request (ISSUE 10): resolve the cache key once
+            # on the enqueue path; admission backpressure for NEW
+            # sessions fires HERE (SessionCacheFull), before any work
+            # is queued; the entry is pinned until its drain resolves
+            key, entry, fp = self.sessions.resolve(request)
+            mode = ("append" if entry is not None
+                    and entry.model is not None else "create")
+            if mode == "create":
+                if request.model is None:
+                    # the entry exists but holds no committed solution
+                    # (its populate failed/diverged): this is still a
+                    # first contact and needs a model — a structured
+                    # error, not an AttributeError mid-admission
+                    raise ValueError(
+                        f"session {request.session_id!r} has no "
+                        "committed solution (its populate did not "
+                        "complete); resubmit with a model")
+                self.sessions.check_admission(
+                    self.sessions.estimate_bytes(request.model),
+                    self._retry_after_hint(len(self._queue) + 1))
+            self.sessions.pin(key)
+            handle = FitHandle()
+            self._queue.append((request, handle, time.perf_counter(),
+                                fp, {"seq": seq, "injected": injected,
+                                     "basis_bucket": 0, "pt_reason": "",
+                                     "session": {"key": key, "fp": fp,
+                                                 "mode": mode}}))
+            telemetry.inc("serve.requests")
+            telemetry.inc("serve.session.requests")
+            return handle
         handle = FitHandle()
         ok, reason = _fp.batchable(request.model, request.toas)
         fp = _fp.structure_fingerprint(request.model, request.toas)
@@ -523,7 +576,21 @@ class ThroughputScheduler:
         bad_devs = self.degraded_devices()
         groups: dict[tuple, list[int]] = {}
         order: list[tuple] = []
+        plans: list[BatchPlan] = []
         for i, (req, _h, _t, fp, m) in enumerate(self._queue):
+            if m.get("session") is not None:
+                # sessionful singleton (ISSUE 10): never batched — the
+                # incremental route holds per-session state and the
+                # full-refit route runs over the ACCUMULATED table, not
+                # the request's append payload. Emitted first so the
+                # async incremental dispatch overlaps later batch prep;
+                # blast radius is one request by construction, so the
+                # degradation ladder needs no special-casing.
+                plans.append(BatchPlan(
+                    "session", _fp.short_id(fp), [i],
+                    bucketing.bucket_size(len(req.toas)), 1, devices=0,
+                    reason=m["session"]["mode"]))
+                continue
             key = _fp.plan_key(fp, bucketing.bucket_size(len(req.toas)),
                                (req.maxiter, req.min_chi2_decrease,
                                 req.max_step_halvings), self.n_devices,
@@ -532,7 +599,6 @@ class ThroughputScheduler:
                 groups[key] = []
                 order.append(key)
             groups[key].append(i)
-        plans: list[BatchPlan] = []
         load = [0] * self.n_devices  # member-slots placed this pass
         width_cap = largest_pow2_leq(self.n_devices)
 
@@ -649,7 +715,7 @@ class ThroughputScheduler:
     def _envelope(self, entry, *, status, plan=None, chi2=float("nan"),
                   converged=False, error=None, attempts=1, trace=None,
                   retry_after_s=None, passthrough=False,
-                  t_done=None) -> FitResult:
+                  t_done=None, session=None) -> FitResult:
         """Build + resolve one request's result envelope (counters,
         deadline override, fault record)."""
         req, handle, t_sub, _fp_i, meta = entry
@@ -672,7 +738,7 @@ class ThroughputScheduler:
             queue_latency_s=round(t_done - t_sub, 6),
             passthrough=passthrough, status=status, error=error,
             attempts=attempts, trace=trace, retry_after_s=retry_after_s,
-            injected=meta.get("injected"))
+            injected=meta.get("injected"), session=session)
         handle._result = res
         telemetry.inc(f"serve.status.{status}")
         if status not in ("ok", "nonconverged"):
@@ -700,6 +766,27 @@ class ThroughputScheduler:
             "group": plan.group, "kind": plan.kind,
             "members": len(plan.indices), "attempts": failure.attempts,
             "error": f"{type(failure.error).__name__}: {failure.error}"})
+        if plan.kind == "session":
+            # a session stage failure must NOT salvage via a standalone
+            # fit of the request payload: an append's toas are only the
+            # new rows, and the session's committed HOST solution is
+            # intact (the cache only updates on success) — resolve
+            # ``failed`` and let the caller retry the append. The
+            # DEVICE state, however, may have been donated to the
+            # failed program (accelerators): invalidate it so the
+            # retry full-refits and repopulates instead of reading
+            # deleted buffers forever.
+            telemetry.inc("serve.fault.request")
+            for i in plan.indices:
+                sm = live[i][4].get("session")
+                if sm is not None:
+                    self.sessions.invalidate(sm["key"])
+            return [self._envelope(
+                live[i], status="failed", plan=plan,
+                error=f"session {failure.stage} stage raised "
+                      f"{type(failure.error).__name__}: {failure.error}",
+                attempts=failure.attempts)
+                for i in plan.indices]
         if plan.kind == "passthrough" and failure.stage == "dispatch":
             telemetry.inc("serve.fault.request")
             return [self._envelope(
@@ -824,6 +911,8 @@ class ThroughputScheduler:
         live = [queue[i] for i in kept]
         plans = self._plans_for(live)
         fail_batches = 0
+        sess_jobs: list = []  # resolved SessionJobs (drain record)
+        sess_prev: dict = {}  # cache key -> last dispatched SessionJob
         # per-plan outcome/placement for shard-local ladder accounting
         # and the drain record's mesh block (keyed by plan sequence)
         failed_plans: set[int] = set()
@@ -842,6 +931,16 @@ class ThroughputScheduler:
             try:
                 if plan_f is not None:
                     plan_f.maybe_prep_fault((drain_id, plan._seq))
+                if plan.kind == "session":
+                    from pint_tpu.serve.session import SessionJob
+
+                    sm = live[plan.indices[0]][4]["session"]
+                    job = SessionJob(self.sessions, sm["key"], sm["fp"],
+                                     live[plan.indices[0]][0],
+                                     sm["mode"])
+                    job.prep()  # gates read here, once per request
+                    state.fitter = job
+                    return state
                 if plan.kind == "passthrough":
                     return state  # Fitter.auto built at dispatch time
                 if plan.kind == "sharded":
@@ -879,6 +978,28 @@ class ThroughputScheduler:
                     if plan_f is not None and plan.kind != "passthrough":
                         plan_f.maybe_device_error(
                             (drain_id, plan._seq), state.attempts - 1)
+                    if plan.kind == "session":
+                        # a same-key job dispatched earlier in THIS
+                        # drain must commit its replacement state
+                        # before this one routes/dispatches — two
+                        # appends to one session in one drain would
+                        # otherwise both read the pre-update state
+                        # (stale math on CPU; deleted donated buffers
+                        # on accelerators). finish() is idempotent, so
+                        # the pipeline's later fetch just reads it.
+                        prev = sess_prev.get(state.fitter.key)
+                        if prev is not None and prev is not state.fitter:
+                            try:
+                                prev.finish()
+                            except Exception:  # noqa: BLE001
+                                pass  # surfaced at prev's own fetch
+                        # incremental route: async fused dispatch (the
+                        # handle's fetch is deferred to the fetch
+                        # stage); populate/full-refit route: host-
+                        # driven, resolved here like a passthrough
+                        state.fitter.dispatch()
+                        sess_prev[state.fitter.key] = state.fitter
+                        return state
                     if plan.kind == "passthrough":
                         # host-driven fitters cannot be suspended
                         # mid-loop: the fit runs here, already resolved
@@ -918,6 +1039,34 @@ class ThroughputScheduler:
                 return self._salvage(live, plan, state)
             if state.device_bytes:
                 plan_bytes[plan._seq] = state.device_bytes
+            if plan.kind == "session":
+                entry = live[plan.indices[0]]
+                job = state.fitter
+                try:
+                    res = job.finish()
+                except Exception as e:  # noqa: BLE001 — isolation
+                    fail_batches += 1
+                    failed_plans.add(plan._seq)
+                    return self._salvage(live, plan,
+                                         _FailedBatch(plan, e, "fetch",
+                                                      state.attempts))
+                clean_plans.add(plan._seq)
+                sess_jobs.append(job)
+                if res["diverged"]:
+                    telemetry.inc("serve.fault.diverged")
+                    return [self._envelope(
+                        entry, status="diverged", plan=plan,
+                        chi2=res["chi2"], t_done=job.t_done,
+                        attempts=job.attempts, session=res["route"],
+                        error="session fit diverged (incremental "
+                              "fallback included)" if job.attempts > 1
+                              else "session fit diverged")]
+                return [self._envelope(
+                    entry,
+                    status="ok" if res["converged"] else "nonconverged",
+                    plan=plan, chi2=res["chi2"],
+                    converged=res["converged"], t_done=job.t_done,
+                    attempts=job.attempts, session=res["route"])]
             if plan.kind == "passthrough":
                 clean_plans.add(plan._seq)
                 entry = live[plan.indices[0]]
@@ -992,6 +1141,8 @@ class ThroughputScheduler:
                 return True
             if state.plan.kind == "passthrough":
                 return True  # resolved synchronously at dispatch
+            if state.plan.kind == "session":
+                return state.fitter.ready()
             try:
                 return bool(state.handle is not None
                             and state.handle.ready())
@@ -1013,6 +1164,13 @@ class ThroughputScheduler:
             # ever silently dropped
             self._queue[:0] = [e for e in queue if e[1]._result is None]
             raise
+        finally:
+            # release session pins for every RESOLVED request (requeued
+            # ones keep theirs — their entry must stay evict-protected)
+            for e in queue:
+                sm = e[4].get("session")
+                if sm is not None and e[1]._result is not None:
+                    self.sessions.unpin(sm["key"])
 
         for plan, batch_results in zip(plans, per_batch):
             for i, res in zip(plan.indices, batch_results):
@@ -1112,6 +1270,34 @@ class ThroughputScheduler:
             self._drain_rate = (fits_per_s if self._drain_rate is None
                                 else 0.5 * self._drain_rate
                                 + 0.5 * fits_per_s)
+        # sessionful rollup (ISSUE 10): per-drain route split, update-
+        # latency percentiles of the incremental path, cache health —
+        # the report CLI's "sessions" section reads this block (absent
+        # on session-free drains; old records degrade gracefully)
+        sessions_block = None
+        if sess_jobs:
+            routes: dict[str, int] = {}
+            trips = 0
+            for j in sess_jobs:
+                routes[j.route] = routes.get(j.route, 0) + 1
+                trips += j.reason in ("append_gate", "drift_gate")
+            incr_walls = sorted(
+                j.wall_s for j in sess_jobs
+                if j.route == "incremental" and j.wall_s is not None)
+            sessions_block = {
+                "requests": len(sess_jobs),
+                "routes": routes,
+                "drift_trips": trips,
+                "update_latencies_s": [round(w, 6)
+                                       for w in incr_walls[:64]],
+                "p50_update_s": (round(float(np.percentile(
+                    incr_walls, 50)), 6) if incr_walls else None),
+                "p95_update_s": (round(float(np.percentile(
+                    incr_walls, 95)), 6) if incr_walls else None),
+                "cache": self.sessions.stats(),
+            }
+            telemetry.inc("serve.session.drains")
+
         statuses: dict[str, int] = {}
         for r in results:
             statuses[r.status] = statuses.get(r.status, 0) + 1
@@ -1153,6 +1339,7 @@ class ThroughputScheduler:
                     str(d): s
                     for d, s in sorted(self._dev_streak.items())},
             },
+            **({"sessions": sessions_block} if sessions_block else {}),
             "batch_detail": [
                 {"kind": p.kind, "group": p.group,
                  "toa_bucket": p.toa_bucket, "real": len(p.indices),

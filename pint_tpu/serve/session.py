@@ -1,0 +1,565 @@
+"""Sessionful serving: cached on-device fit state + incremental refits.
+
+ROADMAP item 3 / ISSUE 10: the throughput engine's missing piece
+between "fast cold fits" and "fast service". A *session* is one user's
+evolving dataset: per-``(session_id, structure fingerprint)`` the cache
+holds the live fitted model, the accumulated TOA table (host side,
+append-only) and — for models the incremental path can express — the
+on-device state the fused rank-k update consumes
+(:mod:`pint_tpu.fitting.incremental`: normalized Gram Cholesky factor,
+column norms, absorbed mean, converged chi2; donated buffers on
+accelerators).
+
+Request routing (see :class:`SessionJob`):
+
+* first request for a key -> **populate**: a normal full fused fit,
+  committed as session state (the device snapshot is taken only for
+  TZR-anchored batchable WLS models — exactly the fused incremental
+  step's domain);
+* append with live device state, inside the gates -> **incremental**:
+  ONE fused launch folds the new TOAs in via the rank-k Cholesky
+  update with warm-started damped iterations (flight recorder riding
+  the carry), one fetch returns solution + uncertainties + the
+  replacement state;
+* anything else -> **full refit** over the accumulated table, warm-
+  started from the session model's current (converged) values — GLS /
+  wideband / anchorless / non-batchable models are therefore fully
+  sessionable, they just pay the full-fit price; a refit REPOPULATES
+  the device state through the same code path a cold populate uses, so
+  the gated path is bitwise the cold path (pinned in
+  tests/test_session.py).
+
+**Drift gate.** The incremental update is recursive least squares: for
+a linear model it is exact; the pulsar phase model is locally linear,
+so the cached quadratic summary of old rows drifts as parameters move.
+Two gates force a full refit: an append-count cap
+(``PINT_TPU_SESSION_MAX_APPENDS``, default 16) and a cumulative
+parameter-motion gate (``PINT_TPU_SESSION_DRIFT_SIGMA``, default 1.0 —
+the sum over appends of the largest parameter move measured in its own
+posterior sigma). Inside the gates the observed chi2 drift against a
+full refit is bounded by :data:`DRIFT_CHI2_REL` (the documented
+acceptance; measured by the BENCH_r13 A/B and the CI smoke).
+
+**Eviction / backpressure.** Device state is LRU-evicted under the byte
+budget (``PINT_TPU_SESSION_BYTES``, default 64 MiB). Eviction drops
+ONLY the device buffers — the committed solution (model values,
+uncertainties, accumulated table) stays host-side, so a later append
+full-refits and repopulates: nothing is ever lost silently. When a new
+state cannot be admitted even after evicting every unpinned entry
+(entries referenced by still-queued requests are pinned),
+:meth:`SessionCache.check_admission` raises :class:`SessionCacheFull`
+— the ``ServeQueueFull``-style contract with a ``retry_after_s`` hint
+— at *submit* time, before any work is queued.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from pint_tpu import telemetry
+from pint_tpu.serve import fingerprint as _fp
+
+#: documented chi2-drift acceptance of the incremental path, relative
+#: to a full refit over the same accumulated table, while inside the
+#: append/motion gates (asserted by bench --smoke and BENCH_r13)
+DRIFT_CHI2_REL = 1e-3
+
+_DEF_BUDGET = 64 * 1024 * 1024
+
+
+def byte_budget() -> int:
+    """Session-cache device-byte budget (read per call for tests)."""
+    return int(os.environ.get("PINT_TPU_SESSION_BYTES", str(_DEF_BUDGET)))
+
+
+def max_appends() -> int:
+    """Append-count gate: full refit after this many rank-k updates."""
+    return int(os.environ.get("PINT_TPU_SESSION_MAX_APPENDS", "16"))
+
+
+def drift_limit_sigma() -> float:
+    """Cumulative parameter-motion gate [posterior sigmas]."""
+    return float(os.environ.get("PINT_TPU_SESSION_DRIFT_SIGMA", "1.0"))
+
+
+class SessionCacheFull(RuntimeError):
+    """Session-state admission failed: every evictable entry is pinned
+    by queued requests and the budget has no room. The ``ServeQueueFull``
+    contract: carries ``bytes_requested`` / ``bytes_in_use`` /
+    ``budget`` and a ``retry_after_s`` hint (drain the scheduler, then
+    retry)."""
+
+    def __init__(self, bytes_requested: int = 0, bytes_in_use: int = 0,
+                 budget: int = 0, retry_after_s: float | None = None):
+        self.bytes_requested = bytes_requested
+        self.bytes_in_use = bytes_in_use
+        self.budget = budget
+        self.retry_after_s = retry_after_s
+        msg = (f"session cache at capacity ({bytes_in_use}/{budget} B in "
+               f"use, {bytes_requested} B requested, every resident "
+               "state pinned by queued requests); drain() first")
+        if retry_after_s is not None:
+            msg += f" and retry after ~{retry_after_s:g}s"
+        super().__init__(msg)
+
+
+@dataclasses.dataclass
+class SessionEntry:
+    """One (session_id, fingerprint)'s committed solution + state."""
+
+    session_id: Any
+    fp: tuple                  # structure fingerprint
+    fp8: str                   # short id (telemetry label)
+    model: Any = None          # live fitted model (host)
+    toas: Any = None           # merged accumulated table (host)
+    #: appended-but-unmerged tables. ``merge_TOAs`` over a 1e5-row
+    #: table costs ~150 ms of host concatenates — measured as ~ALL of
+    #: the incremental update's p50 when done eagerly per append — so
+    #: accumulation is LAZY: appends stack here and merge only when a
+    #: full refit actually needs the whole table
+    pending: list = dataclasses.field(default_factory=list)
+    state: dict | None = None  # on-device incremental state, or None
+    names: list | None = None  # state-vector param order
+    off: int = 0               # offset-coordinate count
+    state_bytes: int = 0
+    chi2: float = float("nan")
+    n_toas: int = 0
+    appends: int = 0           # rank-k updates since last full refit
+    drift: float = 0.0         # cumulative motion [sigma] since refit
+    pins: int = 0              # queued requests referencing this entry
+
+    def accumulated(self):
+        """The full committed table, merging any pending appends."""
+        if self.pending:
+            from pint_tpu.toas import merge_TOAs
+
+            self.toas = merge_TOAs([self.toas] + self.pending)
+            self.pending = []
+        return self.toas
+
+
+class SessionCache:
+    """LRU session store under a device-byte budget.
+
+    One instance per :class:`~pint_tpu.serve.scheduler
+    .ThroughputScheduler` by default; shareable across schedulers. All
+    mutation happens on the scheduler's thread (the serve layer is
+    deliberately thread-free).
+    """
+
+    def __init__(self, budget_bytes: int | None = None):
+        self._budget = budget_bytes
+        self.entries: "collections.OrderedDict[tuple, SessionEntry]" = \
+            collections.OrderedDict()
+        self._by_sid: dict[Any, tuple] = {}  # sid -> most recent key
+        self.bytes_in_use = 0
+        self.evictions = 0
+
+    @property
+    def budget(self) -> int:
+        return self._budget if self._budget is not None else byte_budget()
+
+    # ------------------------------------------------------------------
+    # lookup / routing
+    # ------------------------------------------------------------------
+    def resolve(self, request) -> tuple[tuple, SessionEntry | None, tuple]:
+        """(cache key, entry or None, fingerprint) for one request.
+
+        An append may omit ``model`` — the session's own model is
+        authoritative; when a model IS passed, its fingerprint keys the
+        lookup, so a same-sid request with a different structure opens
+        a separate session entry (the cache key is (sid, fingerprint)).
+        """
+        sid = request.session_id
+        if request.model is None:
+            key = self._by_sid.get(sid)
+            if key is None:
+                raise ValueError(
+                    f"session {sid!r} has no committed state and the "
+                    "request carries no model; the first request of a "
+                    "session must include one")
+            return key, self.entries[key], self.entries[key].fp
+        fp = _fp.structure_fingerprint(request.model, request.toas)
+        key = (sid, _fp.short_id(fp))
+        return key, self.entries.get(key), fp
+
+    def touch(self, key: tuple) -> None:
+        if key in self.entries:
+            self.entries.move_to_end(key)
+
+    def pin(self, key: tuple) -> None:
+        e = self.entries.get(key)
+        if e is not None:
+            e.pins += 1
+
+    def unpin(self, key: tuple) -> None:
+        e = self.entries.get(key)
+        if e is not None and e.pins > 0:
+            e.pins -= 1
+
+    # ------------------------------------------------------------------
+    # admission / eviction (the backpressure contract)
+    # ------------------------------------------------------------------
+    def estimate_bytes(self, model) -> int:
+        """Device bytes a session state for ``model`` will occupy."""
+        q = len(model.free_params) \
+            + (0 if model.has_component("PhaseOffset") else 1)
+        return 8 * (q * q + q + 2)
+
+    def check_admission(self, nbytes: int,
+                        retry_after_s: float | None = None) -> None:
+        """Raise :class:`SessionCacheFull` when ``nbytes`` of NEW state
+        could not be admitted even after evicting every unpinned
+        resident state. Called on the submit path — backpressure fires
+        before work is queued, never silently mid-drain."""
+        if nbytes > self.budget:
+            # a single state larger than the whole budget is not
+            # backpressure (no amount of draining helps): it is served
+            # stateless (full refit per append) and counted
+            return
+        free = self.budget - self.bytes_in_use
+        evictable = sum(e.state_bytes for e in self.entries.values()
+                        if e.state is not None and e.pins == 0)
+        if nbytes > free + evictable:
+            telemetry.inc("serve.session.admission_rejected")
+            raise SessionCacheFull(
+                bytes_requested=nbytes, bytes_in_use=self.bytes_in_use,
+                budget=self.budget, retry_after_s=retry_after_s)
+
+    def _evict_for(self, nbytes: int, keep: tuple) -> bool:
+        """Evict LRU unpinned device states until ``nbytes`` fit.
+
+        Eviction order is strict LRU over entries *with* device state
+        (insertion order refreshed by :meth:`touch`). Only the device
+        buffers are dropped — the committed solution survives."""
+        if nbytes > self.budget:
+            return False
+        for key in list(self.entries):
+            if self.bytes_in_use + nbytes <= self.budget:
+                break
+            e = self.entries[key]
+            if key == keep or e.state is None or e.pins > 0:
+                continue
+            self.evict(key)
+        return self.bytes_in_use + nbytes <= self.budget
+
+    def evict(self, key: tuple) -> None:
+        """Drop one entry's device state (the solution is kept)."""
+        e = self.entries[key]
+        if e.state is None:
+            return
+        self.bytes_in_use -= e.state_bytes
+        e.state = None
+        e.state_bytes = 0
+        self.evictions += 1
+        telemetry.inc("serve.session.evictions")
+
+    def invalidate(self, key: tuple) -> None:
+        """Drop a key's device state after a dispatched-but-uncommitted
+        update (failed dispatch/fetch): on accelerators the buffers
+        were DONATED to the failed program and must never be read
+        again — the committed host solution stays; the next append
+        full-refits and repopulates."""
+        e = self.entries.get(key)
+        if e is not None and e.state is not None:
+            self.evict(key)
+
+    def drop(self, session_id) -> None:
+        """Forget a session entirely (host solution included) — the
+        caller-driven lifecycle end; never done implicitly."""
+        for key in [k for k in self.entries if k[0] == session_id]:
+            self.evict(key)
+            del self.entries[key]
+        self._by_sid.pop(session_id, None)
+
+    # ------------------------------------------------------------------
+    # commit
+    # ------------------------------------------------------------------
+    def entry_for(self, key: tuple, fp: tuple) -> SessionEntry:
+        e = self.entries.get(key)
+        if e is None:
+            e = SessionEntry(session_id=key[0], fp=fp, fp8=key[1])
+            self.entries[key] = e
+        self._by_sid[key[0]] = key
+        self.entries.move_to_end(key)
+        return e
+
+    def commit_state(self, key: tuple, state: dict | None,
+                     nbytes: int) -> bool:
+        """Install (or clear) an entry's device state under the budget;
+        returns False when the state was not admitted (entry stays
+        stateless; appends full-refit)."""
+        e = self.entries[key]
+        if e.state is not None:
+            self.bytes_in_use -= e.state_bytes
+            e.state, e.state_bytes = None, 0
+        if state is None:
+            return True
+        if not self._evict_for(nbytes, key):
+            telemetry.inc("serve.session.uncacheable")
+            return False
+        e.state = state
+        e.state_bytes = nbytes
+        self.bytes_in_use += nbytes
+        telemetry.set_gauge("serve.session.bytes", self.bytes_in_use)
+        return True
+
+    def stats(self) -> dict:
+        with_state = sum(1 for e in self.entries.values()
+                         if e.state is not None)
+        return {"entries": len(self.entries), "with_state": with_state,
+                "bytes": self.bytes_in_use, "budget": self.budget,
+                "evictions": self.evictions}
+
+
+# ----------------------------------------------------------------------
+# per-request execution (driven by the scheduler's drain stages)
+# ----------------------------------------------------------------------
+
+#: route tokens (drain records / counters / batch_detail)
+ROUTES = ("populate", "incremental", "full_refit")
+
+
+class SessionJob:
+    """One session request walked through prep -> dispatch -> finish.
+
+    Mirrors the scheduler's other batch-state objects: ``prep`` decides
+    the route (gates read HERE, once per request), ``dispatch``
+    enqueues the fused incremental program asynchronously (or runs the
+    host-synchronous full refit, stamping its completion time), and
+    ``finish`` performs the single fetch, writes fitted values back
+    into the session model, commits the replacement state and returns
+    the envelope fields. An incremental update that diverges falls back
+    to a full refit (attempts=2) — correctness is always pinned against
+    the cold path.
+    """
+
+    def __init__(self, cache: SessionCache, key: tuple, fp: tuple,
+                 request, mode: str):
+        self.cache = cache
+        self.key = key
+        self.fp = fp
+        self.request = request
+        self.mode = mode          # "create" | "append"
+        self.route = None         # set at prep
+        self.reason = ""
+        self.attempts = 1
+        self._handle = None
+        self._result = None
+        self._t0 = None
+        self.t_done = None
+        self.wall_s = None
+
+    # -- helpers -------------------------------------------------------
+    def _hyper(self) -> dict:
+        r = self.request
+        return dict(maxiter=r.maxiter,
+                    min_chi2_decrease=r.min_chi2_decrease,
+                    max_step_halvings=r.max_step_halvings)
+
+    @staticmethod
+    def _snapshot_eligible(model, toas) -> bool:
+        """Is this fit inside the fused incremental step's domain?
+        TZR-anchored batchable WLS — exactly what
+        :mod:`pint_tpu.fitting.incremental` can express."""
+        ok, _ = _fp.batchable(model, toas)
+        return (ok and _fp.family(model, toas) == "wls"
+                and model.get_tzr_toas() is not None)
+
+    def prep(self) -> None:
+        """Stage-entry stamp. Routing happens at DISPATCH time
+        (:meth:`route_now`): a same-key append earlier in the same
+        drain commits its replacement state between this job's prep and
+        dispatch, and the gates must read the committed state."""
+        self._t0 = time.perf_counter()
+
+    def route_now(self) -> None:
+        """Decide the route against the CURRENT cache state."""
+        entry = self.cache.entries.get(self.key)
+        if self.mode == "create" or entry is None or entry.model is None:
+            self.route = "populate"
+            telemetry.inc("serve.session.miss")
+            return
+        telemetry.inc("serve.session.hit")
+        if entry.state is None:
+            self.route, self.reason = "full_refit", "no_state"
+        elif entry.appends + 1 > max_appends():
+            self.route, self.reason = "full_refit", "append_gate"
+            telemetry.inc("serve.session.drift_trips")
+        elif entry.drift >= drift_limit_sigma():
+            self.route, self.reason = "full_refit", "drift_gate"
+            telemetry.inc("serve.session.drift_trips")
+        else:
+            self.route = "incremental"
+
+    def dispatch(self) -> None:
+        """Enqueue (incremental) or run (full) the fit."""
+        from pint_tpu.fitting import incremental as _incr
+
+        if self.route is None:
+            self.route_now()
+        if self.route == "incremental":
+            entry = self.cache.entries[self.key]
+            with telemetry.span("serve.session.dispatch",
+                                route=self.route):
+                self._handle = _incr.dispatch_incremental(
+                    entry.model, self.request.toas, entry.state,
+                    names=entry.names, **self._hyper())
+            return
+        # populate / full refit: host-driven, resolved synchronously
+        # (like the scheduler's passthrough plans); completion stamped
+        # NOW so deferred fetches cannot inflate latency
+        self._result = self._run_full()
+        self.t_done = time.perf_counter()
+
+    def ready(self) -> bool:
+        if self._result is not None:
+            return True
+        try:
+            return self._handle is not None and self._handle.ready()
+        except Exception:  # noqa: BLE001 — readiness is advisory
+            return True
+
+    # -- full-fit path -------------------------------------------------
+    def _run_full(self) -> dict:
+        """Full fused (or host) fit over the accumulated table; commits
+        model + table + (when eligible) a fresh device snapshot. The
+        ONE populate/refit code path: a gate-tripped refit is bitwise a
+        cold populate over the same table by construction."""
+        from pint_tpu.fitting import incremental as _incr
+        from pint_tpu.toas import merge_TOAs
+
+        telemetry.inc(f"serve.session.{self.route}")
+        if self.reason:
+            telemetry.inc(f"serve.session.refit.{self.reason}")
+        entry = self.cache.entry_for(self.key, self.fp)
+        if self.route == "populate":
+            model, toas_full = self.request.model, self.request.toas
+        else:
+            model = entry.model
+            toas_full = merge_TOAs([entry.accumulated(),
+                                    self.request.toas])
+            self.attempts = max(self.attempts, 1)
+        hyper = self._hyper()
+        eligible = self._snapshot_eligible(model, toas_full)
+        if eligible:
+            from pint_tpu.fitting import device_loop
+
+            d, info, chi2, conv, _cnt = device_loop.dense_wls_fit(
+                toas_full, model, **hyper)
+            div = bool(np.asarray(info.get("diverged", False)))
+            if not div:
+                errors = info["errors"]
+                for k in model.free_params:
+                    model[k].add_delta(float(np.asarray(d[k])))
+                    model[k].uncertainty = float(np.asarray(errors[k]))
+            conv = bool(conv)
+        else:
+            from pint_tpu.fitting.fitter import Fitter
+
+            f = Fitter.auto(toas_full, model)
+            f.max_step_halvings = hyper["max_step_halvings"]
+            chi2 = f.fit_toas(
+                maxiter=hyper["maxiter"],
+                min_chi2_decrease=hyper["min_chi2_decrease"])
+            chi2 = float(np.atleast_1d(np.asarray(chi2, float))[0])
+            div = bool(getattr(f, "diverged", False)) \
+                or not np.isfinite(chi2)
+            conv = bool(np.all(np.asarray(f.converged)))
+        if div:
+            # never commit a poisoned solution: the entry keeps its
+            # last good model/table/chi2 untouched. The device state is
+            # dropped — on an incremental-diverged fallback its buffers
+            # were donated to the failed update, and a stale-but-alive
+            # factor buys nothing a refit will not rebuild
+            self.cache.commit_state(self.key, None, 0)
+            return {"chi2": float(chi2), "converged": False,
+                    "diverged": True, "route": self.route}
+        entry.model = model
+        entry.toas = toas_full
+        entry.pending = []
+        entry.n_toas = len(toas_full)
+        entry.appends = 0
+        entry.drift = 0.0
+        entry.chi2 = float(chi2)
+        if not eligible:
+            self.cache.commit_state(self.key, None, 0)
+            entry.names, entry.off = None, 0
+            telemetry.inc("serve.session.stateless")
+        else:
+            snap = _incr.snapshot_state(model, toas_full)
+            entry.names, entry.off = snap["names"], snap["off"]
+            self.cache.commit_state(self.key, snap["state"],
+                                    snap["bytes"])
+        return {"chi2": float(chi2), "converged": conv, "diverged": div,
+                "route": self.route}
+
+    # -- fetch / commit ------------------------------------------------
+    def finish(self) -> dict:
+        """Resolve the request: fetch, write back, commit state.
+
+        Returns ``{chi2, converged, diverged, route}`` for the
+        scheduler's envelope. Idempotent via ``self._result``.
+        """
+        if self._result is not None:
+            self.wall_s = (self.t_done or time.perf_counter()) - self._t0
+            return self._result
+        entry = self.cache.entries[self.key]
+        u, info, chi2, conv, _cnt = self._handle.fetch()
+        div = bool(np.asarray(info.get("diverged", False)))
+        if div:
+            # a poisoned append (or a stale-state pathology): never
+            # commit — fall back to the cold path, which repopulates
+            telemetry.inc("serve.session.incremental_diverged")
+            self.route, self.reason = "full_refit", "incremental_diverged"
+            self.attempts = 2
+            self._result = self._run_full()
+            self.t_done = time.perf_counter()
+            self.wall_s = self.t_done - self._t0
+            return self._result
+        telemetry.inc("serve.session.incremental")
+        u = np.asarray(u)
+        off, names = entry.off, entry.names
+        sig = np.zeros(len(names))
+        for i, k in enumerate(names):
+            e = float(np.asarray(info["errors"][k]))
+            sig[i] = e
+            entry.model[k].add_delta(float(u[off + i]))
+            entry.model[k].uncertainty = e
+        # cumulative drift: the largest parameter move of this update in
+        # its own posterior sigma (zero-sigma params cannot gate)
+        moves = np.abs(u[off:])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rel = np.where(sig > 0, moves / np.where(sig > 0, sig, 1.0),
+                           0.0)
+        # lazy accumulation: merging the (possibly 1e5-row) table here
+        # would dominate the update wall — a full refit merges instead
+        entry.pending.append(self.request.toas)
+        entry.n_toas += len(self.request.toas)
+        entry.appends += 1
+        entry.drift += float(np.max(rel)) if rel.size else 0.0
+        entry.chi2 = float(np.asarray(chi2))
+        committed = self.cache.commit_state(
+            self.key, self._handle.new_state,
+            _incr_state_bytes(self._handle.new_state))
+        if not committed:
+            telemetry.inc("serve.session.state_dropped")
+        self.cache.touch(self.key)
+        self.t_done = time.perf_counter()
+        self.wall_s = self.t_done - self._t0
+        self._result = {"chi2": float(np.asarray(chi2)),
+                        "converged": bool(conv), "diverged": False,
+                        "route": "incremental"}
+        return self._result
+
+
+def _incr_state_bytes(state: dict) -> int:
+    from pint_tpu.fitting.incremental import state_bytes
+
+    return state_bytes(state)

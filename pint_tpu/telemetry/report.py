@@ -217,6 +217,55 @@ def passthrough_rollup(records: list[dict]) -> dict:
                                        key=lambda kv: -kv[1])[:8])}
 
 
+def sessions_summary(records: list[dict]) -> dict:
+    """Sessionful-serving rollup (ISSUE 10) from the drain records'
+    ``sessions`` blocks: route split (incremental vs full refit vs
+    populate), cache hit rate, drift-gate trips, evictions, and the
+    p50/p95 incremental-update latency over every recorded update.
+    Records predating the block (or session-free drains) are simply
+    skipped — old artifacts degrade gracefully."""
+    drains = requests = trips = 0
+    routes: dict[str, int] = {}
+    lats: list[float] = []
+    cache_last: dict = {}
+    for r in records:
+        if r.get("type") != "serve":
+            continue
+        blk = r.get("sessions")
+        if not isinstance(blk, dict):
+            continue
+        drains += 1
+        requests += int(blk.get("requests") or 0)
+        trips += int(blk.get("drift_trips") or 0)
+        for k, v in (blk.get("routes") or {}).items():
+            routes[k] = routes.get(k, 0) + int(v)
+        lats.extend(float(x) for x in
+                    (blk.get("update_latencies_s") or []))
+        if isinstance(blk.get("cache"), dict):
+            cache_last = blk["cache"]
+    def pct(vals, p):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        i = min(len(vals) - 1, max(0, round(p / 100 * (len(vals) - 1))))
+        return round(vals[i], 6)
+
+    incr = routes.get("incremental", 0)
+    appends = incr + routes.get("full_refit", 0)
+    return {
+        "drains": drains, "requests": requests, "routes": routes,
+        "drift_trips": trips,
+        # hit rate = appends served by the rank-k path (populates are
+        # first contact, not misses)
+        "hit_rate": round(incr / appends, 4) if appends else None,
+        "evictions": cache_last.get("evictions"),
+        "cache": cache_last,
+        "updates_recorded": len(lats),
+        "p50_update_s": pct(lats, 50),
+        "p95_update_s": pct(lats, 95),
+    }
+
+
 def mesh_summary(records: list[dict]) -> dict:
     """Per-device placement rollup from the drain records' ``mesh``
     blocks (ISSUE 7): member-slots vs real members per device (the
@@ -505,6 +554,36 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no serve records)")
 
+    lines.append("\n== sessions (incremental refits) ==")
+    se = summary.get("sessions") or {}
+    if se.get("drains"):
+        lines.append(
+            f"  {se['requests']} session request(s) over "
+            f"{se['drains']} drain(s): "
+            + (", ".join(f"{k}={v}"
+                         for k, v in sorted(se["routes"].items()))
+               or "none"))
+        hr = se.get("hit_rate")
+        lines.append(
+            "  incremental hit rate: "
+            + (f"{hr:.1%}" if hr is not None else "n/a (no appends)")
+            + f", drift-gate trips {se['drift_trips']}"
+            + (f", evictions {se['evictions']}"
+               if se.get("evictions") is not None else ""))
+        if se.get("p50_update_s") is not None:
+            lines.append(
+                f"  update latency over {se['updates_recorded']} "
+                f"update(s): p50 {se['p50_update_s']}s, "
+                f"p95 {se['p95_update_s']}s")
+        cache = se.get("cache") or {}
+        if cache:
+            lines.append(
+                f"  cache: {cache.get('with_state')}/"
+                f"{cache.get('entries')} entries resident, "
+                f"{cache.get('bytes')}/{cache.get('budget')} B")
+    else:
+        lines.append("  (no session records)")
+
     lines.append("\n== mesh (device placement) ==")
     mesh = summary["mesh"]
     if mesh["devices"] > 1 and mesh["drains"]:
@@ -598,6 +677,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "programs": program_summaries(records),
         "serve": serve_summaries(records),
         "passthrough": passthrough_rollup(records),
+        "sessions": sessions_summary(records),
         "mesh": mesh_summary(records),
         "faults": fault_summaries(records),
         "caches": cache_rates(records),

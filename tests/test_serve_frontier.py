@@ -423,3 +423,77 @@ def test_wls_batch_bit_inert_to_noise_paths(monkeypatch):
                      [_fitted_state(r.request.model) for r in res])
     assert out["on"][0] == out["off"][0]      # chi2 bitwise
     assert out["on"][1] == out["off"][1]      # params + sigmas bitwise
+
+
+# ----------------------------------------------------------------------
+# traced EFAC/EQUAD (ISSUE 10 satellite: the PR-8 residue)
+# ----------------------------------------------------------------------
+
+def _efac_par(efac: float) -> str:
+    """Same structure, different EFAC VALUE (ECORR fixed)."""
+    return BARY_PAR + f"EFAC -f fake {efac}\nECORR -f fake 1.1\n"
+
+
+def test_mixed_efac_shares_one_batch_with_parity():
+    """Requests differing only in EFAC/EQUAD values form ONE batch
+    (values ride the traced NoiseStatics.sigma), and every member lands
+    on its own standalone fused oracle."""
+    s = ThroughputScheduler(max_queue=8)
+    reqs = []
+    for i, efac in enumerate((1.1, 1.4)):
+        toas = _paired_toas(_efac_par(efac), 30, seed=940 + i)
+        m = get_model(_efac_par(efac))
+        m["F0"].add_delta(2e-10)
+        reqs.append((toas, efac))
+        s.submit(FitRequest(toas, m, tag=i, **HYPER))
+    plans = s.plan()
+    assert [(p.kind, len(p.indices)) for p in plans] == [("batched", 2)]
+    res = s.drain()
+    for i, (toas, efac) in enumerate(reqs):
+        m2 = get_model(_efac_par(efac))
+        m2["F0"].add_delta(2e-10)
+        _d, _info, chi2, _conv, _ = device_loop.dense_gls_fit(
+            toas, m2, **HYPER)
+        rel = abs(res[i].chi2 - float(chi2)) / abs(float(chi2))
+        assert rel < 1e-9, (i, rel)
+
+
+def test_efac_trace_kill_switch_splits_and_is_parity_pinned(monkeypatch):
+    """PINT_TPU_TRACE_EFAC=0 restores the PR-8 routing: mixed EFAC
+    values split groups again, and the pinned-constant results match
+    the traced path at the 1e-9 class (same values, two arithmetic
+    paths)."""
+    toas_a = _paired_toas(_efac_par(1.1), 30, seed=945)
+    toas_b = _paired_toas(_efac_par(1.4), 30, seed=946)
+
+    def run():
+        s = ThroughputScheduler(max_queue=8)
+        for i, (t, efac) in enumerate(((toas_a, 1.1), (toas_b, 1.4))):
+            m = get_model(_efac_par(efac))
+            m["F0"].add_delta(2e-10)
+            s.submit(FitRequest(t, m, tag=i, **HYPER))
+        plans = s.plan()
+        return plans, s.drain()
+
+    plans_on, res_on = run()
+    assert len(plans_on) == 1
+    monkeypatch.setenv("PINT_TPU_TRACE_EFAC", "0")
+    plans_off, res_off = run()
+    assert len(plans_off) == 2  # values are trace constants again
+    for a, b in zip(res_on, res_off):
+        assert abs(a.chi2 - b.chi2) <= 1e-9 * abs(b.chi2)
+
+
+def test_scaled_sigma_np_matches_traced_expression():
+    """The numpy mirror == model.scaled_toa_uncertainty elementwise,
+    padding rows included (last row's masks at PAD_ERROR weight)."""
+    from pint_tpu import bucketing
+    from pint_tpu.fitting.gls_step import scaled_sigma_np
+
+    par = _efac_par(1.3) + "EQUAD -f fake 0.5\n"
+    toas = _paired_toas(par, 10, seed=950)
+    m = get_model(par)
+    got = scaled_sigma_np(m, toas, 32)
+    ref = np.asarray(m.scaled_toa_uncertainty(
+        bucketing.pad_toas(toas, 32)))
+    np.testing.assert_allclose(got, ref, rtol=1e-14)

@@ -1,0 +1,442 @@
+"""Sessionful serving (ISSUE 10): rank-k incremental refits, drift
+gates, session-cache eviction/backpressure, scheduler routing.
+
+The PAR matches tests/test_serve.py so compiled programs are shared
+across files where shapes coincide (bucketing + process-global caches).
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from pint_tpu import bucketing, telemetry
+from pint_tpu.fitting import device_loop
+from pint_tpu.fitting import incremental as incr
+from pint_tpu.models import get_model
+from pint_tpu.serve import (FitRequest, SessionCache, SessionCacheFull,
+                            ThroughputScheduler)
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas import merge_TOAs
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+HYPER = dict(maxiter=20, min_chi2_decrease=1e-3, max_step_halvings=8)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+
+
+def _toas(n, seed, lo=53000, hi=56000):
+    truth = get_model(PAR)
+    return make_fake_toas_uniform(lo, hi, n, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=seed)
+
+
+def _model(pert=2e-10):
+    m = get_model(PAR)
+    m["F0"].add_delta(pert)
+    return m
+
+
+@pytest.fixture(scope="module")
+def base_problem():
+    """One 60-TOA table (bucket 64) + appends, reused across tests."""
+    return {
+        "toas": _toas(60, seed=301),
+        "app": [_toas(5, seed=310 + i, lo=56010 + 40 * i,
+                      hi=56040 + 40 * i) for i in range(3)],
+    }
+
+
+# ----------------------------------------------------------------------
+# pure policy / math
+# ----------------------------------------------------------------------
+
+def test_append_bucket_size():
+    assert bucketing.append_bucket_size(1) == 8
+    assert bucketing.append_bucket_size(8) == 8
+    assert bucketing.append_bucket_size(9) == 16
+    with pytest.raises(ValueError):
+        bucketing.append_bucket_size(0)
+
+
+def test_append_bucket_kill_switch(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_FIT_BUCKETING", "0")
+    assert bucketing.append_bucket_size(3) == 3
+
+
+def test_rank_k_chol_update_matches_direct():
+    """QR-based factor update == Cholesky of the summed Gram."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    q, k = 6, 9
+    B = rng.normal(size=(q + 3, q))
+    G = B.T @ B + np.eye(q)  # PD
+    L = np.linalg.cholesky(G)
+    Aw = rng.normal(size=(k, q))
+    L2 = np.asarray(incr.rank_k_chol_update(jnp.asarray(L),
+                                            jnp.asarray(Aw)))
+    # lower triangular, positive diagonal, exact product
+    assert np.allclose(np.triu(L2, 1), 0.0)
+    assert np.all(np.diagonal(L2) > 0)
+    np.testing.assert_allclose(L2 @ L2.T, G + Aw.T @ Aw,
+                               rtol=1e-12, atol=1e-12)
+
+
+# ----------------------------------------------------------------------
+# incremental update vs full refit (the correctness pin)
+# ----------------------------------------------------------------------
+
+def _populate(toas, model):
+    d, info, chi2, conv, _ = device_loop.dense_wls_fit(toas, model,
+                                                       **HYPER)
+    assert conv
+    for k in model.free_params:
+        model[k].add_delta(float(np.asarray(d[k])))
+        model[k].uncertainty = float(np.asarray(info["errors"][k]))
+    return float(chi2)
+
+
+def test_incremental_matches_full_refit(base_problem):
+    """One rank-k append lands on the full refit's solution: chi2 drift
+    inside the documented gate, params within a small sigma fraction —
+    and the update is ONE launch + ONE fetch (counter-pinned)."""
+    from pint_tpu.serve.session import DRIFT_CHI2_REL
+
+    toas, app = base_problem["toas"], base_problem["app"][0]
+    m = _model()
+    _populate(toas, m)
+    snap = incr.snapshot_state(m, toas)
+
+    before = telemetry.counters_snapshot()
+    h = incr.dispatch_incremental(m, app, snap["state"],
+                                  names=snap["names"], **HYPER)
+    u, info, chi2, conv, _cnt = h.fetch()
+    delta = telemetry.counters_delta(before)
+    assert delta.get("fit.device_loop.launches", 0) == 1
+    assert delta.get("fit.device_loop.fetches", 0) == 1
+    assert bool(conv)
+    assert not bool(np.asarray(info["diverged"]))
+
+    # the replacement state arrived in the same fetch
+    ns = h.new_state
+    assert sorted(ns) == ["L", "chi2", "mu", "norm"]
+
+    u = np.asarray(u)
+    off, names = snap["off"], snap["names"]
+    m_incr = copy.deepcopy(m)
+    for i, k in enumerate(names):
+        m_incr[k].add_delta(float(u[off + i]))
+
+    merged = merge_TOAs([toas, app])
+    m_full = copy.deepcopy(m)
+    d, info_f, chi2_full, conv_f, _ = device_loop.dense_wls_fit(
+        merged, m_full, **HYPER)
+    assert conv_f
+    rel = abs(float(chi2) - float(chi2_full)) / abs(float(chi2_full))
+    assert rel < DRIFT_CHI2_REL, rel
+    for i, k in enumerate(names):
+        v_full = m_full[k].value_f64 + float(np.asarray(d[k]))
+        sig = float(np.asarray(info_f["errors"][k]))
+        assert abs(m_incr[k].value_f64 - v_full) <= 0.01 * sig, k
+
+
+# ----------------------------------------------------------------------
+# scheduler routing
+# ----------------------------------------------------------------------
+
+def test_session_scheduler_roundtrip(base_problem):
+    """create -> populate; appends -> incremental (route tokens, one
+    fused launch per update, sessions drain-record block)."""
+    s = ThroughputScheduler(max_queue=8)
+    h0 = s.submit(FitRequest(base_problem["toas"], _model(),
+                             tag="c", session_id="u1"))
+    res = s.drain()
+    assert res[0].status == "ok" and res[0].session == "populate"
+    assert s.last_drain["sessions"]["routes"] == {"populate": 1}
+    assert s.last_drain["sessions"]["cache"]["with_state"] == 1
+
+    for i, app in enumerate(base_problem["app"][:2]):
+        before = telemetry.counters_snapshot()
+        h = s.submit(FitRequest(app, None, tag=f"a{i}", session_id="u1"))
+        r = s.drain()[0]
+        delta = telemetry.counters_delta(before)
+        assert r.status == "ok" and r.session == "incremental"
+        assert h.result() is r
+        assert delta.get("fit.device_loop.launches", 0) == 1
+        assert delta.get("fit.device_loop.fetches", 0) == 1
+    blk = s.last_drain["sessions"]
+    assert blk["routes"] == {"incremental": 1}
+    assert blk["p50_update_s"] is not None
+    # batch_detail carries the session plan kind
+    assert s.last_drain["batch_detail"][0]["kind"] == "session"
+
+
+def test_session_first_request_needs_model(base_problem):
+    s = ThroughputScheduler(max_queue=8)
+    with pytest.raises(ValueError):
+        s.submit(FitRequest(base_problem["app"][0], None,
+                            session_id="nobody"))
+
+
+def test_drift_gate_trip_repopulates_bitwise(base_problem, monkeypatch):
+    """A gate-tripped append IS the cold path: the refit's committed
+    state is bitwise a cold populate over the same accumulated table
+    from the same warm values (the full refit repopulates the cache, so
+    correctness is always pinned against the cold path)."""
+    toas, app = base_problem["toas"], base_problem["app"][0]
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, _model(), session_id="g"))
+    s.drain()
+    key = s.sessions._by_sid["g"]
+    entry = s.sessions.entries[key]
+    warm_model = copy.deepcopy(entry.model)
+
+    monkeypatch.setenv("PINT_TPU_SESSION_MAX_APPENDS", "0")
+    before = telemetry.counters_snapshot()
+    s.submit(FitRequest(app, None, session_id="g"))
+    r = s.drain()[0]
+    delta = telemetry.counters_delta(before)
+    assert r.status == "ok" and r.session == "full_refit"
+    assert delta.get("serve.session.drift_trips", 0) == 1
+    assert delta.get("serve.session.refit.append_gate", 0) == 1
+    assert s.last_drain["sessions"]["drift_trips"] == 1
+    assert entry.appends == 0 and entry.drift == 0.0
+
+    # cold comparator: a fresh session populated with the SAME warm
+    # values over the SAME accumulated table
+    merged = entry.toas
+    s2 = ThroughputScheduler(max_queue=8)
+    s2.submit(FitRequest(merged, warm_model, session_id="cold"))
+    r2 = s2.drain()[0]
+    assert r2.status == "ok"
+    e2 = s2.sessions.entries[s2.sessions._by_sid["cold"]]
+    for f in ("L", "norm", "mu", "chi2"):
+        a = np.asarray(entry.state[f])
+        b = np.asarray(e2.state[f])
+        assert np.array_equal(a, b), f
+    assert r.chi2 == r2.chi2
+    for k in entry.model.free_params:
+        assert entry.model[k].value_f64 == e2.model[k].value_f64, k
+
+
+def test_eviction_never_loses_committed_solution(base_problem,
+                                                monkeypatch):
+    """LRU eviction drops only device state; an append to an evicted
+    session full-refits from the committed solution and repopulates —
+    landing where a cold fit over the accumulated table lands."""
+    toas, app = base_problem["toas"], base_problem["app"][1]
+    # budget fits exactly one state (q=6 -> 352 bytes)
+    monkeypatch.setenv("PINT_TPU_SESSION_BYTES", "400")
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, _model(), session_id="a"))
+    s.drain()
+    ka = s.sessions._by_sid["a"]
+    assert s.sessions.entries[ka].state is not None
+    before = telemetry.counters_snapshot()
+    s.submit(FitRequest(toas, _model(), session_id="b"))
+    s.drain()
+    delta = telemetry.counters_delta(before)
+    # LRU: admitting b evicted a's state, never its solution
+    assert delta.get("serve.session.evictions", 0) == 1
+    ea = s.sessions.entries[ka]
+    assert ea.state is None
+    assert ea.model is not None and ea.toas is not None
+    chi2_before = ea.chi2
+    assert np.isfinite(chi2_before)
+
+    r = s.submit(FitRequest(app, None, session_id="a"))
+    out = s.drain()[0]
+    assert out.status == "ok" and out.session == "full_refit"
+    assert ea.state is not None  # repopulated (b now evicted, LRU)
+    # the refit landed where a cold fit over the accumulated table lands
+    m_cold = _model()
+    merged = merge_TOAs([toas, app])
+    _populate(merged, m_cold)
+    for k in ea.model.free_params:
+        sig = ea.model[k].uncertainty or 1.0
+        assert abs(ea.model[k].value_f64
+                   - m_cold[k].value_f64) <= 1e-6 * max(1.0, abs(sig)), k
+
+
+def test_warm_start_from_stale_state_converges(base_problem):
+    """A session whose model drifted (stale cached values) still
+    converges to the cold-fit chi2 through the warm-started full
+    refit path."""
+    toas, app = base_problem["toas"], base_problem["app"][2]
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, _model(), session_id="st"))
+    s.drain()
+    entry = s.sessions.entries[s.sessions._by_sid["st"]]
+    # stale the committed solution: shove F0 several posterior sigmas
+    sig = entry.model["F0"].uncertainty or 1e-10
+    entry.model["F0"].add_delta(5.0 * sig)
+    entry.drift = 1e9  # the motion gate trips on the next append
+    s.submit(FitRequest(app, None, session_id="st"))
+    r = s.drain()[0]
+    assert r.session == "full_refit" and r.status == "ok"
+    m_cold = _model()
+    chi2_cold = _populate(merge_TOAs([toas, app]), m_cold)
+    assert abs(r.chi2 - chi2_cold) <= 1e-6 * abs(chi2_cold)
+
+
+def test_incremental_diverged_falls_back_to_full(base_problem):
+    """A poisoned append diverges the rank-k update; the session layer
+    falls back to the cold path instead of committing garbage."""
+    import dataclasses
+    import jax.numpy as jnp
+
+    toas, app = base_problem["toas"], base_problem["app"][0]
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, _model(), session_id="p"))
+    s.drain()
+    bad = dataclasses.replace(
+        app, error_us=jnp.asarray(np.full(len(app), np.nan)))
+    before = telemetry.counters_snapshot()
+    s.submit(FitRequest(bad, None, session_id="p"))
+    r = s.drain()[0]
+    delta = telemetry.counters_delta(before)
+    assert delta.get("serve.session.incremental_diverged", 0) == 1
+    # the fallback full refit over the poisoned merged table diverges
+    # too — the envelope says so and the entry was not corrupted
+    assert r.status == "diverged" and r.attempts == 2
+
+
+# ----------------------------------------------------------------------
+# backpressure contract (ServeQueueFull-style)
+# ----------------------------------------------------------------------
+
+def test_session_cache_backpressure(base_problem):
+    """Admission fails ONLY when every resident state is pinned by
+    queued requests: SessionCacheFull carries bytes + retry_after_s."""
+    toas = base_problem["toas"]
+    cache = SessionCache(budget_bytes=400)  # one q=6 state (352 B)
+    s = ThroughputScheduler(max_queue=8, session_cache=cache)
+    s.submit(FitRequest(toas, _model(), session_id="a"))
+    s.drain()
+    # unpinned resident state -> a NEW session admits by evicting LRU
+    cache.check_admission(352)  # no raise
+    # queue an append for a: its entry is pinned until the drain
+    s.submit(FitRequest(base_problem["app"][0], None, session_id="a"))
+    with pytest.raises(SessionCacheFull) as ei:
+        s.submit(FitRequest(toas, _model(), session_id="c"))
+    assert ei.value.retry_after_s is not None
+    assert ei.value.budget == 400
+    assert ei.value.bytes_requested > 0
+    # draining unpins; admission recovers
+    s.drain()
+    cache.check_admission(352)
+    s.submit(FitRequest(toas, _model(), session_id="c"))
+    out = s.drain()[0]
+    assert out.status == "ok" and out.session == "populate"
+
+
+def test_session_cache_lru_eviction_order(base_problem, monkeypatch):
+    """Eviction is strict LRU over entries with device state: touching
+    a session protects it; the coldest state goes first."""
+    monkeypatch.setenv("PINT_TPU_SESSION_BYTES", "800")  # two states
+    toas = base_problem["toas"]
+    s = ThroughputScheduler(max_queue=8)
+    for sid in ("a", "b"):
+        s.submit(FitRequest(toas, _model(), session_id=sid))
+        s.drain()
+    # touch a (append) -> b is now LRU
+    s.submit(FitRequest(base_problem["app"][0], None, session_id="a"))
+    s.drain()
+    s.submit(FitRequest(toas, _model(), session_id="c"))
+    s.drain()
+    st = {sid: s.sessions.entries[s.sessions._by_sid[sid]].state
+          for sid in ("a", "b", "c")}
+    assert st["a"] is not None
+    assert st["b"] is None  # LRU victim
+    assert st["c"] is not None
+
+
+def test_oversized_state_is_served_stateless(base_problem, monkeypatch):
+    """A state larger than the whole budget is NOT backpressure: the
+    session is served via full refits (stateless) and counted."""
+    monkeypatch.setenv("PINT_TPU_SESSION_BYTES", "64")
+    toas = base_problem["toas"]
+    s = ThroughputScheduler(max_queue=8)
+    before = telemetry.counters_snapshot()
+    s.submit(FitRequest(toas, _model(), session_id="big"))  # no raise
+    r = s.drain()[0]
+    assert r.status == "ok" and r.session == "populate"
+    delta = telemetry.counters_delta(before)
+    assert delta.get("serve.session.uncacheable", 0) == 1
+    entry = s.sessions.entries[s.sessions._by_sid["big"]]
+    assert entry.state is None
+    s.submit(FitRequest(base_problem["app"][0], None, session_id="big"))
+    r2 = s.drain()[0]
+    assert r2.status == "ok" and r2.session == "full_refit"
+
+
+def test_two_appends_same_session_one_drain(base_problem):
+    """Two appends to one session queued in a single drain serialize:
+    the second update reads the FIRST one's committed state (review
+    finding: both previously dispatched from the pre-update state —
+    stale math on CPU, deleted donated buffers on accelerators)."""
+    toas = base_problem["toas"]
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, _model(), session_id="dd"))
+    s.drain()
+    a0, a1 = base_problem["app"][0], base_problem["app"][1]
+    h0 = s.submit(FitRequest(a0, None, tag=0, session_id="dd"))
+    h1 = s.submit(FitRequest(a1, None, tag=1, session_id="dd"))
+    res = s.drain()
+    assert [r.status for r in res] == ["ok", "ok"]
+    assert [r.session for r in res] == ["incremental", "incremental"]
+    entry = s.sessions.entries[s.sessions._by_sid["dd"]]
+    assert entry.appends == 2
+    assert entry.n_toas == len(toas) + len(a0) + len(a1)
+    # the committed chain lands on the cold fit over ALL three tables
+    m_cold = _model()
+    chi2_cold = _populate(merge_TOAs([toas, a0, a1]), m_cold)
+    assert abs(entry.chi2 - chi2_cold) <= 1e-3 * abs(chi2_cold)
+    # and the second update's chi2 is the larger (more data folded in)
+    assert res[1].chi2 >= res[0].chi2 - 1e-6
+
+
+def test_append_after_failed_populate_is_structured(base_problem):
+    """A model-less append to a session whose populate diverged gets a
+    structured ValueError (review finding: the create-mode admission
+    path used to crash on model=None), and a model-bearing resubmit
+    repopulates the session."""
+    import dataclasses
+    import jax.numpy as jnp
+
+    toas = base_problem["toas"]
+    bad = dataclasses.replace(
+        toas, error_us=jnp.asarray(np.full(len(toas), np.nan)))
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(bad, _model(), session_id="f"))
+    r = s.drain()[0]
+    assert r.status == "diverged"
+    with pytest.raises(ValueError):
+        s.submit(FitRequest(base_problem["app"][0], None,
+                            session_id="f"))
+    s.submit(FitRequest(toas, _model(), session_id="f"))
+    r2 = s.drain()[0]
+    assert r2.status == "ok" and r2.session == "populate"

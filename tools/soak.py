@@ -205,12 +205,16 @@ def _sim_flagged_toas(model, rng, n: int, flag_rng=None):
     return dataclasses.replace(toas, flags=flags)
 
 
-def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
+def one_trial(seed: int, force_chaos: bool = False,
+              force_sessions: bool = False) -> tuple[bool, str, dict]:
     """Returns (ok, failure_text, axes) — axes records which sampler
     dimensions and optional gates this trial exercised, so the committed
     SOAK JSON makes coverage auditable (round-4 VERDICT task 4).
     ``force_chaos`` (the ``--chaos`` flag) arms the fault-injection gate
-    on every trial regardless of its probability draw."""
+    on every trial regardless of its probability draw; ``force_sessions``
+    (``--sessions``) likewise arms the sessionful-append gate (the
+    probability draw is still consumed, so forced and unforced runs of
+    a seed exercise identical axis draws)."""
     rng = np.random.default_rng(seed)
     par = random_par(rng)
     # device-loop/host-loop randomization (ISSUE 3): half the trials run
@@ -719,6 +723,112 @@ def one_trial(seed: int, force_chaos: bool = False) -> tuple[bool, str, dict]:
                 "noise_batch": noise_batch,
             }
 
+        # sessionful append streams (ISSUE 10): the trial's model as a
+        # session — populate, then a randomized stream of small appends
+        # through the scheduler's rank-k incremental path, with the
+        # append-count gate randomized LOW so drift-gate full refits
+        # fire mid-stream, and (half the trials) a byte budget sized to
+        # ONE state so LRU eviction + repopulation run. Every result
+        # must resolve ok/nonconverged with a sane route token, and the
+        # final accumulated solution must land on a standalone cold fit
+        # of the same table. APPENDED gate, own substream.
+        if gates.random() < 0.12 or force_sessions:
+            axes["gates"].append("sessions")
+            from pint_tpu.serve import (FitRequest, SessionCache,
+                                        ThroughputScheduler)
+            from pint_tpu.toas import merge_TOAs
+
+            xrng = np.random.default_rng((seed, 10))
+            n_app = int(xrng.integers(2, 5))
+            max_app = int(xrng.integers(1, 3))  # gate trips mid-stream
+            os.environ["PINT_TPU_SESSION_MAX_APPENDS"] = str(max_app)
+            try:
+                m_s = get_model(par, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_s.free_params:
+                        m_s[name].add_delta(d)
+                t0_s = _sim_flagged_toas(m_s, xrng,
+                                         int(xrng.integers(50, 90)))
+                cache = SessionCache()
+                sched = ThroughputScheduler(max_queue=8,
+                                            session_cache=cache)
+                sched.submit(FitRequest(t0_s, m_s, session_id="soak",
+                                        maxiter=20,
+                                        min_chi2_decrease=1e-5))
+                res0 = sched.drain()[0]
+                assert res0.status in ("ok", "nonconverged"), res0.error
+                assert res0.session == "populate", res0.session
+                key_s = cache._by_sid["soak"]
+                eligible = cache.entries[key_s].state is not None
+                tables = [t0_s]
+                routes = []
+                tiny = eligible and bool(xrng.random() < 0.5)
+                if tiny:
+                    # budget = one state: a second session's populate
+                    # must EVICT this one's state (LRU), never its
+                    # committed solution; the next append repopulates
+                    cache._budget = cache.entries[key_s].state_bytes
+                    m_e = get_model(par, allow_tcb=True)
+                    sched.submit(FitRequest(t0_s, m_e,
+                                            session_id="evictor"))
+                    sched.drain()
+                    assert cache.entries[key_s].state is None, \
+                        "LRU eviction missed the idle session"
+                    assert cache.entries[key_s].model is not None, \
+                        "eviction lost a committed solution"
+                    assert cache.evictions >= 1
+                for j in range(n_app):
+                    app = _sim_flagged_toas(get_model(par,
+                                                      allow_tcb=True),
+                                            xrng,
+                                            int(xrng.integers(2, 9)))
+                    tables.append(app)
+                    sched.submit(FitRequest(app, None,
+                                            session_id="soak",
+                                            maxiter=20,
+                                            min_chi2_decrease=1e-5))
+                    r_j = sched.drain()[0]
+                    assert r_j.status in ("ok", "nonconverged"), \
+                        f"append {j}: {r_j.status} {r_j.error}"
+                    assert r_j.session in ("incremental",
+                                           "full_refit"), r_j.session
+                    routes.append(r_j.session)
+                entry_s = cache.entries[key_s]
+                if eligible and not tiny:
+                    # the gate must have forced >= 1 full refit once
+                    # the stream outran max_app
+                    if n_app > max_app:
+                        assert "full_refit" in routes, (routes, max_app)
+                    assert "incremental" in routes, (routes, max_app)
+                assert entry_s.n_toas == sum(len(t) for t in tables)
+                # final accumulated solution vs a standalone cold fit
+                m_ref = get_model(par, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_ref.free_params:
+                        m_ref[name].add_delta(d)
+                merged_s = merge_TOAs(tables)
+                f_ref = Fitter.auto(merged_s, m_ref)
+                chi2_ref = f_ref.fit_toas(maxiter=20,
+                                          min_chi2_decrease=1e-5)
+                chi2_ref = float(np.atleast_1d(
+                    np.asarray(chi2_ref, float))[0])
+                rel = abs(entry_s.chi2 - chi2_ref) \
+                    / max(abs(chi2_ref), 1e-12)
+                assert rel < 1e-2, (
+                    f"session/standalone chi2 mismatch: "
+                    f"{entry_s.chi2} vs {chi2_ref} (rel {rel:.3g})")
+                for name in entry_s.model.free_params:
+                    assert np.isfinite(entry_s.model[name].value_f64), \
+                        f"session {name} not finite"
+                axes["sessions"] = {
+                    "appends": n_app, "max_appends_gate": max_app,
+                    "routes": routes, "eligible": eligible,
+                    "eviction_branch": tiny,
+                    "chi2_rel_vs_cold": float(f"{rel:.3g}"),
+                }
+            finally:
+                os.environ.pop("PINT_TPU_SESSION_MAX_APPENDS", None)
+
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
         model2 = get_model(par2)
@@ -757,6 +867,10 @@ def main() -> int:
                     help="force the fault-injection gate on every trial "
                          "(ISSUE 6 chaos soak; injection stays seeded and "
                          "reproducible)")
+    ap.add_argument("--sessions", action="store_true",
+                    help="force the sessionful-append gate on every "
+                         "trial (ISSUE 10; append streams stay seeded "
+                         "and reproducible)")
     args = ap.parse_args()
 
     import json
@@ -777,7 +891,7 @@ def main() -> int:
               "git_sha": _git_sha(), "jax": jax.__version__,
               "telemetry_enabled": telemetry.enabled(),
               "seed_base": args.seed, "trials_requested": args.trials,
-              "chaos": args.chaos,
+              "chaos": args.chaos, "sessions": args.sessions,
               "n_pass": 0, "n_fail": 0, "fail_seeds": [], "trials": []}
 
     def save():
@@ -819,7 +933,8 @@ def main() -> int:
         counters_before = telemetry.counters_snapshot()
         t1 = time.time()
         with telemetry.profile_span("soak.trial", seed=seed):
-            ok, msg, axes = one_trial(seed, force_chaos=args.chaos)
+            ok, msg, axes = one_trial(seed, force_chaos=args.chaos,
+                                      force_sessions=args.sessions)
         wall = time.time() - t1
         deltas = telemetry.counters_delta(counters_before)
         repro_path = ""
