@@ -1573,6 +1573,194 @@ def bench_throughput_incremental(n: int, reps: int = 8) -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def _bench_read_mixed(n: int = 100_000, reps: int = 3) -> dict:
+    """The ISSUE-11 acceptance A/B (``PINT_TPU_BENCH_MODE=read_mixed``).
+
+    Mixed read/write serving: a session is populated with an ``n``-TOA
+    WLS fit, the read artifact is warmed, and then batched predictions
+    stream through the scheduler's fast lane — first UNCONTENDED, then
+    CONTENDED with an active ``n``-TOA fused fit in flight on the fit
+    device (the read lane lives on the LAST device of the pool, so with
+    >= 2 devices reads never share a dispatch stream with the fit).
+    Reported: sustained predictions/s (acceptance: >= 1e4), read
+    p50/p99 with and without the concurrent fit (the A/B), prediction
+    parity vs the dense model evaluation, and the zero-fit-launch
+    counter pin over the read stretch. Honest-wall caveat (the
+    SCALE_r06 convention): on a CPU host every virtual device shares
+    the same physical cores, so the contended tail measures host-core
+    contention too — on real silicon the isolation is physical.
+    """
+    import jax as _jax
+
+    from pint_tpu import telemetry
+    from pint_tpu.models import get_model
+    from pint_tpu.parallel.batch import BatchedPulsarFitter
+    from pint_tpu.parallel.mesh import make_mesh
+    from pint_tpu.predict import PHASE_PARITY_CYCLES, dense_predict
+    from pint_tpu.serve import (FitRequest, PredictRequest,
+                                ThroughputScheduler)
+
+    par = _strip_par_lines(PAR, ("EFAC", "ECORR", "TNREDAMP",
+                                 "TNREDGAM", "TNREDC"))
+    rng = np.random.default_rng(17)
+    truth = get_model(par)
+    with telemetry.span("bench.build_problem", n=n):
+        toas = _sim_toas(truth, n, rng)
+    hyper = dict(maxiter=20, min_chi2_decrease=1e-3)
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    s = ThroughputScheduler(max_queue=8)
+    t0 = time.perf_counter()
+    s.submit(FitRequest(toas, m, tag="populate", session_id="bench",
+                        **hyper))
+    r0 = s.drain()[0]
+    populate_s = time.perf_counter() - t0
+    assert r0.status == "ok", r0.error
+    Q = int(os.environ.get("PINT_TPU_BENCH_READ_Q", "256"))
+
+    def q_batch():
+        # one UTC-day cache window: every batch hits the same artifact
+        return np.sort(rng.uniform(54000.0005, 54000.9995, Q))
+
+    # warm: miss (dense + async artifact build), then the steady state
+    r_warm = s.predict(PredictRequest(q_batch(), session_id="bench"))
+    r_hit = s.predict(PredictRequest(q_batch(), session_id="bench"))
+    assert r_hit.cache_hit and r_hit.source == "cheb", r_hit.source
+    # parity vs the dense model evaluation (the documented bound)
+    qp = q_batch()
+    rp = s.predict(PredictRequest(qp, session_id="bench"))
+    entry = s.sessions.lookup_for_read("bench")[1]
+    dpi, dpf, _ = dense_predict(entry.model, qp, obs="@")
+    parity = float(np.max(np.abs((rp.phase_int - dpi)
+                                 + (rp.phase_frac - dpf))))
+
+    # uncontended read stretch (>= 2 s or 400 calls), counter-pinned
+    before = telemetry.counters_snapshot()
+    lats_u: list = []
+    t_loop = time.perf_counter()
+    while len(lats_u) < 400 and time.perf_counter() - t_loop < 2.0:
+        r = s.predict(PredictRequest(q_batch(), session_id="bench"))
+        assert r.status == "ok" and r.cache_hit, (r.status, r.source)
+        lats_u.append(r.latency_s)
+    wall_u = time.perf_counter() - t_loop
+    delta = telemetry.counters_delta(before)
+    launches_reads = int(delta.get("fit.device_loop.launches", 0))
+    preds_per_s = len(lats_u) * Q / wall_u
+
+    # contended stretch: an n-TOA fused fit IN FLIGHT on the fit
+    # device while reads stream. The fit program is warmed first
+    # (compile excluded), each rep dispatches a freshly perturbed model
+    # so the damped loop runs its full depth.
+    fit_devs = [_jax.devices()[0]]
+    mesh = make_mesh(devices=fit_devs, psr_axis=1)
+    lats_c: list = []
+    fit_walls: list = []
+    reads_in_flight = 0
+    for rep in range(max(1, reps)):
+        m_c = get_model(par)
+        m_c["F0"].add_delta(2e-10 * (1 + rep))
+        bf = BatchedPulsarFitter([(toas, m_c)], mesh=mesh)
+        if rep == 0:  # warm the fused loop program once
+            bf.dispatch_fit(**hyper).finish()
+            bf = BatchedPulsarFitter([(toas, m_c)], mesh=mesh)
+        t_fit = time.perf_counter()
+        handle = bf.dispatch_fit(**hyper)
+        while not handle.ready() and len(lats_c) < 2000:
+            r = s.predict(PredictRequest(q_batch(),
+                                         session_id="bench"))
+            assert r.status == "ok", r.error
+            lats_c.append(r.latency_s)
+            reads_in_flight += 1
+        chi2_c = handle.finish()
+        fit_walls.append(time.perf_counter() - t_fit)
+        assert np.all(np.isfinite(np.asarray(chi2_c, dtype=float)))
+
+    def pct(vals, p):
+        return (float(np.percentile(vals, p)) if vals else None)
+
+    p99_u, p99_c = pct(lats_u, 99), pct(lats_c, 99)
+    ratio = (p99_c / p99_u) if (p99_u and p99_c) else None
+    # "unaffected": the contended p99 stays µs-class — within 5x of
+    # the uncontended tail or under an absolute 20 ms SLA (the
+    # honest-wall allowance for shared host cores on XLA:CPU)
+    read_p99_ok = bool(p99_c is not None
+                       and (p99_c <= 5 * p99_u or p99_c <= 0.02))
+    # the MULTICHIP_r06 convention: device-level isolation is only
+    # DEMONSTRABLE with >= 2 physical cores backing the >= 2 devices —
+    # on a 1-core host the XLA:CPU execute pool serializes every
+    # program, so a read dispatched mid-fit waits out the fit wall no
+    # matter which device owns it. The verdict separates "the read
+    # path regressed" from "this host cannot show isolation": this
+    # bench proves placement (reads own their device), parity and
+    # throughput everywhere; the p99 A/B needs real silicon (or a
+    # multi-core host) to pass.
+    cores = os.cpu_count() or 1
+    isolation_provable = bool(cores >= 2 and len(_jax.devices()) >= 2)
+    read_p99_verdict = (
+        "ok" if read_p99_ok
+        else "host_core_bound_needs_silicon" if not isolation_provable
+        else "affected")
+    rec = s.read_stats() or {}
+    return {
+        "n_fit_toas": n,
+        "queries_per_read": Q,
+        "devices": len(_jax.devices()),
+        "read_device": str(s.reads.device),
+        "fit_device": str(fit_devs[0]),
+        "populate_s": round(populate_s, 3),
+        "first_read_s": round(r_warm.latency_s, 6),
+        "reads_uncontended": len(lats_u),
+        "predictions_per_s": round(preds_per_s, 1),
+        "target_predictions_per_s": 1e4,
+        "throughput_ok": bool(preds_per_s >= 1e4),
+        "p50_read_s": pct(lats_u, 50),
+        "p95_read_s": pct(lats_u, 95),
+        "p99_read_s": p99_u,
+        "reads_contended": len(lats_c),
+        "reads_during_fit_flight": reads_in_flight,
+        "fit_walls_s": [round(w, 3) for w in fit_walls],
+        "p50_read_contended_s": pct(lats_c, 50),
+        "p99_read_contended_s": p99_c,
+        "p99_ratio": round(ratio, 2) if ratio else None,
+        "read_p99_ok": read_p99_ok,
+        "host_cores": cores,
+        "isolation_provable": isolation_provable,
+        "read_p99_verdict": read_p99_verdict,
+        "parity_max_cycles": float(f"{parity:.3g}"),
+        "parity_bound_cycles": PHASE_PARITY_CYCLES,
+        "parity_ok": bool(parity < PHASE_PARITY_CYCLES),
+        "fit_launches_during_reads": launches_reads,
+        "zero_fit_launches_ok": launches_reads == 0,
+        "read_record": {k: rec.get(k) for k in
+                        ("requests", "queries", "cache_hit_rate",
+                         "p50_s", "p99_s", "predictions_per_s")},
+        "cache": s.reads.cache.stats(),
+    }
+
+
+def bench_read_mixed(n: int, reps: int = 3) -> None:
+    """Standalone mixed read/write mode (``PINT_TPU_BENCH_MODE=
+    read_mixed``; ISSUE 11). ``value`` is sustained predictions/s;
+    ``vs_baseline`` the ratio to the 1e4/s acceptance floor."""
+    from pint_tpu import telemetry
+
+    metric = f"read_mixed_{n}toas_predictions_per_s"
+    try:
+        with telemetry.span("bench.read_mixed"):
+            rec = _bench_read_mixed(n=n, reps=reps)
+        out = {"metric": metric, "value": rec["predictions_per_s"],
+               "unit": "1/s",
+               "vs_baseline": round(rec["predictions_per_s"] / 1e4, 2),
+               "backend": jax.default_backend(),
+               "host_cores": os.cpu_count(), "mode": "read_mixed",
+               "read_mixed": rec}
+        out.update(_telemetry_fields())
+        _emit(out)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "1/s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
 def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
                  backend: str, device: str, dd_ok_accel: bool) -> None:
     """GLS iteration with the CPU-DD -> accelerator-solve split.
@@ -1721,6 +1909,15 @@ def _compact(record: dict, detail_name: str) -> dict:
              "speedup_vs_warm_refit", "speedup_ok", "chi2_drift_rel",
              "drift_ok", "launches_per_update", "fetches_per_update")
             if k in fi}
+    rm = record.get("read_mixed")
+    if isinstance(rm, dict):
+        out["read_mixed"] = {
+            k: rm[k] for k in
+            ("n_fit_toas", "predictions_per_s", "throughput_ok",
+             "p50_read_s", "p99_read_s", "p99_read_contended_s",
+             "p99_ratio", "read_p99_ok", "read_p99_verdict",
+             "parity_max_cycles", "parity_ok",
+             "zero_fit_launches_ok") if k in rm}
     pta = record.get("pta")
     if isinstance(pta, dict):
         out["pta"] = {k: pta[k] for k in _COMPACT_KEYS if k in pta}
@@ -1738,7 +1935,7 @@ def _compact(record: dict, detail_name: str) -> dict:
         if not fits() and isinstance(out.get(key), str):
             out[key] = out[key][:200]
     for key in ("pta", "fit_throughput", "fit_throughput_mixed",
-                "fit_incremental", "fit_loop", "mfu_pct",
+                "fit_incremental", "read_mixed", "fit_loop", "mfu_pct",
                 "gflops_s", "design_matrix_ms_per_toa", "mode", "device",
                 "load1_start", "wall_median", "wall_spread_pct",
                 "fallback_reason"):
@@ -1859,6 +2056,10 @@ def main() -> None:
         # append path taken, drift inside the gate, one launch/update
         incremental = res.get("incremental") or {}
         ok = ok and incremental.get("ok") is True
+        # read smoke acceptance (ISSUE 11): segment-cache hit, parity
+        # vs dense evaluation, zero fit-loop launches during the read
+        read = res.get("read") or {}
+        ok = ok and read.get("ok") is True
         if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
@@ -1914,6 +2115,17 @@ def main() -> None:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
             n_dev = os.environ.get("PINT_TPU_BENCH_MESH_DEVICES", "8")
+            mode_env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_dev}"
+            ).strip()
+        mode_env.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("PINT_TPU_BENCH_MODE") == "read_mixed":
+        # the read-contention A/B (ISSUE 11) needs >= 2 devices so the
+        # read lane owns a device the contending fit does not: same
+        # virtual-CPU convention as the mesh A/B
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            n_dev = os.environ.get("PINT_TPU_BENCH_READ_DEVICES", "2")
             mode_env["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
@@ -2291,6 +2503,65 @@ def _smoke_incremental() -> dict:
             "p50_update_s": blk.get("p50_update_s")}
 
 
+def _smoke_read() -> dict:
+    """CI read smoke (ISSUE 11): predict against a fitted session.
+
+    Populate a session, read twice — asserting the SECOND read is a
+    segment-cache hit served by the on-device engine, its predictions
+    sit inside the documented parity bound of the dense model-phase
+    evaluation, ZERO fit-loop launches happen during the read (the
+    read path never touches the fit loop — counter-pinned), and the
+    ``type="read"`` record lands with latency percentiles."""
+    from pint_tpu import telemetry
+    from pint_tpu.predict import PHASE_PARITY_CYCLES, dense_predict
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import (FitRequest, PredictRequest,
+                                ThroughputScheduler)
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = ("PSRJ FAKE_READ\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    truth = get_model(par)
+    toas = make_fake_toas_uniform(53000, 56000, 40, truth, obs="@",
+                                  freq_mhz=1400.0, error_us=2.0,
+                                  add_noise=True, seed=140)
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    s = ThroughputScheduler(max_queue=4)
+    s.submit(FitRequest(toas, m, session_id="smoke-read", maxiter=8,
+                        min_chi2_decrease=1e-5))
+    r0 = s.drain()[0]
+    mjds = np.sort(np.random.default_rng(141).uniform(
+        54000.001, 54000.999, 64))
+    r1 = s.predict(PredictRequest(mjds, session_id="smoke-read"))
+    before = telemetry.counters_snapshot()
+    r2 = s.predict(PredictRequest(mjds, session_id="smoke-read"))
+    delta = telemetry.counters_delta(before)
+    launches = int(delta.get("fit.device_loop.launches", 0))
+    entry = s.sessions.lookup_for_read("smoke-read")[1]
+    dpi, dpf, _ = dense_predict(entry.model, mjds, obs="@")
+    parity = float(np.max(np.abs((r2.phase_int - dpi)
+                                 + (r2.phase_frac - dpf))))
+    rec = s.read_stats() or {}
+    ok = (r0.status == "ok" and r1.status == "ok"
+          and r1.source == "dense" and not r1.cache_hit
+          and r2.status == "ok" and r2.cache_hit
+          and r2.source == "cheb"
+          and launches == 0
+          and parity < PHASE_PARITY_CYCLES
+          and rec.get("type") == "read" and rec.get("requests") == 2
+          and rec.get("p50_s") is not None)
+    return {"ok": ok, "sources": [r1.source, r2.source],
+            "cache_hit": bool(r2.cache_hit),
+            "fit_launches_during_read": launches,
+            "parity_max_cycles": float(f"{parity:.3g}"),
+            "parity_bound_cycles": PHASE_PARITY_CYCLES,
+            "p50_read_s": rec.get("p50_s"),
+            "read_device": str(s.reads.device)}
+
+
 def _run_smoke() -> None:
     """CI smoke: one tiny CPU fit proving the telemetry pipeline end-to-end.
 
@@ -2334,6 +2605,10 @@ def _run_smoke() -> None:
         # + drift gate parity every CI pass
         with telemetry.span("bench.incremental_smoke"):
             incremental = _smoke_incremental()
+        # read smoke (ISSUE 11): segment-cache hit + parity + the
+        # zero-fit-launches pin every CI pass
+        with telemetry.span("bench.read_smoke"):
+            read = _smoke_read()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
@@ -2341,7 +2616,8 @@ def _run_smoke() -> None:
                "chi2": round(float(chi2), 3),
                "converged": bool(f.converged),
                "serve": serve, "chaos": chaos, "mesh": mesh,
-               "frontier": frontier, "incremental": incremental}
+               "frontier": frontier, "incremental": incremental,
+               "read": read}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001
@@ -2361,7 +2637,7 @@ def _main_guarded() -> None:
     mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
     if mode in ("pta", "wideband", "batch", "throughput",
                 "throughput_mesh", "throughput_mixed",
-                "throughput_incremental"):
+                "throughput_incremental", "read_mixed"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
@@ -2388,6 +2664,10 @@ def _main_guarded() -> None:
             bench_throughput_incremental(
                 n, max(5, int(os.environ.get("PINT_TPU_BENCH_REPS",
                                              "8"))))
+        elif mode == "read_mixed":
+            bench_read_mixed(
+                int(os.environ.get("PINT_TPU_BENCH_READ_N", "100000")),
+                max(2, int(os.environ.get("PINT_TPU_BENCH_REPS", "3"))))
         else:
             bench_batch(n_psr, max(1, n // n_psr), reps)
         return

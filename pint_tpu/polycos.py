@@ -39,6 +39,33 @@ Array = jax.Array
 MIN_PER_DAY = 1440.0
 
 
+def segment_nodes(mjd_start: float, n_seg: int, segment_length_min: float,
+                  ncoeff: int, nodes_per_coeff: int = 2
+                  ) -> tuple[np.ndarray, np.ndarray, np.ndarray, float]:
+    """Shared node grid of the host and on-device polyco generators.
+
+    Returns ``(tmids (n_seg,), mjds (n_seg, n_nodes + 1), dt_min
+    (n_seg, n_nodes), tscale)``: segment midpoints, the node MJDs with
+    the midpoint FIRST per segment followed by the Chebyshev nodes, the
+    eval-convention minutes-from-midpoint of the Chebyshev nodes, and
+    the least-squares/projection scaling. One function so the host
+    ``generate_polycos`` and ``pint_tpu.predict.engine`` fit the SAME
+    grid — their parity bound is then approximation order, never grid
+    placement. ``dt_min`` comes from the ROUNDED node MJDs actually
+    evaluated (see the comment in :meth:`Polycos.generate_polycos`).
+    """
+    span_days = segment_length_min / MIN_PER_DAY
+    tmids = mjd_start + span_days * (np.arange(n_seg) + 0.5)
+    n_nodes = max(ncoeff * nodes_per_coeff, ncoeff + 2)
+    # Chebyshev nodes over [-1/2, 1/2] segment fractions (+ midpoint)
+    cheb = np.cos(np.pi * (2 * np.arange(n_nodes) + 1) / (2 * n_nodes))
+    offsets_days = np.concatenate([[0.0], 0.5 * span_days * cheb])
+    mjds = tmids[:, None] + offsets_days[None, :]
+    dt_min = (mjds[:, 1:] - tmids[:, None]) * MIN_PER_DAY
+    tscale = max(float(np.max(np.abs(dt_min))), 1.0)
+    return tmids, mjds, dt_min, tscale
+
+
 @dataclasses.dataclass
 class PolycoEntry:
     """One polyco segment (one tempo polyco block)."""
@@ -68,7 +95,12 @@ class PolycoEntry:
         big_i = np.floor(big)
         small = self.rphase_frac + poly + (big - big_i)
         carry = np.floor(small)
-        return self.rphase_int + big_i + carry, small - carry
+        ints = self.rphase_int + big_i + carry
+        frac = small - carry
+        # f64 edge: small = -eps gives carry -1 and small - carry
+        # rounding to EXACTLY 1.0 — re-wrap to keep frac in [0, 1)
+        wrap = frac >= 1.0
+        return ints + wrap, np.where(wrap, frac - 1.0, frac)
 
     def eval_phase(self, mjd) -> np.ndarray:
         """Fractional phase in [0, 1)."""
@@ -106,12 +138,14 @@ class Polycos:
 
         span_days = segment_length_min / MIN_PER_DAY
         n_seg = max(1, int(np.ceil((mjd_end - mjd_start) / span_days)))
-        tmids = mjd_start + span_days * (np.arange(n_seg) + 0.5)
-        n_nodes = max(ncoeff * nodes_per_coeff, ncoeff + 2)
-        # Chebyshev nodes over [-1/2, 1/2] segment fractions (+ midpoint)
-        cheb = np.cos(np.pi * (2 * np.arange(n_nodes) + 1) / (2 * n_nodes))
-        offsets_days = np.concatenate([[0.0], 0.5 * span_days * cheb])
-        mjds = (tmids[:, None] + offsets_days[None, :]).ravel()
+        # dt from the ROUNDED node MJDs actually evaluated: tmid+offset
+        # rounds to f64 before the phase evaluation, and eval-time
+        # dt = (mjd - tmid) * 1440 sees the same rounded values (the
+        # nearby-f64 subtraction is exact); using the unrounded offsets
+        # here would leak an F0-amplified ~ulp(MJD) error (~4e-5 cycles)
+        tmids, mjd_nodes, dt_min_all, tscale = segment_nodes(
+            mjd_start, n_seg, segment_length_min, ncoeff, nodes_per_coeff)
+        mjds = mjd_nodes.ravel()
 
         toas = build_TOAs_from_arrays(
             DD(jnp.asarray(mjds), jnp.zeros(mjds.size)),
@@ -126,14 +160,6 @@ class Polycos:
         f0 = model.f0_f64
         dm = (model.params["DM"].value_f64
               if "DM" in model.params else 0.0)
-        # dt from the ROUNDED node MJDs actually evaluated: tmid+offset
-        # rounds to f64 before the phase evaluation, and eval-time
-        # dt = (mjd - tmid) * 1440 sees the same rounded values (the
-        # nearby-f64 subtraction is exact); using the unrounded offsets
-        # here would leak an F0-amplified ~ulp(MJD) error (~4e-5 cycles)
-        mjd_nodes = mjds.reshape(n_seg, -1)
-        dt_min_all = (mjd_nodes[:, 1:] - tmids[:, None]) * MIN_PER_DAY
-        tscale = max(float(np.max(np.abs(dt_min_all))), 1.0)
         powers = np.arange(ncoeff)
         entries = []
         for s in range(n_seg):
@@ -155,6 +181,33 @@ class Polycos:
                 f0_ref=f0, obs=obs, span_min=float(segment_length_min),
                 ncoeff=ncoeff, coeffs=coeffs, freq_mhz=float(freq_mhz),
                 dm=float(dm)))
+        return cls(entries)
+
+    @classmethod
+    def from_arrays(cls, tmids, coeffs, rphase_int, rphase_frac, *,
+                    f0_ref: float, span_min: float, obs: str = "@",
+                    freq_mhz: float = 1400.0, dm: float = 0.0,
+                    psrname: str = "PSR") -> "Polycos":
+        """Wrap per-segment arrays as a :class:`Polycos`.
+
+        The export seam of the on-device read path
+        (:meth:`pint_tpu.predict.engine.ChebWindow.to_polycos`): a
+        fetched segment-cache artifact becomes a host ``Polycos`` —
+        writable as a classic tempo ``polyco.dat`` for observatory
+        folding backends — evaluating the same polynomials.
+        """
+        tmids = np.asarray(tmids, dtype=np.float64)
+        coeffs = np.asarray(coeffs, dtype=np.float64)
+        rphase_int = np.asarray(rphase_int, dtype=np.float64)
+        rphase_frac = np.asarray(rphase_frac, dtype=np.float64)
+        entries = [PolycoEntry(
+            psrname=psrname, tmid_mjd=float(tmids[s]),
+            rphase_int=float(rphase_int[s]),
+            rphase_frac=float(rphase_frac[s]), f0_ref=float(f0_ref),
+            obs=obs, span_min=float(span_min),
+            ncoeff=int(coeffs.shape[1]), coeffs=coeffs[s],
+            freq_mhz=float(freq_mhz), dm=float(dm))
+            for s in range(len(tmids))]
         return cls(entries)
 
     # ------------------------------------------------------------ evaluate

@@ -1,0 +1,324 @@
+"""On-device polycos engine: the read path's compute core (ISSUE 11).
+
+A fitted model answers "what is the pulse phase/period at time t" — the
+read-dominated traffic of a real timing service — through a two-program
+pipeline that never touches the fit loop:
+
+* **Generation** (:func:`generate_cheb_window`): Chebyshev segment
+  coefficients for one cache window in ONE fused launch. The node grid
+  is :func:`pint_tpu.polycos.segment_nodes` — the SAME grid the host
+  ``Polycos`` generator fits, so parity is approximation order, never
+  grid placement. In-program: the composed phase function evaluates
+  every node of every segment (batched over the flat node axis), the
+  per-segment midpoint-referenced phase differences are formed
+  part-wise (exact integers + DD fraction differences — never
+  collapsing ~1e9-cycle absolute phases to one f64), the big linear
+  ``dt * 60 * F0`` term is subtracted, and a DCT-style Chebyshev
+  analysis + monomial conversion (one static (ncoeff, n_nodes)
+  projection matrix, one matmul) produces tempo-convention polynomial
+  coefficients for ALL segments at once. JAX async dispatch makes the
+  launch non-blocking: a cache miss serves its own request through the
+  dense fallback while the artifact warms in the background.
+* **Evaluation** (:func:`eval_window`): batched phase/apparent-
+  frequency prediction across heterogeneous query times — on-device
+  ``searchsorted`` nearest-segment lookup, gathered coefficients, a
+  Horner pass for the polynomial and its derivative — with the query
+  axis padded to the pow-2 bucket so every read of a window executes
+  one of O(log max-batch) compiled programs. This is the µs-class
+  device work of a read.
+
+The projection differs from the host path's scaled-Vandermonde least
+squares (Chebyshev analysis truncates the degree-``n_nodes - 1``
+interpolant; lstsq minimizes uniform-weight residuals), so raw
+coefficients agree to the shared truncation error, not bitwise — the
+documented parity bounds (tests/test_predict.py) are
+:data:`PHASE_PARITY_CYCLES` on evaluated phase against BOTH the host
+``Polycos`` path and the dense model evaluation,
+:data:`FREQ_PARITY_REL` on apparent spin frequency, and
+:data:`COEFF_PARITY_CYCLES` on each coefficient's cycles-scale
+contribution ``|dc_p| * tscale^p``.
+
+Kill switch: ``PINT_TPU_READ_PATH=0`` (read per call) routes every
+predict request to the host ``Polycos`` reference path —
+:class:`pint_tpu.predict.ReadService` consults :func:`read_path_enabled`
+before touching this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import bucketing, telemetry
+from pint_tpu.ops.dd import DD
+from pint_tpu.polycos import MIN_PER_DAY, segment_nodes
+
+Array = jax.Array
+
+#: documented read-parity acceptance (pinned by tests/test_predict.py
+#: and the bench read smoke): evaluated phase, device engine vs host
+#: ``Polycos`` AND vs dense model evaluation [cycles]
+PHASE_PARITY_CYCLES = 1e-7
+#: apparent spin frequency, device engine vs host ``Polycos`` [relative]
+FREQ_PARITY_REL = 1e-9
+#: per-coefficient cycles-scale contribution |dc_p| * tscale^p [cycles]
+COEFF_PARITY_CYCLES = 1e-6
+
+
+def read_path_enabled() -> bool:
+    """Read-path kill switch (read per call so tests can flip it):
+    ``PINT_TPU_READ_PATH=0`` serves every predict through the host
+    ``Polycos`` reference path instead of the on-device engine."""
+    return os.environ.get("PINT_TPU_READ_PATH", "") != "0"
+
+
+def segment_minutes() -> float:
+    """Segment length of the read artifact [minutes]."""
+    return float(os.environ.get("PINT_TPU_READ_SEGMENT_MIN", "60"))
+
+
+def window_segments() -> int:
+    """Segments per cache window (window span = this x segment)."""
+    return int(os.environ.get("PINT_TPU_READ_WINDOW_SEGMENTS", "24"))
+
+
+def read_ncoeff() -> int:
+    """Polynomial order of the read artifact (tempo NCOEFF)."""
+    return int(os.environ.get("PINT_TPU_READ_NCOEFF", "12"))
+
+
+def window_days() -> float:
+    """Span of one cache window [days]; windows tile the MJD axis from
+    0 so equal-config queries at equal epochs share one artifact."""
+    return window_segments() * segment_minutes() / MIN_PER_DAY
+
+
+# ----------------------------------------------------------------------
+# generation: one fused launch -> per-segment tempo-convention coeffs
+# ----------------------------------------------------------------------
+
+def _projection_matrix(ncoeff: int, n_nodes: int) -> np.ndarray:
+    """Static (ncoeff, n_nodes) map: node values -> monomial coeffs.
+
+    Row space: Chebyshev analysis at the nodes x_k = cos(theta_k),
+    theta_k = pi (2k+1) / (2 n_nodes) — the DCT-style projection
+    a_j = (2/N) sum_k y_k cos(j theta_k) (a_0 halved) — composed with
+    the Chebyshev->monomial change of basis in the scaled domain
+    x = dt / tscale. ``coeffs_x = P @ y`` per segment; one matmul
+    projects every segment at once.
+    """
+    k = np.arange(n_nodes)
+    theta = np.pi * (2 * k + 1) / (2 * n_nodes)
+    D = (2.0 / n_nodes) * np.cos(np.outer(np.arange(ncoeff), theta))
+    D[0] *= 0.5
+    C2M = np.zeros((ncoeff, ncoeff))
+    for j in range(ncoeff):
+        e = np.zeros(j + 1)
+        e[j] = 1.0
+        C2M[: j + 1, j] = np.polynomial.chebyshev.cheb2poly(e)
+    return C2M @ D
+
+
+def _gen_builder(owner, n_seg: int, n_nodes: int, ncoeff: int):
+    """The fused generation program (built under ``_cached_jit``'s
+    deepcopy, jitted by it): phase at all nodes + projection, one
+    launch."""
+    phase_fn = owner.phase_fn_toas()
+    P = jnp.asarray(_projection_matrix(ncoeff, n_nodes))
+    powers = np.arange(ncoeff)
+
+    def gen(base, deltas, toas, dt_min, f0, scale):
+        ph = phase_fn(base, deltas, toas)
+        pi = jnp.reshape(ph.int_part, (n_seg, n_nodes + 1))
+        hi = jnp.reshape(ph.frac.hi, (n_seg, n_nodes + 1))
+        lo = jnp.reshape(ph.frac.lo, (n_seg, n_nodes + 1))
+        # phase difference node - midpoint, part-wise (exact ints, then
+        # the small DD fraction differences) — the host generator's rule
+        dphi = ((pi[:, 1:] - pi[:, :1]) + (hi[:, 1:] - hi[:, :1])
+                + (lo[:, 1:] - lo[:, :1]))
+        y = dphi - dt_min * (60.0 * f0)
+        cx = y @ P.T                       # (n_seg, ncoeff), x-domain
+        # the Chebyshev analysis domain is EXACTLY dt = scale * x with
+        # scale = span_min / 2 (the node construction): unscaling by
+        # anything else (e.g. max |dt| = scale * cos(pi/2N)) leaks a
+        # ~0.2%-per-power coefficient error (~1e-2 cycles measured)
+        coeffs = cx / scale ** powers      # tempo domain (minutes)
+        return {"coeffs": coeffs, "rphase_int": pi[:, 0],
+                "rphase_frac": hi[:, 0] + lo[:, 0]}
+
+    return gen
+
+
+@dataclasses.dataclass
+class ChebWindow:
+    """One cache window's read artifact: per-segment Chebyshev-fitted
+    polynomial coefficients as DEVICE arrays (the generation launch is
+    async — evaluation programs consume them without a host sync)."""
+
+    mjd_start: float
+    mjd_end: float
+    span_min: float
+    ncoeff: int
+    obs: str
+    freq_mhz: float
+    tmids: np.ndarray        # (S,) host copy (keys/export/binning)
+    dev: dict                # device arrays: tmids, coeffs (S, C),
+    #                          rphase_int, rphase_frac, f0
+    f0_ref: float
+    nbytes: int
+
+    def ready(self) -> bool:
+        """Has the async generation launch completed (queue peek)?"""
+        try:
+            return all(x.is_ready() for x in self.dev.values()
+                       if hasattr(x, "is_ready"))
+        except Exception:  # noqa: BLE001 — readiness is advisory
+            return True
+
+    def to_polycos(self, psrname: str = "PSR", dm: float = 0.0):
+        """Fetch + wrap as a host :class:`~pint_tpu.polycos.Polycos`
+        (tempo polyco.dat export seam)."""
+        from pint_tpu.polycos import Polycos
+
+        return Polycos.from_arrays(
+            self.tmids, np.asarray(self.dev["coeffs"]),
+            np.asarray(self.dev["rphase_int"]),
+            np.asarray(self.dev["rphase_frac"]), f0_ref=self.f0_ref,
+            span_min=self.span_min, obs=self.obs,
+            freq_mhz=self.freq_mhz, psrname=psrname, dm=dm)
+
+
+def eligible(model) -> bool:
+    """Can this model feed the Chebyshev engine? Absolute phase needs
+    the TZR anchor, and the tempo format needs a spin frequency."""
+    return model.get_tzr_toas() is not None and "F0" in model.params
+
+
+def generate_cheb_window(model, mjd_start: float, *, n_seg: int,
+                         segment_length_min: float, ncoeff: int,
+                         obs: str = "@", freq_mhz: float = 1400.0,
+                         device=None) -> ChebWindow:
+    """Dispatch the fused generation launch for one window (async).
+
+    Host work is the node-table build (~n_seg x (n_nodes + 1) rows
+    through the clock/ephemeris pipeline); the phase evaluation +
+    projection is ONE program launch whose outputs come back as
+    in-flight device arrays. ``device`` places the artifact (and
+    therefore every evaluation of it) on a specific device — the
+    scheduler's read lane uses this to keep reads off the fit devices.
+    """
+    from pint_tpu.toas import build_TOAs_from_arrays
+
+    tmids, mjd_nodes, dt_min, _tscale = segment_nodes(
+        mjd_start, n_seg, segment_length_min, ncoeff)
+    n_nodes = dt_min.shape[1]
+    mjds = mjd_nodes.ravel()
+    with telemetry.span("predict.generate", segments=n_seg):
+        toas = build_TOAs_from_arrays(
+            DD(jnp.asarray(mjds), jnp.zeros(mjds.size)),
+            freq_mhz=np.full(mjds.size, float(freq_mhz)),
+            error_us=np.full(mjds.size, 1.0),
+            obs_names=(obs,), eph=model.ephem)
+        fn = model._cached_jit(
+            ("predict_cheb", n_seg, n_nodes, ncoeff),
+            lambda owner: _gen_builder(owner, n_seg, n_nodes, ncoeff))
+        bucketing.note_program("predict_cheb", (id(fn),),
+                               (n_seg, n_nodes, ncoeff))
+        out = fn(model.base_dd(), {}, toas, jnp.asarray(dt_min),
+                 jnp.asarray(model.f0_f64),
+                 jnp.asarray(segment_length_min / 2.0))
+    dev = {"tmids": jnp.asarray(tmids), **out,
+           "f0": jnp.asarray(model.f0_f64)}
+    if device is not None:
+        dev = {k: jax.device_put(v, device) for k, v in dev.items()}
+    telemetry.inc("serve.read.segment_builds")
+    span_days = segment_length_min / MIN_PER_DAY
+    return ChebWindow(
+        mjd_start=float(mjd_start),
+        mjd_end=float(mjd_start + n_seg * span_days),
+        span_min=float(segment_length_min), ncoeff=int(ncoeff), obs=obs,
+        freq_mhz=float(freq_mhz), tmids=tmids, dev=dev,
+        f0_ref=float(model.f0_f64),
+        nbytes=8 * (n_seg * ncoeff + 3 * n_seg + 1))
+
+
+# ----------------------------------------------------------------------
+# evaluation: batched queries -> (phase_int, phase_frac, freq) on-device
+# ----------------------------------------------------------------------
+
+@jax.jit
+def _eval_cheb(tmids, coeffs, rp_int, rp_frac, f0, half_span_days, mjds):
+    """Vmapped-in-effect batched evaluation: every query gathers its
+    nearest segment via ``searchsorted`` and runs one Horner pass for
+    the polynomial and its derivative. Shapes specialize per
+    (segments, ncoeff, query bucket); jax.jit caches the programs."""
+    S = tmids.shape[0]
+    C = coeffs.shape[1]
+    if S > 1:
+        idx = jnp.clip(jnp.searchsorted(tmids, mjds), 1, S - 1)
+        left = idx - 1
+        idx = jnp.where(jnp.abs(mjds - tmids[left])
+                        <= jnp.abs(mjds - tmids[idx]), left, idx)
+    else:
+        idx = jnp.zeros(mjds.shape, dtype=jnp.int32)
+    dt = (mjds - tmids[idx]) * MIN_PER_DAY
+    c = coeffs[idx]                          # (Q, C)
+    poly = c[:, C - 1]
+    for p in range(C - 2, -1, -1):
+        poly = poly * dt + c[:, p]
+    dpoly = c[:, C - 1] * (C - 1)
+    for p in range(C - 2, 0, -1):
+        dpoly = dpoly * dt + c[:, p] * p
+    # keep the big linear term separate from the small pieces (the
+    # host PolycoEntry.eval_abs_phase convention)
+    big = dt * (60.0 * f0)
+    big_i = jnp.floor(big)
+    small = rp_frac[idx] + poly + (big - big_i)
+    carry = jnp.floor(small)
+    phase_int = rp_int[idx] + big_i + carry
+    phase_frac = small - carry
+    # f64 edge: small = -eps gives carry -1 and small - carry rounding
+    # to EXACTLY 1.0 — re-wrap so the [0, 1) contract holds
+    wrap = phase_frac >= 1.0
+    phase_int = phase_int + wrap
+    phase_frac = jnp.where(wrap, phase_frac - 1.0, phase_frac)
+    freq = f0 + dpoly / 60.0
+    in_span = jnp.abs(mjds - tmids[idx]) <= half_span_days + 1e-9
+    return phase_int, phase_frac, freq, in_span
+
+
+def eval_window(window: ChebWindow, mjds: np.ndarray
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Evaluate one window at query MJDs: ``(phase_int, phase_frac in
+    [0, 1), freq_hz, in_span)`` as host arrays.
+
+    The query axis pads to the pow-2 bucket (padding replicates the
+    window's first midpoint — always in-span) so heterogeneous query
+    counts share compiled programs; the ``device_get`` here is the
+    read's single device->host sync.
+    """
+    mjds = np.atleast_1d(np.asarray(mjds, dtype=np.float64))
+    n = mjds.size
+    nb = bucketing.bucket_size(n)
+    q = mjds if nb == n else np.concatenate(
+        [mjds, np.full(nb - n, window.tmids[0])])
+    dev = window.dev
+    q_dev = q
+    sharding = getattr(dev["coeffs"], "sharding", None)
+    if sharding is not None and getattr(sharding, "device_set", None):
+        # pin queries to the artifact's device so evaluation runs there
+        # (the read lane's placement), not on the default device
+        q_dev = jax.device_put(jnp.asarray(q),
+                               next(iter(sharding.device_set)))
+    bucketing.note_program("predict_eval", None,
+                           (len(window.tmids), window.ncoeff, nb))
+    half_days = window.span_min / MIN_PER_DAY / 2.0
+    out = _eval_cheb(dev["tmids"], dev["coeffs"], dev["rphase_int"],
+                     dev["rphase_frac"], dev["f0"],
+                     jnp.asarray(half_days), q_dev)
+    pi, pf, fr, ok = (np.asarray(x)[:n] for x in jax.device_get(out))
+    return pi, pf, fr, ok

@@ -12,9 +12,12 @@ degradation ladder, with seed-driven chaos in
 :mod:`pint_tpu.serve.faults`. Sessionful requests
 (``FitRequest.session_id``; :mod:`pint_tpu.serve.session`) append TOAs
 to a cached converged solution via fused rank-k incremental updates
-instead of paying a cold fit. See docs/ARCHITECTURE.md "Throughput
-engine", "Failure domains & degradation ladder" and "Sessionful
-serving".
+instead of paying a cold fit. Reads (:class:`PredictRequest`;
+:mod:`pint_tpu.predict`) are the second tier: phase/TOA predictions
+served from cached fit state through a fast lane that never queues
+behind fit drains. See docs/ARCHITECTURE.md "Throughput engine",
+"Failure domains & degradation ladder", "Sessionful serving" and
+"The read path".
 """
 
 from pint_tpu.serve import faults  # noqa: F401
@@ -25,12 +28,14 @@ from pint_tpu.serve.fingerprint import (  # noqa: F401
     short_id, structure_fingerprint)
 from pint_tpu.serve.pipeline import run_pipeline  # noqa: F401
 from pint_tpu.serve.scheduler import (  # noqa: F401
-    STATUSES, BatchPlan, FitHandle, FitRequest, FitResult, ServeQueueFull,
+    READ_STATUSES, STATUSES, BatchPlan, FitHandle, FitRequest, FitResult,
+    PredictHandle, PredictRequest, PredictResult, ServeQueueFull,
     ThroughputScheduler, transient_error)
 
 __all__ = [
     "BatchPlan", "DRIFT_CHI2_REL", "FitHandle", "FitRequest",
-    "FitResult", "STATUSES", "ServeQueueFull", "SessionCache",
+    "FitResult", "PredictHandle", "PredictRequest", "PredictResult",
+    "READ_STATUSES", "STATUSES", "ServeQueueFull", "SessionCache",
     "SessionCacheFull", "ThroughputScheduler", "basis_bucket",
     "batchable", "faults", "family", "noise_batch_enabled", "plan_key",
     "run_pipeline", "short_id", "structure_fingerprint",

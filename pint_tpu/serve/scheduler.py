@@ -257,6 +257,79 @@ class FitHandle:
         return self._result
 
 
+# ----------------------------------------------------------------------
+# the read path (ISSUE 11): predictions served from cached fit state
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PredictRequest:
+    """One read: pulse phase / apparent spin frequency at query times.
+
+    Reads NEVER touch the fit loop: they are served from the committed
+    session solution (``session_id``) or an explicit fitted ``model``
+    through :mod:`pint_tpu.predict` — segment-cache hit -> on-device
+    Chebyshev evaluation; miss -> direct dense model-phase evaluation
+    while the artifact warms asynchronously; ``PINT_TPU_READ_PATH=0``
+    -> the host ``Polycos`` reference path. ``deadline_s`` is the read
+    SLA, counted from submit exactly like a fit deadline.
+    """
+
+    mjds: Any                     # (n,) site-local MJD query times
+    session_id: Any = None        # serve from this session's solution
+    model: Any = None             # sessionless: an explicit fitted model
+    obs: str = "@"                # tempo site code of the queries
+    freq_mhz: float = 1400.0      # observing frequency of the queries
+    tag: Any = None
+    deadline_s: float | None = None
+
+
+#: read-result status taxonomy (a strict subset of :data:`STATUSES`)
+READ_STATUSES = ("ok", "failed", "timed_out")
+
+
+@dataclasses.dataclass
+class PredictResult:
+    """Per-read outcome envelope (the fast lane's ``FitResult``).
+
+    ``phase_int``/``phase_frac``/``freq_hz`` are host arrays aligned
+    with the request's ``mjds`` (``None`` on ``failed``); ``source``
+    names the ladder rung that served it (``cheb`` / ``dense`` /
+    ``mixed`` / ``host_polycos``); ``latency_s`` counts from submit —
+    for the synchronous fast lane that is the service time itself.
+    """
+
+    tag: Any
+    request: PredictRequest
+    status: str
+    phase_int: Any = None
+    phase_frac: Any = None
+    freq_hz: Any = None
+    source: str = ""
+    cache_hit: bool = False
+    n_queries: int = 0
+    latency_s: float = 0.0
+    error: str | None = None
+
+
+class PredictHandle:
+    """Future-like handle for queued reads (:meth:`ThroughputScheduler
+    .submit` with a :class:`PredictRequest`)."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self):
+        self._result: PredictResult | None = None
+
+    def done(self) -> bool:
+        return self._result is not None
+
+    def result(self) -> PredictResult:
+        if self._result is None:
+            raise RuntimeError("read not drained yet; call "
+                               "ThroughputScheduler.drain_reads() first")
+        return self._result
+
+
 @dataclasses.dataclass
 class BatchPlan:
     """One planned program launch (inspectable, pure — no device work).
@@ -420,6 +493,20 @@ class ThroughputScheduler:
 
         self.sessions = (session_cache if session_cache is not None
                          else SessionCache())
+        # the read path (ISSUE 11): predictions from cached fit state.
+        # Artifacts (and their evaluations) live on the LAST device of
+        # the pool — with > 1 device, reads never share a dispatch
+        # stream with fit programs; the session cache invalidates the
+        # segment cache on every commit
+        from pint_tpu.predict import ReadService
+
+        self.reads = ReadService(
+            device=self.devices[-1 if self.n_devices > 1 else 0])
+        self.sessions.attach_read_cache(self.reads.cache)
+        self._read_queue: list[tuple[PredictRequest, PredictHandle,
+                                     float]] = []
+        self._read_stats: list[dict] = []  # per-read, since last record
+        self.last_read: dict | None = None
 
     # ------------------------------------------------------------------
     # degradation ladder
@@ -466,7 +553,13 @@ class ThroughputScheduler:
         request on the enqueue path (it is ~1 ms of model hashing — in
         the drain it would serialize with every batch), so an
         unfingerprintable model fails fast at submission and
-        :meth:`plan`/:meth:`drain` only group precomputed keys."""
+        :meth:`plan`/:meth:`drain` only group precomputed keys.
+
+        A :class:`PredictRequest` routes to the READ lane instead: its
+        own bounded queue, drained by :meth:`drain_reads` ahead of any
+        fit batch — reads never queue behind fit drains."""
+        if isinstance(request, PredictRequest):
+            return self._submit_read(request)
         degraded = self.degraded()
         cap = self.max_queue if not degraded else max(1, self.max_queue // 2)
         if len(self._queue) >= cap:
@@ -534,6 +627,179 @@ class ThroughputScheduler:
 
     def pending(self) -> int:
         return len(self._queue)
+
+    def pending_reads(self) -> int:
+        return len(self._read_queue)
+
+    # ------------------------------------------------------------------
+    # the read lane (ISSUE 11)
+    # ------------------------------------------------------------------
+    def predict(self, request: PredictRequest) -> PredictResult:
+        """The fast lane: serve one read NOW, synchronously.
+
+        Never enqueued, never behind the fit queue — the µs-class
+        request/response shape observatories and folding pipelines use.
+        Its stats ride the same rolling window as queued reads and land
+        in the next ``type="read"`` record."""
+        return self._serve_read(request, time.perf_counter())
+
+    def _submit_read(self, request: PredictRequest) -> PredictHandle:
+        """Enqueue one read; the read queue is bounded like the fit
+        queue (at 4x — reads are orders of magnitude cheaper) and
+        rejects with the same :class:`ServeQueueFull` contract."""
+        cap = 4 * self.max_queue
+        if len(self._read_queue) >= cap:
+            telemetry.inc("serve.rejected")
+            raise ServeQueueFull(
+                depth=len(self._read_queue), max_queue=cap,
+                retry_after_s=0.05)
+        handle = PredictHandle()
+        self._read_queue.append((request, handle, time.perf_counter()))
+        telemetry.inc("serve.requests")
+        return handle
+
+    def drain_reads(self) -> list[PredictResult]:
+        """Serve every queued read and emit one ``type="read"`` record.
+
+        Called by :meth:`drain` BEFORE any fit batch forms (the
+        two-tier contract) and callable standalone — a read drain never
+        launches, waits on, or fetches fit work."""
+        if not self._read_queue:
+            return []
+        queue, self._read_queue = self._read_queue, []
+        out = []
+        for req, handle, t_sub in queue:
+            res = self._serve_read(req, t_sub)
+            handle._result = res
+            out.append(res)
+        self._emit_read_record()
+        return out
+
+    def read_stats(self) -> dict | None:
+        """Flush fast-lane stats into a ``type="read"`` record and
+        return the latest record (None when no reads ran)."""
+        self._emit_read_record()
+        return self.last_read
+
+    def _serve_read(self, request: PredictRequest,
+                    t_submit: float) -> PredictResult:
+        """Resolve + serve one read through the predict ladder."""
+        from pint_tpu.serve import fingerprint as _fpm
+
+        telemetry.inc("serve.read.requests")
+        t0 = time.perf_counter()
+        try:
+            n = int(np.atleast_1d(np.asarray(request.mjds)).size)
+        except Exception:  # noqa: BLE001 — ragged input: predict()
+            n = 0          # below raises the structured error
+        status, error, out = "ok", None, None
+        with telemetry.span("serve.read"):
+            try:
+                if request.session_id is not None:
+                    skey, entry = self.sessions.lookup_for_read(
+                        request.session_id)
+                    model, version = entry.model, entry.version
+                elif request.model is not None:
+                    model, version = request.model, 0
+                    # sessionless keys carry a value digest: the cache
+                    # has no commit hook into a caller-owned model, so
+                    # changed values must MISS (stale entries LRU out)
+                    fp8 = _fpm.short_id(
+                        _fpm.structure_fingerprint(model, None))
+                    values = tuple(
+                        p.value_f64 for p in model.params.values()
+                        if p.is_numeric)
+                    skey = ("model", fp8, hash(values))
+                else:
+                    raise ValueError(
+                        "PredictRequest needs a session_id or a model")
+                out = self.reads.predict(
+                    model, request.mjds, obs=request.obs,
+                    freq_mhz=request.freq_mhz, skey=skey,
+                    version=version)
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                status = "failed"
+                error = f"{type(e).__name__}: {e}"
+                telemetry.inc("serve.read.failed")
+        t_done = time.perf_counter()
+        latency = t_done - t_submit       # queue-inclusive (the SLA)
+        service_s = t_done - t0           # this read's own work
+        if (status == "ok" and request.deadline_s is not None
+                and latency > request.deadline_s):
+            telemetry.inc("serve.read.deadline_timeouts")
+            status = "timed_out"
+            error = (f"deadline_s={request.deadline_s:g} exceeded "
+                     f"(latency {latency:.6f}s); the completed "
+                     "prediction is attached")
+        telemetry.inc(f"serve.read.status.{status}")
+        res = PredictResult(
+            tag=request.tag, request=request, status=status,
+            phase_int=None if out is None else out.phase_int,
+            phase_frac=None if out is None else out.phase_frac,
+            freq_hz=None if out is None else out.freq_hz,
+            source="" if out is None else out.source,
+            cache_hit=bool(out is not None and out.cache_hit),
+            n_queries=n, latency_s=round(latency, 9), error=error)
+        self._read_stats.append({
+            "latency_s": latency, "service_s": service_s,
+            "queries": n, "status": status,
+            "hit": res.cache_hit,
+            "source": res.source or "error",
+            "misses": 0 if out is None else out.window_misses,
+            "fallback_queries": (0 if out is None
+                                 else out.fallback_queries)})
+        if status == "failed":
+            telemetry.add_record({
+                "type": "fault", "status": "read_failed",
+                "tag": repr(request.tag), "error": error,
+                "queue_latency_s": round(latency, 6)})
+        return res
+
+    def _emit_read_record(self) -> None:
+        """One ``type="read"`` record per window of served reads: the
+        drain-record analogue for the read tier (hit rate, fallbacks,
+        latency percentiles, throughput) — rendered by the report CLI's
+        "read path" section; absent on read-free runs so old artifacts
+        degrade gracefully."""
+        window, self._read_stats = self._read_stats, []
+        if not window:
+            return
+        lats = sorted(r["latency_s"] for r in window)
+
+        def pct(p):
+            i = min(len(lats) - 1, max(0, round(p / 100 * (len(lats) - 1))))
+            return round(lats[i], 9)
+
+        sources: dict[str, int] = {}
+        statuses: dict[str, int] = {}
+        for r in window:
+            sources[r["source"]] = sources.get(r["source"], 0) + 1
+            statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+        queries = sum(r["queries"] for r in window)
+        # throughput over SERVICE time, not queue-inclusive latency:
+        # queued reads all share the same queue wait, so summing their
+        # latencies would overcount the wall by the queue depth
+        busy = sum(r["service_s"] for r in window)
+        self.last_read = {
+            "type": "read",
+            "requests": len(window),
+            "queries": queries,
+            "cache_hit_rate": round(
+                sum(1 for r in window if r["hit"]) / len(window), 4),
+            "window_misses": sum(r["misses"] for r in window),
+            "fallback_queries": sum(r["fallback_queries"]
+                                    for r in window),
+            "sources": sources,
+            "statuses": statuses,
+            "p50_s": pct(50), "p95_s": pct(95), "p99_s": pct(99),
+            "predictions_per_s": (round(queries / busy, 1)
+                                  if busy > 0 else None),
+            "latencies_s": [round(v, 9) for v in lats[:64]],
+            "cache": self.reads.cache.stats(),
+        }
+        telemetry.set_gauge("serve.read.p50_s", self.last_read["p50_s"])
+        telemetry.set_gauge("serve.read.p95_s", self.last_read["p95_s"])
+        telemetry.add_record(dict(self.last_read))
 
     # ------------------------------------------------------------------
     # batch formation
@@ -866,6 +1132,14 @@ class ThroughputScheduler:
         """
         from pint_tpu.telemetry import recorder
 
+        # two-tier scheduling (ISSUE 11): the read lane drains FIRST —
+        # queued reads are served (and any fast-lane stats recorded)
+        # before a single fit batch forms, so a read can never wait on
+        # a fit launch, fetch or salvage
+        if self._read_queue:
+            self.drain_reads()
+        else:
+            self._emit_read_record()
         if not self._queue:
             return []
         queue, self._queue = self._queue, []

@@ -133,6 +133,10 @@ class SessionEntry:
     appends: int = 0           # rank-k updates since last full refit
     drift: float = 0.0         # cumulative motion [sigma] since refit
     pins: int = 0              # queued requests referencing this entry
+    #: commit version (ISSUE 11): bumped on every committed populate/
+    #: refit/incremental update; read artifacts record the version they
+    #: were built from and the segment cache refuses a mismatch
+    version: int = 0
 
     def accumulated(self):
         """The full committed table, merging any pending appends."""
@@ -160,6 +164,9 @@ class SessionCache:
         self._by_sid: dict[Any, tuple] = {}  # sid -> most recent key
         self.bytes_in_use = 0
         self.evictions = 0
+        # read-path invalidation hooks (ISSUE 11): segment caches whose
+        # artifacts derive from this cache's committed models
+        self._read_caches: list = []
 
     @property
     def budget(self) -> int:
@@ -188,6 +195,36 @@ class SessionCache:
         fp = _fp.structure_fingerprint(request.model, request.toas)
         key = (sid, _fp.short_id(fp))
         return key, self.entries.get(key), fp
+
+    def lookup_for_read(self, session_id) -> tuple[tuple, SessionEntry]:
+        """(key, entry) of a session's committed solution for the read
+        path (ISSUE 11). Reads are served from the HOST model — device
+        fit-state eviction never affects them — and never pin."""
+        key = self._by_sid.get(session_id)
+        if key is None or self.entries[key].model is None:
+            raise ValueError(
+                f"session {session_id!r} has no committed solution to "
+                "read from; fit (populate) it first")
+        self.entries.move_to_end(key)
+        return key, self.entries[key]
+
+    def attach_read_cache(self, cache) -> None:
+        """Register a segment cache for commit invalidation (anything
+        with ``invalidate_session(key)``)."""
+        if cache not in self._read_caches:
+            self._read_caches.append(cache)
+
+    def notify_commit(self, key: tuple) -> None:
+        """A populate/refit/incremental update committed new parameter
+        values for ``key``: bump the entry's version and drop every
+        read artifact derived from the old one, so a refit is
+        immediately visible to readers (the invalidation-on-commit
+        rule, docs/ARCHITECTURE.md "The read path")."""
+        e = self.entries.get(key)
+        if e is not None:
+            e.version += 1
+        for c in self._read_caches:
+            c.invalidate_session(key)
 
     def touch(self, key: tuple) -> None:
         if key in self.entries:
@@ -272,10 +309,14 @@ class SessionCache:
 
     def drop(self, session_id) -> None:
         """Forget a session entirely (host solution included) — the
-        caller-driven lifecycle end; never done implicitly."""
+        caller-driven lifecycle end; never done implicitly. Read
+        artifacts derived from the dropped solution go with it (they
+        would otherwise sit orphaned in the segment-cache budget)."""
         for key in [k for k in self.entries if k[0] == session_id]:
             self.evict(key)
             del self.entries[key]
+            for c in self._read_caches:
+                c.invalidate_session(key)
         self._by_sid.pop(session_id, None)
 
     # ------------------------------------------------------------------
@@ -497,6 +538,8 @@ class SessionJob:
             entry.names, entry.off = snap["names"], snap["off"]
             self.cache.commit_state(self.key, snap["state"],
                                     snap["bytes"])
+        # the committed values changed: readers must see THIS solution
+        self.cache.notify_commit(self.key)
         return {"chi2": float(chi2), "converged": conv, "diverged": div,
                 "route": self.route}
 
@@ -550,6 +593,8 @@ class SessionJob:
             _incr_state_bytes(self._handle.new_state))
         if not committed:
             telemetry.inc("serve.session.state_dropped")
+        # incremental commit moved the parameter values too (ISSUE 11)
+        self.cache.notify_commit(self.key)
         self.cache.touch(self.key)
         self.t_done = time.perf_counter()
         self.wall_s = self.t_done - self._t0

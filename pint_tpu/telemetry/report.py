@@ -19,6 +19,10 @@ written by bench.py / tools/soak.py / plain library use):
 * **throughput engine** — ``type="serve"`` records (one per scheduler
   drain: batch occupancy, fits/s, host/device overlap efficiency,
   queue latency — pint_tpu.serve);
+* **read path** — ``type="read"`` records (one per window of served
+  predictions: segment-cache hit rate, ladder-source split, fallback
+  counts, latency percentiles) plus the ``serve.read.*`` counters;
+  artifacts predating the read path degrade gracefully;
 * **mesh** — per-device placement rollup from the drain records' mesh
   blocks (member/occupancy/bytes vectors, member- vs TOA-sharded batch
   counts, work-stealing fetches) with a skew warning when the busiest
@@ -75,6 +79,15 @@ def load_jsonl(path: str) -> tuple[list[dict], int]:
 # ----------------------------------------------------------------------
 # section builders (pure: records in, summary dicts out)
 # ----------------------------------------------------------------------
+
+def _pct(vals: list, p: float, ndigits: int = 6) -> float | None:
+    """Nearest-rank percentile of recorded latencies (one shared
+    implementation for the sessions and read-path sections)."""
+    if not vals:
+        return None
+    vals = sorted(vals)
+    i = min(len(vals) - 1, max(0, round(p / 100 * (len(vals) - 1))))
+    return round(vals[i], ndigits)
 
 def span_tree(records: list[dict]) -> list[dict]:
     """Per-name span aggregates nested by the recorded parent relation.
@@ -243,13 +256,6 @@ def sessions_summary(records: list[dict]) -> dict:
                     (blk.get("update_latencies_s") or []))
         if isinstance(blk.get("cache"), dict):
             cache_last = blk["cache"]
-    def pct(vals, p):
-        if not vals:
-            return None
-        vals = sorted(vals)
-        i = min(len(vals) - 1, max(0, round(p / 100 * (len(vals) - 1))))
-        return round(vals[i], 6)
-
     incr = routes.get("incremental", 0)
     appends = incr + routes.get("full_refit", 0)
     return {
@@ -261,8 +267,57 @@ def sessions_summary(records: list[dict]) -> dict:
         "evictions": cache_last.get("evictions"),
         "cache": cache_last,
         "updates_recorded": len(lats),
-        "p50_update_s": pct(lats, 50),
-        "p95_update_s": pct(lats, 95),
+        "p50_update_s": _pct(lats, 50),
+        "p95_update_s": _pct(lats, 95),
+    }
+
+
+def read_summary(records: list[dict]) -> dict:
+    """Read-path rollup (ISSUE 11) from ``type="read"`` records plus
+    the closing rollup's ``serve.read.*`` counters: request/query
+    volume, segment-cache hit rate, fallback/miss counts, ladder-source
+    split and latency percentiles over every recorded read. Records
+    predating the read path simply contribute nothing — old artifacts
+    degrade gracefully."""
+    reads = requests = queries = misses = fallbacks = 0
+    hits = 0
+    sources: dict[str, int] = {}
+    statuses: dict[str, int] = {}
+    lats: list[float] = []
+    cache_last: dict = {}
+    for r in records:
+        if r.get("type") != "read":
+            continue
+        reads += 1
+        n = int(r.get("requests") or 0)
+        requests += n
+        queries += int(r.get("queries") or 0)
+        misses += int(r.get("window_misses") or 0)
+        fallbacks += int(r.get("fallback_queries") or 0)
+        hits += round(float(r.get("cache_hit_rate") or 0.0) * n)
+        for k, v in (r.get("sources") or {}).items():
+            sources[k] = sources.get(k, 0) + int(v)
+        for k, v in (r.get("statuses") or {}).items():
+            statuses[k] = statuses.get(k, 0) + int(v)
+        lats.extend(float(x) for x in (r.get("latencies_s") or []))
+        if isinstance(r.get("cache"), dict):
+            cache_last = r["cache"]
+    counters: dict = {}
+    for r in records:
+        if r.get("type") == "rollup":
+            counters = r.get("counters") or counters
+    read_counters = {k: int(v) for k, v in counters.items()
+                     if k.startswith("serve.read.")}
+    return {
+        "records": reads, "requests": requests, "queries": queries,
+        "cache_hit_rate": (round(hits / requests, 4) if requests
+                           else None),
+        "window_misses": misses, "fallback_queries": fallbacks,
+        "sources": sources, "statuses": statuses,
+        "reads_recorded": len(lats),
+        "p50_s": _pct(lats, 50, 9), "p95_s": _pct(lats, 95, 9),
+        "p99_s": _pct(lats, 99, 9),
+        "cache": cache_last, "counters": read_counters,
     }
 
 
@@ -584,6 +639,44 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no session records)")
 
+    lines.append("\n== read path (predictions) ==")
+    rd = summary.get("reads") or {}
+    if rd.get("records"):
+        lines.append(
+            f"  {rd['requests']} read(s) / {rd['queries']} quer(ies) "
+            f"over {rd['records']} record(s): "
+            + (", ".join(f"{k}={v}"
+                         for k, v in sorted(rd["sources"].items()))
+               or "none"))
+        hr = rd.get("cache_hit_rate")
+        lines.append(
+            "  segment-cache hit rate: "
+            + (f"{hr:.1%}" if hr is not None else "n/a")
+            + f", {rd['window_misses']} window miss(es), "
+              f"{rd['fallback_queries']} fallback quer(ies)")
+        if rd.get("p50_s") is not None:
+            lines.append(
+                f"  read latency over {rd['reads_recorded']} read(s): "
+                f"p50 {rd['p50_s'] * 1e3:.3f}ms, "
+                f"p95 {rd['p95_s'] * 1e3:.3f}ms, "
+                f"p99 {rd['p99_s'] * 1e3:.3f}ms")
+        if rd.get("statuses") and set(rd["statuses"]) != {"ok"}:
+            lines.append(f"  statuses: {rd['statuses']}")
+        cache = rd.get("cache") or {}
+        if cache:
+            lines.append(
+                f"  segment cache: {cache.get('entries')} window(s), "
+                f"{cache.get('bytes')}/{cache.get('budget')} B, "
+                f"{cache.get('evictions')} eviction(s), "
+                f"{cache.get('invalidations')} invalidation(s)")
+        for k, v in sorted((rd.get("counters") or {}).items()):
+            if k.split(".")[-1] in ("host_path", "deadline_timeouts",
+                                    "ineligible", "window_cap",
+                                    "failed"):
+                lines.append(f"    {k:<32} {v}")
+    else:
+        lines.append("  (no read records)")
+
     lines.append("\n== mesh (device placement) ==")
     mesh = summary["mesh"]
     if mesh["devices"] > 1 and mesh["drains"]:
@@ -678,6 +771,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "serve": serve_summaries(records),
         "passthrough": passthrough_rollup(records),
         "sessions": sessions_summary(records),
+        "reads": read_summary(records),
         "mesh": mesh_summary(records),
         "faults": fault_summaries(records),
         "caches": cache_rates(records),

@@ -1,0 +1,396 @@
+"""The read path (ISSUE 11): on-device polycos engine parity, segment
+cache + invalidation-on-commit, the scheduler's read fast lane, the
+``PINT_TPU_READ_PATH=0`` kill-switch A/B, and the telemetry surface.
+
+Parity bounds are the DOCUMENTED acceptances
+(:data:`pint_tpu.predict.PHASE_PARITY_CYCLES` etc.): evaluated phase
+within 1e-7 cycles of BOTH the host ``Polycos`` path and the dense
+model evaluation, apparent spin frequency within 1e-9 relative of the
+host path, per-coefficient cycles-scale contribution within 1e-6, and
+segment-boundary continuity at the same phase bound.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.models import get_model
+from pint_tpu.polycos import Polycos
+from pint_tpu.predict import (COEFF_PARITY_CYCLES, FREQ_PARITY_REL,
+                              PHASE_PARITY_CYCLES, ReadService,
+                              dense_predict, eval_window,
+                              generate_cheb_window)
+from pint_tpu.serve import (FitRequest, PredictRequest, ThroughputScheduler)
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53750.1
+TZRFRQ  1400
+TZRSITE @
+"""
+
+#: one cache window of the default config starts at this MJD (windows
+#: tile the MJD axis from 0 in 1-day spans at the default 24 x 60 min)
+WIN = 53750.0
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model(PAR)
+
+
+@pytest.fixture(scope="module")
+def window(model):
+    """One generated device artifact (shared: generation compiles the
+    fused node-evaluation program once for the module)."""
+    return generate_cheb_window(model, WIN, n_seg=24,
+                                segment_length_min=60.0, ncoeff=12,
+                                obs="gbt", freq_mhz=1400.0)
+
+
+@pytest.fixture(scope="module")
+def host_polycos(model):
+    """The host reference over the SAME window grid."""
+    return Polycos.generate_polycos(model, WIN, WIN + 1.0, obs="gbt",
+                                    segment_length_min=60.0, ncoeff=12,
+                                    freq_mhz=1400.0)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(7)
+    return np.sort(rng.uniform(WIN + 1e-3, WIN + 0.999, 120))
+
+
+# ----------------------------------------------------------------------
+# engine parity (satellite 1)
+# ----------------------------------------------------------------------
+
+def test_engine_matches_dense_phase(model, window, queries):
+    pi, pf, fr, ok = eval_window(window, queries)
+    assert ok.all()
+    assert np.all((pf >= 0) & (pf < 1))
+    dpi, dpf, _ = dense_predict(model, queries, obs="gbt",
+                                freq_mhz=1400.0)
+    diff = (pi - dpi) + (pf - dpf)
+    assert np.max(np.abs(diff)) < PHASE_PARITY_CYCLES
+
+
+def test_engine_matches_host_polycos(window, host_polycos, queries):
+    pi, pf, fr, _ok = eval_window(window, queries)
+    hi, hf = host_polycos.eval_abs_phase(queries)
+    diff = (pi - hi) + (pf - hf)
+    assert np.max(np.abs(diff)) < PHASE_PARITY_CYCLES
+    hfr = host_polycos.eval_spin_freq(queries)
+    assert np.max(np.abs(fr / hfr - 1.0)) < FREQ_PARITY_REL
+
+
+def test_coefficient_parity(window, host_polycos):
+    """Raw coefficients: DCT-projection vs scaled-Vandermonde lstsq
+    agree to the shared truncation error on each coefficient's
+    cycles-scale contribution |dc_p| * tscale^p."""
+    c_dev = np.asarray(window.dev["coeffs"])
+    tscale = window.span_min / 2.0
+    powers = np.arange(window.ncoeff)
+    for s, e in enumerate(host_polycos.entries):
+        dc = np.abs(c_dev[s] - e.coeffs) * tscale ** powers
+        assert dc.max() < COEFF_PARITY_CYCLES, f"segment {s}"
+    # rphase anchors are the SAME midpoint phase evaluation: exact-int
+    # agreement, fraction to f64 round-off
+    ri = np.asarray(window.dev["rphase_int"])
+    rf = np.asarray(window.dev["rphase_frac"])
+    for s, e in enumerate(host_polycos.entries):
+        assert ri[s] == e.rphase_int
+        assert abs(rf[s] - e.rphase_frac) < 1e-12
+
+
+def test_segment_boundary_continuity(model, window):
+    """Both segments' polynomials agree with the dense phase AT their
+    shared edge (evaluated a hair inside each side, against dense at
+    the SAME epochs — the phase itself advances ~5e-3 cycles per 1e-9
+    day at 61 Hz, so a naive two-sided difference measures the pulsar,
+    not the fit)."""
+    eps = 1e-9
+    edges = WIN + np.arange(1, 24) / 24.0
+    for side in (-eps, +eps):
+        pi, pf, _fr, ok = eval_window(window, edges + side)
+        assert ok.all()
+        dpi, dpf, _ = dense_predict(model, edges + side, obs="gbt",
+                                    freq_mhz=1400.0)
+        diff = (pi - dpi) + (pf - dpf)
+        assert np.max(np.abs(diff)) < PHASE_PARITY_CYCLES
+
+
+def test_window_exports_to_polycos(window, queries):
+    """The device artifact round-trips through the tempo-format seam:
+    Polycos.from_arrays evaluates the same polynomials."""
+    pcs = window.to_polycos(psrname="J1748-2021E")
+    pi, pf, fr, _ok = eval_window(window, queries)
+    hi, hf = pcs.eval_abs_phase(queries)
+    np.testing.assert_allclose((hi - pi) + (hf - pf), 0.0, atol=1e-9)
+    np.testing.assert_allclose(pcs.eval_spin_freq(queries), fr,
+                               rtol=1e-12)
+
+
+# ----------------------------------------------------------------------
+# ReadService ladder + cache
+# ----------------------------------------------------------------------
+
+def test_service_miss_then_hit(model, queries):
+    svc = ReadService()
+    o1 = svc.predict(model, queries, obs="gbt", skey=("t", "a"))
+    assert o1.source == "dense" and not o1.cache_hit
+    assert o1.window_misses == 1
+    o2 = svc.predict(model, queries, obs="gbt", skey=("t", "a"))
+    assert o2.source == "cheb" and o2.cache_hit
+    diff = ((o2.phase_int - o1.phase_int)
+            + (o2.phase_frac - o1.phase_frac))
+    # the miss was served dense, the hit by the engine: the ladder's
+    # rungs agree to the documented parity bound
+    assert np.max(np.abs(diff)) < PHASE_PARITY_CYCLES
+    assert svc.cache.stats()["entries"] == 1
+
+
+def test_service_version_mismatch_is_a_miss(model, queries):
+    svc = ReadService()
+    svc.predict(model, queries, obs="gbt", skey=("t", "v"), version=1)
+    o = svc.predict(model, queries, obs="gbt", skey=("t", "v"),
+                    version=2)
+    assert o.source == "dense" and o.window_misses == 1
+
+
+def test_service_ineligible_model_falls_back_dense(queries):
+    # no TZR anchor -> no absolute phase -> dense fallback rung
+    par = "\n".join(ln for ln in PAR.splitlines()
+                    if not ln.startswith("TZR"))
+    m = get_model(par)
+    svc = ReadService()
+    o = svc.predict(m, queries[:8], obs="gbt", skey=("t", "i"))
+    assert o.source == "dense" and o.fallback_queries == 8
+    # relative phase (no TZR): still finite and normalized
+    assert np.all(np.isfinite(o.phase_int))
+    assert np.all((o.phase_frac >= 0) & (o.phase_frac < 1))
+    assert np.all(np.isfinite(o.freq_hz))
+    assert svc.cache.stats()["entries"] == 0  # nothing cacheable
+
+
+def test_kill_switch_host_path_ab(model, queries):
+    """PINT_TPU_READ_PATH=0 routes to the host Polycos reference path;
+    the A/B pins identical predictions (within the documented parity
+    bound — measured ~1e-11) between the two routes."""
+    import os
+
+    svc = ReadService()
+    svc.predict(model, queries, obs="gbt", skey=("t", "k"))  # warm
+    dev = svc.predict(model, queries, obs="gbt", skey=("t", "k"))
+    assert dev.source == "cheb"
+    os.environ["PINT_TPU_READ_PATH"] = "0"
+    try:
+        h1 = svc.predict(model, queries, obs="gbt", skey=("t", "k"))
+        assert h1.source == "host_polycos" and not h1.cache_hit
+        h2 = svc.predict(model, queries, obs="gbt", skey=("t", "k"))
+        assert h2.cache_hit  # host artifacts cache like device ones
+    finally:
+        os.environ.pop("PINT_TPU_READ_PATH", None)
+    diff = ((h1.phase_int - dev.phase_int)
+            + (h1.phase_frac - dev.phase_frac))
+    assert np.max(np.abs(diff)) < PHASE_PARITY_CYCLES
+    assert np.max(np.abs(h1.freq_hz / dev.freq_hz - 1.0)) \
+        < FREQ_PARITY_REL
+
+
+def test_cache_lru_eviction(model, queries):
+    from pint_tpu.predict import SegmentCache
+
+    svc = ReadService(cache=SegmentCache(budget_bytes=6000))
+    # each window is ~2.3 KB: the third distinct window evicts the first
+    for day in (0, 1, 2):
+        q = queries[:4] + day
+        svc.predict(model, q, obs="gbt", skey=("t", "l"))
+    assert svc.cache.stats()["entries"] <= 2
+    assert svc.cache.evictions >= 1
+
+
+# ----------------------------------------------------------------------
+# the scheduler's read lane (fast lane + two-tier drain)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    """A scheduler with a populated session and a WARM read window."""
+    par = ("PSRJ FAKE_READLANE\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    truth = get_model(par)
+    toas = make_fake_toas_uniform(53000, 56000, 40, truth, obs="@",
+                                  freq_mhz=1400.0, error_us=2.0,
+                                  add_noise=True, seed=31)
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, m, session_id="read", maxiter=10,
+                        min_chi2_decrease=1e-7))
+    assert s.drain()[0].status == "ok"
+    mjds = np.sort(np.random.default_rng(3).uniform(54000.001,
+                                                    54000.999, 48))
+    s.predict(PredictRequest(mjds, session_id="read"))  # warm the cache
+    return s, par, truth, mjds
+
+
+def test_fast_lane_never_touches_the_fit_loop(served):
+    s, par, truth, mjds = served
+    # a fit is QUEUED but not drained: the fast lane must serve the
+    # read immediately, without forming batches or launching fits
+    toas = make_fake_toas_uniform(53000, 56000, 40, truth, obs="@",
+                                  freq_mhz=1400.0, error_us=2.0,
+                                  add_noise=True, seed=32)
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    s.submit(FitRequest(toas, m, tag="queued-fit", maxiter=10,
+                        min_chi2_decrease=1e-7))
+    telemetry.configure(enabled=True)
+    try:
+        before = telemetry.counters_snapshot()
+        res = s.predict(PredictRequest(mjds, session_id="read",
+                                       tag="fast"))
+        delta = telemetry.counters_delta(before)
+    finally:
+        telemetry.configure(enabled=False)
+    assert res.status == "ok"
+    assert res.cache_hit and res.source == "cheb"
+    assert delta.get("fit.device_loop.launches", 0) == 0
+    assert s.pending() == 1  # the fit is still queued, untouched
+    assert s.drain()[0].status == "ok"  # and still drains cleanly
+
+
+def test_two_tier_drain_resolves_reads_first(served):
+    s, par, truth, mjds = served
+    h = s.submit(PredictRequest(mjds[:8], session_id="read", tag="q1"))
+    assert s.pending_reads() == 1
+    s.drain()  # fit queue empty; the read tier still drains
+    assert h.done() and h.result().status == "ok"
+    assert s.pending_reads() == 0
+
+
+def test_read_deadline_sla(served):
+    s, _par, _truth, mjds = served
+    res = s.predict(PredictRequest(mjds, session_id="read",
+                                   deadline_s=1e-12))
+    assert res.status == "timed_out"
+    assert res.phase_frac is not None  # the prediction is attached
+
+
+def test_read_errors_are_structured(served):
+    s, *_ = served
+    res = s.predict(PredictRequest(np.array([54000.5]),
+                                   session_id="no-such-session"))
+    assert res.status == "failed"
+    assert "no committed solution" in res.error
+    res2 = s.predict(PredictRequest(np.array([np.nan]),
+                                    session_id="read"))
+    assert res2.status == "failed"
+
+
+def test_sessionless_model_predict(served, model, queries):
+    s, *_ = served
+    r1 = s.predict(PredictRequest(queries[:16], model=model, obs="gbt"))
+    r2 = s.predict(PredictRequest(queries[:16], model=model, obs="gbt"))
+    assert r1.status == r2.status == "ok"
+    assert r2.cache_hit
+    # changed parameter values must MISS (value-digested key)
+    import copy
+
+    m2 = copy.deepcopy(model)
+    m2["F0"].add_delta(1e-6)
+    r3 = s.predict(PredictRequest(queries[:16], model=m2, obs="gbt"))
+    assert not r3.cache_hit
+    assert np.max(np.abs(r3.phase_frac - r2.phase_frac)) > 0
+
+
+def test_commit_invalidates_read_cache(served):
+    """The invalidation-on-commit rule: an append's committed values
+    are immediately visible to readers — the old artifact is dropped
+    and the next read re-derives from the NEW model."""
+    s, par, truth, mjds = served
+    before = s.predict(PredictRequest(mjds, session_id="read"))
+    assert before.cache_hit
+    app = make_fake_toas_uniform(56010, 56030, 3, truth, obs="@",
+                                 freq_mhz=1400.0, error_us=2.0,
+                                 add_noise=True, seed=33)
+    r = s.submit(FitRequest(app, None, session_id="read", maxiter=10,
+                            min_chi2_decrease=1e-7))
+    assert s.drain()[0].status == "ok"
+    assert r.done()
+    after = s.predict(PredictRequest(mjds, session_id="read"))
+    assert not after.cache_hit  # invalidated by the commit
+    key, entry = s.sessions.lookup_for_read("read")
+    dpi, dpf, _ = dense_predict(entry.model, mjds, obs="@")
+    diff = ((after.phase_int - dpi) + (after.phase_frac - dpf))
+    assert np.max(np.abs(diff)) < PHASE_PARITY_CYCLES
+    warm = s.predict(PredictRequest(mjds, session_id="read"))
+    assert warm.cache_hit  # re-warmed from the committed solution
+
+
+# ----------------------------------------------------------------------
+# telemetry surface (satellite 2)
+# ----------------------------------------------------------------------
+
+def test_read_record_and_counters(served):
+    s, _par, _truth, mjds = served
+    s.read_stats()  # flush fast-lane stats of earlier tests
+    telemetry.reset()  # clear data BEFORE enabling (reset re-disables)
+    telemetry.configure(enabled=True)
+    try:
+        s.predict(PredictRequest(mjds, session_id="read"))
+        s.predict(PredictRequest(mjds, session_id="read"))
+        rec = s.read_stats()
+        counters = telemetry.counters_snapshot()
+    finally:
+        telemetry.configure(enabled=False)
+    assert rec["type"] == "read"
+    assert rec["requests"] == 2
+    assert rec["p50_s"] is not None and rec["p95_s"] is not None
+    assert rec["predictions_per_s"] > 0
+    assert rec["sources"].get("cheb") == 2
+    assert counters.get("serve.read.requests") == 2
+    assert counters.get("serve.read.cache_hits") == 2
+    assert counters.get("serve.read.status.ok") == 2
+
+
+def test_report_cli_read_section(served, capsys):
+    from pint_tpu.telemetry import report
+
+    s, _par, _truth, mjds = served
+    s.predict(PredictRequest(mjds, session_id="read"))
+    s.read_stats()
+    records = [dict(s.last_read),
+               {"type": "rollup",
+                "counters": {"serve.read.host_path": 1}}]
+    rd = report.read_summary(records)
+    assert rd["records"] == 1 and rd["requests"] >= 1
+    assert rd["p50_s"] is not None
+    assert rd["counters"] == {"serve.read.host_path": 1}
+    summary = {"sources": [], "spans": [], "traces": [], "programs": [],
+               "serve": [], "passthrough": report.passthrough_rollup([]),
+               "sessions": report.sessions_summary([]), "reads": rd,
+               "mesh": report.mesh_summary([]),
+               "faults": report.fault_summaries([]), "caches": {},
+               "pollution": report.pollution_windows([])}
+    text = report.render(summary)
+    assert "read path" in text
+    assert "segment-cache hit rate" in text
+    # old artifacts (no read records) degrade gracefully
+    summary["reads"] = report.read_summary([])
+    text2 = report.render(summary)
+    assert "(no read records)" in text2

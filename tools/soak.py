@@ -613,6 +613,59 @@ def one_trial(seed: int, force_chaos: bool = False,
                                - m_ref[name].value_f64) < tol, (
                         f"serve/standalone {name} mismatch ({r.tag})")
 
+            # reads axis (ISSUE 11): a randomized predict stream
+            # against a sessionful fit on the SAME scheduler — random
+            # model structures fuzz the Chebyshev engine, the segment
+            # cache, the miss->dense->warm ladder and the
+            # invalidation-on-commit rule. APPENDED (own substream;
+            # a small engine config bounds the per-structure compile).
+            rrng = np.random.default_rng((seed, 11))
+            from pint_tpu.predict import PHASE_PARITY_CYCLES, dense_predict
+            from pint_tpu.serve import PredictRequest
+
+            os.environ["PINT_TPU_READ_WINDOW_SEGMENTS"] = "4"
+            os.environ["PINT_TPU_READ_NCOEFF"] = "8"
+            try:
+                m_read = _perturbed_model(par)
+                t_read = _sim_flagged_toas(get_model(par, allow_tcb=True),
+                                           rrng, int(rrng.integers(50, 90)))
+                sched.submit(FitRequest(t_read, m_read,
+                                        session_id="soak-read",
+                                        maxiter=20,
+                                        min_chi2_decrease=1e-5))
+                rr = sched.drain()[0]
+                assert rr.status in ("ok", "nonconverged"), rr.error
+                read_stream = []
+                for _ in range(int(rrng.integers(2, 5))):
+                    q = np.sort(rrng.uniform(54000.0, 54000.99,
+                                             int(rrng.integers(3, 33))))
+                    pres = sched.predict(PredictRequest(
+                        q, session_id="soak-read", obs="gbt"))
+                    assert pres.status == "ok", pres.error
+                    assert np.all(np.isfinite(pres.phase_frac))
+                    assert np.all((pres.phase_frac >= 0)
+                                  & (pres.phase_frac < 1))
+                    assert np.all(np.isfinite(pres.freq_hz))
+                    read_stream.append((pres.source, pres.cache_hit))
+                    if pres.cache_hit:
+                        # a cache hit must sit on the dense oracle
+                        entry_r = sched.sessions.lookup_for_read(
+                            "soak-read")[1]
+                        dpi, dpf, _dfr = dense_predict(
+                            entry_r.model, q, obs="gbt")
+                        dphase = ((pres.phase_int - dpi)
+                                  + (pres.phase_frac - dpf))
+                        assert np.max(np.abs(dphase)) \
+                            < PHASE_PARITY_CYCLES, (
+                            f"read parity {np.max(np.abs(dphase)):.3g}")
+                axes["serve"]["reads"] = {
+                    "stream": read_stream,
+                    "hits": sum(1 for _s, h in read_stream if h),
+                }
+            finally:
+                os.environ.pop("PINT_TPU_READ_WINDOW_SEGMENTS", None)
+                os.environ.pop("PINT_TPU_READ_NCOEFF", None)
+
         # fault-domain chaos (ISSUE 6): the trial's model mix through
         # the throughput scheduler with seed-driven fault injection
         # armed (pint_tpu.serve.faults) — NaN-poisoned tables,
@@ -677,23 +730,64 @@ def one_trial(seed: int, force_chaos: bool = False,
                                         retry_backoff_s=0.0,
                                         member_floor=2,
                                         mesh_devices=chaos_mdev)
-            faults.configure(plan)
+            # reads axis (ISSUE 11): a co-resident read session,
+            # populated BEFORE injection arms (populate is write
+            # traffic; the read contract is about READS under chaos) —
+            # predict streams then interleave with the faulted fit
+            # traffic and must stay ok while fits quarantine/degrade.
+            # APPENDED (own substream; small engine config).
+            qrng = np.random.default_rng((seed, 12))
+            from pint_tpu.serve import PredictRequest
+
+            os.environ["PINT_TPU_READ_WINDOW_SEGMENTS"] = "4"
+            os.environ["PINT_TPU_READ_NCOEFF"] = "8"
+            read_chaos: list = []
+
+            def _chaos_read():
+                q = np.sort(qrng.uniform(54000.0, 54000.99,
+                                         int(qrng.integers(3, 17))))
+                pres = sched.predict(PredictRequest(
+                    q, session_id="chaos-read", obs="gbt"))
+                assert pres.status == "ok", (
+                    f"read under chaos: {pres.status} {pres.error}")
+                assert np.all(np.isfinite(pres.phase_frac))
+                read_chaos.append((pres.source, pres.cache_hit))
+
             try:
-                flooded = 0
-                handles = []
-                for j, (par_j, t_j) in enumerate(specs):
-                    try:
-                        handles.append(sched.submit(
-                            FitRequest(t_j, _chaos_model(par_j),
-                                       maxiter=12, tag=j)))
-                    except ServeQueueFull as e:
-                        flooded += 1
-                        assert e.depth >= 1 and e.max_queue >= 2, e
-                        assert e.retry_after_s is not None, \
-                            "flood reject must carry a retry-after hint"
-                chaos_res = sched.drain()
+                m_cr = _chaos_model(par)
+                t_cr = _sim_flagged_toas(get_model(par, allow_tcb=True),
+                                         qrng, int(qrng.integers(40, 70)))
+                sched.submit(FitRequest(t_cr, m_cr,
+                                        session_id="chaos-read",
+                                        maxiter=12))
+                r_cr = sched.drain()[0]
+                assert r_cr.status in ("ok", "nonconverged"), r_cr.error
+                _chaos_read()  # miss -> dense + warm, pre-injection
+                faults.configure(plan)
+                try:
+                    flooded = 0
+                    handles = []
+                    for j, (par_j, t_j) in enumerate(specs):
+                        try:
+                            handles.append(sched.submit(
+                                FitRequest(t_j, _chaos_model(par_j),
+                                           maxiter=12, tag=j)))
+                        except ServeQueueFull as e:
+                            flooded += 1
+                            assert e.depth >= 1 and e.max_queue >= 2, e
+                            assert e.retry_after_s is not None, \
+                                "flood reject must carry a retry-after" \
+                                " hint"
+                    # the fast lane serves reads while faulted fits sit
+                    # queued, and again right after the chaos drain
+                    _chaos_read()
+                    chaos_res = sched.drain()
+                    _chaos_read()
+                finally:
+                    faults.configure(None)
             finally:
-                faults.configure(None)
+                os.environ.pop("PINT_TPU_READ_WINDOW_SEGMENTS", None)
+                os.environ.pop("PINT_TPU_READ_NCOEFF", None)
             statuses: dict[str, int] = {}
             injected: dict[str, int] = {}
             for r in chaos_res:
@@ -721,6 +815,8 @@ def one_trial(seed: int, force_chaos: bool = False,
                 "failed_batches": sched.last_drain["failed_batches"],
                 "mesh_devices": chaos_mdev,
                 "noise_batch": noise_batch,
+                "reads": {"stream": read_chaos,
+                          "hits": sum(1 for _s, h in read_chaos if h)},
             }
 
         # sessionful append streams (ISSUE 10): the trial's model as a
