@@ -1,0 +1,58 @@
+"""pint_tpu.fleet — fingerprint-sticky multi-host routing (ISSUE 12).
+
+The scale-OUT tier over :mod:`pint_tpu.serve`: a
+:class:`~pint_tpu.fleet.router.FleetRouter` rendezvous-hashes structure
+fingerprints onto N per-host schedulers so each structure's compiled
+programs, sessions and read caches stay hot on exactly one host, with
+session stickiness, cold-structure work stealing, health-fed failover
+(reads before fits) and a transport seam
+(:mod:`pint_tpu.fleet.transport`) whose loopback implementation proves
+every routing invariant without sockets or silicon. ``python -m
+pint_tpu.fleet worker`` runs one real host process
+(:mod:`pint_tpu.fleet.worker`; TCP/JSONL, optional jax.distributed).
+At N=1 — or under ``PINT_TPU_FLEET=0`` — everything degenerates
+bitwise to the single-host path. See docs/ARCHITECTURE.md
+"Fleet tier".
+"""
+
+from __future__ import annotations
+
+import os
+
+from pint_tpu.fleet.router import (  # noqa: F401
+    FleetHandle, FleetPredictHandle, FleetRouter, fleet_enabled,
+    rendezvous_rank)
+from pint_tpu.fleet.transport import (  # noqa: F401
+    HostDown, LoopbackHost, TcpHost, serve_worker)
+
+
+def build_fleet(n_hosts: int | None = None, *,
+                host_ids=None, router_kwargs=None,
+                **sched_kwargs) -> FleetRouter:
+    """An N-host LOOPBACK fleet (one process, N schedulers).
+
+    The zero-network construction tests/bench/soak use; real
+    deployments build :class:`~pint_tpu.fleet.transport.TcpHost`
+    transports against ``python -m pint_tpu.fleet worker`` processes
+    and hand them to :class:`FleetRouter` directly. ``n_hosts``
+    defaults to ``PINT_TPU_FLEET_PROCESSES`` (1 when unset); N=1 or
+    ``PINT_TPU_FLEET=0`` yields the degenerate single-host router.
+    ``sched_kwargs`` pass through to every host's scheduler.
+    """
+    if n_hosts is None:
+        n_hosts = int(os.environ.get("PINT_TPU_FLEET_PROCESSES", "1")
+                      or "1")
+    if not fleet_enabled():
+        n_hosts = 1
+    n_hosts = max(1, int(n_hosts))
+    ids = list(host_ids) if host_ids is not None else [
+        f"host{i}" for i in range(n_hosts)]
+    hosts = [LoopbackHost(hid, **sched_kwargs) for hid in ids]
+    return FleetRouter(hosts, **(router_kwargs or {}))
+
+
+__all__ = [
+    "FleetHandle", "FleetPredictHandle", "FleetRouter", "HostDown",
+    "LoopbackHost", "TcpHost", "build_fleet", "fleet_enabled",
+    "rendezvous_rank", "serve_worker",
+]

@@ -1,0 +1,727 @@
+"""Fingerprint-sticky rendezvous routing over N per-host schedulers.
+
+ROADMAP item 1 / ISSUE 12: every tier below this one scales within ONE
+process — union batching, mesh placement, fault domains, sessions, the
+read path all live inside a single
+:class:`~pint_tpu.serve.scheduler.ThroughputScheduler`. The fleet tier
+is the scale-OUT seam: a :class:`FleetRouter` in front of N host
+transports (:mod:`pint_tpu.fleet.transport`), each owning one
+scheduler over its process-local device pool.
+
+**Routing IS the performance feature.** Compiled fit programs, TZR
+caches, session rank-k state and read-path segment caches are all
+per-host (device memory + process-local jit caches): a request landing
+on the wrong host pays a full recompile (~tens of seconds) instead of
+a ~ms warm-cache hit. The router therefore concentrates each structure
+on exactly one host:
+
+* **Rendezvous (HRW) hashing** on the structure-fingerprint short id:
+  every (key, host) pair gets a deterministic score
+  (:func:`rendezvous_rank`); the key routes to its highest-scoring
+  alive host. Host join/leave moves only the keys whose top choice
+  changed — ~1/N of them, measured over 1k fingerprints in
+  tests/test_fleet.py — while every other structure stays hot where it
+  is. No central ring state: the ranking is a pure function of
+  (key, host ids).
+* **Session stickiness** keyed ``(session_id, fingerprint)``: the
+  first sessionful request pins its session to the routed host; every
+  later append and read follows the pin (rank-k device state and
+  polycos segment caches are that host's memory), surviving ring
+  rebalance — a new host joining NEVER moves an existing session, only
+  fresh structures.
+* **Work stealing for cold structures**: when the sticky host's queue
+  depth reaches ``steal_depth`` and the structure is not yet warm
+  there, the request goes to the least-loaded healthy host instead —
+  a cold structure recompiles wherever it lands, so stealing costs
+  nothing extra and drains the hot spot. Warm structures are NEVER
+  stolen (that would trade a queue wait for a recompile).
+* **Health + failover**: per-host health is fed only from
+  :meth:`~pint_tpu.serve.scheduler.ThroughputScheduler.report`
+  envelopes (fail streak, queue depth, degraded flag — the PR-6
+  degradation ladder, now visible across hosts) plus transport-level
+  :class:`~pint_tpu.fleet.transport.HostDown` failures. A *degraded*
+  host sheds fits to its ring successor (the next host in its
+  rendezvous ranking); **reads fail over before fits** — a merely
+  *suspect* host (fail streak >= 1, below the degrade threshold)
+  already loses its model-carrying reads (any host can serve those
+  dense) while fits keep flowing until the ladder actually trips.
+  A dead host's pending work is re-routed and re-submitted at drain —
+  never silently dropped; requests that cannot be re-served elsewhere
+  (a session append whose state died with the host and whose request
+  carries no model) resolve as structured ``failed`` envelopes.
+
+At N=1 — or under the ``PINT_TPU_FLEET=0`` kill switch — the router is
+*degenerate*: every request goes to host 0 with zero routing
+bookkeeping (no second fingerprint canonicalization, no health
+machinery on the submit path), so the single-host path is bitwise
+today's behavior (pinned in tests/test_fleet.py).
+
+Telemetry: ``fleet.*`` counters (route split, failovers, steals,
+host-down events), one ``type="fleet"`` record per router drain with
+the per-host report block — rendered by ``python -m
+pint_tpu.telemetry.report`` under "fleet tier".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any
+
+from pint_tpu import telemetry
+from pint_tpu.fleet.transport import HostDown
+from pint_tpu.serve import fingerprint as _fp
+from pint_tpu.serve.scheduler import (FitResult, PredictRequest,
+                                      PredictResult, ServeQueueFull)
+
+
+def fleet_enabled() -> bool:
+    """Kill switch (read per call so tests can flip it):
+    ``PINT_TPU_FLEET=0`` forces the degenerate single-host path."""
+    return os.environ.get("PINT_TPU_FLEET", "") != "0"
+
+
+def _score(host_id: str, key: str) -> str:
+    """The (host, key) rendezvous score: a content digest, never
+    ``hash()`` (salted per process — the ranking must agree across
+    router restarts and across processes)."""
+    return hashlib.sha1(f"{host_id}|{key}".encode()).hexdigest()
+
+
+def rendezvous_rank(key: str, host_ids) -> list[str]:
+    """All hosts ranked for ``key``, best first (highest-random-weight
+    hashing). Deterministic in (key, set of hosts): independent of list
+    order, stable across processes, and removing a host only promotes
+    lower-ranked hosts — keys whose top choice survives never move."""
+    return sorted(host_ids, key=lambda h: _score(h, key), reverse=True)
+
+
+class FleetHandle:
+    """Future-like handle for a routed fit (the router's FitHandle)."""
+
+    __slots__ = ("_result", "host", "route")
+
+    def __init__(self, host: str, route: str):
+        self._result: FitResult | None = None
+        self.host = host      # host id the request was routed to
+        self.route = route    # routing token (sticky/rendezvous/...)
+
+    def done(self) -> bool:
+        return self._result is not None
+
+    def result(self) -> FitResult:
+        if self._result is None:
+            raise RuntimeError("request not drained yet; call "
+                               "FleetRouter.drain() first")
+        return self._result
+
+
+class FleetPredictHandle:
+    """Future-like handle for a routed queued read."""
+
+    __slots__ = ("_result", "host")
+
+    def __init__(self, host: str):
+        self._result: PredictResult | None = None
+        self.host = host
+
+    def done(self) -> bool:
+        return self._result is not None
+
+    def result(self) -> PredictResult:
+        if self._result is None:
+            raise RuntimeError("read not drained yet; call "
+                               "FleetRouter.drain_reads() first")
+        return self._result
+
+
+class _Pending:
+    """One routed, not-yet-resolved request on a host."""
+
+    __slots__ = ("seq", "token", "request", "handle", "route", "read")
+
+    def __init__(self, seq, token, request, handle, route, read=False):
+        self.seq = seq
+        self.token = token
+        self.request = request
+        self.handle = handle
+        self.route = route
+        self.read = read
+
+
+class FleetRouter:
+    """Route fits/reads over host transports; drain and resolve them.
+
+    ``hosts`` is a list of transports (each carries a unique
+    ``host_id``). ``steal_depth`` is the queue depth at which a cold
+    structure is stolen to the least-loaded host; ``degrade_after``
+    the router-side fail-streak threshold above which a host that
+    stopped reporting cleanly counts as degraded even without a
+    report saying so. ``degenerate`` forces the N=1 fast path
+    (implied by a single host or the ``PINT_TPU_FLEET=0`` switch).
+    """
+
+    def __init__(self, hosts, *, steal_depth: int = 8,
+                 degrade_after: int = 2, degenerate: bool = False):
+        hosts = list(hosts)
+        if not hosts:
+            raise ValueError("FleetRouter needs at least one host")
+        ids = [h.host_id for h in hosts]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate host ids: {ids}")
+        self.hosts = {h.host_id: h for h in hosts}
+        self._order = ids
+        self.steal_depth = max(1, int(steal_depth))
+        self.degrade_after = max(1, int(degrade_after))
+        self.degenerate = bool(degenerate or len(hosts) == 1
+                               or not fleet_enabled())
+        self._health: dict[str, dict] = {
+            hid: {"alive": True, "fail_streak": 0, "queue_depth": 0,
+                  "read_depth": 0, "degraded": False, "latency_s": None,
+                  "program_misses": 0}
+            for hid in ids}
+        self._warm: dict[str, set] = {hid: set() for hid in ids}
+        self._sticky: dict[tuple, str] = {}   # (sid, fp8) -> host id
+        self._sid_last: dict[Any, tuple] = {}  # sid -> last sticky key
+        self._inflight: dict[str, int] = {hid: 0 for hid in ids}
+        self._pending: dict[str, list[_Pending]] = {hid: [] for hid in ids}
+        self._seq = 0
+        self._route_counts: dict[str, int] = {}
+        self._failovers = 0
+        self._warm_hits = 0   # requests landing on an already-warm host
+        self._warm_total = 0  # ... out of all warm-trackable fits
+        self.last_drain: dict | None = None
+
+    # ------------------------------------------------------------------
+    # health
+    # ------------------------------------------------------------------
+    def alive_hosts(self) -> list[str]:
+        return [h for h in self._order if self._health[h]["alive"]]
+
+    def _degraded(self, hid: str) -> bool:
+        h = self._health[hid]
+        return bool(h["degraded"]
+                    or h["fail_streak"] >= self.degrade_after)
+
+    def _suspect(self, hid: str) -> bool:
+        """Read-level caution: trips BEFORE the fit-shedding threshold
+        (reads fail over first — any host serves a model-carrying read
+        dense, so there is no reason to send one toward trouble)."""
+        h = self._health[hid]
+        return bool(self._degraded(hid) or h["fail_streak"] >= 1)
+
+    def _depth(self, hid: str) -> int:
+        return self._health[hid]["queue_depth"] + self._inflight[hid]
+
+    def add_host(self, transport) -> None:
+        """Host JOIN: register a new transport. Rendezvous ranking is a
+        pure function of (key, host set), so only keys whose top score
+        the new host beats move to it (~1/(N+1), measured in
+        tests/test_fleet.py) — and existing session pins never move
+        (stickiness beats the ring)."""
+        hid = transport.host_id
+        if hid in self.hosts:
+            raise ValueError(f"duplicate host id {hid!r}")
+        self.hosts[hid] = transport
+        self._order.append(hid)
+        self._health[hid] = {"alive": True, "fail_streak": 0,
+                             "queue_depth": 0, "read_depth": 0,
+                             "degraded": False, "latency_s": None,
+                             "program_misses": 0}
+        self._warm[hid] = set()
+        self._inflight[hid] = 0
+        self._pending[hid] = []
+        self.degenerate = False if len(self._order) > 1 \
+            and fleet_enabled() else self.degenerate
+        telemetry.inc("fleet.host_join")
+
+    def retire_host(self, host_id: str) -> None:
+        """Host LEAVE (administrative): mark it dead so routing moves
+        its keys to their next-ranked hosts; pending work fails over at
+        the next :meth:`drain` exactly like a crash."""
+        if host_id not in self.hosts:
+            raise KeyError(host_id)
+        self._health[host_id]["alive"] = False
+        telemetry.inc("fleet.host_leave")
+
+    def mark(self, host_id: str, *, alive: bool | None = None,
+             fail_streak: int | None = None,
+             degraded: bool | None = None) -> None:
+        """Operator/test surface: override one host's health state
+        (e.g. administratively drain a host before maintenance). The
+        next report from the host refreshes the report-fed fields."""
+        h = self._health[host_id]
+        if alive is not None:
+            h["alive"] = bool(alive)
+        if fail_streak is not None:
+            h["fail_streak"] = int(fail_streak)
+        if degraded is not None:
+            h["degraded"] = bool(degraded)
+
+    def _note_down(self, hid: str) -> None:
+        h = self._health[hid]
+        if h["alive"]:
+            telemetry.inc("fleet.host_down")
+        h["alive"] = False
+        h["fail_streak"] += 1
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+    def _fit_candidates(self, key: str) -> list[str]:
+        """Fit routing order for ``key``: rendezvous ranking over alive
+        hosts, degraded hosts moved to the back (a degraded host sheds
+        to its ring successor — the next alive host in ITS OWN
+        ranking — but remains the last resort before failing)."""
+        ranked = rendezvous_rank(key, self.alive_hosts())
+        return ([h for h in ranked if not self._degraded(h)]
+                + [h for h in ranked if self._degraded(h)])
+
+    def _route_fit(self, request) -> tuple[str, str, str | None]:
+        """(host id, route token, fp8) for one fit request — fp8 is
+        threaded back so the submit path ranks its fallback candidates
+        by the request's OWN ring order and never canonicalizes the
+        structure twice."""
+        sid = getattr(request, "session_id", None)
+        fp8 = None
+        if request.model is not None:
+            fp8 = _fp.short_id(
+                _fp.structure_fingerprint(request.model, request.toas))
+        if sid is not None:
+            skey = (sid, fp8) if fp8 is not None else self._sid_last.get(sid)
+            if skey is None:
+                raise ValueError(
+                    f"session {sid!r} is unknown to the fleet and the "
+                    "request carries no model; the first request of a "
+                    "session must include one")
+            self._sid_last[sid] = skey
+            hid = self._sticky.get(skey)
+            if hid is not None and self._health[hid]["alive"] \
+                    and not self._degraded(hid):
+                return hid, "sticky", skey[1]
+            if hid is not None:
+                # sticky host dead/degraded: fail over to the ring
+                # successor; the session re-pins there (its device
+                # state is gone — the new host repopulates from the
+                # request, or resolves a structured error when it
+                # cannot)
+                cands = [h for h in self._fit_candidates(skey[1] or
+                                                         repr(sid))
+                         if h != hid] or [hid]
+                new = cands[0]
+                self._sticky[skey] = new
+                return new, "failover", skey[1]
+            hid, token = self._route_structure(fp8)
+            self._sticky[skey] = hid
+            return hid, token, skey[1]
+        return (*self._route_structure(fp8), fp8)
+
+    def _route_structure(self, fp8: str | None) -> tuple[str, str]:
+        cands = self._fit_candidates(fp8 or "?")
+        if not cands:
+            raise HostDown("no alive hosts in the fleet")
+        primary = cands[0]
+        token = "rendezvous"
+        if self._degraded(primary):
+            token = "failover"  # every host degraded: last resort
+        elif fp8 is not None and primary != rendezvous_rank(
+                fp8, self.alive_hosts())[0]:
+            token = "failover"  # rendezvous winner was degraded: shed
+        if (fp8 is not None and token == "rendezvous"
+                and self._depth(primary) >= self.steal_depth
+                and fp8 not in self._warm[primary]):
+            # cold-structure work stealing: recompiles wherever it
+            # lands, so send it to the shortest healthy queue
+            others = [h for h in cands[1:] if not self._degraded(h)]
+            if others:
+                target = min(others, key=self._depth)
+                if self._depth(target) < self._depth(primary):
+                    return target, "stolen"
+        return primary, token
+
+    def _route_read(self, request) -> tuple[str, str]:
+        """(host id, token) for one read. Session reads follow the
+        sticky pin (the segment cache and committed solution live
+        there); model-carrying reads avoid suspect hosts entirely."""
+        sid = request.session_id
+        if sid is not None:
+            skey = self._sid_last.get(sid)
+            hid = self._sticky.get(skey) if skey is not None else None
+            if hid is not None and self._health[hid]["alive"]:
+                if not self._suspect(hid) or request.model is None:
+                    # the state lives here; a suspect host still beats
+                    # a guaranteed "no committed solution" elsewhere
+                    return hid, "sticky"
+            if request.model is None:
+                if hid is not None:
+                    raise HostDown(
+                        f"session {sid!r} is pinned to dead host "
+                        f"{hid}; resubmit with a model to re-fit")
+                raise ValueError(
+                    f"session {sid!r} is unknown to the fleet; fit "
+                    "(populate) it first")
+            # fall through: serve dense from the model, away from the
+            # suspect/dead sticky host
+        fp8 = "?"
+        if request.model is not None:
+            fp8 = _fp.short_id(
+                _fp.structure_fingerprint(request.model, None))
+        ranked = rendezvous_rank(fp8, self.alive_hosts())
+        if not ranked:
+            raise HostDown("no alive hosts in the fleet")
+        clean = [h for h in ranked if not self._suspect(h)]
+        if clean:
+            return clean[0], ("rendezvous" if clean[0] == ranked[0]
+                              else "failover")
+        return ranked[0], "failover"
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def submit(self, request):
+        """Route + enqueue one request on its host; returns a
+        :class:`FleetHandle` (fits) / :class:`FleetPredictHandle`
+        (reads). A full primary host sheds to the next candidate
+        (backpressure composes); :class:`ServeQueueFull` surfaces only
+        when the whole fleet is full. A host dying at submit fails
+        over transparently."""
+        read = isinstance(request, PredictRequest)
+        fp8 = None
+        if self.degenerate:
+            hid = self._order[0]
+            cands, token = [hid], "degenerate"
+        else:
+            if read:
+                hid, token = self._route_read(request)
+                cands = [hid] + [h for h in self.alive_hosts()
+                                 if h != hid]
+            else:
+                hid, token, fp8 = self._route_fit(request)
+                # fallback candidates follow the request's OWN ring
+                # order — shed/failover traffic spreads per key, not
+                # onto whichever host wins some constant ranking
+                cands = [hid] + [h for h in
+                                 self._fit_candidates(fp8 or "?")
+                                 if h != hid]
+        last_exc: BaseException | None = None
+        for i, h in enumerate(cands):
+            if i > 0:
+                token = "failover" if isinstance(last_exc, HostDown) \
+                    else "shed"
+            try:
+                tok = self.hosts[h].submit(request)
+            except HostDown as e:
+                self._note_down(h)
+                last_exc = e
+                continue
+            except ServeQueueFull as e:
+                if self.degenerate:
+                    raise
+                telemetry.inc("fleet.shed")
+                self._health[h]["queue_depth"] = e.depth
+                last_exc = e
+                continue
+            return self._track(h, tok, request, token, read, fp8)
+        assert last_exc is not None
+        raise last_exc
+
+    def _track(self, hid, tok, request, token, read, fp8=None):
+        self._seq += 1
+        if read:
+            handle = FleetPredictHandle(hid)
+            telemetry.inc("fleet.read.requests")
+        else:
+            handle = FleetHandle(hid, token)
+            telemetry.inc("fleet.requests")
+            sid = getattr(request, "session_id", None)
+            if sid is not None and not self.degenerate:
+                # pin (or RE-pin) the session to the host that actually
+                # accepted the work: a shed/failover at submit must
+                # move the pin with the state, or later appends would
+                # chase a host that never saw this session
+                skey = self._sid_last.get(sid)
+                if skey is not None:
+                    self._sticky[skey] = hid
+            if fp8 is not None:
+                # the sticky-routing hit rate: did this request land on
+                # a host whose caches its structure already warmed?
+                self._warm_total += 1
+                if fp8 in self._warm[hid]:
+                    self._warm_hits += 1
+                    telemetry.inc("fleet.route.warm_hit")
+                self._warm[hid].add(fp8)
+        telemetry.inc(f"fleet.route.{token}")
+        self._route_counts[token] = self._route_counts.get(token, 0) + 1
+        self._inflight[hid] += 1
+        self._pending[hid].append(
+            _Pending(self._seq, tok, request, handle, token, read))
+        return handle
+
+    def pending(self) -> int:
+        return sum(len(p) for p in self._pending.values())
+
+    # ------------------------------------------------------------------
+    # the read fast lane
+    # ------------------------------------------------------------------
+    def predict(self, request: PredictRequest) -> PredictResult:
+        """Serve one read NOW through its host's synchronous fast lane.
+
+        The worker serves ``predict`` as its own protocol op — it never
+        triggers, joins, or waits on a fit drain on the remote host
+        (zero fit-loop launches, counter-pinned in tests/test_fleet.py)
+        — and session stickiness routes the read to the host whose
+        memory holds the session's segment cache."""
+        if self.degenerate:
+            hid = self._order[0]
+        else:
+            hid, token = self._route_read(request)
+            telemetry.inc(f"fleet.read.route.{token}")
+        telemetry.inc("fleet.read.requests")
+        try:
+            wire = self.hosts[hid].predict(request)
+        except HostDown:
+            self._note_down(hid)
+            if self.degenerate:
+                raise
+            alive = self.alive_hosts()
+            if not alive or request.session_id is not None \
+                    and request.model is None:
+                return PredictResult(
+                    tag=request.tag, request=request, status="failed",
+                    error=f"host {hid} down and the read cannot be "
+                          "served elsewhere", host=hid)
+            telemetry.inc("fleet.read.route.failover")
+            hid = self._route_read(request)[0]
+            wire = self.hosts[hid].predict(request)
+        return self._unwire_read(wire, request)
+
+    @staticmethod
+    def _unwire_read(wire: dict, request) -> PredictResult:
+        if "result" in wire:           # loopback: the real object
+            return wire["result"]
+        return PredictResult(
+            tag=request.tag, request=request, status=wire["status"],
+            phase_int=wire["phase_int"], phase_frac=wire["phase_frac"],
+            freq_hz=wire["freq_hz"], source=wire["source"],
+            cache_hit=wire["cache_hit"], n_queries=wire["n_queries"],
+            latency_s=wire["latency_s"], error=wire["error"],
+            host=wire.get("host"))
+
+    def _unwire_fit(self, wire: dict, pend: _Pending) -> FitResult:
+        if "result" in wire:           # loopback: the real object
+            return wire["result"]
+        req = pend.request
+        if wire.get("params") and req.model is not None:
+            for name, (hi, lo, unc) in wire["params"].items():
+                if name in req.model.params:
+                    p = req.model[name]
+                    p.set_value_dd(hi, lo)
+                    p.uncertainty = unc
+        return FitResult(
+            tag=req.tag, request=req, chi2=wire["chi2"],
+            converged=wire["converged"], batch=wire["batch"],
+            group=wire["group"], n_members=wire["n_members"],
+            occupancy=wire["occupancy"],
+            queue_latency_s=wire["queue_latency_s"],
+            passthrough=wire["passthrough"], status=wire["status"],
+            error=wire["error"], attempts=wire["attempts"],
+            trace=wire["trace"], retry_after_s=wire["retry_after_s"],
+            injected=wire["injected"], session=wire["session"],
+            host=wire.get("host"))
+
+    # ------------------------------------------------------------------
+    # drain
+    # ------------------------------------------------------------------
+    def drain_reads(self) -> list[PredictResult]:
+        """Drain every host's queued reads (fit queues untouched —
+        the two-tier contract holds fleet-wide)."""
+        out: list[tuple[int, PredictResult]] = []
+        orphans: list[tuple[str, _Pending]] = []
+        for hid in self._order:
+            pend = [p for p in self._pending[hid] if p.read]
+            if not pend:
+                continue
+            try:
+                wires = self.hosts[hid].drain_reads()
+            except HostDown:
+                self._note_down(hid)
+                wires = []
+            matched, left = self._match(hid, pend, wires, reads=True)
+            out.extend(matched)
+            orphans.extend((hid, p) for p in left)
+        for hid, p in orphans:
+            out.append((p.seq, self._failover_pending(hid, p)))
+        return [r for _s, r in sorted(out, key=lambda t: t[0])]
+
+    def _match(self, hid, pend, wires, *, reads: bool):
+        """Resolve one host's drained wire results against its pending
+        list. Returns ``(matched, leftovers)`` — leftovers are pending
+        entries the host died holding; the CALLER fails them over
+        AFTER its sweep (a failover drains the target host, which
+        mid-sweep would discard that host's own undrained results)."""
+        by_tok = {w["token"]: w for w in wires
+                  if isinstance(w, dict) and "token" in w}
+        out = []
+        leftovers = []
+        for p in pend:
+            self._pending[hid].remove(p)
+            self._inflight[hid] = max(0, self._inflight[hid] - 1)
+            w = by_tok.get(p.token)
+            if w is None:
+                leftovers.append(p)
+                continue
+            res = (self._unwire_read(w, p.request) if reads
+                   else self._unwire_fit(w, p))
+            p.handle._result = res
+            out.append((p.seq, res))
+        return out, leftovers
+
+    def _failover_pending(self, hid: str, p: _Pending):
+        """A host died holding ``p``: re-route + re-run it on a
+        surviving host (synchronously — failover is the slow path),
+        or resolve a structured failure. Nothing is silently dropped."""
+        self._failovers += 1
+        telemetry.inc("fleet.failover.requests")
+        # a sessionful request pinned to the dead host must re-pin
+        sid = getattr(p.request, "session_id", None)
+        if sid is not None:
+            skey = self._sid_last.get(sid)
+            if skey is not None and self._sticky.get(skey) == hid:
+                del self._sticky[skey]
+        try:
+            if p.read:
+                res = self.predict(p.request)
+                p.handle._result = res
+                return res
+            alive = self.alive_hosts()
+            if not alive:
+                raise HostDown("no alive hosts in the fleet")
+            new_hid, _token, _fp8 = self._route_fit(p.request)
+            tok = self.hosts[new_hid].submit(p.request)
+            wires = self.hosts[new_hid].drain()
+            w = next(w for w in wires if w["token"] == tok)
+            res = self._unwire_fit(w, p)
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            if p.read:
+                res = PredictResult(
+                    tag=p.request.tag, request=p.request,
+                    status="failed",
+                    error=f"host {hid} died; failover failed: "
+                          f"{type(e).__name__}: {e}", host=hid)
+            else:
+                res = FitResult(
+                    tag=p.request.tag, request=p.request,
+                    chi2=float("nan"), converged=False, batch=-1,
+                    group="", n_members=0, occupancy=0.0,
+                    queue_latency_s=0.0, status="failed",
+                    error=f"host {hid} died; failover failed: "
+                          f"{type(e).__name__}: {e}", host=hid)
+        p.handle._result = res
+        return res
+
+    def drain(self) -> list[FitResult]:
+        """Drain every host with pending work; resolve all handles.
+
+        Reads drain first fleet-wide (the two-tier contract), then
+        each host's fit queue; a host that died since submit has its
+        pending requests re-routed to survivors. Results return in
+        fleet submission order. One ``type="fleet"`` record per drain
+        carries the per-host health/report block."""
+        t0 = time.perf_counter()
+        self.drain_reads()
+        out: list[tuple[int, FitResult]] = []
+        per_host_n: dict[str, int] = {}
+        orphans: list[tuple[str, _Pending]] = []
+        for hid in self._order:
+            pend = [p for p in self._pending[hid] if not p.read]
+            if not pend:
+                continue
+            per_host_n[hid] = len(pend)
+            try:
+                wires = self.hosts[hid].drain()
+            except HostDown:
+                self._note_down(hid)
+                wires = []
+            matched, left = self._match(hid, pend, wires, reads=False)
+            out.extend(matched)
+            orphans.extend((hid, p) for p in left)
+        # failover AFTER the sweep: every survivor's own pending is
+        # resolved by now, so the failover's drain on it cannot
+        # swallow co-pending work
+        for hid, p in orphans:
+            out.append((p.seq, self._failover_pending(hid, p)))
+        self._refresh_reports()
+        wall = time.perf_counter() - t0
+        results = [r for _s, r in sorted(out, key=lambda t: t[0])]
+        if results or per_host_n:
+            self._emit_record(results, per_host_n, wall)
+        return results
+
+    def _refresh_reports(self) -> None:
+        for hid in self._order:
+            h = self._health[hid]
+            if not h["alive"]:
+                continue
+            try:
+                rep = self.hosts[hid].report()
+            except (HostDown, OSError):
+                self._note_down(hid)
+                continue
+            h["queue_depth"] = int(rep.get("queue_depth", 0))
+            h["read_depth"] = int(rep.get("read_depth", 0))
+            h["fail_streak"] = int(rep.get("fail_streak", 0))
+            h["degraded"] = bool(rep.get("degraded", False))
+            h["latency_s"] = rep.get("last_drain_wall_s")
+            h["program_misses"] = int(rep.get("program_misses", 0))
+
+    def _emit_record(self, results, per_host_n, wall) -> None:
+        routes, self._route_counts = self._route_counts, {}
+        failovers, self._failovers = self._failovers, 0
+        warm_hits, self._warm_hits = self._warm_hits, 0
+        warm_total, self._warm_total = self._warm_total, 0
+        sticky = routes.get("sticky", 0)
+        routed = sum(routes.values())
+        statuses: dict[str, int] = {}
+        for r in results:
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+        alive = self.alive_hosts()
+        telemetry.set_gauge("fleet.hosts_alive", len(alive))
+        self.last_drain = {
+            "type": "fleet",
+            "hosts": [
+                {"host": hid,
+                 "alive": self._health[hid]["alive"],
+                 "requests": per_host_n.get(hid, 0),
+                 "queue_depth": self._health[hid]["queue_depth"],
+                 "fail_streak": self._health[hid]["fail_streak"],
+                 "degraded": self._degraded(hid),
+                 "program_misses": self._health[hid]["program_misses"]}
+                for hid in self._order],
+            "requests": len(results),
+            "routes": routes,
+            "sticky_hit_rate": (round(sticky / routed, 4)
+                                if routed else None),
+            # fraction of warm-trackable fits that landed on a host
+            # already holding their structure's caches — the sticky-
+            # routing effectiveness headline of the FLEET artifacts
+            # (raw counts carried too so rollups aggregate exactly:
+            # the rate's denominator is warm-trackable fits, NOT the
+            # route-count total, which also counts reads/sheds)
+            "warm_hits": warm_hits,
+            "warm_total": warm_total,
+            "warm_hit_rate": (round(warm_hits / warm_total, 4)
+                              if warm_total else None),
+            "failovers": failovers,
+            "statuses": statuses,
+            "degenerate": self.degenerate,
+            "wall_s": round(wall, 6),
+        }
+        telemetry.add_record(dict(self.last_drain))
+
+    def close(self) -> None:
+        for h in self.hosts.values():
+            try:
+                h.close()
+            except (HostDown, OSError):
+                pass

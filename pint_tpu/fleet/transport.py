@@ -1,0 +1,415 @@
+"""Transport seam between the fleet router and per-host schedulers.
+
+The router (:mod:`pint_tpu.fleet.router`) never talks to a
+:class:`~pint_tpu.serve.scheduler.ThroughputScheduler` directly — it
+talks to a *host transport*, a small duck-typed surface
+(:class:`LoopbackHost` documents it) with exactly the operations the
+routing tier needs:
+
+* ``submit(request) -> token`` — enqueue one fit/read on the host,
+  returning an opaque per-host token;
+* ``drain() -> [wire results]`` / ``drain_reads() -> [wire reads]`` —
+  resolve everything queued since the last drain;
+* ``predict(request) -> wire read`` — the synchronous read fast lane
+  (never behind the host's fit queue — the worker serves it as its own
+  op, not as part of a drain);
+* ``report() -> dict`` — the host's health surface
+  (:meth:`ThroughputScheduler.report`): queue depth, fail streak,
+  degraded flag, program-cache misses. The router's per-host health
+  state is fed ONLY from these reports plus transport-level failures.
+
+Two implementations:
+
+:class:`LoopbackHost` wraps an in-process scheduler — N "hosts" in one
+process, zero network, zero serialization (results are the scheduler's
+own objects; the caller's model is mutated in place exactly as in
+single-host serving). Tests, ``bench --smoke`` and the soak fleet axis
+run on loopback, so every routing invariant is provable without
+silicon or sockets.
+
+:class:`TcpHost` speaks a line-oriented JSONL protocol to a real
+worker process (:mod:`pint_tpu.fleet.worker`): one JSON object per
+line, ``{"op": ..., "payload": <base64 pickle>}`` requests and
+``{"ok": ..., ...}`` responses. Payloads (TOA tables, models, results)
+are pickled — the fleet protocol is for a TRUSTED pod-internal
+network, like any jax.distributed coordinator traffic, never an
+internet-facing surface. Because a remote worker fits a *copy* of the
+request, fitted parameter values come back in the wire result
+(``params``: name -> (hi, lo, uncertainty) double-double parts, exact)
+and the router writes them onto the caller's model — the same
+in-place contract the loopback path gets for free.
+
+A dead socket raises :class:`HostDown` — the router's signal to mark
+the host dead and re-route its pending work (failover), never an
+exception surfaced to a submit caller.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import os
+import pickle
+import socket
+import time
+
+from pint_tpu import telemetry
+
+
+class HostDown(ConnectionError):
+    """The transport lost the host (refused/reset/closed socket or an
+    explicitly killed loopback). The router catches this everywhere a
+    transport is touched and fails over; it never reaches a caller."""
+
+
+def _b64(obj) -> str:
+    return base64.b64encode(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)).decode()
+
+
+def _unb64(s: str):
+    return pickle.loads(base64.b64decode(s.encode()))
+
+
+def wire_fit_result(token, res) -> dict:
+    """Slim wire form of one FitResult: everything the router needs to
+    rebuild the envelope against the CALLER's request object, without
+    shipping the TOA table back. ``params`` carries the fitted values
+    as exact (hi, lo) double-double parts plus uncertainties — only for
+    results whose status writes back (:attr:`FitResult.fitted`)."""
+    params = None
+    if res.fitted and res.request.model is not None:
+        m = res.request.model
+        params = {k: (m[k].hi, m[k].lo, m[k].uncertainty)
+                  for k in m.free_params}
+    return {"token": token, "status": res.status, "chi2": res.chi2,
+            "converged": res.converged, "error": res.error,
+            "attempts": res.attempts, "retry_after_s": res.retry_after_s,
+            "session": res.session, "passthrough": res.passthrough,
+            "queue_latency_s": res.queue_latency_s, "group": res.group,
+            "batch": res.batch, "n_members": res.n_members,
+            "occupancy": res.occupancy, "host": res.host,
+            "injected": res.injected, "trace": res.trace,
+            "params": params}
+
+
+def wire_read_result(res) -> dict:
+    """Wire form of one PredictResult (arrays ride the pickle)."""
+    return {"status": res.status, "phase_int": res.phase_int,
+            "phase_frac": res.phase_frac, "freq_hz": res.freq_hz,
+            "source": res.source, "cache_hit": res.cache_hit,
+            "n_queries": res.n_queries, "latency_s": res.latency_s,
+            "error": res.error, "host": res.host}
+
+
+# ----------------------------------------------------------------------
+# loopback: N hosts in one process (tests / bench / soak)
+# ----------------------------------------------------------------------
+
+class LoopbackHost:
+    """In-process host: a scheduler behind the transport surface.
+
+    ``kill()`` simulates a host crash for failover tests — every later
+    operation raises :class:`HostDown`, exactly what a dead TCP socket
+    surfaces, so the router's failover path is transport-agnostic.
+    """
+
+    kind = "loopback"
+
+    def __init__(self, host_id: str, scheduler=None, **sched_kwargs):
+        from pint_tpu.serve.scheduler import ThroughputScheduler
+
+        self.host_id = host_id
+        self.scheduler = (scheduler if scheduler is not None
+                          else ThroughputScheduler(host_id=host_id,
+                                                   **sched_kwargs))
+        if not self.scheduler.host_id:
+            self.scheduler.host_id = host_id
+        self._tokens = itertools.count()
+        self._pending: list[tuple[int, object]] = []       # (token, handle)
+        self._pending_reads: list[tuple[int, object]] = []
+        self._dead = False
+
+    def _check(self):
+        if self._dead:
+            raise HostDown(f"loopback host {self.host_id} was killed")
+
+    def kill(self) -> None:
+        """Simulate a crashed host (failover tests / soak host-kill)."""
+        self._dead = True
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def submit(self, request) -> int:
+        from pint_tpu.serve.scheduler import PredictRequest
+
+        self._check()
+        token = next(self._tokens)
+        handle = self.scheduler.submit(request)
+        if isinstance(request, PredictRequest):
+            self._pending_reads.append((token, handle))
+        else:
+            self._pending.append((token, handle))
+        return token
+
+    def drain(self) -> list[dict]:
+        self._check()
+        self.scheduler.drain()
+        out = [{"token": t, "result": h.result()}
+               for t, h in self._pending]
+        self._pending = []
+        return out
+
+    def drain_reads(self) -> list[dict]:
+        self._check()
+        self.scheduler.drain_reads()
+        out = [{"token": t, "result": h.result()}
+               for t, h in self._pending_reads]
+        self._pending_reads = []
+        return out
+
+    def predict(self, request) -> dict:
+        self._check()
+        return {"result": self.scheduler.predict(request)}
+
+    def report(self) -> dict:
+        self._check()
+        return self.scheduler.report()
+
+    def close(self) -> None:
+        self._dead = True
+
+
+# ----------------------------------------------------------------------
+# TCP/JSONL: a real worker process behind a socket
+# ----------------------------------------------------------------------
+
+class TcpHost:
+    """JSONL client for one :mod:`pint_tpu.fleet.worker` process."""
+
+    kind = "tcp"
+
+    def __init__(self, host_id: str, address: tuple[str, int],
+                 timeout_s: float = 600.0):
+        self.host_id = host_id
+        self.address = tuple(address)
+        self.timeout_s = timeout_s
+        self._sock = None
+        self._fh = None
+
+    def _connect(self):
+        if self._sock is not None:
+            return
+        try:
+            self._sock = socket.create_connection(self.address,
+                                                  timeout=self.timeout_s)
+            self._fh = self._sock.makefile("rwb")
+        except OSError as e:
+            self._sock = self._fh = None
+            raise HostDown(
+                f"host {self.host_id} at {self.address}: {e}") from e
+
+    def _rpc(self, op: str, payload=None, **fields) -> dict:
+        self._connect()
+        msg = {"op": op, **fields}
+        if payload is not None:
+            msg["payload"] = _b64(payload)
+        try:
+            self._fh.write((json.dumps(msg) + "\n").encode())
+            self._fh.flush()
+            line = self._fh.readline()
+        except OSError as e:
+            self.close()
+            raise HostDown(
+                f"host {self.host_id} at {self.address}: {e}") from e
+        if not line:
+            self.close()
+            raise HostDown(f"host {self.host_id} at {self.address}: "
+                           "connection closed")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            # a structured application error (bad request, backpressure)
+            # — the host is alive; re-raise the typed error router-side
+            et = resp.get("error_type", "RuntimeError")
+            if et == "ServeQueueFull":
+                from pint_tpu.serve.scheduler import ServeQueueFull
+
+                a = resp.get("attrs", {})
+                raise ServeQueueFull(**a)
+            raise RuntimeError(f"host {self.host_id}: "
+                               f"{et}: {resp.get('error')}")
+        return resp
+
+    def ping(self) -> dict:
+        return self._rpc("ping")
+
+    def alive(self) -> bool:
+        try:
+            self.ping()
+            return True
+        except (HostDown, OSError):
+            return False
+
+    def submit(self, request) -> int:
+        return int(self._rpc("submit", payload=request)["token"])
+
+    def drain(self) -> list[dict]:
+        return _unb64(self._rpc("drain")["payload"])
+
+    def drain_reads(self) -> list[dict]:
+        return _unb64(self._rpc("drain_reads")["payload"])
+
+    def predict(self, request) -> dict:
+        return _unb64(self._rpc("predict", payload=request)["payload"])
+
+    def report(self) -> dict:
+        return self._rpc("report")["report"]
+
+    def shutdown(self) -> None:
+        """Ask the worker to exit cleanly (best-effort)."""
+        try:
+            self._rpc("shutdown")
+        except (HostDown, OSError, RuntimeError):
+            pass
+        self.close()
+
+    def close(self) -> None:
+        for o in (self._fh, self._sock):
+            try:
+                if o is not None:
+                    o.close()
+            except OSError:
+                pass
+        self._sock = self._fh = None
+
+
+# ----------------------------------------------------------------------
+# worker-side server loop
+# ----------------------------------------------------------------------
+
+def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
+                 ready_fh=None, extra_report=None) -> int:
+    """Serve one scheduler over the JSONL protocol until ``shutdown``.
+
+    Single-threaded by design — the serve layer is thread-free, and the
+    fleet has exactly one router per worker. Sequential reconnects are
+    accepted (a router that restarts resumes against the same host
+    state). ``ready_fh`` (when given) receives one ``{"ready": ...}``
+    JSON line after the socket is listening — the spawn handshake the
+    bench/worker entry points wait on. ``extra_report`` is merged into
+    every ``report`` response (the worker adds its jax.distributed
+    status and pid). Returns the number of requests served.
+    """
+    from pint_tpu.serve.scheduler import PredictRequest, ServeQueueFull
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(1)
+    bound_port = srv.getsockname()[1]
+    if ready_fh is not None:
+        ready_fh.write(json.dumps(
+            {"ready": True, "host": scheduler.host_id,
+             "port": bound_port, "pid": os.getpid()}) + "\n")
+        ready_fh.flush()
+    tokens = itertools.count()
+    pending: list[tuple[int, object]] = []
+    pending_reads: list[tuple[int, object]] = []
+    state = {"served": 0, "running": True}
+
+    def handle(msg: dict, reply) -> None:
+        """Dispatch one protocol op (replies structured app errors via
+        the surrounding handlers; only a dead pipe's OSError escapes)."""
+        nonlocal pending, pending_reads
+
+        op = msg.get("op")
+        state["served"] += 1
+        if op == "ping":
+            reply({"ok": True, "host": scheduler.host_id,
+                   "t": time.time()})
+        elif op == "submit":
+            req = _unb64(msg["payload"])
+            token = next(tokens)
+            h = scheduler.submit(req)
+            if isinstance(req, PredictRequest):
+                pending_reads.append((token, h))
+            else:
+                pending.append((token, h))
+            telemetry.inc("fleet.worker.requests")
+            reply({"ok": True, "token": token})
+        elif op == "drain":
+            scheduler.drain()
+            out = [wire_fit_result(t, h.result()) for t, h in pending]
+            pending = []
+            out_r = [dict(wire_read_result(h.result()), token=t)
+                     for t, h in pending_reads]
+            pending_reads = []
+            reply({"ok": True, "payload": _b64(out + out_r)})
+        elif op == "drain_reads":
+            scheduler.drain_reads()
+            out = [dict(wire_read_result(h.result()), token=t)
+                   for t, h in pending_reads]
+            pending_reads = []
+            reply({"ok": True, "payload": _b64(out)})
+        elif op == "predict":
+            res = scheduler.predict(_unb64(msg["payload"]))
+            reply({"ok": True, "payload": _b64(wire_read_result(res))})
+        elif op == "report":
+            rep = scheduler.report()
+            if extra_report:
+                rep.update(extra_report)
+            reply({"ok": True, "report": rep})
+        elif op == "shutdown":
+            reply({"ok": True})
+            state["running"] = False
+        else:
+            reply({"ok": False, "error_type": "ValueError",
+                   "error": f"unknown op {op!r}"})
+
+    while state["running"]:
+        try:
+            conn, _addr = srv.accept()
+        except OSError:
+            break
+        fh = conn.makefile("rwb")
+
+        def reply(obj: dict) -> None:
+            fh.write((json.dumps(obj) + "\n").encode())
+            fh.flush()
+
+        while state["running"]:
+            try:
+                line = fh.readline()
+            except OSError:
+                break  # reset mid-read: await a reconnect, don't die
+            if not line:
+                break  # router went away; await a reconnect
+            # the inner handlers reply structured app errors; a reply
+            # on a DEAD pipe raises OSError through them to the outer
+            # except, which drops the connection and awaits a
+            # reconnect instead of killing the worker — warm programs
+            # and session state must survive a router crash
+            try:
+                try:
+                    handle(json.loads(line), reply)
+                except ServeQueueFull as e:
+                    reply({"ok": False, "error_type": "ServeQueueFull",
+                           "attrs": {"depth": e.depth,
+                                     "max_queue": e.max_queue,
+                                     "retry_after_s": e.retry_after_s,
+                                     "degraded": e.degraded}})
+                except Exception as e:  # noqa: BLE001 — isolation
+                    # boundary: a bad request must never kill the worker
+                    reply({"ok": False, "error_type": type(e).__name__,
+                           "error": str(e)})
+            except OSError:
+                break  # pipe died mid-reply: await a reconnect
+        try:
+            fh.close()
+            conn.close()
+        except OSError:
+            pass
+    srv.close()
+    return state["served"]

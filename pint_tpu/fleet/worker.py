@@ -1,0 +1,145 @@
+"""Fleet worker/coordinator entry points.
+
+A *worker* is one host process: it (optionally) joins the
+``jax.distributed`` process group, builds a
+:class:`~pint_tpu.serve.scheduler.ThroughputScheduler` over its
+process-LOCAL device pool, and serves the JSONL transport protocol
+(:func:`pint_tpu.fleet.transport.serve_worker`) until told to shut
+down. ``python -m pint_tpu.fleet worker --port 0 --host-id w0`` is the
+CLI; :func:`spawn_local_workers` is the same thing as a library call
+for the bench/A-B harness (N real processes on one machine, ports
+auto-assigned, ready lines handshaked over stdout).
+
+**jax.distributed.** When ``PINT_TPU_FLEET_PROCESSES > 1`` the worker
+attempts ``jax.distributed.initialize(coordinator_address=
+$PINT_TPU_FLEET_COORD, num_processes=N, process_id=$PINT_TPU_FLEET_
+PROCESS_ID)`` — the pjit multi-process machinery (SNIPPETS.md [1][2]):
+on pod-scale platforms this is what makes each process's
+``jax.local_devices()`` its slice of the pod. The attempt is guarded
+and *honestly recorded*: runtimes without multi-process support (or
+with no live coordinator) degrade to single-process local devices and
+the worker's ``report`` op carries ``jax_distributed: "unavailable:
+..."`` so committed artifacts state which mode actually ran. At
+``PINT_TPU_FLEET_PROCESSES`` unset/1 (or under ``PINT_TPU_FLEET=0``)
+nothing distributed is touched at all — the worker is bitwise today's
+single-host scheduler behind a socket.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def init_distributed() -> str:
+    """Join the jax.distributed process group when configured.
+
+    Returns a status token for the worker's report surface:
+    ``"off"`` (not configured / N=1 / kill switch),
+    ``"initialized(N=...)"`` on success, or ``"unavailable: <err>"``
+    when the runtime refused — the caller continues single-process
+    either way (the loopback-fallback honesty rule of FLEET_r01).
+    """
+    from pint_tpu.fleet.router import fleet_enabled
+
+    n = int(os.environ.get("PINT_TPU_FLEET_PROCESSES", "1") or "1")
+    if n <= 1 or not fleet_enabled():
+        return "off"
+    coord = os.environ.get("PINT_TPU_FLEET_COORD", "127.0.0.1:9733")
+    pid = int(os.environ.get("PINT_TPU_FLEET_PROCESS_ID", "0"))
+    try:
+        import jax
+
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=pid)
+        return f"initialized(N={n}, process={pid})"
+    except Exception as e:  # noqa: BLE001 — recorded, never fatal
+        return f"unavailable: {type(e).__name__}: {e}"
+
+
+def build_host_scheduler(host_id: str, **sched_kwargs):
+    """One scheduler over this PROCESS's local devices.
+
+    ``jax.local_devices()`` — not ``jax.devices()`` — is the pool: in
+    a jax.distributed fleet the global device list spans processes,
+    and a scheduler must only place buffers on devices its own process
+    addresses. Single-process, the two lists are identical."""
+    import jax
+
+    from pint_tpu.serve.scheduler import ThroughputScheduler
+
+    sched_kwargs.setdefault("devices", list(jax.local_devices()))
+    return ThroughputScheduler(host_id=host_id, **sched_kwargs)
+
+
+def run_worker(port: int, host_id: str, *, max_queue: int = 256,
+               window: int = 2, ready_fh=None) -> int:
+    """Worker main: distributed init, local scheduler, serve protocol."""
+    from pint_tpu.fleet.transport import serve_worker
+
+    dist = init_distributed()
+    sched = build_host_scheduler(host_id, max_queue=max_queue,
+                                 window=window)
+    import jax
+
+    extra = {"jax_distributed": dist, "pid": os.getpid(),
+             "n_local_devices": len(jax.local_devices()),
+             "backend": jax.default_backend()}
+    return serve_worker(sched, port,
+                        ready_fh=ready_fh if ready_fh is not None
+                        else sys.stdout,
+                        extra_report=extra)
+
+
+def spawn_local_workers(n: int, *, env=None, ready_timeout_s: float = 120.0,
+                        distributed: bool = False,
+                        coord_port: int = 9733, prefix: str = "w"):
+    """Spawn N real worker processes on this machine; returns
+    ``[(host_id, port, Popen)]`` once every worker's ready line has
+    been read (ports are OS-assigned: ``--port 0``; host ids are
+    ``<prefix>0..<prefix>N-1``).
+
+    With ``distributed=True`` the workers are armed to attempt
+    ``jax.distributed.initialize`` against a local coordinator
+    (process 0); whether that succeeded is read from each worker's
+    ``report`` op, not assumed."""
+    out = []
+    procs = []
+    for i in range(n):
+        wenv = dict(os.environ, **(env or {}))
+        wenv.setdefault("JAX_PLATFORMS", "cpu")
+        if distributed:
+            wenv["PINT_TPU_FLEET_PROCESSES"] = str(n)
+            wenv["PINT_TPU_FLEET_PROCESS_ID"] = str(i)
+            wenv["PINT_TPU_FLEET_COORD"] = f"127.0.0.1:{coord_port}"
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pint_tpu.fleet", "worker",
+             "--port", "0", "--host-id", f"{prefix}{i}"],
+            env=wenv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        procs.append((f"{prefix}{i}", p))
+    deadline = time.time() + ready_timeout_s
+    for hid, p in procs:
+        line = ""
+        while time.time() < deadline:
+            line = p.stdout.readline()
+            if line.strip().startswith("{"):
+                break
+            if not line and p.poll() is not None:
+                break  # child died before its ready line: fail fast
+                # (a closed stdout returns "" instantly — without the
+                # poll() check this loop would busy-spin the timeout)
+        if not line.strip().startswith("{"):
+            for _hid, q in procs:
+                q.kill()
+            raise TimeoutError(
+                f"worker {hid} never reported ready within "
+                f"{ready_timeout_s:g}s"
+                + (f" (exited rc={p.returncode})"
+                   if p.poll() is not None else ""))
+        info = json.loads(line)
+        out.append((hid, int(info["port"]), p))
+    return out

@@ -15,9 +15,12 @@ to a cached converged solution via fused rank-k incremental updates
 instead of paying a cold fit. Reads (:class:`PredictRequest`;
 :mod:`pint_tpu.predict`) are the second tier: phase/TOA predictions
 served from cached fit state through a fast lane that never queues
-behind fit drains. See docs/ARCHITECTURE.md "Throughput engine",
-"Failure domains & degradation ladder", "Sessionful serving" and
-"The read path".
+behind fit drains. Scale-OUT over many hosts lives one tier up in
+:mod:`pint_tpu.fleet` (fingerprint-sticky rendezvous routing over N
+per-host schedulers; this scheduler's ``host_id`` / ``report()`` are
+its per-host surface). See docs/ARCHITECTURE.md "Throughput engine",
+"Failure domains & degradation ladder", "Sessionful serving",
+"The read path" and "Fleet tier".
 """
 
 from pint_tpu.serve import faults  # noqa: F401

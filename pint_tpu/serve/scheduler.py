@@ -225,6 +225,7 @@ class FitResult:
     retry_after_s: float | None = None
     injected: str | None = None
     session: str | None = None  # session route token (ISSUE 10)
+    host: str | None = None     # serving host id (ISSUE 12 fleet tier)
 
     @property
     def fitted(self) -> bool:
@@ -309,6 +310,7 @@ class PredictResult:
     n_queries: int = 0
     latency_s: float = 0.0
     error: str | None = None
+    host: str | None = None     # serving host id (ISSUE 12 fleet tier)
 
 
 class PredictHandle:
@@ -356,6 +358,12 @@ class BatchPlan:
     slot: int = 0             # first device index of the block
     basis_bucket: int = 0     # padded ECORR epoch columns (ISSUE 8)
     reason: str = ""          # passthrough reason token (ISSUE 8)
+    #: member x TOA grid depth (ISSUE 12, the PR-7 residue): a batched
+    #: plan whose member axis is narrower than its device block also
+    #: shards each member's TOA axis over ``toa_devices`` devices —
+    #: the block is a (devices/toa_devices, toa_devices) ("psr","toa")
+    #: grid instead of idling the spare devices
+    toa_devices: int = 1
 
     @property
     def occupancy(self) -> float:
@@ -446,10 +454,12 @@ class ThroughputScheduler:
     def __init__(self, *, max_queue: int = 256,
                  max_batch_members: int = 64, member_floor: int = 1,
                  window: int = 2, mesh=None, mesh_devices: int | None = None,
-                 toa_shard_min: int = 16384,
+                 devices=None, toa_shard_min: int = 16384,
+                 toa_grid_min: int = 1024,
                  max_dispatch_retries: int = 2,
                  retry_backoff_s: float = 0.05,
-                 degrade_after: int = 2, session_cache=None):
+                 degrade_after: int = 2, session_cache=None,
+                 host_id: str = ""):
         import jax
 
         if max_queue < 1 or max_batch_members < 1:
@@ -463,7 +473,16 @@ class ThroughputScheduler:
         if isinstance(window, bool) or not isinstance(window, int):
             raise TypeError(f"window must be an int >= 1, got {window!r}")
         self.window = max(1, window)
-        if mesh is not None:
+        # fleet identity (ISSUE 12): stamped on every result envelope,
+        # drain record and read record so a multi-host rollup can
+        # attribute work; empty for plain single-host use
+        self.host_id = host_id
+        if devices is not None:
+            # explicit pool (the fleet worker passes its PROCESS-LOCAL
+            # devices: in a jax.distributed fleet jax.devices() spans
+            # processes and must not be this host's placement pool)
+            devs = list(devices)
+        elif mesh is not None:
             devs = list(np.asarray(mesh.devices).ravel())
         else:
             devs = list(jax.devices())
@@ -473,7 +492,11 @@ class ThroughputScheduler:
         self.n_devices = len(devs)
         self._dev_index = {d.id: i for i, d in enumerate(devs)}
         self.toa_shard_min = max(1, int(toa_shard_min))
-        self._meshes: dict = {}  # (kind-is-sharded, slot, width) -> Mesh
+        # member x TOA grid floor (ISSUE 12 / PR-7 residue): batched
+        # plans only grid their TOA axis over spare devices when the
+        # bucket reaches this (sharding a tiny table buys nothing)
+        self.toa_grid_min = max(1, int(toa_grid_min))
+        self._meshes: dict = {}  # (kind-is-sharded, slot, psr, toa) -> Mesh
         self.max_dispatch_retries = max(0, max_dispatch_retries)
         self.retry_backoff_s = max(0.0, retry_backoff_s)
         self.degrade_after = max(1, degrade_after)
@@ -540,6 +563,30 @@ class ThroughputScheduler:
         if rate <= 0.0:
             return round(max(1.0, 0.02 * depth), 3)
         return round(min(60.0, max(0.05, depth / rate)), 3)
+
+    def report(self) -> dict:
+        """The host health surface (ISSUE 12): everything the fleet
+        router's per-host health state is fed from — queue depths, the
+        PR-6 ladder state, the EWMA drain rate, and the process's
+        program-cache miss total (the cross-host-recompile measurement
+        of the FLEET A/B). Cheap, side-effect-free, callable between
+        drains; the fleet worker serves it as its own protocol op."""
+        from pint_tpu.telemetry.counters import counter_value
+
+        return {
+            "host": self.host_id,
+            "queue_depth": len(self._queue),
+            "read_depth": len(self._read_queue),
+            "fail_streak": self._fail_streak,
+            "degraded": self.degraded(),
+            "degraded_devices": sorted(self.degraded_devices()),
+            "drain_rate": self._drain_rate,
+            "devices": self.n_devices,
+            "sessions": len(self.sessions.entries),
+            "last_drain_wall_s": (self.last_drain or {}).get("wall_s"),
+            "program_misses": int(
+                counter_value("cache.fit_program.miss") or 0),
+        }
 
     # ------------------------------------------------------------------
     # intake
@@ -739,7 +786,8 @@ class ThroughputScheduler:
             freq_hz=None if out is None else out.freq_hz,
             source="" if out is None else out.source,
             cache_hit=bool(out is not None and out.cache_hit),
-            n_queries=n, latency_s=round(latency, 9), error=error)
+            n_queries=n, latency_s=round(latency, 9), error=error,
+            host=self.host_id or None)
         self._read_stats.append({
             "latency_s": latency, "service_s": service_s,
             "queries": n, "status": status,
@@ -782,6 +830,7 @@ class ThroughputScheduler:
         busy = sum(r["service_s"] for r in window)
         self.last_read = {
             "type": "read",
+            **({"host": self.host_id} if self.host_id else {}),
             "requests": len(window),
             "queries": queries,
             "cache_hit_rate": round(
@@ -891,6 +940,10 @@ class ThroughputScheduler:
                     best = (k, a)
             return best[1], not best[0][0]
 
+        # pass 1: chunk every group; batched chunks are DEFERRED (an
+        # ordered placeholder) so the member x TOA grid rule below can
+        # see the whole pass's demand before widths are fixed
+        batched_specs: list[tuple] = []  # (plans pos, fp, chunk, ...)
         for key in order:
             fp, bucket, bb = key[0], key[1], key[4]
             idxs = groups[key]
@@ -924,18 +977,42 @@ class ThroughputScheduler:
                 n_members = min(bucketing.member_bucket_size(
                                     len(chunk), floor=self.member_floor),
                                 self.max_batch_members)
-                width = min(largest_pow2_divisor(n_members), width_cap)
-                slot, clean = _place(width)
-                if not clean:
-                    _passthrough(fp, chunk, bucket, "degraded_devices")
-                    continue
-                for d in range(slot, slot + width):
-                    load[d] += n_members // width
-                plans.append(BatchPlan(
-                    "batched", _fp.short_id(fp), chunk, bucket,
-                    n_members, devices=width, slot=slot,
-                    basis_bucket=bb))
-        return plans
+                plans.append(None)  # placeholder: filled in pass 2
+                batched_specs.append((len(plans) - 1, fp, chunk,
+                                      bucket, n_members, bb))
+
+        # pass 2 (ISSUE 12, the PR-7 residue): when the pass's batched
+        # chunks demand fewer device slots than the pool holds, the
+        # spare capacity grids each plan's TOA axis instead of idling —
+        # a 2-member batch on an 8-device pool becomes a (2, 4)
+        # ("psr", "toa") grid, each member's TOA axis sharded over 4
+        # devices. Demand >= pool (a busy drain) degenerates to the
+        # pure member-sharded PR-7 rule; tiny tables (< toa_grid_min)
+        # never grid (partition overhead would exceed the work).
+        demand = sum(min(largest_pow2_divisor(nm), width_cap)
+                     for _pos, _fp_, _c, _b, nm, _bb in batched_specs)
+        spare = (largest_pow2_leq(max(1, self.n_devices // demand))
+                 if demand else 1)
+        filled: dict[int, BatchPlan] = {}
+        for pos, fp, chunk, bucket, n_members, bb in batched_specs:
+            m_width = min(largest_pow2_divisor(n_members), width_cap)
+            toa_w = 1
+            if bucket >= self.toa_grid_min and self.n_devices > 1:
+                toa_w = min(spare, max(1, width_cap // m_width),
+                            largest_pow2_leq(bucket))
+            width = m_width * toa_w
+            slot, clean = _place(width)
+            if not clean:
+                _passthrough(fp, chunk, bucket, "degraded_devices")
+                continue
+            for d in range(slot, slot + width):
+                load[d] += n_members // m_width
+            filled[pos] = BatchPlan(
+                "batched", _fp.short_id(fp), chunk, bucket,
+                n_members, devices=width, slot=slot,
+                basis_bucket=bb, toa_devices=toa_w)
+        return [filled.get(i, p) for i, p in enumerate(plans)
+                if p is not None or i in filled]
 
     # ------------------------------------------------------------------
     # execution
@@ -946,16 +1023,18 @@ class ThroughputScheduler:
         fresh instances would hit the program caches; the dict just
         skips rebuilding). ``"batched"`` plans get a (width, 1)
         psr-major mesh (member axis sharded, TOA axis whole);
-        ``"sharded"`` plans a (1, width) toa-major mesh."""
+        ``"sharded"`` plans a (1, width) toa-major mesh; a gridded
+        batched plan (``toa_devices > 1``, ISSUE 12) a
+        (width/toa_devices, toa_devices) psr x toa grid."""
         from pint_tpu.parallel.mesh import make_mesh
 
         sharded = plan.kind == "sharded"
-        key = (sharded, plan.slot, plan.devices)
+        psr = 1 if sharded else plan.devices // plan.toa_devices
+        key = (sharded, plan.slot, plan.devices, psr)
         m = self._meshes.get(key)
         if m is None:
             devs = self.devices[plan.slot:plan.slot + plan.devices]
-            m = make_mesh(devices=devs,
-                          psr_axis=1 if sharded else len(devs))
+            m = make_mesh(devices=devs, psr_axis=psr)
             self._meshes[key] = m
         return m
 
@@ -1004,7 +1083,8 @@ class ThroughputScheduler:
             queue_latency_s=round(t_done - t_sub, 6),
             passthrough=passthrough, status=status, error=error,
             attempts=attempts, trace=trace, retry_after_s=retry_after_s,
-            injected=meta.get("injected"), session=session)
+            injected=meta.get("injected"), session=session,
+            host=self.host_id or None)
         handle._result = res
         telemetry.inc(f"serve.status.{status}")
         if status not in ("ok", "nonconverged"):
@@ -1514,8 +1594,14 @@ class ThroughputScheduler:
         for p in plans:
             if p.kind == "batched":
                 member_sharded += p.devices > 1
-                per = p.n_members // p.devices
-                for j, d in enumerate(p.device_ids):
+                # a gridded plan (ISSUE 12) spans a (m_width,
+                # toa_devices) block: each member row occupies
+                # toa_devices consecutive devices, every one holding a
+                # TOA shard of that row's members
+                m_width = p.devices // p.toa_devices
+                per = p.n_members // m_width
+                for o, d in enumerate(p.device_ids):
+                    j = o // p.toa_devices  # this device's member row
                     dev_slots[d] += per
                     dev_members[d] += max(
                         0, min(per, len(p.indices) - j * per))
@@ -1531,11 +1617,15 @@ class ThroughputScheduler:
                     dev_bytes[idx] += nb
         occ_vec = [round(dev_members[d] / dev_slots[d], 4)
                    if dev_slots[d] else 0.0 for d in range(D)]
+        gridded = sum(p.kind == "batched" and p.toa_devices > 1
+                      for p in plans)
         telemetry.set_gauge("serve.mesh.devices", D)
         if member_sharded:
             telemetry.inc("serve.mesh.member_sharded", member_sharded)
         if toa_sharded:
             telemetry.inc("serve.mesh.toa_sharded", toa_sharded)
+        if gridded:
+            telemetry.inc("serve.mesh.gridded", gridded)
         if stats.get("stolen_fetches"):
             telemetry.inc("serve.mesh.stolen_fetches",
                           stats["stolen_fetches"])
@@ -1583,7 +1673,9 @@ class ThroughputScheduler:
         telemetry.set_gauge("serve.overlap_efficiency",
                             stats["overlap_efficiency"])
         self.last_drain = {
-            "type": "serve", "fits": n_real, "batches": len(plans),
+            "type": "serve",
+            **({"host": self.host_id} if self.host_id else {}),
+            "fits": n_real, "batches": len(plans),
             "occupancy": round(occupancy, 4),
             "fits_per_s": round(fits_per_s, 3),
             "queue_latency_s_mean": round(
@@ -1609,6 +1701,7 @@ class ThroughputScheduler:
                 "per_device_bytes": dev_bytes,
                 "member_sharded": member_sharded,
                 "toa_sharded": toa_sharded,
+                "gridded": gridded,
                 "shard_fail_streaks": {
                     str(d): s
                     for d, s in sorted(self._dev_streak.items())},
@@ -1622,6 +1715,8 @@ class ThroughputScheduler:
                  "occupancy": round(p.occupancy, 4),
                  **({"basis_bucket": p.basis_bucket}
                     if p.basis_bucket else {}),
+                 **({"toa_devices": p.toa_devices}
+                    if p.toa_devices > 1 else {}),
                  **({"reason": p.reason} if p.reason else {})}
                 for p in plans],
             **stats,

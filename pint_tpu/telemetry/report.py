@@ -321,6 +321,59 @@ def read_summary(records: list[dict]) -> dict:
     }
 
 
+def fleet_summary(records: list[dict]) -> dict:
+    """Fleet-tier rollup (ISSUE 12) from ``type="fleet"`` router drain
+    records: per-host request/queue/failure state, route split (sticky
+    vs rendezvous vs stolen vs failover/shed), the warm-routing hit
+    rate and failover count. Records predating the fleet tier simply
+    contribute nothing — old artifacts degrade gracefully."""
+    drains = requests = failovers = 0
+    routes: dict[str, int] = {}
+    hosts: dict[str, dict] = {}
+    warm_hits = warm_total = 0
+    sticky = routed = 0
+    for r in records:
+        if r.get("type") != "fleet":
+            continue
+        drains += 1
+        requests += int(r.get("requests") or 0)
+        failovers += int(r.get("failovers") or 0)
+        for k, v in (r.get("routes") or {}).items():
+            routes[k] = routes.get(k, 0) + int(v)
+            routed += int(v)
+            if k == "sticky":
+                sticky += int(v)
+        if r.get("warm_total") is not None:
+            warm_hits += int(r.get("warm_hits") or 0)
+            warm_total += int(r.get("warm_total") or 0)
+        elif r.get("warm_hit_rate") is not None:
+            # records predating the raw counts: approximate from the
+            # rate over the route total (lossy — routes also count
+            # reads/sheds — kept only for graceful degradation)
+            n = sum(int(v) for v in (r.get("routes") or {}).values())
+            warm_hits += round(float(r["warm_hit_rate"]) * n)
+            warm_total += n
+        for h in r.get("hosts") or []:
+            hid = str(h.get("host"))
+            agg = hosts.setdefault(hid, {
+                "requests": 0, "fail_streak": 0, "degraded": False,
+                "alive": True, "program_misses": 0})
+            agg["requests"] += int(h.get("requests") or 0)
+            agg["fail_streak"] = int(h.get("fail_streak") or 0)
+            agg["degraded"] = bool(h.get("degraded"))
+            agg["alive"] = bool(h.get("alive", True))
+            agg["program_misses"] = int(h.get("program_misses") or 0)
+    return {
+        "drains": drains, "requests": requests, "routes": routes,
+        "failovers": failovers,
+        "sticky_hit_rate": (round(sticky / routed, 4) if routed
+                            else None),
+        "warm_hit_rate": (round(warm_hits / warm_total, 4)
+                          if warm_total else None),
+        "hosts": hosts,
+    }
+
+
 def mesh_summary(records: list[dict]) -> dict:
     """Per-device placement rollup from the drain records' ``mesh``
     blocks (ISSUE 7): member-slots vs real members per device (the
@@ -677,6 +730,29 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no read records)")
 
+    fl = summary.get("fleet") or {}
+    if fl.get("drains"):
+        lines.append("\n== fleet tier (multi-host routing) ==")
+        lines.append(
+            f"  {fl['requests']} request(s) over {fl['drains']} router "
+            f"drain(s), {fl['failovers']} failover(s): "
+            + (", ".join(f"{k}={v}"
+                         for k, v in sorted(fl["routes"].items()))
+               or "none"))
+        whr = fl.get("warm_hit_rate")
+        lines.append(
+            "  warm-routing hit rate: "
+            + (f"{whr:.1%}" if whr is not None else "n/a")
+            + " (requests landing on a host already holding their "
+              "structure)")
+        for hid, h in sorted(fl["hosts"].items()):
+            state = ("DEAD" if not h["alive"]
+                     else "degraded" if h["degraded"] else "ok")
+            lines.append(
+                f"    host {hid}: {h['requests']:>5} requests  "
+                f"fail_streak {h['fail_streak']}  "
+                f"program_misses {h['program_misses']}  [{state}]")
+
     lines.append("\n== mesh (device placement) ==")
     mesh = summary["mesh"]
     if mesh["devices"] > 1 and mesh["drains"]:
@@ -772,6 +848,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "passthrough": passthrough_rollup(records),
         "sessions": sessions_summary(records),
         "reads": read_summary(records),
+        "fleet": fleet_summary(records),
         "mesh": mesh_summary(records),
         "faults": fault_summaries(records),
         "caches": cache_rates(records),

@@ -320,3 +320,69 @@ def test_report_mesh_section(toas_a, capsys):
         "caches": {}, "pollution": {"samples": 0, "polluted_samples": 0,
                                     "windows": []}})
     assert "WARNING: occupancy skew" in text
+
+
+# ----------------------------------------------------------------------
+# member x TOA grid (ISSUE 12: the PR-7 residue)
+# ----------------------------------------------------------------------
+
+def test_grid_members_x_toas_when_pool_has_spare(toas_a):
+    """A 2-member batch on the 8-device pool grids each member's TOA
+    axis over 4 devices — a (2, 4) psr x toa block instead of 6 idle
+    devices — with per-member parity vs the dense fused fit."""
+    from pint_tpu.fitting import device_loop
+
+    hyper = dict(maxiter=10, min_chi2_decrease=1e-7)
+    s = ThroughputScheduler(max_queue=8, toa_grid_min=32)
+    before = telemetry.counters_snapshot()
+    for i in range(2):
+        s.submit(_request(PAR, toas_a, tag=i, **hyper))
+    (p,) = s.plan()
+    assert (p.kind, p.n_members, p.devices, p.toa_devices) == \
+        ("batched", 2, 8, 4)
+    res = s.drain()
+    delta = telemetry.counters_delta(before)
+    mesh = s.last_drain["mesh"]
+    assert mesh["gridded"] == 1
+    assert delta.get("serve.mesh.gridded") == 1
+    # every device holds one member-row shard: slots [1]*8, no idle
+    assert mesh["per_device_slots"] == [1] * 8
+    assert sum(mesh["per_device_members"]) == 8  # 2 members x 4 shards
+    assert s.last_drain["batch_detail"][0]["toa_devices"] == 4
+    m_ref = get_model(PAR)
+    m_ref["F0"].add_delta(2e-10)
+    _d, _i, chi2, conv, _c = device_loop.dense_wls_fit(toas_a, m_ref,
+                                                       **hyper)
+    for r in res:
+        assert r.status == "ok"
+        assert r.chi2 == pytest.approx(float(chi2), rel=1e-9)
+        assert bool(r.converged) == bool(conv)
+
+
+def test_grid_degenerates_on_busy_pool_and_small_tables(toas_a):
+    """The grid only spends SPARE devices: a pass whose member demand
+    fills the pool keeps the pure member-sharded widths, and tables
+    below toa_grid_min (the default 1024 floors out this 64-bucket
+    table) never grid at all."""
+    # small tables, default floor: no grid even with a spare pool
+    s = ThroughputScheduler(max_queue=8)
+    for i in range(2):
+        s.submit(_request(PAR, toas_a, tag=i))
+    (p,) = s.plan()
+    assert (p.devices, p.toa_devices) == (2, 1)
+    # busy pool: 8 members of one structure demand all 8 devices
+    s2 = ThroughputScheduler(max_queue=16, toa_grid_min=32)
+    for i in range(8):
+        s2.submit(_request(PAR, toas_a, tag=i))
+    (p2,) = s2.plan()
+    assert (p2.n_members, p2.devices, p2.toa_devices) == (8, 8, 1)
+    # two 2-member groups with grid headroom split the pool as
+    # (2 members x 2 toa-shards) blocks side by side
+    s3 = ThroughputScheduler(max_queue=16, toa_grid_min=32)
+    for i in range(2):
+        s3.submit(_request(PAR, toas_a, tag=i))
+        s3.submit(_request(PAR_FD, toas_a, tag=10 + i))
+    p3 = s3.plan()
+    assert len(p3) == 2
+    assert all(pl.devices == 4 and pl.toa_devices == 2 for pl in p3)
+    assert {pl.slot for pl in p3} == {0, 4}

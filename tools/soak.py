@@ -206,13 +206,15 @@ def _sim_flagged_toas(model, rng, n: int, flag_rng=None):
 
 
 def one_trial(seed: int, force_chaos: bool = False,
-              force_sessions: bool = False) -> tuple[bool, str, dict]:
+              force_sessions: bool = False,
+              force_fleet: bool = False) -> tuple[bool, str, dict]:
     """Returns (ok, failure_text, axes) — axes records which sampler
     dimensions and optional gates this trial exercised, so the committed
     SOAK JSON makes coverage auditable (round-4 VERDICT task 4).
     ``force_chaos`` (the ``--chaos`` flag) arms the fault-injection gate
     on every trial regardless of its probability draw; ``force_sessions``
-    (``--sessions``) likewise arms the sessionful-append gate (the
+    (``--sessions``) likewise arms the sessionful-append gate, and
+    ``force_fleet`` (``--fleet``) the multi-host routing gate (every
     probability draw is still consumed, so forced and unforced runs of
     a seed exercise identical axis draws)."""
     rng = np.random.default_rng(seed)
@@ -925,6 +927,85 @@ def one_trial(seed: int, force_chaos: bool = False,
             finally:
                 os.environ.pop("PINT_TPU_SESSION_MAX_APPENDS", None)
 
+        # fleet routing gate (ISSUE 12): the trial's model (plus the
+        # structure variant) through a randomized 1/2/4-host loopback
+        # fleet — half the multi-host trials KILL a host mid-stream and
+        # every request must still resolve via failover (re-routed and
+        # re-fit on a survivor, never silently dropped), with sticky
+        # routing keeping each structure on one host in the clean case.
+        # APPENDED gate, own substream.
+        if gates.random() < 0.12 or force_fleet:
+            axes["gates"].append("fleet")
+            from pint_tpu.fleet import build_fleet
+            from pint_tpu.serve import FitRequest
+
+            frng = np.random.default_rng((seed, 11))
+            n_hosts = int(frng.choice([1, 2, 4]))
+            k_req = int(frng.integers(4, 7))
+            kill = bool(n_hosts > 1 and frng.random() < 0.5)
+            par_v = "\n".join(ln for ln in par.splitlines()
+                              if not ln.startswith("F1 ")) + "\n"
+            have_variant = par_v != par and "F2 " not in par
+            specs = []
+            for j in range(k_req):
+                par_j = (par_v if have_variant and j % 2 else par)
+                m_truth = get_model(par_j, allow_tcb=True)
+                t_j = _sim_flagged_toas(m_truth, frng,
+                                        int(frng.integers(50, 110)))
+                specs.append((par_j, t_j))
+
+            def _fleet_model(par_j):
+                m_j = get_model(par_j, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_j.free_params:
+                        m_j[name].add_delta(d)
+                return m_j
+
+            router = build_fleet(n_hosts, max_queue=2 * k_req)
+            handles = []
+            victim = None
+            for j, (par_j, t_j) in enumerate(specs):
+                handles.append(router.submit(
+                    FitRequest(t_j, _fleet_model(par_j), maxiter=30,
+                               min_chi2_decrease=1e-7, tag=j)))
+                if kill and j == k_req // 2:
+                    # kill a host that holds pending work RIGHT NOW,
+                    # mid-stream; later submits must route around the
+                    # corpse and its pending requests must fail over
+                    victim = handles[0].host
+                    router.hosts[victim].kill()
+            fleet_res = router.drain()
+            assert len(fleet_res) == k_req, "fleet dropped requests"
+            assert all(h.done() for h in handles), \
+                "fleet left an unresolved handle"
+            for r in fleet_res:
+                assert r.status in ("ok", "nonconverged"), (
+                    f"fleet request {r.tag} -> {r.status}: {r.error}")
+                assert np.isfinite(r.chi2), \
+                    f"fleet chi2 not finite ({r.tag})"
+            rec_f = router.last_drain
+            if kill:
+                dead = [h for h in rec_f["hosts"]
+                        if h["host"] == victim]
+                assert dead and dead[0]["alive"] is False
+                assert rec_f["failovers"] >= 1, \
+                    "host killed with pending work but zero failovers"
+            elif n_hosts > 1:
+                # clean multi-host run: each structure's requests all
+                # landed on one host (fingerprint-sticky routing)
+                by_struct: dict = {}
+                for j, h in enumerate(handles):
+                    by_struct.setdefault(specs[j][0], set()).add(h.host)
+                assert all(len(s) == 1 for s in by_struct.values()), \
+                    f"structure split across hosts: {by_struct}"
+            axes["fleet"] = {
+                "hosts": n_hosts, "requests": k_req,
+                "killed_host": victim,
+                "failovers": rec_f["failovers"],
+                "routes": rec_f["routes"],
+                "statuses": rec_f["statuses"],
+            }
+
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
         model2 = get_model(par2)
@@ -967,6 +1048,10 @@ def main() -> int:
                     help="force the sessionful-append gate on every "
                          "trial (ISSUE 10; append streams stay seeded "
                          "and reproducible)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="force the multi-host routing gate on every "
+                         "trial (ISSUE 12; host counts and host-kills "
+                         "stay seeded and reproducible)")
     args = ap.parse_args()
 
     import json
@@ -988,6 +1073,7 @@ def main() -> int:
               "telemetry_enabled": telemetry.enabled(),
               "seed_base": args.seed, "trials_requested": args.trials,
               "chaos": args.chaos, "sessions": args.sessions,
+              "fleet": args.fleet,
               "n_pass": 0, "n_fail": 0, "fail_seeds": [], "trials": []}
 
     def save():
@@ -1030,7 +1116,8 @@ def main() -> int:
         t1 = time.time()
         with telemetry.profile_span("soak.trial", seed=seed):
             ok, msg, axes = one_trial(seed, force_chaos=args.chaos,
-                                      force_sessions=args.sessions)
+                                      force_sessions=args.sessions,
+                                      force_fleet=args.fleet)
         wall = time.time() - t1
         deltas = telemetry.counters_delta(counters_before)
         repro_path = ""
