@@ -1761,6 +1761,251 @@ def bench_read_mixed(n: int, reps: int = 3) -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def _bench_fleet_durability(par_a: str, hyper: dict) -> tuple:
+    """FLEET_r02 phases (ISSUE 13), N=2 REAL worker processes.
+
+    **Durable sessions**: one worker holds >= 4 live sessions
+    (same-structure sessions pin to one rendezvous winner) and is
+    SIGKILLed mid-append-stream; every session's pending append must
+    resolve on the survivor AFTER its state was restored (replica
+    adopt or journal replay over the wire), and every final committed
+    solution must match an uninterrupted control pair — parameters
+    within 1e-6 of a posterior sigma, chi2 at the 1e-6 class, exact
+    TOA counts, zero duplicate commits.
+
+    **Partition**: on the control pair, the session-holding worker is
+    SIGSTOPped with an append pending. The drain must complete within
+    the wire deadline + heartbeat budget (the old 600 s stall), the
+    append fails over with a bumped epoch, and after SIGCONT the stale
+    worker's late replies are FENCED with zero divergence of the
+    successor's committed state."""
+    import signal as _signal
+
+    from pint_tpu import telemetry as _t
+    from pint_tpu.fleet import FleetRouter, TcpHost
+    from pint_tpu.fleet.worker import spawn_local_workers
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    truth = get_model(par_a)
+    n_sessions = 6
+    pop_toas = [make_fake_toas_uniform(
+        53000, 56000, 40, truth, obs="@", freq_mhz=1400.0,
+        error_us=2.0, add_noise=True, seed=300 + s)
+        for s in range(n_sessions)]
+    app_toas = [[make_fake_toas_uniform(
+        56010 + 20 * i, 56020 + 20 * i, 4, truth, obs="@",
+        freq_mhz=1400.0, error_us=2.0, add_noise=True,
+        seed=330 + 10 * s + i) for i in range(2)]
+        for s in range(n_sessions)]
+
+    def stream(router, *, fault=None):
+        """populate all sessions, then two append rounds; ``fault(rnd,
+        pins)`` (when given) runs after round ``rnd``'s appends are
+        submitted, before the drain. Returns (pins, walls, statuses)."""
+        walls, statuses = [], []
+        hs = []
+        for s in range(n_sessions):
+            m = get_model(par_a)
+            m["F0"].add_delta(2e-10)
+            hs.append(router.submit(FitRequest(
+                pop_toas[s], m, session_id=f"s{s}", **hyper)))
+        t0 = time.perf_counter()
+        res = router.drain()
+        walls.append(time.perf_counter() - t0)
+        statuses.append([r.status for r in res])
+        pins = {f"s{s}": hs[s].host for s in range(n_sessions)}
+        for rnd in range(2):
+            for s in range(n_sessions):
+                router.submit(FitRequest(
+                    app_toas[s][rnd], None, session_id=f"s{s}",
+                    **hyper))
+            if fault is not None:
+                fault(rnd, pins)
+            t0 = time.perf_counter()
+            res = router.drain()
+            walls.append(time.perf_counter() - t0)
+            statuses.append([r.status for r in res])
+        return pins, walls, statuses
+
+    def summaries(router):
+        out = {}
+        for s in range(n_sessions):
+            skey = router._sid_last[f"s{s}"]
+            hid = router._sticky[skey]
+            summ = router.hosts[hid].session_summary(skey)
+            out[f"s{s}"] = {"host": hid, "chi2": summ["chi2"],
+                            "n_toas": summ["n_toas"],
+                            "params": summ["params"]}
+        return out
+
+    def spawn_pair(prefix):
+        ws = spawn_local_workers(2, prefix=prefix)
+        hosts = {h: TcpHost(h, ("127.0.0.1", port))
+                 for h, port, _p in ws}
+        procs = {h: p for h, _port, p in ws}
+        # warm BOTH workers' fit programs before any timed/deadlined
+        # phase: the durability claims are about failover semantics
+        # and stall bounds, not cold-compile walls — a fresh worker's
+        # first fit compiles for ~10 s, which the short wire deadlines
+        # below must not misread as a partition
+        for hid, t in hosts.items():
+            mw = get_model(par_a)
+            mw["F0"].add_delta(2e-10)
+            t.submit(FitRequest(pop_toas[0], mw, tag=f"warm-{hid}",
+                                deadline_s=240.0, **hyper))
+            t.drain(240.0)
+        return FleetRouter(list(hosts.values())), hosts, procs
+
+    # -- kill trial ----------------------------------------------------
+    krouter, khosts, kprocs = spawn_pair("dk")
+    before = _t.counters_snapshot()
+    killed = {}
+    try:
+        def kill_fault(rnd, pins):
+            if rnd == 1:
+                victim = pins["s0"]
+                killed["victim"] = victim
+                kprocs[victim].send_signal(_signal.SIGKILL)
+                kprocs[victim].wait(timeout=30)
+
+        pins, kwalls, kstatuses = stream(krouter, fault=kill_fault)
+        victim = killed["victim"]
+        held = sum(1 for v in pins.values() if v == victim)
+        ksum = summaries(krouter)
+        kdelta = _t.counters_delta(before)
+    finally:
+        for h in khosts.values():
+            h.shutdown()
+        for p in kprocs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+    # -- control pair (also hosts the partition trial) -----------------
+    crouter, chosts, cprocs = spawn_pair("dc")
+    try:
+        _pins, cwalls, cstatuses = stream(crouter)
+        csum = summaries(crouter)
+        # parity: killed vs control, per session
+        max_sigma = 0.0
+        max_chi2_rel = 0.0
+        toas_ok = True
+        for s in range(n_sessions):
+            pk, pc = ksum[f"s{s}"], csum[f"s{s}"]
+            toas_ok = toas_ok and pk["n_toas"] == pc["n_toas"]
+            max_chi2_rel = max(max_chi2_rel,
+                               abs(pk["chi2"] - pc["chi2"])
+                               / max(abs(pc["chi2"]), 1e-12))
+            for name, (hi, lo, unc) in pc["params"].items():
+                vk = pk["params"][name][0] + pk["params"][name][1]
+                max_sigma = max(max_sigma,
+                                abs(vk - (hi + lo)) / max(unc, 1e-300))
+        restores = (int(kdelta.get("fleet.session.restore.warm", 0))
+                    + int(kdelta.get("fleet.session.restore.cold", 0)))
+        durable = {
+            "sessions": n_sessions,
+            "victim_held_sessions": held,
+            "statuses": kstatuses,
+            "all_resolved_ok": all(
+                st == "ok" for drain in kstatuses for st in drain),
+            "restores": restores,
+            "replayed": int(kdelta.get("fleet.session.replayed", 0)),
+            "replicated": int(kdelta.get(
+                "fleet.session.replicated", 0)),
+            "fenced_rejects": int(kdelta.get(
+                "fleet.session.fenced_rejects", 0)),
+            "parity_max_sigma": float(f"{max_sigma:.3g}"),
+            "parity_max_chi2_rel": float(f"{max_chi2_rel:.3g}"),
+            "toa_counts_match": toas_ok,
+            "drain_walls_s": [round(w, 3) for w in kwalls],
+        }
+        durable["ok"] = bool(
+            held >= 4 and durable["all_resolved_ok"]
+            and restores >= held and toas_ok
+            and max_sigma < 1e-6 and max_chi2_rel < 1e-6)
+
+        # -- partition trial on the control pair -----------------------
+        before_p = _t.counters_snapshot()
+        svictim = csum["s0"]["host"]
+        skey0 = crouter._sid_last["s0"]
+        pre_params = dict(csum["s0"]["params"])
+        extra = make_fake_toas_uniform(
+            56060, 56070, 4, truth, obs="@", freq_mhz=1400.0,
+            error_us=2.0, add_noise=True, seed=390)
+        crouter.submit(FitRequest(extra, None, session_id="s0",
+                                  **hyper))
+        cprocs[svictim].send_signal(_signal.SIGSTOP)
+        t0 = time.perf_counter()
+        pres = crouter.drain()
+        stall_wall = time.perf_counter() - t0
+        blocked = ((crouter.last_drain or {}).get("durability")
+                   or {}).get("blocked_wall_s")
+        new_pin = crouter._sticky[skey0]
+        mid = crouter.hosts[new_pin].session_summary(skey0)
+        cprocs[svictim].send_signal(_signal.SIGCONT)
+        time.sleep(0.2)
+        t0 = time.perf_counter()
+        crouter.drain()          # heartbeat reconciles + fences
+        crouter.heartbeat()      # and the rejoin is visible
+        post = crouter.hosts[new_pin].session_summary(skey0)
+        pdelta = _t.counters_delta(before_p)
+        budget = (float(os.environ["PINT_TPU_FLEET_OP_DEADLINE_S"])
+                  + float(os.environ["PINT_TPU_FLEET_HEARTBEAT_S"]))
+        # the stall component: this drain vs the same pair's previous
+        # (unpartitioned) append drain — the fit work cancels out
+        stall_overhead = stall_wall - cwalls[-1]
+        partition = {
+            "victim": svictim,
+            "append_status": pres[0].status if pres else None,
+            "failed_over_to": new_pin,
+            "moved": new_pin != svictim,
+            "epoch": crouter._epoch.get(skey0),
+            "fenced_rejects": int(pdelta.get(
+                "fleet.session.fenced_rejects", 0)),
+            "rejoined": int(pdelta.get("fleet.host_rejoin", 0)),
+            "victim_alive_after_resume": bool(
+                crouter._health[svictim]["alive"]),
+            "successor_state_unchanged_by_late_commit": bool(
+                mid is not None and post is not None
+                and mid["params"] == post["params"]
+                and mid["chi2"] == post["chi2"]),
+            "stall_drain_wall_s": round(stall_wall, 3),
+            "reference_drain_wall_s": round(cwalls[-1], 3),
+            # total overhead includes PRODUCTIVE failover work on the
+            # live survivor (state restore + cold-compile of the
+            # re-run); the liveness claim bounds only the time spent
+            # BLOCKED on the unresponsive host, measured exactly by
+            # the router
+            "stall_overhead_s": round(stall_overhead, 3),
+            "blocked_on_victim_s": blocked,
+            "deadline_plus_heartbeat_s": budget,
+            "old_flat_timeout_s": 600.0,
+        }
+        partition["ok"] = bool(
+            pres and pres[0].status == "ok" and partition["moved"]
+            and partition["fenced_rejects"] >= 1
+            and partition["victim_alive_after_resume"]
+            and partition["successor_state_unchanged_by_late_commit"]
+            and blocked is not None and blocked <= budget + 2.0)
+    finally:
+        for h in chosts.values():
+            h.shutdown()
+        for p in cprocs.values():
+            try:
+                p.send_signal(_signal.SIGCONT)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+    return durable, partition
+
+
 def _bench_fleet_ab() -> dict:
     """The ISSUE-12 acceptance A/B: an N=2 REAL-PROCESS fleet over the
     TCP/JSONL transport on this host (the SCALE_r06/MULTICHIP_r06
@@ -2005,6 +2250,23 @@ def _bench_fleet_ab() -> dict:
                 p.wait(timeout=10)
             except Exception:  # noqa: BLE001
                 pass
+    # -- phase 5 + 6 (ISSUE 13 / FLEET_r02): durable sessions ----------
+    # SIGKILLed mid-append-stream + a SIGSTOP partition with fencing,
+    # on independent real-process workers, short wire deadlines armed
+    old_env = {k: os.environ.get(k) for k in
+               ("PINT_TPU_FLEET_OP_DEADLINE_S",
+                "PINT_TPU_FLEET_HEARTBEAT_S")}
+    os.environ["PINT_TPU_FLEET_OP_DEADLINE_S"] = "20"
+    os.environ["PINT_TPU_FLEET_HEARTBEAT_S"] = "3"
+    try:
+        rec["durable_sessions"], rec["partition"] = \
+            _bench_fleet_durability(par_a, hyper)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     rec["ok"] = bool(
         rec["sticky"]["sticky_across_rounds"]
         and rec["sticky"]["zero_cross_host_recompiles"]
@@ -2013,7 +2275,9 @@ def _bench_fleet_ab() -> dict:
         and rec["host_kill"]["all_ok_after_failover"]
         and rec["host_kill"]["victim_marked_dead"]
         and rec["poisoned_host"]["poisoned_all_structured_failures"]
-        and rec["poisoned_host"]["healthy_unaffected"])
+        and rec["poisoned_host"]["healthy_unaffected"]
+        and rec["durable_sessions"]["ok"]
+        and rec["partition"]["ok"])
     rec["honest_wall_note"] = (
         "2 worker processes share this host's cores (os.cpu_count()="
         f"{os.cpu_count()}): walls prove transport overhead and "
@@ -2027,8 +2291,9 @@ def bench_fleet() -> None:
     ISSUE 12). ``value`` is the round-2 (all-warm) routed wall;
     ``vs_baseline`` 1.0 on a fully-passing A/B, 0.0 otherwise. The
     full record is written to PINT_TPU_FLEET_DETAIL (default
-    ``FLEET_r01.json`` next to this script — the committed fleet
-    artifact); stdout carries the compact line."""
+    ``FLEET_r02.json`` next to this script — the committed fleet
+    artifact; r01 predates the ISSUE-13 durability phases); stdout
+    carries the compact line."""
     from pint_tpu import telemetry
 
     metric = "fleet_ab_2proc_wall"
@@ -2045,7 +2310,7 @@ def bench_fleet() -> None:
         detail_path = os.environ.get(
             "PINT_TPU_FLEET_DETAIL",
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "FLEET_r01.json"))
+                         "FLEET_r02.json"))
         try:
             with open(detail_path, "w") as fh:
                 json.dump(out, fh, indent=1)
@@ -2067,6 +2332,11 @@ def bench_fleet() -> None:
             "poisoned_isolated":
                 rec["poisoned_host"]["healthy_unaffected"],
             "jax_distributed": rec.get("jax_distributed"),
+            "durable_sessions_ok": rec["durable_sessions"]["ok"],
+            "durable_parity_max_sigma":
+                rec["durable_sessions"]["parity_max_sigma"],
+            "partition_ok": rec["partition"]["ok"],
+            "partition_fenced": rec["partition"]["fenced_rejects"],
         }
         compact["detail"] = os.path.basename(detail_path)
         _emit(compact)
@@ -2956,6 +3226,74 @@ def _smoke_fleet() -> dict:
             bad += 1
     rec = router.last_drain or {}
     per_struct_hosts = [len(set(hosts2[:4])), len(set(hosts2[4:]))]
+
+    # kill-and-recover gate (ISSUE 13): populate a session, append,
+    # KILL the pinned host mid-append-stream — the re-pin must adopt
+    # the replayed/replicated state and the final solution must match
+    # an unkilled control stream, with zero duplicate commits
+    def session_stream(kill: bool):
+        from pint_tpu import telemetry as _t
+
+        truth = get_model(par_a)
+        s_toas = make_fake_toas_uniform(
+            53000, 56000, 40, truth, obs="@", freq_mhz=1400.0,
+            error_us=2.0, add_noise=True, seed=164)
+        apps = [make_fake_toas_uniform(
+            56010 + 20 * i, 56020 + 20 * i, 4, truth, obs="@",
+            freq_mhz=1400.0, error_us=2.0, add_noise=True,
+            seed=165 + i) for i in range(2)]
+        r = build_fleet(2, max_queue=16, host_ids=["d0", "d1"])
+        m = get_model(par_a)
+        m["F0"].add_delta(2e-10)
+        h0 = r.submit(FitRequest(s_toas, m, session_id="dur",
+                                 **hyper))
+        assert r.drain()[0].status == "ok"
+        before = _t.counters_snapshot()
+        for i, a in enumerate(apps):
+            r.submit(FitRequest(a, None, session_id="dur", **hyper))
+            if kill and i == 1:
+                r.hosts[h0.host].kill()
+            res = r.drain()
+            assert res[0].status == "ok", res[0].error
+        delta = _t.counters_delta(before)
+        skey = r._sid_last["dur"]
+        e = r.hosts[r._sticky[skey]].scheduler.sessions.entries[skey]
+        lg = r._journal.log(skey)
+        commits = lg.base_appends + len(lg.appends)
+        return ({k: e.model[k].hi + e.model[k].lo
+                 for k in e.model.free_params},
+                {k: e.model[k].uncertainty
+                 for k in e.model.free_params},
+                e.chi2, e.n_toas, commits, delta)
+
+    pk, sig, chi2k, nk, commits_k, delta_k = session_stream(True)
+    ck, _csig, chi2c, nc, commits_c, _dc = session_stream(False)
+    dur_bad = 0
+    dur_max_sigma = 0.0
+    for k in ck:
+        rel_sigma = abs(pk[k] - ck[k]) / max(sig[k], 1e-300)
+        dur_max_sigma = max(dur_max_sigma, rel_sigma)
+        if rel_sigma > 1e-6:
+            dur_bad += 1
+    restores = (int(delta_k.get("fleet.session.restore.warm", 0))
+                + int(delta_k.get("fleet.session.restore.cold", 0)))
+    durability = {
+        "restored": restores >= 1,
+        "replayed": int(delta_k.get("fleet.session.replayed", 0)),
+        "replicated": int(delta_k.get("fleet.session.replicated", 0)),
+        "fenced_rejects": int(delta_k.get(
+            "fleet.session.fenced_rejects", 0)),
+        "parity_max_sigma": float(f"{dur_max_sigma:.3g}"),
+        "chi2_rel_vs_control": float(
+            f"{abs(chi2k - chi2c) / max(abs(chi2c), 1e-12):.3g}"),
+        "toas_match": nk == nc,
+        "zero_duplicate_commits": commits_k == commits_c == 2,
+    }
+    dur_ok = (durability["restored"] and dur_bad == 0
+              and durability["toas_match"]
+              and durability["zero_duplicate_commits"]
+              and durability["chi2_rel_vs_control"] < 1e-6)
+
     ok = (all(r.status == "ok" for r in res1)
           and hosts2 == hosts1            # sticky across drains
           and per_struct_hosts == [1, 1]  # one host per structure
@@ -2963,13 +3301,15 @@ def _smoke_fleet() -> dict:
           and bad == 0
           and rec.get("type") == "fleet"
           and len(rec.get("hosts", [])) == 2
-          and rec.get("sticky_hit_rate") is not None)
+          and rec.get("sticky_hit_rate") is not None
+          and dur_ok)
     return {"ok": ok, "hosts_round1": hosts1, "hosts_round2": hosts2,
             "program_misses_after_warmup": misses,
             "parity_ok": bad == 0,
             "parity_max_chi2_rel": float(f"{max_rel:.3g}"),
             "routes": rec.get("routes"),
-            "sticky_hit_rate": rec.get("sticky_hit_rate")}
+            "sticky_hit_rate": rec.get("sticky_hit_rate"),
+            "durability": durability, "durability_ok": dur_ok}
 
 
 def _run_smoke() -> None:

@@ -19,11 +19,12 @@ from __future__ import annotations
 
 import os
 
+from pint_tpu.fleet.durability import SessionJournal  # noqa: F401
 from pint_tpu.fleet.router import (  # noqa: F401
     FleetHandle, FleetPredictHandle, FleetRouter, fleet_enabled,
     rendezvous_rank)
 from pint_tpu.fleet.transport import (  # noqa: F401
-    HostDown, LoopbackHost, TcpHost, serve_worker)
+    HostDown, HostSuspect, LoopbackHost, TcpHost, serve_worker)
 
 
 def build_fleet(n_hosts: int | None = None, *,
@@ -53,6 +54,6 @@ def build_fleet(n_hosts: int | None = None, *,
 
 __all__ = [
     "FleetHandle", "FleetPredictHandle", "FleetRouter", "HostDown",
-    "LoopbackHost", "TcpHost", "build_fleet", "fleet_enabled",
-    "rendezvous_rank", "serve_worker",
+    "HostSuspect", "LoopbackHost", "SessionJournal", "TcpHost",
+    "build_fleet", "fleet_enabled", "rendezvous_rank", "serve_worker",
 ]

@@ -70,7 +70,8 @@ import time
 from typing import Any
 
 from pint_tpu import telemetry
-from pint_tpu.fleet.transport import HostDown
+from pint_tpu.fleet import durability as _dur
+from pint_tpu.fleet.transport import HostDown, HostSuspect
 from pint_tpu.serve import fingerprint as _fp
 from pint_tpu.serve.scheduler import (FitResult, PredictRequest,
                                       PredictResult, ServeQueueFull)
@@ -137,17 +138,24 @@ class FleetPredictHandle:
 
 
 class _Pending:
-    """One routed, not-yet-resolved request on a host."""
+    """One routed, not-yet-resolved request on a host. Sessionful
+    requests also carry their session key and the pin EPOCH they were
+    submitted under (ISSUE 13): a commit arriving after the session
+    re-pinned — the submit epoch no longer current — is fenced."""
 
-    __slots__ = ("seq", "token", "request", "handle", "route", "read")
+    __slots__ = ("seq", "token", "request", "handle", "route", "read",
+                 "skey", "epoch")
 
-    def __init__(self, seq, token, request, handle, route, read=False):
+    def __init__(self, seq, token, request, handle, route, read=False,
+                 skey=None, epoch=0):
         self.seq = seq
         self.token = token
         self.request = request
         self.handle = handle
         self.route = route
         self.read = read
+        self.skey = skey
+        self.epoch = epoch
 
 
 class FleetRouter:
@@ -163,7 +171,8 @@ class FleetRouter:
     """
 
     def __init__(self, hosts, *, steal_depth: int = 8,
-                 degrade_after: int = 2, degenerate: bool = False):
+                 degrade_after: int = 2, dead_after: int = 3,
+                 degenerate: bool = False):
         hosts = list(hosts)
         if not hosts:
             raise ValueError("FleetRouter needs at least one host")
@@ -174,12 +183,16 @@ class FleetRouter:
         self._order = ids
         self.steal_depth = max(1, int(steal_depth))
         self.degrade_after = max(1, int(degrade_after))
+        # the suspicion ladder's top rung (ISSUE 13): this many
+        # CONSECUTIVE transport deadline misses presume the host dead
+        # (one miss only suspects it — reads re-route, fencing arms)
+        self.dead_after = max(1, int(dead_after))
         self.degenerate = bool(degenerate or len(hosts) == 1
                                or not fleet_enabled())
         self._health: dict[str, dict] = {
             hid: {"alive": True, "fail_streak": 0, "queue_depth": 0,
                   "read_depth": 0, "degraded": False, "latency_s": None,
-                  "program_misses": 0}
+                  "program_misses": 0, "misses": 0}
             for hid in ids}
         self._warm: dict[str, set] = {hid: set() for hid in ids}
         self._sticky: dict[tuple, str] = {}   # (sid, fp8) -> host id
@@ -191,6 +204,31 @@ class FleetRouter:
         self._failovers = 0
         self._warm_hits = 0   # requests landing on an already-warm host
         self._warm_total = 0  # ... out of all warm-trackable fits
+        # durable sessions (ISSUE 13): the append journal, per-session
+        # pin epochs, and per-host fence maps of tokens whose work was
+        # re-routed away while the host might still reply
+        self._journal = _dur.SessionJournal()
+        self._epoch: dict[tuple, int] = {}
+        self._fence: dict[str, dict] = {}
+        # (host, session_id) pairs whose sessionful SUBMIT timed out
+        # after the host may have accepted it: the host may hold an
+        # orphaned (never-acknowledged) session entry that a later
+        # shed/re-route back to it must drop before submitting — an
+        # append resolving against the orphan would commit diverged
+        # state (at-least-once submits, exactly-once session effect)
+        self._maybe_orphaned: set[tuple] = set()
+        self._committed: set = set()   # skeys committed this drain
+        self._replicated = 0           # per-drain durability counters
+        self._replayed = 0
+        self._fenced_rejects = 0
+        self._duplicates = 0
+        self._restores: dict[str, int] = {}
+        #: wall seconds this drain spent BLOCKED on unresponsive hosts
+        #: (deadline misses + dead sockets) — the quantity the ISSUE-13
+        #: liveness work bounds at one op deadline + one heartbeat per
+        #: hung host, vs the old flat 600 s; productive failover work
+        #: (restores, re-fits on live hosts) is not blocked time
+        self._blocked_s = 0.0
         self.last_drain: dict | None = None
 
     # ------------------------------------------------------------------
@@ -214,6 +252,25 @@ class FleetRouter:
     def _depth(self, hid: str) -> int:
         return self._health[hid]["queue_depth"] + self._inflight[hid]
 
+    @staticmethod
+    def _drain_deadline(pend) -> float:
+        """The wire deadline for draining these pendings: the largest
+        per-request SLA carried by any of them, floored at the fleet
+        op default — per-request deadlines propagated over the wire
+        (ISSUE 13), replacing the old flat 600 s socket timeout.
+
+        A drain is an AGGREGATE op (the host executes its whole
+        queue), so the allowance scales with the pending count — an
+        eighth of the base per extra request — or a deep-queued but
+        healthy host would be falsely suspected and its entire batch
+        re-run elsewhere. Operators size ``PINT_TPU_FLEET_OP_
+        DEADLINE_S`` to their per-drain SLA; the TcpHost ``timeout_s``
+        ceiling (600 s) still caps everything."""
+        base = _dur.op_deadline_s()
+        dls = [p.request.deadline_s for p in pend
+               if getattr(p.request, "deadline_s", None)]
+        return max([base] + dls) + base * max(0, len(pend) - 1) / 8.0
+
     def add_host(self, transport) -> None:
         """Host JOIN: register a new transport. Rendezvous ranking is a
         pure function of (key, host set), so only keys whose top score
@@ -228,7 +285,7 @@ class FleetRouter:
         self._health[hid] = {"alive": True, "fail_streak": 0,
                              "queue_depth": 0, "read_depth": 0,
                              "degraded": False, "latency_s": None,
-                             "program_misses": 0}
+                             "program_misses": 0, "misses": 0}
         self._warm[hid] = set()
         self._inflight[hid] = 0
         self._pending[hid] = []
@@ -266,6 +323,121 @@ class FleetRouter:
         h["alive"] = False
         h["fail_streak"] += 1
 
+    def _note_timeout(self, hid: str) -> None:
+        """One transport deadline miss: climb the suspicion ladder
+        (ISSUE 13). First miss -> suspect (fail streak feeds the
+        existing read-failover-first rule); ``dead_after`` consecutive
+        misses -> presumed dead (full failover). A later successful
+        heartbeat resets the ladder — and fences any late replies the
+        host accumulated while partitioned."""
+        h = self._health[hid]
+        h["misses"] += 1
+        h["fail_streak"] += 1
+        telemetry.inc("fleet.heartbeat.miss")
+        if h["misses"] >= self.dead_after and h["alive"]:
+            self._note_down(hid)
+
+    def heartbeat(self) -> dict:
+        """One liveness pass over every host: a cheap ``ping`` under
+        the heartbeat deadline (``PINT_TPU_FLEET_HEARTBEAT_S``) drives
+        the suspicion ladder WITHOUT waiting on a full drain deadline.
+        A host that answers after being suspected/presumed dead first
+        has its late replies collected and FENCED
+        (:meth:`_reconcile`), then rejoins the ring for fresh work —
+        its sessions stay wherever failover re-pinned them (the stale
+        epoch keeps its old commits harmless). Runs at the top of
+        every :meth:`drain`; callable standalone as the operator's
+        liveness probe. Returns {host: status token}."""
+        if self.degenerate:
+            return {}
+        out: dict[str, str] = {}
+        dl = _dur.heartbeat_deadline_s()
+        for hid in list(self._order):
+            h = self._health[hid]
+            t0 = time.perf_counter()
+            try:
+                self.hosts[hid].ping(dl)
+            except HostSuspect:
+                self._blocked_s += time.perf_counter() - t0
+                self._note_timeout(hid)
+                out[hid] = "suspect" if h["alive"] else "dead"
+                continue
+            except (HostDown, OSError):
+                self._blocked_s += time.perf_counter() - t0
+                self._note_down(hid)
+                out[hid] = "dead"
+                continue
+            was_dead = not h["alive"]
+            h["misses"] = 0
+            if was_dead or self._fence.get(hid):
+                # the host is responsive again but may hold replies to
+                # work this router already re-routed: drain + fence
+                # them BEFORE it serves anything new
+                self._reconcile(hid)
+            if was_dead:
+                h["alive"] = True
+                h["fail_streak"] = 0
+                telemetry.inc("fleet.host_rejoin")
+                out[hid] = "rejoined"
+            else:
+                out[hid] = "ok"
+        telemetry.set_gauge("fleet.hosts_alive", len(self.alive_hosts()))
+        telemetry.set_gauge(
+            "fleet.hosts_suspect",
+            sum(1 for hid in self._order
+                if self._health[hid]["alive"] and self._suspect(hid)))
+        return out
+
+    def _reconcile(self, hid: str) -> None:
+        """Collect a recovered host's LATE replies and fence them.
+
+        Every token here answers a request the router failed over
+        while the host was unresponsive — the duplicate execution of
+        the at-least-once retry. The fence map carries the (session
+        key, submit epoch) of each; all are rejected (counted,
+        recorded with the stale epoch) and none touches the journal or
+        a caller's handle. Skipped while the host still holds live
+        pendings (a regular drain owns those)."""
+        if self._pending[hid]:
+            return
+        dl = _dur.heartbeat_deadline_s()
+        try:
+            wires = list(self.hosts[hid].drain(dl))
+            wires += list(self.hosts[hid].drain_reads(dl))
+        except (HostDown, HostSuspect, OSError):
+            return
+        fence = self._fence.get(hid) or {}
+        for w in wires:
+            tok = w.get("token") if isinstance(w, dict) else None
+            info = fence.pop(tok, None) if tok is not None else None
+            if info is not None:
+                self._fence_reject(hid, tok, info)
+            elif tok is not None:
+                telemetry.inc("fleet.transport.stale_replies")
+
+    def _fence_reject(self, hid: str, token, info: tuple) -> None:
+        """Reject one stale-epoch commit/reply (never applied to the
+        caller's model, the journal, or replication)."""
+        skey, epoch = info
+        self._fenced_rejects += 1
+        telemetry.inc("fleet.session.fenced_rejects")
+        telemetry.add_record({
+            "type": "fleet_fence", "host": hid, "token": token,
+            "session": repr(skey[0]) if skey else None,
+            "stale_epoch": epoch,
+            "epoch": self._epoch.get(skey, 0) if skey else None})
+
+    def _fence_arm(self, hid: str, p: _Pending) -> None:
+        """The router is about to re-run ``p`` elsewhere while ``hid``
+        may still reply: remember the token so the late duplicate is
+        recognized and rejected (FIFO-bounded — an overflowing entry
+        degrades to the stale-reply counter, never a double-commit:
+        unmatched tokens are always dropped)."""
+        fm = self._fence.setdefault(hid, {})
+        while len(fm) >= 256:
+            fm.pop(next(iter(fm)))
+        fm[p.token] = (p.skey, p.epoch)
+
     # ------------------------------------------------------------------
     # routing
     # ------------------------------------------------------------------
@@ -277,6 +449,21 @@ class FleetRouter:
         ranked = rendezvous_rank(key, self.alive_hosts())
         return ([h for h in ranked if not self._degraded(h)]
                 + [h for h in ranked if self._degraded(h)])
+
+    def _ring_successor(self, skey: tuple,
+                        exclude: str | None) -> str | None:
+        """THE session ring successor: the first host in the session
+        key's own ring order that is not ``exclude``, is alive, and
+        has not missed a deadline this cycle (restoring onto or
+        stashing at a suspect host would trade the stall we just
+        avoided for a new one). One definition shared by replication,
+        failover restore and re-pinning — the three must never
+        disagree about who the successor is."""
+        for h in self._fit_candidates(skey[1] or repr(skey[0])):
+            if h != exclude and self._health[h]["alive"] \
+                    and not self._health[h]["misses"]:
+                return h
+        return None
 
     def _route_fit(self, request) -> tuple[str, str, str | None]:
         """(host id, route token, fp8) for one fit request — fp8 is
@@ -302,14 +489,21 @@ class FleetRouter:
                 return hid, "sticky", skey[1]
             if hid is not None:
                 # sticky host dead/degraded: fail over to the ring
-                # successor; the session re-pins there (its device
-                # state is gone — the new host repopulates from the
-                # request, or resolves a structured error when it
-                # cannot)
-                cands = [h for h in self._fit_candidates(skey[1] or
-                                                         repr(sid))
-                         if h != hid] or [hid]
-                new = cands[0]
+                # successor. ISSUE 13: the re-pin ADOPTS the session's
+                # replicated/journaled state on the successor BEFORE
+                # this request dispatches — warm from the replica when
+                # the successor holds one, else a journal replay — so
+                # the retry appends to the dead host's solution, not
+                # to reconstructed-from-nothing state. The epoch bumps
+                # either way: any late commit from the old pin is now
+                # fenced.
+                new = self._ring_successor(skey, hid)
+                if new is None:
+                    new = next(
+                        (h for h in self._fit_candidates(
+                            skey[1] or repr(sid)) if h != hid), hid)
+                if new != hid and not self.degenerate:
+                    self._restore_session(skey, new)
                 self._sticky[skey] = new
                 return new, "failover", skey[1]
             hid, token = self._route_structure(fp8)
@@ -377,6 +571,87 @@ class FleetRouter:
         return ranked[0], "failover"
 
     # ------------------------------------------------------------------
+    # durable-session restore (ISSUE 13)
+    # ------------------------------------------------------------------
+    def _restore_session(self, skey: tuple, target_hid: str) -> str:
+        """Rebuild a re-pinned session's committed state on
+        ``target_hid`` before any retry dispatches.
+
+        Bumps the pin epoch FIRST (fencing arms even when the rebuild
+        fails), then restores: **warm** when the target holds the
+        session's replica (one ``adopt`` op installs the committed
+        solution + device snapshot; only the journal's post-replication
+        suffix replays), **cold** otherwise (replay the journal's base
+        populate then every retained append — the exact stream the
+        dead host served, so the rebuilt solution matches it at the
+        1e-9 class). Replays run through the host-side ``replay`` op:
+        atomic on the host, invisible to the router's own pending
+        bookkeeping. Returns the restore-kind token (``warm`` /
+        ``cold`` / ``miss`` / ``failed``); on anything but
+        warm/cold the caller proceeds exactly as pre-ISSUE-13 (the
+        retry repopulates from its own payload or resolves a
+        structured error)."""
+        self._epoch[skey] = self._epoch.get(skey, 0) + 1
+        host = self.hosts[target_hid]
+        # restore ops run FITS (and may compile the structure cold on
+        # the successor): the generous slow-path deadline, never the
+        # cheap per-op default
+        restore_dl = max(_dur.op_deadline_s(), 300.0)
+        # the target must start CLEAN: any entry it already holds for
+        # this session is the orphan of an unacknowledged (fenced)
+        # commit — an at-least-once duplicate populate resolving as an
+        # "append" against it would MERGE the same table twice
+        try:
+            host.drop_session(skey[0], deadline_s=restore_dl)
+            self._maybe_orphaned.discard((target_hid, skey[0]))
+        except Exception:  # noqa: BLE001 — a failed drop degrades to
+            pass           # the restore-failed path below (or "miss")
+        lg = self._journal.log(skey)
+        if lg is None or lg.base_toas is None:
+            telemetry.inc("fleet.session.restore_miss")
+            return "miss"
+        kind = "cold"
+        try:
+            if lg.replica_host == target_hid:
+                ad = host.adopt_session(skey, lg.base_toas,
+                                        deadline_s=restore_dl)
+                if ad.get("adopted"):
+                    kind = "warm"
+            if kind == "cold":
+                populate, appends = _dur.replay_requests(
+                    lg, suffix_only=False)
+                w0 = host.replay([populate],
+                                 deadline_s=restore_dl)[0]
+                if w0["status"] not in ("ok", "nonconverged"):
+                    raise RuntimeError(
+                        f"journal populate replay -> {w0['status']}")
+            else:
+                _populate, appends = _dur.replay_requests(
+                    lg, suffix_only=True)
+            if appends:
+                wires = host.replay(appends, deadline_s=restore_dl)
+                bad = [w for w in wires
+                       if w["status"] not in ("ok", "nonconverged")]
+                if bad:
+                    raise RuntimeError(
+                        f"journal append replay -> {bad[0]['status']}")
+                self._replayed += len(appends)
+                telemetry.inc("fleet.session.replayed", len(appends))
+        except Exception as e:  # noqa: BLE001 — restore is best-effort:
+            # the retry still runs (PR-12 behavior) and the journal
+            # keeps the history for the next attempt
+            telemetry.inc("fleet.session.restore_failed")
+            telemetry.add_record({
+                "type": "fault", "status": "session_restore_failed",
+                "host": target_hid, "session": repr(skey[0]),
+                "error": f"{type(e).__name__}: {e}"})
+            return "failed"
+        self._sticky[skey] = target_hid
+        self._restores[kind] = self._restores.get(kind, 0) + 1
+        telemetry.inc(f"fleet.session.restore.{kind}")
+        return kind
+
+    # ------------------------------------------------------------------
     # intake
     # ------------------------------------------------------------------
     def submit(self, request):
@@ -404,13 +679,37 @@ class FleetRouter:
                 cands = [hid] + [h for h in
                                  self._fit_candidates(fp8 or "?")
                                  if h != hid]
+        sid = (getattr(request, "session_id", None)
+               if not read else None)
         last_exc: BaseException | None = None
         for i, h in enumerate(cands):
             if i > 0:
-                token = "failover" if isinstance(last_exc, HostDown) \
-                    else "shed"
+                token = ("failover" if isinstance(
+                    last_exc, (HostDown, HostSuspect)) else "shed")
+            if sid is not None and (h, sid) in self._maybe_orphaned:
+                # this host may hold an orphan of an earlier timed-out
+                # submit for this session: clear it before handing the
+                # session back (see _maybe_orphaned)
+                try:
+                    self.hosts[h].drop_session(sid)
+                    self._maybe_orphaned.discard((h, sid))
+                except Exception:  # noqa: BLE001 — the submit below
+                    pass           # will surface real transport state
             try:
                 tok = self.hosts[h].submit(request)
+            except HostSuspect as e:
+                # missed deadline, not a dead socket: climb the
+                # suspicion ladder and try the next candidate — the
+                # hung host keeps its state and may rejoin. The host
+                # MAY have accepted the sessionful work before the
+                # deadline: remember the possible orphan (bounded)
+                if sid is not None:
+                    if len(self._maybe_orphaned) >= 256:
+                        self._maybe_orphaned.pop()
+                    self._maybe_orphaned.add((h, sid))
+                self._note_timeout(h)
+                last_exc = e
+                continue
             except HostDown as e:
                 self._note_down(h)
                 last_exc = e
@@ -428,9 +727,13 @@ class FleetRouter:
 
     def _track(self, hid, tok, request, token, read, fp8=None):
         self._seq += 1
+        skey = None
         if read:
             handle = FleetPredictHandle(hid)
             telemetry.inc("fleet.read.requests")
+            sid = getattr(request, "session_id", None)
+            if sid is not None and not self.degenerate:
+                skey = self._sid_last.get(sid)
         else:
             handle = FleetHandle(hid, token)
             telemetry.inc("fleet.requests")
@@ -455,7 +758,10 @@ class FleetRouter:
         self._route_counts[token] = self._route_counts.get(token, 0) + 1
         self._inflight[hid] += 1
         self._pending[hid].append(
-            _Pending(self._seq, tok, request, handle, token, read))
+            _Pending(self._seq, tok, request, handle, token, read,
+                     skey=skey,
+                     epoch=(self._epoch.get(skey, 0)
+                            if skey is not None else 0)))
         return handle
 
     def pending(self) -> int:
@@ -480,8 +786,11 @@ class FleetRouter:
         telemetry.inc("fleet.read.requests")
         try:
             wire = self.hosts[hid].predict(request)
-        except HostDown:
-            self._note_down(hid)
+        except (HostDown, HostSuspect) as e:
+            if isinstance(e, HostSuspect):
+                self._note_timeout(hid)
+            else:
+                self._note_down(hid)
             if self.degenerate:
                 raise
             alive = self.alive_hosts()
@@ -489,8 +798,8 @@ class FleetRouter:
                     and request.model is None:
                 return PredictResult(
                     tag=request.tag, request=request, status="failed",
-                    error=f"host {hid} down and the read cannot be "
-                          "served elsewhere", host=hid)
+                    error=f"host {hid} unresponsive and the read "
+                          "cannot be served elsewhere", host=hid)
             telemetry.inc("fleet.read.route.failover")
             hid = self._route_read(request)[0]
             wire = self.hosts[hid].predict(request)
@@ -542,9 +851,16 @@ class FleetRouter:
             pend = [p for p in self._pending[hid] if p.read]
             if not pend:
                 continue
+            t_host = time.perf_counter()
             try:
-                wires = self.hosts[hid].drain_reads()
+                wires = self.hosts[hid].drain_reads(
+                    self._drain_deadline(pend))
+            except HostSuspect:
+                self._blocked_s += time.perf_counter() - t_host
+                self._note_timeout(hid)
+                wires = []
             except HostDown:
+                self._blocked_s += time.perf_counter() - t_host
                 self._note_down(hid)
                 wires = []
             matched, left = self._match(hid, pend, wires, reads=True)
@@ -559,9 +875,37 @@ class FleetRouter:
         list. Returns ``(matched, leftovers)`` — leftovers are pending
         entries the host died holding; the CALLER fails them over
         AFTER its sweep (a failover drains the target host, which
-        mid-sweep would discard that host's own undrained results)."""
-        by_tok = {w["token"]: w for w in wires
-                  if isinstance(w, dict) and "token" in w}
+        mid-sweep would discard that host's own undrained results).
+
+        Durability rules (ISSUE 13) enforced here: duplicate wire
+        deliveries dedup by token (counted, never double-committed);
+        replies answering already-failed-over tokens fence (or count
+        as stale); a sessionful result whose submit EPOCH is no longer
+        the session's current pin epoch is rejected — its request
+        re-runs on the current pin instead — and a committed
+        sessionful result is appended to the journal."""
+        by_tok: dict = {}
+        dups = 0
+        for w in wires:
+            if not (isinstance(w, dict) and "token" in w):
+                continue
+            if w["token"] in by_tok:
+                dups += 1  # at-least-once delivery: keep the first
+            else:
+                by_tok[w["token"]] = w
+        if dups:
+            self._duplicates += dups
+            telemetry.inc("fleet.transport.duplicates", dups)
+        known = {p.token for p in pend}
+        fence = self._fence.get(hid)
+        for tok in list(by_tok):
+            if tok in known:
+                continue
+            info = fence.pop(tok, None) if fence else None
+            if info is not None:
+                self._fence_reject(hid, tok, info)
+            else:
+                telemetry.inc("fleet.transport.stale_replies")
         out = []
         leftovers = []
         for p in pend:
@@ -571,24 +915,115 @@ class FleetRouter:
             if w is None:
                 leftovers.append(p)
                 continue
+            if (p.skey is not None
+                    and self._epoch.get(p.skey, 0) != p.epoch):
+                # the session re-pinned while this host held the
+                # request (partition failover mid-drain): the stale
+                # pin's commit must not become the record — reject it
+                # and re-run on the current pin
+                self._fence_reject(hid, p.token, (p.skey, p.epoch))
+                leftovers.append(p)
+                continue
             res = (self._unwire_read(w, p.request) if reads
                    else self._unwire_fit(w, p))
+            if not reads:
+                self._journal_commit(p, res)
             p.handle._result = res
             out.append((p.seq, res))
         return out, leftovers
 
+    def _journal_commit(self, p: _Pending, res: FitResult) -> None:
+        """Record one resolved sessionful fit in the append journal
+        (committed results only — failures/rejections never journal)
+        and mark the session for post-drain replication."""
+        if self.degenerate or p.skey is None or not res.fitted:
+            return
+        route = res.session
+        req = p.request
+        if route == "populate":
+            self._journal.record_populate(
+                p.skey, req.session_id, req.model, req.toas, res.chi2)
+        elif route in ("incremental", "full_refit"):
+            ok = self._journal.record_append(
+                p.skey, req.toas,
+                {"maxiter": req.maxiter,
+                 "min_chi2_decrease": req.min_chi2_decrease,
+                 "max_step_halvings": req.max_step_halvings},
+                res.chi2)
+            if not ok:
+                telemetry.inc("fleet.journal.orphan_appends")
+        else:
+            return
+        self._committed.add(p.skey)
+
+    def _replicate_committed(self) -> None:
+        """Ship each just-committed session's summary to its ring
+        successor (the ``stash`` op), then snapshot-truncate the
+        journal: the replica now restores the whole prefix, so replay
+        need only cover appends recorded after this point.
+        Best-effort — a failed stash leaves the journal covering
+        everything, losing nothing but the warm path."""
+        committed, self._committed = self._committed, set()
+        if self.degenerate or not committed:
+            return
+        for skey in committed:
+            hid = self._sticky.get(skey)
+            if hid is None or not self._health[hid]["alive"]:
+                continue
+            # suspect hosts are excluded: stashing at a hung successor
+            # would block this drain an extra op deadline — exactly
+            # the stall the liveness work bounds
+            succ = self._ring_successor(skey, hid)
+            if succ is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                summary = self.hosts[hid].session_summary(skey)
+                if summary is None:
+                    continue
+                blob = _dur.build_replica(
+                    summary, epoch=self._epoch.get(skey, 0))
+                self.hosts[succ].stash_replica(skey, blob)
+            except HostSuspect as e:
+                # accounted and laddered: a timeout here is real
+                # blocked wall, never silently swallowed
+                self._blocked_s += time.perf_counter() - t0
+                self._note_timeout(getattr(e, "host_id", None) or succ)
+                continue
+            except (HostDown, OSError, RuntimeError):
+                continue
+            self._journal.note_replica(skey, succ,
+                                       summary["model_blob"])
+            self._replicated += 1
+            telemetry.inc("fleet.session.replicated")
+
     def _failover_pending(self, hid: str, p: _Pending):
-        """A host died holding ``p``: re-route + re-run it on a
-        surviving host (synchronously — failover is the slow path),
-        or resolve a structured failure. Nothing is silently dropped."""
+        """A host died (or went unresponsive) holding ``p``: re-route
+        + re-run it on a surviving host (synchronously — failover is
+        the slow path), or resolve a structured failure. Nothing is
+        silently dropped.
+
+        Sessionful requests get the full ISSUE-13 treatment first: the
+        old pin's token is FENCED (the host may be partitioned, not
+        dead — its eventual reply must not double-commit), the pin
+        epoch bumps, and the session's journaled/replicated state is
+        restored onto the new pin BEFORE the retry dispatches, so the
+        re-run appends to the dead host's committed solution."""
         self._failovers += 1
         telemetry.inc("fleet.failover.requests")
-        # a sessionful request pinned to the dead host must re-pin
+        # a sessionful request pinned to the dead host must re-pin —
+        # with its state restored and the old pin fenced
         sid = getattr(p.request, "session_id", None)
-        if sid is not None:
+        if sid is not None and not self.degenerate:
             skey = self._sid_last.get(sid)
-            if skey is not None and self._sticky.get(skey) == hid:
-                del self._sticky[skey]
+            if skey is not None:
+                self._fence_arm(hid, p)
+                if self._sticky.get(skey) == hid:
+                    del self._sticky[skey]
+                if self._sticky.get(skey) is None:
+                    new = self._ring_successor(skey, hid)
+                    if new is not None:
+                        self._restore_session(skey, new)
         try:
             if p.read:
                 res = self.predict(p.request)
@@ -599,9 +1034,24 @@ class FleetRouter:
                 raise HostDown("no alive hosts in the fleet")
             new_hid, _token, _fp8 = self._route_fit(p.request)
             tok = self.hosts[new_hid].submit(p.request)
-            wires = self.hosts[new_hid].drain()
+            # failover is the slow path and may compile the structure
+            # cold on the survivor: the generous deadline, not the
+            # per-op default (the target just accepted the submit —
+            # it is alive, merely working)
+            wires = self.hosts[new_hid].drain(
+                max(self._drain_deadline([p]), 300.0))
             w = next(w for w in wires if w["token"] == tok)
             res = self._unwire_fit(w, p)
+            if sid is not None and not self.degenerate:
+                # the re-run committed on the NEW pin: journal it
+                # there (the fenced original never journals)
+                skey = self._sid_last.get(sid)
+                if skey is not None:
+                    self._journal_commit(
+                        _Pending(p.seq, tok, p.request, p.handle,
+                                 "failover", skey=skey,
+                                 epoch=self._epoch.get(skey, 0)),
+                        res)
         except Exception as e:  # noqa: BLE001 — isolation boundary
             if p.read:
                 res = PredictResult(
@@ -629,6 +1079,11 @@ class FleetRouter:
         fleet submission order. One ``type="fleet"`` record per drain
         carries the per-host health/report block."""
         t0 = time.perf_counter()
+        # liveness pass first (ISSUE 13): climb/heal the suspicion
+        # ladder under the cheap heartbeat deadline and fence any late
+        # replies from recovered hosts — a hung host costs this drain
+        # at most one op deadline, never the old 600 s socket stall
+        self.heartbeat()
         self.drain_reads()
         out: list[tuple[int, FitResult]] = []
         per_host_n: dict[str, int] = {}
@@ -638,9 +1093,20 @@ class FleetRouter:
             if not pend:
                 continue
             per_host_n[hid] = len(pend)
+            t_host = time.perf_counter()
             try:
-                wires = self.hosts[hid].drain()
+                wires = self.hosts[hid].drain(
+                    self._drain_deadline(pend))
+            except HostSuspect:
+                # missed the drain deadline: suspect (maybe dead) —
+                # the pendings fail over NOW (fenced), the drain wall
+                # never blocks on an unresponsive host beyond its one
+                # deadline
+                self._blocked_s += time.perf_counter() - t_host
+                self._note_timeout(hid)
+                wires = []
             except HostDown:
+                self._blocked_s += time.perf_counter() - t_host
                 self._note_down(hid)
                 wires = []
             matched, left = self._match(hid, pend, wires, reads=False)
@@ -651,6 +1117,9 @@ class FleetRouter:
         # swallow co-pending work
         for hid, p in orphans:
             out.append((p.seq, self._failover_pending(hid, p)))
+        # replication AFTER failover: re-pinned sessions replicate
+        # from their NEW pin
+        self._replicate_committed()
         self._refresh_reports()
         wall = time.perf_counter() - t0
         results = [r for _s, r in sorted(out, key=lambda t: t[0])]
@@ -661,13 +1130,21 @@ class FleetRouter:
     def _refresh_reports(self) -> None:
         for hid in self._order:
             h = self._health[hid]
-            if not h["alive"]:
+            if not h["alive"] or h["misses"]:
+                # a host that already missed a deadline this cycle is
+                # known-unresponsive: another blocking report would
+                # just re-pay the timeout (the stall budget is ONE
+                # deadline + heartbeat per drain, never per op)
                 continue
             try:
                 rep = self.hosts[hid].report()
+            except HostSuspect:
+                self._note_timeout(hid)
+                continue
             except (HostDown, OSError):
                 self._note_down(hid)
                 continue
+            h["misses"] = 0
             h["queue_depth"] = int(rep.get("queue_depth", 0))
             h["read_depth"] = int(rep.get("read_depth", 0))
             h["fail_streak"] = int(rep.get("fail_streak", 0))
@@ -680,6 +1157,12 @@ class FleetRouter:
         failovers, self._failovers = self._failovers, 0
         warm_hits, self._warm_hits = self._warm_hits, 0
         warm_total, self._warm_total = self._warm_total, 0
+        replicated, self._replicated = self._replicated, 0
+        replayed, self._replayed = self._replayed, 0
+        fenced, self._fenced_rejects = self._fenced_rejects, 0
+        duplicates, self._duplicates = self._duplicates, 0
+        restores, self._restores = self._restores, {}
+        blocked, self._blocked_s = self._blocked_s, 0.0
         sticky = routes.get("sticky", 0)
         routed = sum(routes.values())
         statuses: dict[str, int] = {}
@@ -695,6 +1178,7 @@ class FleetRouter:
                  "requests": per_host_n.get(hid, 0),
                  "queue_depth": self._health[hid]["queue_depth"],
                  "fail_streak": self._health[hid]["fail_streak"],
+                 "misses": self._health[hid]["misses"],
                  "degraded": self._degraded(hid),
                  "program_misses": self._health[hid]["program_misses"]}
                 for hid in self._order],
@@ -714,6 +1198,21 @@ class FleetRouter:
                               if warm_total else None),
             "failovers": failovers,
             "statuses": statuses,
+            # durable-sessions rollup (ISSUE 13): journal health plus
+            # this drain's replication/replay/fencing activity — the
+            # report CLI's durability section reads this block; old
+            # fleet records simply lack it and degrade gracefully
+            "durability": {
+                "journal": self._journal.stats(),
+                "replicated": replicated,
+                "replayed": replayed,
+                "fenced_rejects": fenced,
+                "duplicates_deduped": duplicates,
+                "restores": restores,
+                "blocked_wall_s": round(blocked, 6),
+                "epochs": {repr(k[0]): v
+                           for k, v in list(self._epoch.items())[:32]},
+            },
             "degenerate": self.degenerate,
             "wall_s": round(wall, 6),
         }

@@ -63,6 +63,29 @@ class HostDown(ConnectionError):
     transport is touched and fails over; it never reaches a caller."""
 
 
+class HostSuspect(ConnectionError):
+    """A transport operation TIMED OUT — the host may be hung,
+    partitioned, or merely slow, but it is not provably dead (ISSUE
+    13). Distinct from :class:`HostDown` on purpose: one miss feeds
+    the router's suspicion ladder (suspect -> degraded -> dead after
+    ``dead_after`` consecutive misses) instead of immediately
+    declaring a corpse, and the work routed away from a suspect host
+    is *fenced* — if the host comes back, its late replies are
+    rejected at the router rather than double-committed."""
+
+    def __init__(self, host_id: str = "", op: str = "",
+                 deadline_s: float | None = None, detail: str = ""):
+        self.host_id = host_id
+        self.op = op
+        self.deadline_s = deadline_s
+        msg = f"host {host_id} missed the {op or 'op'} deadline"
+        if deadline_s is not None:
+            msg += f" ({deadline_s:g}s)"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 def _b64(obj) -> str:
     return base64.b64encode(
         pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)).decode()
@@ -113,6 +136,15 @@ class LoopbackHost:
     ``kill()`` simulates a host crash for failover tests — every later
     operation raises :class:`HostDown`, exactly what a dead TCP socket
     surfaces, so the router's failover path is transport-agnostic.
+
+    Partition chaos (ISSUE 13 — the soak ``--partition`` axis and the
+    fencing tests drive these): ``hang()`` makes every operation raise
+    :class:`HostSuspect` (a SIGSTOP-shaped host: alive, unresponsive,
+    state intact) until ``resume()``; ``delay_ops(n)`` times out the
+    next ``n`` operations then self-heals (a transiently slow peer);
+    ``duplicate_delivery(True)`` returns every drained wire result
+    twice (an at-least-once network) — the router must dedup, never
+    double-commit.
     """
 
     kind = "loopback"
@@ -130,22 +162,52 @@ class LoopbackHost:
         self._pending: list[tuple[int, object]] = []       # (token, handle)
         self._pending_reads: list[tuple[int, object]] = []
         self._dead = False
+        self._hung = False
+        self._delay_ops = 0
+        self._duplicate = False
 
-    def _check(self):
+    def _check(self, op: str = "op", deadline_s=None):
         if self._dead:
             raise HostDown(f"loopback host {self.host_id} was killed")
+        if self._hung:
+            raise HostSuspect(self.host_id, op, deadline_s,
+                              "host is hung (simulated partition)")
+        if self._delay_ops > 0:
+            self._delay_ops -= 1
+            raise HostSuspect(self.host_id, op, deadline_s,
+                              "reply delayed past the deadline "
+                              "(simulated)")
 
     def kill(self) -> None:
         """Simulate a crashed host (failover tests / soak host-kill)."""
         self._dead = True
 
+    def hang(self) -> None:
+        """Simulate a partitioned/SIGSTOPped host: alive but every op
+        times out; queued work and session state stay intact."""
+        self._hung = True
+
+    def resume(self) -> None:
+        self._hung = False
+
+    def delay_ops(self, n: int) -> None:
+        """Time out the next ``n`` operations, then heal."""
+        self._delay_ops = max(0, int(n))
+
+    def duplicate_delivery(self, on: bool = True) -> None:
+        self._duplicate = bool(on)
+
     def alive(self) -> bool:
         return not self._dead
+
+    def ping(self, deadline_s=None) -> dict:
+        self._check("ping", deadline_s)
+        return {"ok": True, "host": self.host_id, "t": time.time()}
 
     def submit(self, request) -> int:
         from pint_tpu.serve.scheduler import PredictRequest
 
-        self._check()
+        self._check("submit", getattr(request, "deadline_s", None))
         token = next(self._tokens)
         handle = self.scheduler.submit(request)
         if isinstance(request, PredictRequest):
@@ -154,29 +216,71 @@ class LoopbackHost:
             self._pending.append((token, handle))
         return token
 
-    def drain(self) -> list[dict]:
-        self._check()
+    def _dup(self, out: list[dict]) -> list[dict]:
+        if self._duplicate and out:
+            return out + [dict(w) for w in out]
+        return out
+
+    def drain(self, deadline_s=None) -> list[dict]:
+        self._check("drain", deadline_s)
         self.scheduler.drain()
         out = [{"token": t, "result": h.result()}
                for t, h in self._pending]
         self._pending = []
-        return out
+        return self._dup(out)
 
-    def drain_reads(self) -> list[dict]:
-        self._check()
+    def drain_reads(self, deadline_s=None) -> list[dict]:
+        self._check("drain_reads", deadline_s)
         self.scheduler.drain_reads()
         out = [{"token": t, "result": h.result()}
                for t, h in self._pending_reads]
         self._pending_reads = []
-        return out
+        return self._dup(out)
 
     def predict(self, request) -> dict:
-        self._check()
+        self._check("predict", getattr(request, "deadline_s", None))
         return {"result": self.scheduler.predict(request)}
 
     def report(self) -> dict:
-        self._check()
+        self._check("report")
         return self.scheduler.report()
+
+    # -- durable sessions (ISSUE 13) -----------------------------------
+    def session_summary(self, skey) -> dict | None:
+        self._check("session_summary")
+        return self.scheduler.session_summary(skey)
+
+    def stash_replica(self, skey, blob: dict) -> None:
+        self._check("stash_replica")
+        self.scheduler.stash_replica(skey, blob)
+
+    def adopt_session(self, skey, toas, replica=None,
+                      deadline_s=None) -> dict:
+        self._check("adopt_session", deadline_s)
+        return self.scheduler.adopt_session(skey, toas, replica=replica)
+
+    def drop_session(self, session_id, deadline_s=None) -> None:
+        """Forget any entry this host holds for ``session_id`` —
+        the router calls it on a restore target before rebuilding:
+        an entry there is by definition an orphan of an
+        unacknowledged (fenced) commit, and a replayed populate must
+        never MERGE into it (the duplicate-populate corruption of the
+        at-least-once retry path)."""
+        self._check("drop_session", deadline_s)
+        self.scheduler.sessions.drop(session_id)
+
+    def replay(self, requests, deadline_s=None) -> list[dict]:
+        """Run journal-replay requests to completion in ONE host-side
+        step (submit + drain inside the op): the router's restore path
+        never touches this host's transport-pending bookkeeping, and
+        co-queued work simply resolves early — its wire results still
+        deliver at the next ``drain`` op."""
+        self._check("replay", deadline_s)
+        handles = [self.scheduler.submit(r) for r in requests]
+        self.scheduler.drain()
+        return [{"status": h.result().status, "chi2": h.result().chi2,
+                 "session": h.result().session}
+                for h in handles]
 
     def close(self) -> None:
         self._dead = True
@@ -187,39 +291,80 @@ class LoopbackHost:
 # ----------------------------------------------------------------------
 
 class TcpHost:
-    """JSONL client for one :mod:`pint_tpu.fleet.worker` process."""
+    """JSONL client for one :mod:`pint_tpu.fleet.worker` process.
+
+    Liveness above the socket (ISSUE 13): every RPC runs under a
+    per-operation deadline — the request's own ``deadline_s`` when it
+    carries one, else ``op_deadline_s`` (default from
+    ``PINT_TPU_FLEET_OP_DEADLINE_S``, 60 s) — instead of the old flat
+    600 s socket timeout. A deadline miss raises
+    :class:`HostSuspect` (the peer accepted the connection but never
+    replied: hung/partitioned, not provably dead) and drops the now
+    desynchronized connection; a refused/reset/closed socket is still
+    :class:`HostDown`. ``timeout_s`` survives as the absolute ceiling
+    no deadline may exceed."""
 
     kind = "tcp"
 
     def __init__(self, host_id: str, address: tuple[str, int],
-                 timeout_s: float = 600.0):
+                 timeout_s: float = 600.0,
+                 op_deadline_s: float | None = None):
         self.host_id = host_id
         self.address = tuple(address)
         self.timeout_s = timeout_s
+        self.op_deadline_s = op_deadline_s
         self._sock = None
         self._fh = None
+        # at-least-once drain delivery: the highest drain sequence
+        # number whose reply this client has SEEN, echoed back as the
+        # ``ack`` of the next drain op — the worker redelivers
+        # anything newer (a reply lost with a dead connection)
+        self._drain_ack = -1
 
-    def _connect(self):
+    def _deadline(self, deadline_s=None) -> float:
+        from pint_tpu.fleet.durability import op_deadline_s
+
+        d = deadline_s
+        if d is None:
+            d = (self.op_deadline_s if self.op_deadline_s is not None
+                 else op_deadline_s())
+        return max(0.05, min(float(d), self.timeout_s))
+
+    def _connect(self, deadline: float):
         if self._sock is not None:
             return
         try:
-            self._sock = socket.create_connection(self.address,
-                                                  timeout=self.timeout_s)
+            self._sock = socket.create_connection(
+                self.address, timeout=min(10.0, deadline))
             self._fh = self._sock.makefile("rwb")
+        except socket.timeout as e:
+            self._sock = self._fh = None
+            raise HostSuspect(self.host_id, "connect", deadline,
+                              str(e)) from e
         except OSError as e:
             self._sock = self._fh = None
             raise HostDown(
                 f"host {self.host_id} at {self.address}: {e}") from e
 
-    def _rpc(self, op: str, payload=None, **fields) -> dict:
-        self._connect()
+    def _rpc(self, op: str, payload=None, deadline_s=None,
+             **fields) -> dict:
+        deadline = self._deadline(deadline_s)
+        self._connect(deadline)
         msg = {"op": op, **fields}
         if payload is not None:
             msg["payload"] = _b64(payload)
         try:
+            self._sock.settimeout(deadline)
             self._fh.write((json.dumps(msg) + "\n").encode())
             self._fh.flush()
             line = self._fh.readline()
+        except socket.timeout as e:
+            # the peer holds the connection but missed the deadline: a
+            # hung/partitioned host. The stream is desynchronized (a
+            # late reply would answer the WRONG request) — drop it; a
+            # recovered host gets a fresh connection
+            self.close()
+            raise HostSuspect(self.host_id, op, deadline, str(e)) from e
         except OSError as e:
             self.close()
             raise HostDown(
@@ -242,30 +387,63 @@ class TcpHost:
                                f"{et}: {resp.get('error')}")
         return resp
 
-    def ping(self) -> dict:
-        return self._rpc("ping")
+    def ping(self, deadline_s=None) -> dict:
+        return self._rpc("ping", deadline_s=deadline_s)
 
     def alive(self) -> bool:
         try:
             self.ping()
             return True
-        except (HostDown, OSError):
+        except (HostDown, HostSuspect, OSError):
             return False
 
     def submit(self, request) -> int:
-        return int(self._rpc("submit", payload=request)["token"])
+        # the request's own SLA rides the wire as the socket deadline
+        return int(self._rpc(
+            "submit", payload=request,
+            deadline_s=getattr(request, "deadline_s", None))["token"])
 
-    def drain(self) -> list[dict]:
-        return _unb64(self._rpc("drain")["payload"])
+    def drain(self, deadline_s=None) -> list[dict]:
+        resp = self._rpc("drain", deadline_s=deadline_s,
+                         ack=self._drain_ack)
+        if resp.get("seq") is not None:
+            self._drain_ack = max(self._drain_ack, int(resp["seq"]))
+        return _unb64(resp["payload"])
 
-    def drain_reads(self) -> list[dict]:
-        return _unb64(self._rpc("drain_reads")["payload"])
+    def drain_reads(self, deadline_s=None) -> list[dict]:
+        return _unb64(self._rpc("drain_reads",
+                                deadline_s=deadline_s)["payload"])
 
     def predict(self, request) -> dict:
-        return _unb64(self._rpc("predict", payload=request)["payload"])
+        return _unb64(self._rpc(
+            "predict", payload=request,
+            deadline_s=getattr(request, "deadline_s", None))["payload"])
 
     def report(self) -> dict:
         return self._rpc("report")["report"]
+
+    # -- durable sessions (ISSUE 13) -----------------------------------
+    def session_summary(self, skey) -> dict | None:
+        resp = self._rpc("session_summary", payload=tuple(skey))
+        return _unb64(resp["payload"]) if resp.get("payload") else None
+
+    def stash_replica(self, skey, blob: dict) -> None:
+        self._rpc("stash", payload={"skey": tuple(skey), "blob": blob})
+
+    def adopt_session(self, skey, toas, replica=None,
+                      deadline_s=None) -> dict:
+        return _unb64(self._rpc(
+            "adopt", payload={"skey": tuple(skey), "toas": toas,
+                              "replica": replica},
+            deadline_s=deadline_s)["payload"])
+
+    def drop_session(self, session_id, deadline_s=None) -> None:
+        self._rpc("drop_session", payload=session_id,
+                  deadline_s=deadline_s)
+
+    def replay(self, requests, deadline_s=None) -> list[dict]:
+        return _unb64(self._rpc("replay", payload=list(requests),
+                                deadline_s=deadline_s)["payload"])
 
     def shutdown(self) -> None:
         """Ask the worker to exit cleanly (best-effort)."""
@@ -318,6 +496,15 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
     pending: list[tuple[int, object]] = []
     pending_reads: list[tuple[int, object]] = []
     state = {"served": 0, "running": True}
+    # at-least-once delivery (ISSUE 13): drain replies are sequenced
+    # and kept until the CLIENT acks them (the next drain op echoes
+    # the last seq it saw) — a reply lost with a dead/partitioned
+    # connection is redelivered on the next drain, whichever
+    # connection it arrives on. The router dedups by token and FENCES
+    # stale sessionful replies, so redelivery is harmless and late
+    # commits become visible instead of silently vanishing.
+    unacked: list[tuple[int, list]] = []   # (seq, wire results)
+    drain_seq = itertools.count()
 
     def handle(msg: dict, reply) -> None:
         """Dispatch one protocol op (replies structured app errors via
@@ -327,8 +514,13 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
         op = msg.get("op")
         state["served"] += 1
         if op == "ping":
+            # the heartbeat op (ISSUE 13): cheap liveness + queue
+            # depths, never touching device work — what the router's
+            # suspicion ladder pings between drains
             reply({"ok": True, "host": scheduler.host_id,
-                   "t": time.time()})
+                   "t": time.time(),
+                   "queue_depth": scheduler.pending(),
+                   "read_depth": scheduler.pending_reads()})
         elif op == "submit":
             req = _unb64(msg["payload"])
             token = next(tokens)
@@ -340,13 +532,25 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             telemetry.inc("fleet.worker.requests")
             reply({"ok": True, "token": token})
         elif op == "drain":
+            ack = msg.get("ack")
+            if ack is not None:
+                unacked[:] = [(s, w) for s, w in unacked if s > ack]
             scheduler.drain()
             out = [wire_fit_result(t, h.result()) for t, h in pending]
             pending = []
             out_r = [dict(wire_read_result(h.result()), token=t)
                      for t, h in pending_reads]
             pending_reads = []
-            reply({"ok": True, "payload": _b64(out + out_r)})
+            fresh = out + out_r
+            payload = [w for _s, ws in unacked for w in ws] + fresh
+            if fresh:
+                unacked.append((next(drain_seq), fresh))
+                while sum(len(ws) for _s, ws in unacked) > 512:
+                    unacked.pop(0)
+            seq = unacked[-1][0] if unacked else (ack if ack is not
+                                                  None else -1)
+            reply({"ok": True, "seq": seq,
+                   "payload": _b64(payload)})
         elif op == "drain_reads":
             scheduler.drain_reads()
             out = [dict(wire_read_result(h.result()), token=t)
@@ -356,6 +560,35 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
         elif op == "predict":
             res = scheduler.predict(_unb64(msg["payload"]))
             reply({"ok": True, "payload": _b64(wire_read_result(res))})
+        elif op == "session_summary":
+            # durable sessions (ISSUE 13): the router pulls this host's
+            # committed summary to replicate it onto the ring successor
+            summary = scheduler.session_summary(_unb64(msg["payload"]))
+            reply({"ok": True,
+                   "payload": _b64(summary) if summary else None})
+        elif op == "stash":
+            p = _unb64(msg["payload"])
+            scheduler.stash_replica(tuple(p["skey"]), p["blob"])
+            reply({"ok": True})
+        elif op == "adopt":
+            p = _unb64(msg["payload"])
+            out = scheduler.adopt_session(tuple(p["skey"]), p["toas"],
+                                          replica=p.get("replica"))
+            reply({"ok": True, "payload": _b64(out)})
+        elif op == "drop_session":
+            scheduler.sessions.drop(_unb64(msg["payload"]))
+            reply({"ok": True})
+        elif op == "replay":
+            # journal replay: run the requests to completion in ONE op
+            # (atomic on this host; co-queued handles resolving early
+            # still wire out at the next drain op)
+            reqs = _unb64(msg["payload"])
+            handles = [scheduler.submit(r) for r in reqs]
+            scheduler.drain()
+            reply({"ok": True, "payload": _b64(
+                [{"status": h.result().status,
+                  "chi2": h.result().chi2,
+                  "session": h.result().session} for h in handles])})
         elif op == "report":
             rep = scheduler.report()
             if extra_report:

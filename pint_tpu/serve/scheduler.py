@@ -530,6 +530,76 @@ class ThroughputScheduler:
                                      float]] = []
         self._read_stats: list[dict] = []  # per-read, since last record
         self.last_read: dict | None = None
+        # durable fleet sessions (ISSUE 13): replicas stashed HERE by
+        # the router make this host the warm-failover successor for
+        # sessions owned elsewhere — small committed summaries only
+        # (model blob + DD values + chi2), bounded FIFO
+        self.replicas: dict[tuple, dict] = {}
+        self.max_replicas = 64
+
+    # ------------------------------------------------------------------
+    # durable sessions: the replication/adoption surface (ISSUE 13)
+    # ------------------------------------------------------------------
+    def session_summary(self, key: tuple) -> dict | None:
+        """This host's committed summary for one session key — the
+        replica payload the router ships to the ring successor after a
+        commit: the fitted model (pickled with its exact (hi, lo)
+        double-double values + uncertainties), chi2, append count.
+        Small by design: the accumulated table stays in the router's
+        journal. None when the key holds no committed solution."""
+        import pickle
+
+        e = self.sessions.entries.get(tuple(key))
+        if e is None or e.model is None:
+            return None
+        return {
+            "skey": tuple(key),
+            "model_blob": pickle.dumps(
+                e.model, protocol=pickle.HIGHEST_PROTOCOL),
+            "params": {k: (e.model[k].hi, e.model[k].lo,
+                           e.model[k].uncertainty)
+                       for k in e.model.free_params},
+            "chi2": e.chi2, "appends": e.appends,
+            "n_toas": e.n_toas, "version": e.version,
+        }
+
+    def stash_replica(self, key: tuple, blob: dict) -> None:
+        """Store a replica for a session another host owns (FIFO-capped
+        — replicas are a warm-failover accelerant, never the only copy:
+        the router's journal can always cold-rebuild)."""
+        key = tuple(key)
+        self.replicas.pop(key, None)
+        while len(self.replicas) >= self.max_replicas:
+            self.replicas.pop(next(iter(self.replicas)))
+            telemetry.inc("serve.session.replica_evicted")
+        self.replicas[key] = blob
+        telemetry.inc("serve.session.replica_stashed")
+
+    def adopt_session(self, key: tuple, toas,
+                      replica: dict | None = None) -> dict:
+        """Warm failover (ISSUE 13): adopt a replicated session as this
+        host's own committed state. The replica comes from the local
+        stash (shipped by the router after each commit) unless passed
+        explicitly; ``toas`` is the journal's accumulated table the
+        replica's solution was fitted to. Returns ``{"adopted": bool,
+        "chi2": float|None, "epoch": int|None}`` — not adopted when no
+        replica is held (the router then cold-replays the journal)."""
+        import pickle
+
+        from pint_tpu.serve import fingerprint as _fpm
+
+        key = tuple(key)
+        blob = replica if replica is not None \
+            else self.replicas.pop(key, None)
+        if blob is None:
+            return {"adopted": False, "chi2": None, "epoch": None}
+        model = pickle.loads(blob["model_blob"])
+        fp = _fpm.structure_fingerprint(model, toas)
+        entry = self.sessions.adopt(key, fp, model, toas,
+                                    chi2=blob["chi2"])
+        return {"adopted": True, "chi2": entry.chi2,
+                "epoch": blob.get("epoch"),
+                "with_state": entry.state is not None}
 
     # ------------------------------------------------------------------
     # degradation ladder
@@ -583,6 +653,7 @@ class ThroughputScheduler:
             "drain_rate": self._drain_rate,
             "devices": self.n_devices,
             "sessions": len(self.sessions.entries),
+            "replicas": len(self.replicas),
             "last_drain_wall_s": (self.last_drain or {}).get("wall_s"),
             "program_misses": int(
                 counter_value("cache.fit_program.miss") or 0),

@@ -351,6 +351,45 @@ class SessionCache:
         telemetry.set_gauge("serve.session.bytes", self.bytes_in_use)
         return True
 
+    def adopt(self, key: tuple, fp: tuple, model, toas,
+              chi2: float) -> SessionEntry:
+        """Install a REPLICATED committed solution as this cache's own
+        state (ISSUE 13 warm failover): the ring successor receives the
+        dead host's small summary (fitted model, chi2, append count)
+        plus the journal's accumulated table and adopts it exactly as
+        if its own populate had committed it — including the device
+        snapshot when the model is inside the incremental step's
+        domain, so the very next append takes the rank-k path. Gates
+        reset: the adopted point is a converged solution, the same
+        fresh start a populate commit gives."""
+        e = self.entry_for(key, fp)
+        e.model = model
+        e.toas = toas
+        e.pending = []
+        e.n_toas = len(toas)
+        e.appends = 0
+        e.drift = 0.0
+        e.chi2 = float(chi2)
+        eligible = False
+        try:
+            ok, _ = _fp.batchable(model, toas)
+            eligible = (ok and _fp.family(model, toas) == "wls"
+                        and model.get_tzr_toas() is not None)
+        except Exception:  # noqa: BLE001 — snapshot is an optimization
+            eligible = False
+        if eligible:
+            from pint_tpu.fitting import incremental as _incr
+
+            snap = _incr.snapshot_state(model, toas)
+            e.names, e.off = snap["names"], snap["off"]
+            self.commit_state(key, snap["state"], snap["bytes"])
+        else:
+            self.commit_state(key, None, 0)
+            e.names, e.off = None, 0
+        self.notify_commit(key)
+        telemetry.inc("serve.session.adopted")
+        return e
+
     def stats(self) -> dict:
         with_state = sum(1 for e in self.entries.values()
                          if e.state is not None)

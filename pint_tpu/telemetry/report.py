@@ -332,12 +332,29 @@ def fleet_summary(records: list[dict]) -> dict:
     hosts: dict[str, dict] = {}
     warm_hits = warm_total = 0
     sticky = routed = 0
+    # durability rollup (ISSUE 13): summed activity + the LAST drain's
+    # journal health; records predating the block contribute nothing
+    dur = {"replicated": 0, "replayed": 0, "fenced_rejects": 0,
+           "duplicates_deduped": 0, "restores": {},
+           "journal": None, "fences": 0}
     for r in records:
+        if r.get("type") == "fleet_fence":
+            dur["fences"] += 1
+            continue
         if r.get("type") != "fleet":
             continue
         drains += 1
         requests += int(r.get("requests") or 0)
         failovers += int(r.get("failovers") or 0)
+        d = r.get("durability")
+        if isinstance(d, dict):
+            for k in ("replicated", "replayed", "fenced_rejects",
+                      "duplicates_deduped"):
+                dur[k] += int(d.get(k) or 0)
+            for k, v in (d.get("restores") or {}).items():
+                dur["restores"][k] = dur["restores"].get(k, 0) + int(v)
+            if d.get("journal"):
+                dur["journal"] = d["journal"]
         for k, v in (r.get("routes") or {}).items():
             routes[k] = routes.get(k, 0) + int(v)
             routed += int(v)
@@ -371,6 +388,7 @@ def fleet_summary(records: list[dict]) -> dict:
         "warm_hit_rate": (round(warm_hits / warm_total, 4)
                           if warm_total else None),
         "hosts": hosts,
+        "durability": dur,
     }
 
 
@@ -752,6 +770,32 @@ def render(summary: dict) -> str:
                 f"    host {hid}: {h['requests']:>5} requests  "
                 f"fail_streak {h['fail_streak']}  "
                 f"program_misses {h['program_misses']}  [{state}]")
+        dur = fl.get("durability") or {}
+        if any(dur.get(k) for k in ("replicated", "replayed",
+                                    "fenced_rejects", "restores",
+                                    "journal", "fences",
+                                    "duplicates_deduped")):
+            lines.append(
+                "  durability: "
+                f"{dur.get('replicated', 0)} replica stash(es), "
+                f"{dur.get('replayed', 0)} journal replay(s), "
+                f"{dur.get('fenced_rejects', 0)} fenced reject(s), "
+                f"{dur.get('duplicates_deduped', 0)} duplicate(s) "
+                "deduped")
+            rest = dur.get("restores") or {}
+            if rest:
+                lines.append(
+                    "    restores: "
+                    + ", ".join(f"{k}={v}"
+                                for k, v in sorted(rest.items())))
+            j = dur.get("journal")
+            if j:
+                lines.append(
+                    f"    journal: {j.get('sessions')} session(s), "
+                    f"{j.get('bytes')}/{j.get('budget')} B, "
+                    f"{j.get('appends')} retained append(s), "
+                    f"{j.get('truncations')} truncation(s), "
+                    f"{j.get('dropped')} dropped log(s)")
 
     lines.append("\n== mesh (device placement) ==")
     mesh = summary["mesh"]

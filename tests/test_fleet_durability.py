@@ -1,0 +1,456 @@
+"""Durable fleet sessions (ISSUE 13): append journaling, successor
+replication, fenced failover under partitions, and liveness above the
+socket (the suspicion ladder + per-request wire deadlines).
+
+All on the loopback transport — the durability logic lives in the
+router/transport tier and is transport-agnostic by construction; the
+TCP deadline behavior is pinned by a never-replying fake server below
+and the real-process FLEET_r02 artifact.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.fleet import (FleetRouter, HostDown, HostSuspect,
+                            LoopbackHost, build_fleet)
+from pint_tpu.fleet.durability import SessionJournal, replay_requests
+from pint_tpu.models import get_model
+from pint_tpu.serve import FitRequest, PredictRequest
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+HYPER = dict(maxiter=8, min_chi2_decrease=1e-5)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def truth():
+    return get_model(PAR)
+
+
+@pytest.fixture(scope="module")
+def toas(truth):
+    return make_fake_toas_uniform(53000, 56000, 60, truth, obs="gbt",
+                                  freq_mhz=1400.0, error_us=1.0,
+                                  add_noise=True, seed=601)
+
+
+@pytest.fixture(scope="module")
+def appends(truth):
+    return [make_fake_toas_uniform(56010 + 20 * i, 56020 + 20 * i, 4,
+                                   truth, obs="gbt", freq_mhz=1400.0,
+                                   error_us=1.0, add_noise=True,
+                                   seed=610 + i)
+            for i in range(4)]
+
+
+def _populate(sid="s1"):
+    m = get_model(PAR)
+    m["F0"].add_delta(2e-10)
+    return m
+
+
+def _entry_of(router, sid):
+    skey = router._sid_last[sid]
+    host = router.hosts[router._sticky[skey]]
+    return router._sticky[skey], host.scheduler.sessions.entries[skey]
+
+
+def _solution(entry):
+    return ({k: (entry.model[k].hi, entry.model[k].lo,
+                 entry.model[k].uncertainty)
+             for k in entry.model.free_params},
+            entry.chi2, entry.n_toas)
+
+
+def _run_stream(toas, appends, *, fail=None):
+    """Populate + appends through a 2-host fleet; ``fail(router,
+    pinned, i)`` (optional) injects the fault before append i's
+    drain. Returns (router, per-append statuses)."""
+    router = build_fleet(2, max_queue=16)
+    h0 = router.submit(FitRequest(toas, _populate(), session_id="s1",
+                                  **HYPER))
+    assert router.drain()[0].status == "ok"
+    pinned = h0.host
+    statuses = []
+    for i, a in enumerate(appends):
+        router.submit(FitRequest(a, None, session_id="s1", **HYPER))
+        if fail is not None:
+            fail(router, pinned, i)
+        res = router.drain()
+        statuses.append(res[0].status)
+    return router, statuses
+
+
+# ----------------------------------------------------------------------
+# journal unit behavior
+# ----------------------------------------------------------------------
+
+def test_journal_budget_truncates_appends_into_base(toas, appends,
+                                                    truth):
+    j = SessionJournal(budget_bytes=1 << 30)
+    skey = ("s", "fp8")
+    j.record_populate(skey, "s", truth, toas, 1.0)
+    for a in appends:
+        assert j.record_append(skey, a, dict(HYPER,
+                                             max_step_halvings=8), 1.0)
+    lg = j.log(skey)
+    assert len(lg.appends) == 4 and lg.base_appends == 0
+    n_before = len(toas) + sum(len(a) for a in appends)
+    # shrink the budget below the current size (but big enough for the
+    # merged base): appends merge into the base (snapshot truncation),
+    # no TOA is lost
+    j._budget = lg.bytes - 200
+    j._enforce_budget()
+    lg = j.log(skey)
+    assert lg.appends == [] and lg.base_appends == 4
+    assert len(lg.base_toas) == n_before
+    assert j.truncations >= 1
+    # replay of the truncated log is populate-only over the full table
+    pop, apps = replay_requests(lg, suffix_only=False)
+    assert pop is not None and len(pop.toas) == n_before
+    assert apps == []
+    # a budget smaller than any base drops the log entirely (counted)
+    j._budget = 16
+    j._enforce_budget()
+    assert j.log(skey) is None and j.dropped == 1
+
+
+def test_journal_records_ride_the_router(toas, appends):
+    router, statuses = _run_stream(toas, appends[:2])
+    assert statuses == ["ok", "ok"]
+    skey = router._sid_last["s1"]
+    lg = router._journal.log(skey)
+    assert lg is not None
+    # every commit replicated to the ring successor, so every covered
+    # append merged into the base (snapshot truncation)
+    assert lg.base_appends + len(lg.appends) == 2
+    dur = router.last_drain["durability"]
+    assert dur["journal"]["sessions"] == 1
+    assert dur["replicated"] == 1  # this drain's one commit
+    succ = lg.replica_host
+    assert succ is not None and succ != router._sticky[skey]
+    assert skey in router.hosts[succ].scheduler.replicas
+
+
+# ----------------------------------------------------------------------
+# kill-and-recover: the tentpole parity pin (satellite 2 regression)
+# ----------------------------------------------------------------------
+
+def test_host_kill_mid_stream_restores_and_matches_control(toas,
+                                                           appends):
+    """A pinned host SIGKILL-equivalent dies with an append pending
+    (the stream straddles the kill): the re-pin must adopt the
+    replayed/replicated state BEFORE the retry dispatches, and the
+    final solution must match an uninterrupted control stream."""
+    def kill(router, pinned, i):
+        if i == 2:
+            router.hosts[pinned].kill()
+
+    before = telemetry.counters_snapshot()
+    r_kill, st_kill = _run_stream(toas, appends, fail=kill)
+    delta = telemetry.counters_delta(before)
+    r_ctrl, st_ctrl = _run_stream(toas, appends)
+    assert st_kill == st_ctrl == ["ok"] * 4
+    hk, ek = _entry_of(r_kill, "s1")
+    hc, ec = _entry_of(r_ctrl, "s1")
+    pk, chi2k, nk = _solution(ek)
+    pc, chi2c, nc = _solution(ec)
+    assert nk == nc  # no TOA lost or duplicated across the kill
+    assert abs(chi2k - chi2c) / abs(chi2c) < 1e-6
+    for k in pc:
+        v_k, v_c = pk[k][0] + pk[k][1], pc[k][0] + pc[k][1]
+        sig = max(pc[k][2], 1e-300)
+        assert abs(v_k - v_c) / sig < 1e-6, (k, v_k, v_c)
+    # the restore actually ran (warm adopt or cold replay — never
+    # reconstructed-from-nothing), and the re-pin moved with it
+    assert (int(delta.get("fleet.session.restore.warm", 0))
+            + int(delta.get("fleet.session.restore.cold", 0))) >= 1
+    assert int(delta.get("fleet.session.restore_miss", 0)) == 0
+    skey = r_kill._sid_last["s1"]
+    assert r_kill._sticky[skey] == hk
+    # zero duplicate commits: the journal's history length equals the
+    # control's (the failed-over append committed exactly once)
+    lk, lc = r_kill._journal.log(skey), r_ctrl._journal.log(skey)
+    assert (lk.base_appends + len(lk.appends)
+            == lc.base_appends + len(lc.appends) == 4)
+
+
+def test_cold_replay_without_replica_converges(toas, appends,
+                                               monkeypatch):
+    """With replication disabled (successor holds nothing), failover
+    falls back to a full journal replay and still converges to the
+    control solution."""
+    def no_stash(self):
+        self._committed = set()
+
+    monkeypatch.setattr(FleetRouter, "_replicate_committed", no_stash)
+
+    def kill(router, pinned, i):
+        if i == 1:
+            router.hosts[pinned].kill()
+
+    before = telemetry.counters_snapshot()
+    r_kill, st = _run_stream(toas, appends[:3], fail=kill)
+    delta = telemetry.counters_delta(before)
+    assert st == ["ok"] * 3
+    assert int(delta.get("fleet.session.restore.cold", 0)) >= 1
+    assert int(delta.get("fleet.session.replayed", 0)) >= 1
+    monkeypatch.undo()
+    r_ctrl, _ = _run_stream(toas, appends[:3])
+    _, ek = _entry_of(r_kill, "s1")
+    _, ec = _entry_of(r_ctrl, "s1")
+    pk, chi2k, nk = _solution(ek)
+    pc, chi2c, nc = _solution(ec)
+    assert nk == nc
+    assert abs(chi2k - chi2c) / abs(chi2c) < 1e-6
+    for k in pc:
+        sig = max(pc[k][2], 1e-300)
+        assert abs((pk[k][0] + pk[k][1])
+                   - (pc[k][0] + pc[k][1])) / sig < 1e-6
+
+
+# ----------------------------------------------------------------------
+# partitions: fencing (satellite 3) + the suspicion ladder (satellite 1)
+# ----------------------------------------------------------------------
+
+def test_partition_fences_late_commit_and_drain_reply(toas, appends,
+                                                      monkeypatch):
+    """A partitioned (hung, not dead) host resumed after failover:
+    its late session commit and late drain reply are both rejected
+    with the stale epoch recorded, and the successor's committed state
+    is byte-identical before vs after the late replies arrive."""
+    captured = []
+    real_add = telemetry.add_record
+    monkeypatch.setattr(
+        telemetry, "add_record",
+        lambda rec: (captured.append(rec), real_add(rec)))
+    router, _ = _run_stream(toas, [])
+    skey = router._sid_last["s1"]
+    pinned = router._sticky[skey]
+    # an append goes pending, then the host hangs (SIGSTOP shape)
+    router.submit(FitRequest(appends[0], None, session_id="s1",
+                             **HYPER))
+    router.hosts[pinned].hang()
+    res = router.drain()
+    assert res[0].status == "ok"          # failed over, restored
+    succ = router._sticky[skey]
+    assert succ != pinned
+    assert router._epoch[skey] == 1       # the re-pin bumped the epoch
+    _, entry = _entry_of(router, "s1")
+    committed = _solution(entry)
+    version = entry.version
+    # resume the stale host: the next drain's heartbeat collects and
+    # FENCES its late reply (which carries the old epoch's commit)
+    router.hosts[pinned].resume()
+    before = telemetry.counters_snapshot()
+    router.submit(FitRequest(appends[1], None, session_id="s1",
+                             **HYPER))
+    res2 = router.drain()
+    delta = telemetry.counters_delta(before)
+    assert res2[0].status == "ok" and res2[0].host == succ
+    assert int(delta.get("fleet.session.fenced_rejects", 0)) >= 1
+    # the fence event recorded the stale epoch
+    fences = [r for r in captured if r.get("type") == "fleet_fence"]
+    assert fences and fences[-1]["stale_epoch"] == 0
+    assert fences[-1]["epoch"] == 1
+    # successor state: byte-identical to the pre-resume commit for the
+    # prefix (the late commit changed NOTHING; only our own append
+    # moved it, bumping exactly one version)
+    _, entry2 = _entry_of(router, "s1")
+    assert entry2.version == version + 1
+    assert router._health[pinned]["alive"] is True  # rejoined
+
+
+def test_partition_no_append_in_flight_state_untouched(toas, appends):
+    """Fencing with NO pending work: the partitioned host resumes and
+    replays nothing — the successor's committed solution is untouched
+    byte for byte (the zero-divergence control of the FLEET_r02
+    partition trial)."""
+    router, _ = _run_stream(toas, appends[:1])
+    skey = router._sid_last["s1"]
+    pinned = router._sticky[skey]
+    router.hosts[pinned].hang()
+    # drive the ladder to presumed-dead via heartbeats (no drain work)
+    for _ in range(router.dead_after):
+        router.heartbeat()
+    assert not router._health[pinned]["alive"]
+    # session reads re-route... a fresh append re-pins + restores
+    router.submit(FitRequest(appends[1], None, session_id="s1",
+                             **HYPER))
+    res = router.drain()
+    assert res[0].status == "ok" and res[0].host != pinned
+    _, entry = _entry_of(router, "s1")
+    sol = _solution(entry)
+    router.hosts[pinned].resume()
+    router.heartbeat()                    # rejoin + reconcile
+    _, entry2 = _entry_of(router, "s1")
+    assert _solution(entry2) == sol       # byte-identical
+    assert router._health[pinned]["alive"] is True
+
+
+def test_suspicion_ladder_first_miss_suspects_not_dead(toas):
+    """Satellite 1: one missed deadline surfaces HostSuspect and makes
+    the host *suspect* (reads re-route, fits keep flowing) — never a
+    blanket HostDown."""
+    router = build_fleet(3, max_queue=8)
+    req = FitRequest(toas, _populate(), tag=0, **HYPER)
+    h = router.submit(req)
+    primary = h.host
+    router.drain()
+    # one timed-out op: suspect, still alive
+    router.hosts[primary].delay_ops(1)
+    hb = router.heartbeat()
+    assert hb[primary] == "suspect"
+    assert router._health[primary]["alive"] is True
+    assert router._health[primary]["misses"] == 1
+    assert router._suspect(primary) and not router._degraded(primary)
+    # model-carrying reads already avoid it; fits still land there
+    rd_host, _ = router._route_read(
+        PredictRequest(np.array([54000.5]), model=req.model))
+    assert rd_host != primary
+    h2 = router.submit(FitRequest(toas, _populate(), tag=1, **HYPER))
+    assert h2.host == primary
+    # healed by the next clean heartbeat
+    hb2 = router.heartbeat()
+    assert hb2[primary] == "ok" and router._health[primary]["misses"] == 0
+    router.drain()
+
+
+def test_hung_host_never_stalls_the_drain(toas, monkeypatch):
+    """The 600 s stall is gone: a hung host costs a drain at most the
+    op deadline; with the in-process loopback the timeout is
+    immediate, and the drain wall stays far under the old flat
+    timeout while every request still resolves."""
+    monkeypatch.setenv("PINT_TPU_FLEET_OP_DEADLINE_S", "2")
+    router = build_fleet(2, max_queue=8)
+    handles = [router.submit(FitRequest(toas, _populate(), tag=i,
+                                        **HYPER)) for i in range(2)]
+    hung = handles[0].host
+    router.hosts[hung].hang()
+    t0 = time.perf_counter()
+    res = router.drain()
+    wall = time.perf_counter() - t0
+    assert all(r.status == "ok" for r in res)
+    assert all(r.host != hung for r in res)
+    assert wall < 30.0  # fit work, never a socket stall
+    assert router.last_drain["failovers"] >= 1
+    # the router accounts blocked-on-unresponsive-host time exactly;
+    # loopback timeouts are instantaneous
+    dur = router.last_drain["durability"]
+    assert dur["blocked_wall_s"] < 1.0
+
+
+def test_duplicate_delivery_never_double_commits(toas, appends):
+    """An at-least-once network delivering every wire result twice:
+    the router dedups by token — one commit per request, duplicates
+    counted, journal history length exact."""
+    router = build_fleet(2, max_queue=16)
+    for h in router.hosts.values():
+        h.duplicate_delivery(True)
+    router.submit(FitRequest(toas, _populate(), session_id="s1",
+                             **HYPER))
+    assert router.drain()[0].status == "ok"
+    before = telemetry.counters_snapshot()
+    for a in appends[:2]:
+        router.submit(FitRequest(a, None, session_id="s1", **HYPER))
+        assert router.drain()[0].status == "ok"
+    delta = telemetry.counters_delta(before)
+    assert int(delta.get("fleet.transport.duplicates", 0)) >= 2
+    skey = router._sid_last["s1"]
+    lg = router._journal.log(skey)
+    assert lg.base_appends + len(lg.appends) == 2  # not 4
+    _, entry = _entry_of(router, "s1")
+    assert entry.n_toas == len(toas) + sum(len(a) for a in appends[:2])
+
+
+# ----------------------------------------------------------------------
+# TCP deadlines (satellite 1, wire level) — a fake never-replying peer
+# ----------------------------------------------------------------------
+
+def test_tcp_deadline_surfaces_host_suspect_quickly():
+    """A worker that accepts the connection but never replies used to
+    block the router for the full 600 s socket timeout; now the
+    per-op deadline trips in seconds and surfaces HostSuspect (the
+    structured 'maybe hung' signal), not HostDown."""
+    from pint_tpu.fleet import TcpHost
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def absorb():
+        conn, _ = srv.accept()
+        stop.wait(10.0)   # read nothing, reply nothing
+        conn.close()
+
+    t = threading.Thread(target=absorb, daemon=True)
+    t.start()
+    host = TcpHost("hang0", ("127.0.0.1", port), op_deadline_s=0.5)
+    t0 = time.perf_counter()
+    with pytest.raises(HostSuspect) as ei:
+        host.ping()
+    wall = time.perf_counter() - t0
+    assert wall < 5.0
+    assert ei.value.host_id == "hang0" and ei.value.op == "ping"
+    # a per-request deadline rides the wire too
+    with pytest.raises(HostSuspect):
+        host.drain(deadline_s=0.3)
+    stop.set()
+    srv.close()
+    host.close()
+    # a REFUSED connection is still the dead signal
+    with pytest.raises(HostDown):
+        TcpHost("dead0", ("127.0.0.1", port), op_deadline_s=0.5).ping()
+
+
+# ----------------------------------------------------------------------
+# record / report plumbing
+# ----------------------------------------------------------------------
+
+def test_fleet_record_durability_block_and_report_rollup(toas,
+                                                         appends,
+                                                         tmp_path):
+    router, _ = _run_stream(toas, appends[:1])
+    rec = router.last_drain
+    dur = rec["durability"]
+    assert set(dur) >= {"journal", "replicated", "replayed",
+                        "fenced_rejects", "restores"}
+    assert all("misses" in h for h in rec["hosts"])
+    # the report CLI rolls it up — and degrades on records without it
+    from pint_tpu.telemetry.report import fleet_summary
+
+    s = fleet_summary([rec, {"type": "fleet", "requests": 1,
+                             "routes": {"sticky": 1}, "hosts": []}])
+    assert s["durability"]["replicated"] >= 1
+    assert s["durability"]["journal"]["sessions"] == 1

@@ -207,7 +207,8 @@ def _sim_flagged_toas(model, rng, n: int, flag_rng=None):
 
 def one_trial(seed: int, force_chaos: bool = False,
               force_sessions: bool = False,
-              force_fleet: bool = False) -> tuple[bool, str, dict]:
+              force_fleet: bool = False,
+              force_partition: bool = False) -> tuple[bool, str, dict]:
     """Returns (ok, failure_text, axes) — axes records which sampler
     dimensions and optional gates this trial exercised, so the committed
     SOAK JSON makes coverage auditable (round-4 VERDICT task 4).
@@ -927,14 +928,17 @@ def one_trial(seed: int, force_chaos: bool = False,
             finally:
                 os.environ.pop("PINT_TPU_SESSION_MAX_APPENDS", None)
 
-        # fleet routing gate (ISSUE 12): the trial's model (plus the
-        # structure variant) through a randomized 1/2/4-host loopback
-        # fleet — half the multi-host trials KILL a host mid-stream and
-        # every request must still resolve via failover (re-routed and
-        # re-fit on a survivor, never silently dropped), with sticky
-        # routing keeping each structure on one host in the clean case.
-        # APPENDED gate, own substream.
-        if gates.random() < 0.12 or force_fleet:
+        # fleet routing gate (ISSUE 12 + 13): the trial's model (plus
+        # the structure variant) through a randomized 1/2/4-host
+        # loopback fleet. Multi-host trials draw a fault axis: KILL a
+        # host mid-stream (every request must resolve via failover),
+        # or — the ISSUE-13 ``--partition`` chaos — HANG it (a
+        # SIGSTOP-shaped partition: the drain must not stall, the
+        # resumed host's late replies must fence), DELAY one reply
+        # past the deadline (transient suspicion, then healing), or
+        # arm DUPLICATE delivery (at-least-once wires must never
+        # double-commit). APPENDED gate, own substream.
+        if gates.random() < 0.12 or force_fleet or force_partition:
             axes["gates"].append("fleet")
             from pint_tpu.fleet import build_fleet
             from pint_tpu.serve import FitRequest
@@ -942,7 +946,19 @@ def one_trial(seed: int, force_chaos: bool = False,
             frng = np.random.default_rng((seed, 11))
             n_hosts = int(frng.choice([1, 2, 4]))
             k_req = int(frng.integers(4, 7))
-            kill = bool(n_hosts > 1 and frng.random() < 0.5)
+            fdraw = frng.random()
+            fault = "none"
+            if force_partition:
+                n_hosts = max(2, n_hosts)
+                fault = ["hang", "delay", "duplicate"][
+                    int(frng.integers(3))]
+            elif n_hosts > 1:
+                fault = ("kill" if fdraw < 0.35
+                         else "hang" if fdraw < 0.50
+                         else "delay" if fdraw < 0.60
+                         else "duplicate" if fdraw < 0.70
+                         else "none")
+            kill = fault == "kill"
             par_v = "\n".join(ln for ln in par.splitlines()
                               if not ln.startswith("F1 ")) + "\n"
             have_variant = par_v != par and "F2 " not in par
@@ -962,18 +978,29 @@ def one_trial(seed: int, force_chaos: bool = False,
                 return m_j
 
             router = build_fleet(n_hosts, max_queue=2 * k_req)
+            if fault == "duplicate":
+                for h in router.hosts.values():
+                    h.duplicate_delivery(True)
             handles = []
             victim = None
             for j, (par_j, t_j) in enumerate(specs):
                 handles.append(router.submit(
                     FitRequest(t_j, _fleet_model(par_j), maxiter=30,
                                min_chi2_decrease=1e-7, tag=j)))
-                if kill and j == k_req // 2:
-                    # kill a host that holds pending work RIGHT NOW,
-                    # mid-stream; later submits must route around the
-                    # corpse and its pending requests must fail over
-                    victim = handles[0].host
-                    router.hosts[victim].kill()
+                if j == k_req // 2:
+                    if kill:
+                        # kill a host that holds pending work RIGHT
+                        # NOW, mid-stream; later submits must route
+                        # around the corpse and its pending requests
+                        # must fail over
+                        victim = handles[0].host
+                        router.hosts[victim].kill()
+                    elif fault == "hang":
+                        victim = handles[0].host
+                        router.hosts[victim].hang()
+                    elif fault == "delay":
+                        victim = handles[0].host
+                        router.hosts[victim].delay_ops(1)
             fleet_res = router.drain()
             assert len(fleet_res) == k_req, "fleet dropped requests"
             assert all(h.done() for h in handles), \
@@ -990,7 +1017,26 @@ def one_trial(seed: int, force_chaos: bool = False,
                 assert dead and dead[0]["alive"] is False
                 assert rec_f["failovers"] >= 1, \
                     "host killed with pending work but zero failovers"
-            elif n_hosts > 1:
+            elif fault == "hang":
+                # the partition axis (ISSUE 13): the drain completed
+                # without stalling on the hung host (every request
+                # already resolved above); resuming it must fence/
+                # drop its late replies without touching anything
+                assert rec_f["failovers"] >= 1, \
+                    "host hung with pending work but zero failovers"
+                solved = [(r.tag, r.chi2) for r in fleet_res]
+                router.hosts[victim].resume()
+                router.drain()  # heartbeat reconciles the late replies
+                assert [(r.tag, r.chi2) for r in fleet_res] == solved
+                assert router._health[victim]["alive"], \
+                    "resumed host did not rejoin the ring"
+                h2 = router.submit(FitRequest(
+                    specs[0][1], _fleet_model(specs[0][0]),
+                    maxiter=30, min_chi2_decrease=1e-7, tag="post"))
+                post = router.drain()
+                assert post and post[0].status in ("ok",
+                                                   "nonconverged")
+            elif fault == "none" and n_hosts > 1:
                 # clean multi-host run: each structure's requests all
                 # landed on one host (fingerprint-sticky routing)
                 by_struct: dict = {}
@@ -1000,10 +1046,90 @@ def one_trial(seed: int, force_chaos: bool = False,
                     f"structure split across hosts: {by_struct}"
             axes["fleet"] = {
                 "hosts": n_hosts, "requests": k_req,
+                "fault": fault,
                 "killed_host": victim,
                 "failovers": rec_f["failovers"],
                 "routes": rec_f["routes"],
                 "statuses": rec_f["statuses"],
+                "durability": {
+                    k: v for k, v in
+                    (rec_f.get("durability") or {}).items()
+                    if k != "epochs"},
+            }
+
+        # fleet SESSION durability gate (ISSUE 13): a sessionful
+        # append stream whose pinned host is partitioned (hung)
+        # mid-append — the append must fail over onto restored state,
+        # the resumed host's late commit must be FENCED, and the
+        # successor's committed solution must not move when the late
+        # replies arrive. APPENDED gate, own substream.
+        if gates.random() < 0.10 or force_partition:
+            axes["gates"].append("fleet_session_partition")
+            from pint_tpu import telemetry
+            from pint_tpu.fleet import build_fleet
+            from pint_tpu.serve import FitRequest
+
+            def _fleet_model(par_j):
+                m_j = get_model(par_j, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_j.free_params:
+                        m_j[name].add_delta(d)
+                return m_j
+
+            prng = np.random.default_rng((seed, 13))
+            srouter = build_fleet(2, max_queue=16)
+            m_truth = get_model(par, allow_tcb=True)
+            t_pop = _sim_flagged_toas(m_truth, prng,
+                                      int(prng.integers(50, 90)))
+            t_apps = [_sim_flagged_toas(m_truth, prng, 6)
+                      for _ in range(2)]
+            h0 = srouter.submit(FitRequest(
+                t_pop, _fleet_model(par), maxiter=30,
+                min_chi2_decrease=1e-7, session_id="soak_s",
+                tag="pop"))
+            rpop = srouter.drain()
+            assert rpop[0].status in ("ok", "nonconverged"), \
+                f"session populate -> {rpop[0].status}: {rpop[0].error}"
+            pinned_s = h0.host
+            srouter.submit(FitRequest(
+                t_apps[0], None, maxiter=30, min_chi2_decrease=1e-7,
+                session_id="soak_s", tag="app0"))
+            srouter.hosts[pinned_s].hang()
+            rapp = srouter.drain()
+            assert rapp[0].status in ("ok", "nonconverged"), \
+                f"partitioned append -> {rapp[0].status}: {rapp[0].error}"
+            skey_s = srouter._sid_last["soak_s"]
+            succ_s = srouter._sticky[skey_s]
+            assert succ_s != pinned_s, "append did not re-pin"
+            e_s = srouter.hosts[succ_s].scheduler.sessions \
+                .entries[skey_s]
+            frozen = ({k: (e_s.model[k].hi, e_s.model[k].lo)
+                       for k in e_s.model.free_params}, e_s.chi2)
+            before_f = telemetry.counters_snapshot()
+            srouter.hosts[pinned_s].resume()
+            srouter.drain()   # reconcile + fence the late commit
+            delta_f = telemetry.counters_delta(before_f)
+            e_s2 = srouter.hosts[succ_s].scheduler.sessions \
+                .entries[skey_s]
+            frozen2 = ({k: (e_s2.model[k].hi, e_s2.model[k].lo)
+                        for k in e_s2.model.free_params}, e_s2.chi2)
+            assert frozen2 == frozen, \
+                "late commit moved the successor's committed state"
+            fenced_n = int(delta_f.get("fleet.session.fenced_rejects",
+                                       0))
+            assert fenced_n >= 1, \
+                "resumed host's late session commit was not fenced"
+            rapp2 = srouter.submit(FitRequest(
+                t_apps[1], None, maxiter=30, min_chi2_decrease=1e-7,
+                session_id="soak_s", tag="app1"))
+            rfin = srouter.drain()
+            assert rfin[0].status in ("ok", "nonconverged")
+            assert rapp2.host == succ_s and rapp2.route == "sticky"
+            axes["fleet_session_partition"] = {
+                "pinned": pinned_s, "successor": succ_s,
+                "fenced_rejects": fenced_n,
+                "restores": (srouter.last_drain.get("durability")
+                             or {}).get("restores"),
             }
 
         # checkpoint contract: par round-trip preserves the phase model
@@ -1052,6 +1178,13 @@ def main() -> int:
                     help="force the multi-host routing gate on every "
                          "trial (ISSUE 12; host counts and host-kills "
                          "stay seeded and reproducible)")
+    ap.add_argument("--partition", action="store_true",
+                    help="force the partition-chaos axes on every "
+                         "trial (ISSUE 13): the fleet gate draws a "
+                         "hang/delay/duplicate-delivery fault instead "
+                         "of a kill, and the sessionful fence gate "
+                         "(hang -> failover -> resume -> fenced late "
+                         "commit) runs every trial")
     args = ap.parse_args()
 
     import json
@@ -1073,7 +1206,7 @@ def main() -> int:
               "telemetry_enabled": telemetry.enabled(),
               "seed_base": args.seed, "trials_requested": args.trials,
               "chaos": args.chaos, "sessions": args.sessions,
-              "fleet": args.fleet,
+              "fleet": args.fleet, "partition": args.partition,
               "n_pass": 0, "n_fail": 0, "fail_seeds": [], "trials": []}
 
     def save():
@@ -1117,7 +1250,8 @@ def main() -> int:
         with telemetry.profile_span("soak.trial", seed=seed):
             ok, msg, axes = one_trial(seed, force_chaos=args.chaos,
                                       force_sessions=args.sessions,
-                                      force_fleet=args.fleet)
+                                      force_fleet=args.fleet,
+                                      force_partition=args.partition)
         wall = time.time() - t1
         deltas = telemetry.counters_delta(counters_before)
         repro_path = ""
