@@ -2658,6 +2658,11 @@ def main() -> None:
         # parity vs the single-host scheduler
         fleet = res.get("fleet") or {}
         ok = ok and fleet.get("ok") is True
+        # catalog smoke acceptance (ISSUE 14): the served joint fit
+        # converges in slices, >= 1 progress record, read served
+        # mid-fit with zero fit-loop launches
+        catalog = res.get("catalog") or {}
+        ok = ok and catalog.get("ok") is True
         if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
@@ -3312,6 +3317,87 @@ def _smoke_fleet() -> dict:
             "durability": durability, "durability_ok": dur_ok}
 
 
+def _smoke_catalog() -> dict:
+    """CI catalog smoke (ISSUE 14): a tiny 4-pulsar catalog joint fit
+    served as a long job.
+
+    Asserted every CI pass: the job advances in bounded slices through
+    normal scheduler drains and CONVERGES; at least one
+    ``type="longjob"`` progress record is emitted with per-iteration
+    chi2; and a read served WHILE the joint fit is mid-flight touches
+    zero fit-loop launches (the long job never blocks the fast lane —
+    counter-pinned)."""
+    import copy as _copy
+
+    from pint_tpu import telemetry
+    from pint_tpu.catalog import CatalogFitRequest, CatalogSpec
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import (FitRequest, PredictRequest,
+                                ThroughputScheduler)
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    spec = CatalogSpec(n_pulsars=4, toas_per_pulsar=48, seed=11,
+                       red_nharm=3, gw_nharm=3)
+    os.environ["PINT_TPU_CATALOG_SLICE_S"] = "0.0"  # 1 iter / slice
+    try:
+        s = ThroughputScheduler(max_queue=8, mesh_devices=1)
+        h = s.submit(CatalogFitRequest(
+            spec=spec, gw_log10_amp=-14.0, gw_gamma=4.33, gw_nharm=3,
+            maxiter=6, min_chi2_decrease=0.0))
+        s.drain()  # first slice: generate + prepare + bootstrap + iter
+        mid_fit = not h.done()
+        # a read mid-joint-fit: the fast lane must not touch the fit
+        # loop (the two-tier + bounded-slice contract)
+        par = ("PSRJ FAKE_CATREAD\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+               "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+               "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+               "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+        truth = get_model(par)
+        toas = make_fake_toas_uniform(53000, 56000, 32, truth, obs="@",
+                                      freq_mhz=1400.0, error_us=2.0,
+                                      add_noise=True, seed=150)
+        m = get_model(par)
+        s.submit(FitRequest(toas, _copy.deepcopy(m), maxiter=5,
+                            min_chi2_decrease=1e-5))
+        small = s.drain()[0]
+        entry_model = small.request.model
+        before = telemetry.counters_snapshot()
+        r = s.predict(PredictRequest(
+            np.sort(np.random.default_rng(151).uniform(
+                54000.001, 54000.999, 16)), model=entry_model))
+        delta = telemetry.counters_delta(before)
+        launches = int(delta.get("fit.device_loop.launches", 0))
+        before_cat = telemetry.counters_snapshot()
+        n = 0
+        while not h.done() and n < 40:
+            s.drain()
+            n += 1
+        cat_delta = telemetry.counters_delta(before_cat)
+        res = h.result()
+        progress_records = int(telemetry.counters_snapshot().get(
+            "catalog.iterations", 0))
+    finally:
+        os.environ.pop("PINT_TPU_CATALOG_SLICE_S", None)
+    ok = (mid_fit
+          and res["state"] == "done" and res["converged"]
+          and res["iterations"] >= 1
+          and res["checkpoints"] >= res["iterations"]
+          and small.status == "ok"
+          and r.status == "ok" and launches == 0
+          and progress_records >= 1)
+    return {"ok": ok, "state": res["state"],
+            "converged": res["converged"],
+            "iterations": res["iterations"],
+            "checkpoints": res["checkpoints"],
+            "chi2": round(float(res["chi2"]), 4),
+            "read_mid_fit_status": r.status,
+            "fit_launches_during_read": launches,
+            "small_fit_mid_catalog": small.status,
+            "longjob_iter_records": progress_records,
+            "catalog_iters_while_draining": int(
+                cat_delta.get("catalog.iterations", 0))}
+
+
 def _run_smoke() -> None:
     """CI smoke: one tiny CPU fit proving the telemetry pipeline end-to-end.
 
@@ -3363,6 +3449,10 @@ def _run_smoke() -> None:
         # recompiles after warmup + single-host parity every CI pass
         with telemetry.span("bench.fleet_smoke"):
             fleet = _smoke_fleet()
+        # catalog smoke (ISSUE 14): a served 4-psr joint fit converges
+        # in slices with progress records, reads unblocked mid-fit
+        with telemetry.span("bench.catalog_smoke"):
+            catalog = _smoke_catalog()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
@@ -3371,7 +3461,7 @@ def _run_smoke() -> None:
                "converged": bool(f.converged),
                "serve": serve, "chaos": chaos, "mesh": mesh,
                "frontier": frontier, "incremental": incremental,
-               "read": read, "fleet": fleet}
+               "read": read, "fleet": fleet, "catalog": catalog}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001

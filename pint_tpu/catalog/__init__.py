@@ -1,0 +1,39 @@
+"""pint_tpu.catalog — catalog-scale workloads as served jobs (ISSUE 14).
+
+The NANOGrav-15yr-class joint PTA fit (68 pulsars, ~6e5 TOAs, ECORR +
+red noise, HD-correlated GW background) as a first-class *served*
+workload instead of a hand-built script:
+
+* :mod:`pint_tpu.catalog.generate` — the seeded synthetic catalog
+  generator: N pulsars with heterogeneous noise structures drawn from
+  the soak axes plus an injected HD-correlated GW signal, emitted as
+  in-memory (model, TOAs) problems and a deterministic manifest. The
+  one fixture source for scale_proof.py, bench, soak and tests.
+* :mod:`pint_tpu.catalog.job` — :class:`CatalogFitRequest` /
+  :class:`CatalogJob`: the joint fit as a long-running, per-iteration
+  checkpointing, progress-reporting request class the throughput
+  scheduler advances in bounded device-budget slices, so small-fit and
+  read traffic keep flowing (reads NEVER starve — they drain first).
+  Progress rides ``type="longjob"`` telemetry records and the pollable
+  :class:`CatalogHandle`.
+* :mod:`pint_tpu.catalog.hypergrid` — the noise-hyperparameter grid /
+  marginalization mode over the fused PTA loop: every grid point
+  shares ONE compiled gram program (hyper values are traced operands),
+  which retires ``free_noise_param`` from permanent-passthrough status
+  at the catalog level.
+
+See docs/ARCHITECTURE.md "Catalog workloads".
+"""
+
+from pint_tpu.catalog.generate import (  # noqa: F401
+    Catalog, CatalogMember, CatalogSpec, generate_catalog)
+from pint_tpu.catalog.job import (  # noqa: F401
+    CatalogFitRequest, CatalogHandle, CatalogJob)
+from pint_tpu.catalog.hypergrid import (  # noqa: F401
+    HypergridResult, grid_points, points_for_free_noise)
+
+__all__ = [
+    "Catalog", "CatalogFitRequest", "CatalogHandle", "CatalogJob",
+    "CatalogMember", "CatalogSpec", "HypergridResult",
+    "generate_catalog", "grid_points", "points_for_free_noise",
+]

@@ -1,0 +1,140 @@
+"""Noise-hyperparameter grid / marginalization mode (ISSUE 14 c).
+
+Real PTA pipelines never fit at one fixed red-noise (amplitude, gamma)
+— they scan or marginalize a grid. The repo's serving layers route any
+model with a FREE noise hyperparameter to the per-request passthrough
+(``free_noise_param``), because the fused steps read hyper values as
+static host constants... except they don't anymore: the PL values ride
+the TRACED ``NoiseStatics.pl_params`` operand, so the ONLY missing
+piece was a driver that evaluates many points against one prepared
+fitter. That driver is here (plus :class:`pint_tpu.catalog.job
+.CatalogJob`'s grid mode, which slices and checkpoints it):
+
+* every grid point swaps ONLY the traced values
+  (:meth:`PTAGLSFitter.set_pl_params`) — no recompile, no re-prepare;
+  all points share one compiled gram program (program-cache
+  counter-pinned in tests and the CI smoke);
+* each point runs its own damped fit to convergence, exactly a
+  standalone fit at those hyper values (per-point parity pinned);
+* ``points_for_free_noise`` derives a grid from the members' free
+  red-noise hyperparameters, which are then frozen for the fused loop
+  — the catalog-level retirement of the ``free_noise_param``
+  permanent-passthrough status: freedom is served by the grid, not by
+  per-request host fits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: default grid half-widths around the free values (log10-amp, gamma)
+AMP_SPAN = 0.6
+GAMMA_SPAN = 1.0
+
+
+@dataclasses.dataclass
+class HypergridResult:
+    """One grid point's fit outcome."""
+
+    point: tuple
+    chi2: float
+    converged: bool
+    iterations: int
+
+
+def grid_points(amp_range: tuple[float, float],
+                gamma_range: tuple[float, float],
+                n_amp: int = 4, n_gamma: int = 2) -> list[tuple]:
+    """Cartesian (log10_amp, gamma) grid, amp-major ordered."""
+    amps = np.linspace(amp_range[0], amp_range[1], max(1, n_amp))
+    gams = np.linspace(gamma_range[0], gamma_range[1], max(1, n_gamma))
+    return [(float(a), float(g)) for a in amps for g in gams]
+
+
+def free_noise_values(models) -> tuple[float, float] | None:
+    """(log10_amp, gamma) of the first free red-noise hyperparameter
+    pair found across the members, or None when every value is frozen
+    (the grid then centers on the frozen values instead)."""
+    for m in models:
+        for c in m.components:
+            if not getattr(c, "is_noise_basis", False):
+                continue
+            if not hasattr(c, "pl_spec"):
+                continue
+            if any(not p.frozen for p in c.params if p.is_numeric):
+                _scale, amp, gamma, _n, _a = c.pl_spec()
+                return float(amp), float(gamma)
+    return None
+
+
+def points_for_free_noise(models, n_amp: int = 4,
+                          n_gamma: int = 2) -> list[tuple]:
+    """Grid centered on the members' (free, else frozen) red-noise
+    values — the ``hypergrid="auto"`` derivation. Deterministic in the
+    models' values, so a resume host regenerates the same grid."""
+    center = free_noise_values(models)
+    if center is None:
+        for m in models:
+            for c in m.components:
+                if hasattr(c, "pl_spec"):
+                    _s, amp, gamma, _n, _a = c.pl_spec()
+                    center = (float(amp), float(gamma))
+                    break
+            if center is not None:
+                break
+    if center is None:
+        raise ValueError("hypergrid='auto' needs at least one member "
+                         "with a power-law noise component")
+    amp, gamma = center
+    return grid_points((amp - AMP_SPAN, amp + AMP_SPAN),
+                       (gamma - GAMMA_SPAN, gamma + GAMMA_SPAN),
+                       n_amp, n_gamma)
+
+
+def freeze_noise_params(models) -> int:
+    """Freeze every free noise-basis hyperparameter in place (counted).
+    The grid serves their freedom now; the fused loop requires frozen
+    values (``build_union_model`` / ``free_noise_param`` rule)."""
+    frozen = 0
+    for m in models:
+        for c in m.components:
+            if not getattr(c, "is_noise_basis", False):
+                continue
+            for p in c.params:
+                if p.is_numeric and not p.frozen:
+                    p.frozen = True
+                    frozen += 1
+    return frozen
+
+
+def run_grid(fitter, points, *, maxiter: int = 10,
+             min_chi2_decrease: float = 1e-3,
+             max_step_halvings: int = 8) -> list[HypergridResult]:
+    """Sequential-batched grid evaluation over one prepared fitter —
+    the non-sliced convenience driver (tests / scripts; the served
+    path is :class:`pint_tpu.catalog.job.CatalogJob` with
+    ``hypergrid=``, which adds slicing + checkpointing on top of the
+    same per-point semantics)."""
+    from pint_tpu.fitting.damped import downhill_iterate
+
+    out = []
+    for amp, gamma in points:
+        fitter.set_pl_params(amp, gamma)
+        it0 = _counter_value("fit.iterations")
+        deltas, info, chi2, conv = downhill_iterate(
+            fitter.step, fitter.zero_flat(), maxiter=maxiter,
+            min_chi2_decrease=min_chi2_decrease,
+            max_step_halvings=max_step_halvings)
+        out.append(HypergridResult(
+            point=(float(amp), float(gamma)), chi2=float(chi2),
+            converged=bool(conv),
+            iterations=_counter_value("fit.iterations") - it0))
+    return out
+
+
+def _counter_value(name: str) -> int:
+    from pint_tpu.telemetry.counters import counter_value
+
+    return int(counter_value(name) or 0)

@@ -81,6 +81,16 @@ class NoiseStatics(NamedTuple):
     ecorr_phi: Array  # (ne,) prior variances [s^2]
     pl_params: Array  # (n_pl, 2) [log10_amp, gamma] per PLSpec entry
     sigma: Array | None = None  # (n,) scaled uncertainties [s], or None
+    #: (ISSUE 14 satellite, the PR-10 residue) optionally carries the
+    #: DMEFAC/DMEQUAD-scaled wideband DM uncertainties [pc/cm^3] as a
+    #: TRACED (n,) operand: when present, the wideband step/probe read
+    #: it instead of applying ``ScaleDmError.scale_dm_sigma`` — whose
+    #: DMEFAC/DMEQUAD values are host-side trace constants that split
+    #: compiled programs per value set. ``None`` (the default, and the
+    #: only value under ``PINT_TPU_TRACE_DMEFAC=0``) keeps the
+    #: pinned-constant behavior bit-for-bit. Ignored by narrowband
+    #: steps (they never read DM errors).
+    dm_sigma: Array | None = None  # (n,) scaled DM sigmas, or None
 
 
 def trace_efac_enabled() -> bool:
@@ -91,6 +101,17 @@ def trace_efac_enabled() -> bool:
     import os
 
     return os.environ.get("PINT_TPU_TRACE_EFAC", "") != "0"
+
+
+def trace_dmefac_enabled() -> bool:
+    """DMEFAC/DMEQUAD-tracing gate (ISSUE 14 satellite; mirrors
+    ``trace_efac_enabled``): ``PINT_TPU_TRACE_DMEFAC=0`` pins wideband
+    DM-error scaling values as trace constants again, in which
+    mixed-DMEFAC wideband traffic splits compiled programs and serve
+    batches."""
+    import os
+
+    return os.environ.get("PINT_TPU_TRACE_DMEFAC", "") != "0"
 
 
 def scaled_sigma_np(model, toas, n_target: int | None = None) -> np.ndarray:
@@ -150,6 +171,60 @@ def sigma_traceable(model) -> bool:
     traced table leaf)."""
     return sum(1 for c in model.components
                if getattr(c, "is_noise_scale", False)) == 1
+
+
+def scaled_dm_sigma_np(model, toas, n_target: int | None = None
+                       ) -> np.ndarray:
+    """Numpy mirror of ``model.scaled_dm_uncertainty`` (+ padding).
+
+    The DMEFAC/DMEQUAD analogue of :func:`scaled_sigma_np` (ISSUE 14
+    satellite): one (n,) scaled wideband DM-uncertainty vector per
+    member on the host, reproducing ``ScaleDmError.scale_dm_sigma``
+    applied to the raw ``-pp_dme`` errors. ``n_target`` extends the
+    result the way ``wideband.build_wb_data`` pads: appended rows carry
+    ``DM_PAD_ERROR`` uncertainty with the LAST row's selector masks, so
+    the traced vector is elementwise what the pinned path computes on
+    the padded DM block.
+    """
+    from pint_tpu.fitting.wideband import DM_PAD_ERROR
+    from pint_tpu.models.parameter import toa_mask
+
+    sigma = np.asarray(toas.get_dm_errors(), dtype=np.float64)
+    k = 0 if n_target is None else n_target - len(sigma)
+    if k < 0:
+        raise ValueError(f"n_target {n_target} < ntoas {len(sigma)}")
+    if k:
+        sigma = np.concatenate([sigma, np.full(k, DM_PAD_ERROR)])
+
+    def mask_of(selector):
+        m = np.asarray(toa_mask(selector, toas), dtype=np.float64)
+        if k:
+            m = np.concatenate([m, np.full(k, m[-1])])
+        return m
+
+    var = np.square(sigma)
+    scale = np.ones_like(sigma)
+    for c in model.components:
+        if not hasattr(c, "scale_dm_sigma"):
+            continue
+        for name in getattr(c, "dmequad_names", ()):
+            p = c.param(name)
+            var = var + mask_of(p.selector) * p.value_f64 ** 2
+        for name in getattr(c, "dmefac_names", ()):
+            p = c.param(name)
+            scale = np.where(mask_of(p.selector) != 0.0, p.value_f64,
+                             scale)
+    return scale * np.sqrt(var)
+
+
+def dm_sigma_traceable(model) -> bool:
+    """Can this model's DM-error scaling ride the traced ``dm_sigma``?
+    Exactly one ``ScaleDmError``-shaped component (the
+    :func:`sigma_traceable` rule: a chain would be reassociated by the
+    one-shot mirror); zero needs no tracing — the raw ``-pp_dme``
+    errors already ride the traced ``dm`` block."""
+    return sum(1 for c in model.components
+               if hasattr(c, "scale_dm_sigma")) == 1
 
 
 def build_noise_statics(model, toas, *, as_numpy: bool = False
@@ -229,6 +304,7 @@ def pad_noise_statics(noise: NoiseStatics, n_target: int,
         phi = xp.asarray(phi)
         ne = ne_target
     sigma = noise.sigma
+    dm_sigma = noise.dm_sigma
     if n_target != n:
         pad = xp.full(n_target - n, ne, dtype=xp.int32)
         epoch_idx = xp.concatenate([xp.asarray(epoch_idx, xp.int32),
@@ -242,10 +318,19 @@ def pad_noise_statics(noise: NoiseStatics, n_target: int,
             sigma = xp.concatenate([
                 xp.asarray(sigma),
                 xp.full(n_target - n, PAD_ERROR_US * 1e-6)])
+        if dm_sigma is not None and int(np.shape(dm_sigma)[0]) == n:
+            # same rule for the DM block: pad rows at DM_PAD_ERROR
+            # weight (the build_wb_data convention; the last row's
+            # DMEFAC on a 1e12 sigma is round-off below the contract)
+            from pint_tpu.fitting.wideband import DM_PAD_ERROR
+
+            dm_sigma = xp.concatenate([
+                xp.asarray(dm_sigma),
+                xp.full(n_target - n, DM_PAD_ERROR)])
     if (epoch_idx is noise.epoch_idx and phi is noise.ecorr_phi
-            and sigma is noise.sigma):
+            and sigma is noise.sigma and dm_sigma is noise.dm_sigma):
         return noise
-    return NoiseStatics(epoch_idx, phi, noise.pl_params, sigma)
+    return NoiseStatics(epoch_idx, phi, noise.pl_params, sigma, dm_sigma)
 
 
 def stack_noise_statics(statics: list[NoiseStatics], n_target: int,
@@ -258,16 +343,19 @@ def stack_noise_statics(statics: list[NoiseStatics], n_target: int,
     Numpy leaves (the caller device-places them with the batch mesh).
     """
     padded = [pad_noise_statics(s, n_target, ne_target) for s in statics]
-    if any(s.sigma is not None for s in padded) \
-            and not all(s.sigma is not None for s in padded):
-        raise ValueError("mixed traced/pinned sigma across a batch; "
-                         "attach sigma to every member or none")
+    for leaf in ("sigma", "dm_sigma"):
+        if any(getattr(s, leaf) is not None for s in padded) \
+                and not all(getattr(s, leaf) is not None for s in padded):
+            raise ValueError(f"mixed traced/pinned {leaf} across a "
+                             "batch; attach it to every member or none")
     return NoiseStatics(
         np.stack([np.asarray(s.epoch_idx) for s in padded]),
         np.stack([np.asarray(s.ecorr_phi) for s in padded]),
         np.stack([np.asarray(s.pl_params) for s in padded]),
         (np.stack([np.asarray(s.sigma) for s in padded])
-         if padded and padded[0].sigma is not None else None))
+         if padded and padded[0].sigma is not None else None),
+        (np.stack([np.asarray(s.dm_sigma) for s in padded])
+         if padded and padded[0].dm_sigma is not None else None))
 
 
 def fourier_design(t_s: Array, nharm: int, t_ref=None, tspan=None

@@ -236,8 +236,10 @@ def make_wb_step(model, tzr=None, *, abs_phase: bool = True,
                     (ph.frac.hi + ph.frac.lo, dm_m))
 
         # traced white-noise scaling (ISSUE 10 satellite): statics-
-        # carried scaled sigmas keep EFAC/EQUAD values out of the trace
-        # (DMEFAC/DMEQUAD stay pinned constants — documented residue)
+        # carried scaled sigmas keep EFAC/EQUAD values out of the trace;
+        # DMEFAC/DMEQUAD ride ``noise.dm_sigma`` the same way (ISSUE 14
+        # satellite — the PR-10 residue), so one compiled program serves
+        # every wideband DM-error value mix
         err_t = (noise.sigma if noise.sigma is not None
                  else model.scaled_toa_uncertainty(toas))
         w_t = 1.0 / jnp.square(err_t)
@@ -251,9 +253,12 @@ def make_wb_step(model, tzr=None, *, abs_phase: bool = True,
                 - jnp.sum(resid_turns * w_t) / jnp.sum(w_t)
         r_t = resid_turns / f0
         r_dm = dm["vals"] - dm_m
-        err_dm = dm["errs"]
-        for c in dm_scale_comps:
-            err_dm = c.scale_dm_sigma(err_dm, toas)
+        if noise.dm_sigma is not None:
+            err_dm = noise.dm_sigma
+        else:
+            err_dm = dm["errs"]
+            for c in dm_scale_comps:
+                err_dm = c.scale_dm_sigma(err_dm, toas)
 
         # stacked design matrix: the Offset column moves no DM
         # measurement (zeros over the DM rows), parameter columns are
@@ -368,9 +373,12 @@ def make_wb_probe(model, tzr=None, *, abs_phase: bool = True,
         dm_m = jnp.zeros(np.shape(toas.freq_mhz)[-1])
         for c in dm_comps:
             dm_m = dm_m + c.dm_value(p, toas)
-        err_dm = dm["errs"]
-        for c in dm_scale_comps:
-            err_dm = c.scale_dm_sigma(err_dm, toas)
+        if noise.dm_sigma is not None:
+            err_dm = noise.dm_sigma
+        else:
+            err_dm = dm["errs"]
+            for c in dm_scale_comps:
+                err_dm = c.scale_dm_sigma(err_dm, toas)
         r = jnp.concatenate([r_t, dm["vals"] - dm_m])
         err = jnp.concatenate([err_t, err_dm])
         F, phi_F = pl_bases(toas, pl_specs, noise.pl_params)

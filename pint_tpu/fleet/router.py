@@ -137,6 +137,44 @@ class FleetPredictHandle:
         return self._result
 
 
+class FleetCatalogHandle:
+    """Pollable fleet-side handle for a routed catalog long job: the
+    router refreshes ``progress`` (and the checkpoint behind it) once
+    per drain slice; ``host`` tracks the CURRENT owner across
+    failovers."""
+
+    __slots__ = ("_router", "job_id")
+
+    def __init__(self, router: "FleetRouter", job_id: str):
+        self._router = router
+        self.job_id = job_id
+
+    @property
+    def host(self) -> str:
+        return self._router._catalog[self.job_id]["host"]
+
+    def done(self) -> bool:
+        p = self._router._catalog[self.job_id].get("progress")
+        return bool(p and p.get("state") in ("done", "failed"))
+
+    def progress(self) -> dict | None:
+        """The last slice's progress dict (None before the first
+        slice); includes fleet routing fields."""
+        e = self._router._catalog[self.job_id]
+        p = e.get("progress")
+        if p is None:
+            return None
+        return dict(p, host=e["host"],
+                    fleet_resumes=e["resumes"])
+
+    def result(self) -> dict:
+        if not self.done():
+            raise RuntimeError(
+                f"catalog job {self.job_id} still running; keep "
+                "draining the router")
+        return self.progress()
+
+
 class _Pending:
     """One routed, not-yet-resolved request on a host. Sessionful
     requests also carry their session key and the pin EPOCH they were
@@ -223,6 +261,13 @@ class FleetRouter:
         self._fenced_rejects = 0
         self._duplicates = 0
         self._restores: dict[str, int] = {}
+        # catalog long jobs (ISSUE 14): job_id -> routing entry. The
+        # router advances each job one slice per drain and stashes the
+        # slice's CHECKPOINT here — the long-job analogue of the
+        # session journal: a host death costs the slice since the last
+        # checkpoint, never the fit
+        self._catalog: dict[str, dict] = {}
+        self._catalog_resumes = 0
         #: wall seconds this drain spent BLOCKED on unresponsive hosts
         #: (deadline misses + dead sockets) — the quantity the ISSUE-13
         #: liveness work bounds at one op deadline + one heartbeat per
@@ -1070,6 +1115,124 @@ class FleetRouter:
         p.handle._result = res
         return res
 
+    # ------------------------------------------------------------------
+    # catalog long jobs (ISSUE 14)
+    # ------------------------------------------------------------------
+    def _catalog_target(self, exclude: set[str] = frozenset()) -> str:
+        """Least-loaded healthy host for a catalog job: a long job is
+        structure-cold by definition (its programs compile wherever it
+        lands), so load — queue depth + in-flight — beats ring
+        affinity; degraded/suspect hosts are skipped while any clean
+        host exists."""
+        alive = [h for h in self.alive_hosts() if h not in exclude]
+        if not alive:
+            raise RuntimeError("no alive host for catalog job")
+        clean = [h for h in alive
+                 if not self._degraded(h) and not self._suspect(h)]
+        pool = clean or alive
+        return min(pool, key=lambda h: (self._depth(h)
+                                        + sum(1 for e in
+                                              self._catalog.values()
+                                              if e["host"] == h
+                                              and not e["done"]),
+                                        self._order.index(h)))
+
+    def submit_catalog(self, request) -> FleetCatalogHandle:
+        """Route one catalog long job to the least-loaded healthy
+        host. The job advances one slice per :meth:`drain`; its
+        checkpoint is pulled back after every slice, so
+        :meth:`_failover_catalog` can resume it on a survivor."""
+        hid = self._catalog_target()
+        job_id = self.hosts[hid].submit_catalog(request)
+        # the handle key is the FIRST host's job id, stable for the
+        # job's life; "remote_id" tracks the current host-local id (a
+        # checkpoint-less fresh re-submit on a survivor mints a new
+        # one — the handle must keep resolving)
+        self._catalog[job_id] = {
+            "host": hid, "remote_id": job_id, "request": request,
+            "checkpoint": None, "progress": None, "resumes": 0,
+            "done": False}
+        self._route_counts["catalog"] = \
+            self._route_counts.get("catalog", 0) + 1
+        telemetry.inc("fleet.catalog.jobs")
+        return FleetCatalogHandle(self, job_id)
+
+    def catalog_progress(self, job_id: str) -> dict | None:
+        e = self._catalog.get(job_id)
+        return None if e is None else e.get("progress")
+
+    def _advance_catalog(self) -> None:
+        """One slice per live job; checkpoint stashed router-side.
+
+        A slice is long DEVICE work (a joint iteration at catalog
+        scale), so it runs under the generous slow-path deadline, like
+        restores — a working host must never be suspected for doing
+        the work it was asked to do. A miss or dead socket fails the
+        job over to a survivor via its last checkpoint: resumed, not
+        restarted (iteration counters continue — asserted by soak and
+        the smoke gate)."""
+        slow_dl = max(_dur.op_deadline_s(), 300.0)
+        for job_id, e in list(self._catalog.items()):
+            if e["done"]:
+                continue
+            hid = e["host"]
+            t0 = time.perf_counter()
+            try:
+                out = self.hosts[hid].advance_catalog(
+                    e.get("remote_id", job_id), deadline_s=slow_dl)
+            except HostSuspect:
+                self._blocked_s += time.perf_counter() - t0
+                self._note_timeout(hid)
+                self._failover_catalog(job_id, e, hid)
+                continue
+            except (HostDown, OSError):
+                self._blocked_s += time.perf_counter() - t0
+                self._note_down(hid)
+                self._failover_catalog(job_id, e, hid)
+                continue
+            e["progress"] = out["progress"]
+            if out.get("checkpoint") is not None:
+                e["checkpoint"] = out["checkpoint"]
+            if out["progress"]["state"] in ("done", "failed"):
+                e["done"] = True
+
+    def _failover_catalog(self, job_id: str, e: dict,
+                          dead_hid: str) -> None:
+        """Resume the job on a survivor from its stashed checkpoint
+        (no checkpoint yet -> fresh re-submit: nothing was lost, the
+        job had not started). The adopted job continues the SAME
+        iteration count — pre-kill work is accounted, never re-run."""
+        try:
+            target = self._catalog_target(exclude={dead_hid})
+        except RuntimeError:
+            e["done"] = True
+            e["progress"] = dict(e.get("progress") or {},
+                                 state="failed",
+                                 error="no surviving host")
+            telemetry.inc("fleet.catalog.lost")
+            return
+        slow_dl = max(_dur.op_deadline_s(), 300.0)
+        try:
+            if e["checkpoint"] is not None:
+                e["remote_id"] = self.hosts[target].adopt_catalog(
+                    e["checkpoint"], deadline_s=slow_dl)
+                telemetry.inc("fleet.catalog.resumed")
+            else:
+                # nothing ran yet (no checkpoint): fresh re-submit;
+                # the survivor mints its own id — the entry keeps its
+                # stable handle key and only the remote id moves
+                e["remote_id"] = self.hosts[target].submit_catalog(
+                    e["request"], deadline_s=slow_dl)
+                telemetry.inc("fleet.catalog.restarted")
+            e["host"] = target
+            e["resumes"] += 1
+            self._catalog_resumes += 1
+            self._failovers += 1
+        except (HostSuspect, HostDown, OSError):
+            # the fallback died too: the next drain's sweep retries
+            # against whatever is still alive
+            self._note_down(target)
+
     def drain(self) -> list[FitResult]:
         """Drain every host with pending work; resolve all handles.
 
@@ -1120,10 +1283,19 @@ class FleetRouter:
         # replication AFTER failover: re-pinned sessions replicate
         # from their NEW pin
         self._replicate_committed()
+        # catalog slice AFTER the whole fit sweep (ISSUE 14): long
+        # jobs advance once per drain, checkpoints pulled back — small
+        # fits and reads are already resolved, so the slice bounds the
+        # drain's long-job cost without starving anything. LIVE jobs
+        # only: finished entries stay resolvable through their handles
+        # but must not keep sweeping hosts or emitting records forever
+        catalog_live = any(not e["done"] for e in self._catalog.values())
+        if catalog_live:
+            self._advance_catalog()
         self._refresh_reports()
         wall = time.perf_counter() - t0
         results = [r for _s, r in sorted(out, key=lambda t: t[0])]
-        if results or per_host_n:
+        if results or per_host_n or catalog_live:
             self._emit_record(results, per_host_n, wall)
         return results
 
@@ -1216,6 +1388,18 @@ class FleetRouter:
             "degenerate": self.degenerate,
             "wall_s": round(wall, 6),
         }
+        if self._catalog:
+            cat_resumes, self._catalog_resumes = self._catalog_resumes, 0
+            self.last_drain["catalog"] = {
+                "jobs": len(self._catalog),
+                "running": sum(1 for e in self._catalog.values()
+                               if not e["done"]),
+                "resumes_this_drain": cat_resumes,
+                "by_host": {
+                    hid: sum(1 for e in self._catalog.values()
+                             if e["host"] == hid and not e["done"])
+                    for hid in self._order},
+            }
         telemetry.add_record(dict(self.last_drain))
 
     def close(self) -> None:

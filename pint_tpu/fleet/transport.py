@@ -223,7 +223,10 @@ class LoopbackHost:
 
     def drain(self, deadline_s=None) -> list[dict]:
         self._check("drain", deadline_s)
-        self.scheduler.drain()
+        # catalog slices advance through the router's OWN
+        # advance_catalog op (slow-path deadline), never inside the
+        # fit-drain RPC (see ThroughputScheduler.drain)
+        self.scheduler.drain(advance_catalog=False)
         out = [{"token": t, "result": h.result()}
                for t, h in self._pending]
         self._pending = []
@@ -277,10 +280,38 @@ class LoopbackHost:
         deliver at the next ``drain`` op."""
         self._check("replay", deadline_s)
         handles = [self.scheduler.submit(r) for r in requests]
-        self.scheduler.drain()
+        self.scheduler.drain(advance_catalog=False)
         return [{"status": h.result().status, "chi2": h.result().chi2,
                  "session": h.result().session}
                 for h in handles]
+
+    # -- catalog long jobs (ISSUE 14) ----------------------------------
+    def submit_catalog(self, request, deadline_s=None) -> str:
+        self._check("submit_catalog", deadline_s)
+        return self.scheduler.submit_catalog(request).job_id
+
+    def adopt_catalog(self, checkpoint, deadline_s=None) -> str:
+        """Resume a checkpointed catalog job on this host (failover)."""
+        self._check("adopt_catalog", deadline_s)
+        return self.scheduler.adopt_catalog(checkpoint).job_id
+
+    def advance_catalog(self, job_id, budget_s=None,
+                        deadline_s=None) -> dict:
+        """One slice + the refreshed checkpoint: the router calls this
+        per drain and stashes the checkpoint so a later host death
+        resumes from the last slice instead of restarting."""
+        self._check("advance_catalog", deadline_s)
+        job = self.scheduler.catalog_jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown catalog job {job_id!r}")
+        if job.state not in ("done", "failed"):
+            job.advance(budget_s)
+        return {"progress": job.progress(),
+                "checkpoint": self.scheduler.catalog_checkpoint(job_id)}
+
+    def catalog_progress(self, job_id, deadline_s=None) -> dict | None:
+        self._check("catalog_progress", deadline_s)
+        return self.scheduler.catalog_progress(job_id)
 
     def close(self) -> None:
         self._dead = True
@@ -445,6 +476,27 @@ class TcpHost:
         return _unb64(self._rpc("replay", payload=list(requests),
                                 deadline_s=deadline_s)["payload"])
 
+    # -- catalog long jobs (ISSUE 14) ----------------------------------
+    def submit_catalog(self, request, deadline_s=None) -> str:
+        return self._rpc("submit_catalog", payload=request,
+                         deadline_s=deadline_s)["job_id"]
+
+    def adopt_catalog(self, checkpoint, deadline_s=None) -> str:
+        return self._rpc("adopt_catalog", payload=checkpoint,
+                         deadline_s=deadline_s)["job_id"]
+
+    def advance_catalog(self, job_id, budget_s=None,
+                        deadline_s=None) -> dict:
+        return _unb64(self._rpc(
+            "advance_catalog",
+            payload={"job_id": job_id, "budget_s": budget_s},
+            deadline_s=deadline_s)["payload"])
+
+    def catalog_progress(self, job_id, deadline_s=None) -> dict | None:
+        resp = self._rpc("catalog_progress", payload=job_id,
+                         deadline_s=deadline_s)
+        return _unb64(resp["payload"]) if resp.get("payload") else None
+
     def shutdown(self) -> None:
         """Ask the worker to exit cleanly (best-effort)."""
         try:
@@ -535,7 +587,9 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             ack = msg.get("ack")
             if ack is not None:
                 unacked[:] = [(s, w) for s, w in unacked if s > ack]
-            scheduler.drain()
+            # catalog slices run under the router's advance_catalog op
+            # (slow-path deadline), never inside the fit-drain RPC
+            scheduler.drain(advance_catalog=False)
             out = [wire_fit_result(t, h.result()) for t, h in pending]
             pending = []
             out_r = [dict(wire_read_result(h.result()), token=t)
@@ -584,11 +638,36 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             # still wire out at the next drain op)
             reqs = _unb64(msg["payload"])
             handles = [scheduler.submit(r) for r in reqs]
-            scheduler.drain()
+            scheduler.drain(advance_catalog=False)
             reply({"ok": True, "payload": _b64(
                 [{"status": h.result().status,
                   "chi2": h.result().chi2,
                   "session": h.result().session} for h in handles])})
+        elif op == "submit_catalog":
+            # catalog long jobs (ISSUE 14): submit returns the job id;
+            # the router advances it slice-by-slice via advance_catalog
+            h = scheduler.submit_catalog(_unb64(msg["payload"]))
+            reply({"ok": True, "job_id": h.job_id})
+        elif op == "adopt_catalog":
+            h = scheduler.adopt_catalog(_unb64(msg["payload"]))
+            reply({"ok": True, "job_id": h.job_id})
+        elif op == "advance_catalog":
+            p = _unb64(msg["payload"])
+            job = scheduler.catalog_jobs.get(p["job_id"])
+            if job is None:
+                reply({"ok": False, "error_type": "KeyError",
+                       "error": f"unknown catalog job {p['job_id']!r}"})
+            else:
+                if job.state not in ("done", "failed"):
+                    job.advance(p.get("budget_s"))
+                reply({"ok": True, "payload": _b64(
+                    {"progress": job.progress(),
+                     "checkpoint": scheduler.catalog_checkpoint(
+                         p["job_id"])})})
+        elif op == "catalog_progress":
+            prog = scheduler.catalog_progress(_unb64(msg["payload"]))
+            reply({"ok": True,
+                   "payload": _b64(prog) if prog else None})
         elif op == "report":
             rep = scheduler.report()
             if extra_report:

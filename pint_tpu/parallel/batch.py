@@ -151,7 +151,8 @@ def _check_noise_merge(prev, c, name: str) -> None:
             "across the batch; split the batch")
 
 
-def build_union_model(models, drop_noise_scale: bool = False
+def build_union_model(models, drop_noise_scale: bool = False,
+                      drop_dm_scale: bool = False
                       ) -> tuple[TimingModel, dict[str, dict[int, tuple]]]:
     """Union of the models' components for batched fitting.
 
@@ -163,6 +164,11 @@ def build_union_model(models, drop_noise_scale: bool = False
     white-noise values at all. Only valid for noise/wideband batches
     whose step consumes statics (the WLS union step has no statics
     operand and keeps the merged-scale machinery below).
+    ``drop_dm_scale=True`` (ISSUE 14 satellite) is the wideband
+    analogue: every ``ScaleDmError`` is omitted and per-member DM-error
+    scaling rides the traced ``NoiseStatics.dm_sigma``, so mixed-DMEFAC
+    wideband members share one union fingerprint — only valid for
+    wideband batches (narrowband steps never read DM errors).
 
     Returns (union_model, owners) where ``owners`` maps each merged
     mask-parameter's synthetic selector key to a per-member dict
@@ -224,6 +230,8 @@ def build_union_model(models, drop_noise_scale: bool = False
                 else:
                     _check_noise_merge(prev[1], c, name)
                 continue
+            if hasattr(c, "scale_dm_sigma") and drop_dm_scale:
+                continue  # DM-error scaling rides NoiseStatics.dm_sigma
             if isinstance(c, ScaleToaError):
                 if drop_noise_scale:
                     continue  # scaling rides NoiseStatics.sigma
@@ -479,8 +487,27 @@ class BatchedPulsarFitter:
             and any(_has_scale(m) for m in self.models)
             and all(sigma_traceable(m) for m in self.models
                     if _has_scale(m)))
+        # traced-DMEFAC frontier (ISSUE 14 satellite, the PR-10
+        # residue): wideband batches whose DM-error scaling is
+        # expressible as one per-TOA dm_sigma vector ride it as a
+        # traced statics leaf; the union then carries no ScaleDmError,
+        # so mixed-DMEFAC members share one compiled program.
+        # PINT_TPU_TRACE_DMEFAC=0 restores the pinned-constant path.
+        from pint_tpu.fitting.gls_step import (dm_sigma_traceable,
+                                               trace_dmefac_enabled)
+
+        def _has_dm_scale(m):
+            return any(hasattr(c, "scale_dm_sigma")
+                       for c in m.components)
+
+        self._trace_dm_sigma = (
+            self.family == "wb" and trace_dmefac_enabled()
+            and any(_has_dm_scale(m) for m in self.models)
+            and all(dm_sigma_traceable(m) for m in self.models
+                    if _has_dm_scale(m)))
         self.union, owners = build_union_model(
-            self.models, drop_noise_scale=self._trace_sigma)
+            self.models, drop_noise_scale=self._trace_sigma,
+            drop_dm_scale=self._trace_dm_sigma)
 
         # free-parameter union + per-pulsar 0/1 masks. Mask params that
         # were merged (JUMP/EFAC family) are fitted under their synthetic
@@ -606,6 +633,12 @@ class BatchedPulsarFitter:
                     # at PAD_ERROR weight — elementwise what the pinned
                     # path computes on the padded stacked table)
                     s = s._replace(sigma=scaled_sigma_np(m, t, n_max))
+                if self._trace_dm_sigma:
+                    from pint_tpu.fitting.gls_step import \
+                        scaled_dm_sigma_np
+
+                    s = s._replace(
+                        dm_sigma=scaled_dm_sigma_np(m, t, n_max))
                 statics.append(s)
                 specs_list.append(specs)
             if any(sp != specs_list[0] for sp in specs_list[1:]):

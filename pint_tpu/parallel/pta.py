@@ -190,7 +190,12 @@ def make_pta_gram(model, gw: GWSpec, pl_specs, tzr=None):
             return (ph.int_part + (ph.frac.hi + ph.frac.lo),
                     ph.frac.hi + ph.frac.lo)
 
-        err = model.scaled_toa_uncertainty(toas)
+        # statics-carried scaled sigmas (the PR-10 traced-EFAC rule):
+        # the pulsar-major stacked route erases flag metadata when it
+        # stacks tables, so EFAC/EQUAD selectors must ride the traced
+        # operand; absent sigma keeps the host-read path bit-for-bit
+        err = (noise.sigma if noise.sigma is not None
+               else model.scaled_toa_uncertainty(toas))
         w = 1.0 / jnp.square(err)
 
         J, resid_turns = jax.jacfwd(total_phase, has_aux=True)(deltas)
@@ -438,6 +443,11 @@ class PTAGLSFitter:
         self.gw_coeffs: np.ndarray | None = None
         self._prepared = None        # delta-independent per-pulsar state
         self._batched = None         # stacked hybrid state (uniform shapes)
+        #: pulsar-major stacked mesh state (ISSUE 14): uniform-structure
+        #: catalogs on a mesh whose "psr" axis > 1 stack every operand
+        #: (P, ...) sharded pulsar-major and run ONE vmapped gram per
+        #: joint evaluation — None = per-pulsar route
+        self._psr_stacked: dict | None = None
         self._accel_batched = bool(accel_batched)
         # common GW per-frequency prior phi_gw (f on the shared grid)
         f = np.arange(1, self.gw.nharm + 1) / self.gw.tspan_s
@@ -456,6 +466,16 @@ class PTAGLSFitter:
         """
         if self._prepared is not None:
             return self._prepared
+        if (self.mesh is not None
+                and int(self.mesh.shape.get("psr", 1)) > 1):
+            # pulsar-major catalogs (ISSUE 14): try the stacked route;
+            # heterogeneous structures/shapes fall back per-pulsar
+            # (the TOA axis still shards over the mesh's "toa" dim)
+            stacked = self._prepare_stacked()
+            if stacked is not None:
+                self._psr_stacked = stacked
+                self._prepared = []
+                return self._prepared
         prepared = []
         cpu = (None if self.accel_dev is None
                else jax.devices("cpu")[0])
@@ -566,6 +586,240 @@ class PTAGLSFitter:
         # them so the fitter does not hold 2x the stage-2 HBM footprint
         for i, e in enumerate(prepared):
             prepared[i] = (e[0], e[1], e[2], None, None)
+
+    def _prepare_stacked(self) -> dict | None:
+        """Pulsar-major stacked mesh state (ISSUE 14 tentpole b).
+
+        For a uniform catalog — every pulsar the same model structure
+        (fingerprint-equal: identical frozen values, free values ride
+        the traced base) and the same TOA count, the 68-pulsar
+        north-star shape — all per-pulsar operands stack to (P, ...)
+        leaves sharded over the mesh's "psr" axis (TOA axis over
+        "toa"), and every joint evaluation runs the per-pulsar Gram as
+        ONE vmapped partitioned program instead of P sequential calls:
+        each device holds (and reduces) only its own pulsars' tables.
+        Returns None when the catalog is not uniform or the pulsar
+        count does not divide the psr axis — the caller falls back to
+        the per-pulsar route.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pint_tpu.bucketing import bucket_size, pad_toas
+        from pint_tpu.fitting.gls_step import stack_noise_statics
+        from pint_tpu.parallel.batch import stack_toas
+        from pint_tpu.parallel.mesh import shard_toas
+
+        from pint_tpu.fitting.gls_step import (scaled_sigma_np,
+                                               sigma_traceable)
+
+        n_psr_dev = int(self.mesh.shape["psr"])
+        if len(self.models) % n_psr_dev != 0:
+            return None
+        fp0 = self.models[0]._fn_fingerprint()
+        if any(m._fn_fingerprint() != fp0 for m in self.models[1:]):
+            return None
+        if len({len(t) for t in self.toas_list}) != 1:
+            return None
+        model0 = self.models[0]
+        # stacking erases flag metadata (parallel.batch._strip_static),
+        # so every selector the traced gram consults must ride a traced
+        # operand: EFAC/EQUAD go through NoiseStatics.sigma (requires
+        # the one-component sigma_traceable form); any OTHER
+        # selector-bearing component (mask JUMPs etc.) falls back to
+        # the per-pulsar route, which keeps real flags
+        has_scale = any(getattr(c, "is_noise_scale", False)
+                        for c in model0.components)
+        if has_scale and not sigma_traceable(model0):
+            return None
+        for c in model0.components:
+            if (getattr(c, "is_noise_scale", False)
+                    or getattr(c, "is_noise_basis", False)
+                    or hasattr(c, "epoch_indices")):
+                continue
+            if any(getattr(p, "selector", None)
+                   for p in getattr(c, "params", ())):
+                return None
+        statics, specs_list = [], []
+        n_target = bucket_size(len(self.toas_list[0]),
+                               multiple=int(self.mesh.shape["toa"]))
+        for toas, model in zip(self.toas_list, self.models):
+            s, specs = build_noise_statics(model, toas, as_numpy=True)
+            if has_scale:
+                s = s._replace(sigma=scaled_sigma_np(model, toas,
+                                                     n_target))
+            statics.append(s)
+            specs_list.append(specs)
+        if any(sp != specs_list[0] for sp in specs_list[1:]):
+            return None
+        pl_specs = specs_list[0]
+        ne_max = max(int(np.shape(s.ecorr_phi)[0]) for s in statics)
+        noise_np = stack_noise_statics(statics, n_target, ne_max)
+        toas_st = stack_toas([pad_toas(t, n_target)
+                              for t in self.toas_list], n_target)
+        toas_sh = shard_toas(toas_st, self.mesh, batched=True)
+        psr = NamedSharding(self.mesh, P("psr"))
+        psr_toa = NamedSharding(self.mesh, P("psr", "toa"))
+        noise_sh = NoiseStatics(
+            jax.device_put(noise_np.epoch_idx, psr_toa),
+            jax.device_put(noise_np.ecorr_phi, psr),
+            jax.device_put(noise_np.pl_params, psr),
+            (None if noise_np.sigma is None
+             else jax.device_put(noise_np.sigma, psr_toa)))
+        gram = model0._cached_jit(
+            ("pta_gram_stacked", self.gw, pl_specs),
+            lambda owner, _pl=pl_specs: jax.vmap(
+                make_pta_gram(owner, self.gw, _pl)))
+        basis_key = ("basis", self.gw, pl_specs, "stacked")
+        basis_fn = _STAGE2_CACHE.get_lru(basis_key)
+        if basis_fn is None:
+            basis_fn = _STAGE2_CACHE.put_lru(basis_key, jax.jit(
+                jax.vmap(make_pta_basis_fn(self.gw, pl_specs))))
+        with self.mesh:
+            basis = basis_fn(toas_sh)
+        p = (len(model0.free_params)
+             + (0 if model0.has_component("PhaseOffset") else 1))
+        k_pl = int(basis[0].shape[-1]) - 2 * self.gw.nharm
+        return {"gram": gram, "toas": toas_sh, "noise": noise_sh,
+                "basis": basis, "pl_specs": pl_specs, "p": p,
+                "k_pl": k_pl, "n_target": n_target}
+
+    @staticmethod
+    def _stack_tree(trees):
+        """Stack a list of congruent pytrees along a new leading axis
+        (numpy leaves — the jitted call device-places them)."""
+        return jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees)
+
+    def _grams_stacked(self, deltas_list):
+        """One vmapped pulsar-major gram evaluation over the catalog."""
+        st = self._psr_stacked
+        base = self._stack_tree([m.base_dd() for m in self.models])
+        deltas = self._stack_tree([
+            self._deltas_for(m, deltas_list, i)
+            for i, m in enumerate(self.models)])
+        note_program("pta_gram", (id(st["gram"]), "stacked"),
+                     (len(self.models), st["n_target"]))
+        with self.mesh:
+            out = st["gram"](base, deltas, st["toas"], st["noise"],
+                             *st["basis"])
+        # small replicated outputs; ONE fetch for the stacked arrays
+        S = np.asarray(out["S"])
+        rhs = np.asarray(out["rhs"])
+        norm = np.asarray(out["norm"])
+        chi2_base = np.asarray(out["chi2_base"])
+        return [{"S": S[i], "rhs": rhs[i], "norm": norm[i],
+                 "chi2_base": chi2_base[i], "p": st["p"],
+                 "k_pl": st["k_pl"]}
+                for i in range(len(self.models))]
+
+    def set_pl_params(self, log10_amp: float, gamma: float,
+                      spec_index: int = 0) -> int:
+        """Re-point every prepared pulsar's power-law hyperparameters
+        at ``(log10_amp, gamma)`` — the hypergrid mode's program-reuse
+        hook (ISSUE 14 tentpole c).
+
+        The PL values are TRACED operands (``NoiseStatics.pl_params``),
+        so swapping them re-executes the SAME compiled gram program:
+        no recompile, no re-prepare, no model mutation (the models keep
+        their own values — grid points are an evaluation overlay, and
+        mutating frozen values would fork the program-cache key).
+        Returns the number of pulsars updated (those carrying a PL
+        spec at ``spec_index``); pulsars without one are untouched.
+        """
+        self._prepare()
+        updated = 0
+        if self._psr_stacked is not None:
+            st = self._psr_stacked
+            if not st["pl_specs"] or spec_index >= len(st["pl_specs"]):
+                return 0
+            vals = np.asarray(st["noise"].pl_params)  # (P, n_pl, 2)
+            vals = np.array(vals)
+            vals[:, spec_index, 0] = log10_amp
+            vals[:, spec_index, 1] = gamma
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            st["noise"] = st["noise"]._replace(pl_params=jax.device_put(
+                vals, NamedSharding(self.mesh, P("psr"))))
+            return len(self.models)
+        if self._batched is not None:
+            # hybrid stacked state: the per-pulsar dev_args were
+            # dropped in favor of one (P, ...) stack — pl_params is
+            # stack leaf 2 (the ship_stage2_statics argument order)
+            vals = np.array(np.asarray(self._batched[2]))
+            if vals.ndim != 3 or spec_index >= vals.shape[1]:
+                return 0
+            vals[:, spec_index, 0] = log10_amp
+            vals[:, spec_index, 1] = gamma
+            self._batched = (self._batched[:2]
+                             + (jax.device_put(jnp.asarray(vals),
+                                               self.accel_dev),)
+                             + self._batched[3:])
+            return len(self.models)
+        prepared = self._prepared
+        for i, entry in enumerate(prepared):
+            if entry[0] == "hybrid":
+                kind, meta, toas_cpu, dev_args, basis = entry
+                pl_specs = meta[2]
+                if (dev_args is None or not pl_specs
+                        or spec_index >= len(pl_specs)):
+                    continue
+                vals = np.array(np.asarray(dev_args[2]))
+                vals[spec_index] = (log10_amp, gamma)
+                dev_args = (dev_args[0], dev_args[1],
+                            jax.device_put(jnp.asarray(vals),
+                                           self.accel_dev)) + dev_args[3:]
+                prepared[i] = (kind, meta, toas_cpu, dev_args, basis)
+                updated += 1
+                continue
+            kind, gram, toas, noise, model, basis = entry
+            n_pl = int(np.shape(noise.pl_params)[0])
+            if spec_index >= n_pl:
+                continue
+            vals = np.array(np.asarray(noise.pl_params))
+            vals[spec_index] = (log10_amp, gamma)
+            new_vals = jnp.asarray(vals)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                new_vals = jax.device_put(
+                    new_vals, NamedSharding(self.mesh, P()))
+            prepared[i] = (kind, gram, toas,
+                           noise._replace(pl_params=new_vals), model,
+                           basis)
+            updated += 1
+        return updated
+
+    def per_device_bytes(self) -> dict[int, int]:
+        """Placed bytes of the prepared fit operands by device id —
+        the catalog SCALE record's accounting surface (sharded leaves
+        only; host numpy staging is not device memory)."""
+        from pint_tpu.parallel.mesh import per_device_bytes as _pdb
+
+        self._prepare()
+        if self._psr_stacked is not None:
+            st = self._psr_stacked
+            return _pdb((st["toas"], st["noise"], st["basis"]))
+        out: dict[int, int] = {}
+        for entry in self._prepared:
+            if entry[0] != "plain":
+                continue
+            for did, nb in _pdb((entry[2], entry[3], entry[5])).items():
+                out[did] = out.get(did, 0) + nb
+        return out
+
+    def apply_solution(self, flat: dict, info: dict) -> None:
+        """Write a host-driver solution back into the member models:
+        the ``fit_toas`` tail, shared with the resumable catalog job
+        (:mod:`pint_tpu.catalog.job`) so a checkpointed long fit
+        commits through exactly the code path an uninterrupted
+        ``fit_toas`` uses."""
+        self.gw_coeffs = info["gw_coeffs"]
+        errors = info["errors_fn"]()
+        for i, model in enumerate(self.models):
+            for name in model.free_params:
+                par = model[name]
+                par.add_delta(float(flat[(i, name)]))
+                par.uncertainty = float(errors[(i, name)])
 
     def _grams_batched(self, prepared, deltas_list):
         """One vmapped stage-2 evaluation over all (uniform) pulsars."""
@@ -682,6 +936,8 @@ class PTAGLSFitter:
         evaluation); ``None`` means zeros.
         """
         prepared = self._prepare()
+        if self._psr_stacked is not None:
+            return self._grams_stacked(deltas_list)
         if self._batched is not None:
             return self._grams_batched(prepared, deltas_list)
         out = []
@@ -741,7 +997,14 @@ class PTAGLSFitter:
         n_toas = sum(len(t) for t in self.toas_list)
         telemetry.set_gauge("pta.n_pulsars", len(self.models))
         telemetry.set_gauge("fit.ntoas", n_toas)
-        if device_loop.enabled() and self.accel_dev is None:
+        self._prepare()
+        if (device_loop.enabled() and self.accel_dev is None
+                and self._psr_stacked is None):
+            # the pulsar-major stacked route keeps the host driver: its
+            # vmapped partitioned gram is the per-evaluation unit the
+            # resumable catalog job checkpoints between (catalog.job),
+            # and tracing P stacked grams into one while_loop program
+            # buys nothing the stacked dispatch does not already fuse
             return self._fit_device_loop(maxiter)
         with telemetry.profile_span("fit.pta_joint", n_pulsars=len(self.models),
                             ntoas=n_toas,
@@ -757,13 +1020,7 @@ class PTAGLSFitter:
             self.converged = False
             self.chi2 = chi2
             return chi2
-        self.gw_coeffs = info["gw_coeffs"]
-        errors = info["errors_fn"]()
-        for i, model in enumerate(self.models):
-            for name in model.free_params:
-                par = model[name]
-                par.add_delta(float(deltas[(i, name)]))
-                par.uncertainty = float(errors[(i, name)])
+        self.apply_solution(deltas, info)
         self.chi2 = chi2
         return chi2
 

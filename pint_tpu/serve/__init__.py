@@ -15,12 +15,16 @@ to a cached converged solution via fused rank-k incremental updates
 instead of paying a cold fit. Reads (:class:`PredictRequest`;
 :mod:`pint_tpu.predict`) are the second tier: phase/TOA predictions
 served from cached fit state through a fast lane that never queues
-behind fit drains. Scale-OUT over many hosts lives one tier up in
+behind fit drains. Catalog-scale joint PTA fits
+(:class:`pint_tpu.catalog.job.CatalogFitRequest`) are the third tier:
+long-running checkpointing jobs advanced one bounded device-budget
+slice per drain, so they coexist with (and never starve) the fit and
+read lanes. Scale-OUT over many hosts lives one tier up in
 :mod:`pint_tpu.fleet` (fingerprint-sticky rendezvous routing over N
 per-host schedulers; this scheduler's ``host_id`` / ``report()`` are
 its per-host surface). See docs/ARCHITECTURE.md "Throughput engine",
 "Failure domains & degradation ladder", "Sessionful serving",
-"The read path" and "Fleet tier".
+"The read path", "Catalog workloads" and "Fleet tier".
 """
 
 from pint_tpu.serve import faults  # noqa: F401

@@ -75,7 +75,7 @@ def family(model, toas=None) -> str:
     return "wls"
 
 
-def _noise_value_params(model) -> frozenset:
+def _noise_value_params(model, wideband: bool = False) -> frozenset:
     """Names of noise hyperparameters whose VALUES ride the traced
     ``NoiseStatics`` operand of the batched GLS/wideband steps — the
     harmonic-count parameter (shape-static) stays pinned.
@@ -88,18 +88,33 @@ def _noise_value_params(model) -> frozenset:
     programs. Selectors stay pinned (they are structure), and models
     whose scaling cannot ride the traced vector (several chained
     noise-scale components — see ``sigma_traceable``) keep their
-    values pinned."""
-    from pint_tpu.fitting.gls_step import (sigma_traceable,
+    values pinned.
+
+    With DMEFAC/DMEQUAD tracing on (``trace_dmefac_enabled``, ISSUE 14
+    satellite — the PR-10 residue), wideband DM-error scaling values
+    join the traced set the same way: the wideband step reads per-TOA
+    scaled DM sigmas from ``NoiseStatics.dm_sigma``, so mixed-DMEFAC
+    wideband catalog members hash equal and share one compiled
+    program. ``wideband=False`` (narrowband families) keeps them out:
+    a narrowband step never reads DM errors, and an inert component's
+    values may as well stay pinned."""
+    from pint_tpu.fitting.gls_step import (dm_sigma_traceable,
+                                           sigma_traceable,
+                                           trace_dmefac_enabled,
                                            trace_efac_enabled)
 
     out = set()
     trace_scale = trace_efac_enabled() and sigma_traceable(model)
+    trace_dm = (wideband and trace_dmefac_enabled()
+                and dm_sigma_traceable(model))
     for c in model.components:
         if getattr(c, "is_noise_basis", False):
             keep = getattr(c, "_c_name", None)
             out.update(p.name for p in c.params
                        if p.is_numeric and p.name != keep)
         elif trace_scale and getattr(c, "is_noise_scale", False):
+            out.update(p.name for p in c.params if p.is_numeric)
+        elif trace_dm and hasattr(c, "scale_dm_sigma"):
             out.update(p.name for p in c.params if p.is_numeric)
     return frozenset(out)
 
@@ -168,7 +183,8 @@ def structure_fingerprint(model, toas=None) -> tuple:
     """
     ok, _reason = batchable(model, toas)
     fam = family(model, toas)
-    traced = _noise_value_params(model) if fam != "wls" else frozenset()
+    traced = (_noise_value_params(model, wideband=fam == "wb")
+              if fam != "wls" else frozenset())
     return (ok, fam, model._fn_fingerprint(value_traced=traced),
             _structural_state(model))
 

@@ -536,6 +536,74 @@ class ThroughputScheduler:
         # (model blob + DD values + chi2), bounded FIFO
         self.replicas: dict[tuple, dict] = {}
         self.max_replicas = 64
+        # catalog workloads (ISSUE 14): long-running joint-fit jobs
+        # advanced one bounded device-budget slice per drain — reads
+        # and small fits interleave between slices by construction
+        self.catalog_jobs: dict[str, Any] = {}
+        self._catalog_seq = 0
+
+    # ------------------------------------------------------------------
+    # catalog workloads: the long-job surface (ISSUE 14)
+    # ------------------------------------------------------------------
+    def submit_catalog(self, request):
+        """Accept one long-running catalog joint fit; returns a
+        :class:`pint_tpu.catalog.job.CatalogHandle`.
+
+        Nothing runs here — the job advances in bounded slices
+        (``PINT_TPU_CATALOG_SLICE_S``) at the END of every
+        :meth:`drain` (and via :meth:`advance_catalog` standalone), so
+        reads (which drain FIRST) and small-fit batches keep flowing
+        while the catalog fit is in progress: long jobs never starve
+        the fast lanes."""
+        from pint_tpu.catalog.job import CatalogHandle, CatalogJob
+
+        self._catalog_seq += 1
+        job_id = (f"cat-{self.host_id or 'local'}-"
+                  f"{self._catalog_seq}")
+        job = CatalogJob(request, job_id, host_id=self.host_id,
+                         devices=self.devices)
+        self.catalog_jobs[job_id] = job
+        telemetry.inc("catalog.jobs")
+        return CatalogHandle(job)
+
+    def adopt_catalog(self, checkpoint: dict):
+        """Resume a checkpointed catalog job as this host's own (the
+        fleet failover path): the catalog regenerates from the spec,
+        pre-checkpoint iterations are accounted (never re-run), and
+        the job keeps advancing under this host's slices."""
+        from pint_tpu.catalog.job import CatalogHandle, CatalogJob
+
+        job = CatalogJob.from_checkpoint(
+            checkpoint, host_id=self.host_id, devices=self.devices)
+        self.catalog_jobs[job.job_id] = job
+        telemetry.inc("catalog.adopted")
+        return CatalogHandle(job)
+
+    def advance_catalog(self, budget_s: float | None = None
+                        ) -> list[dict]:
+        """Advance every live catalog job by at most one device-budget
+        slice each; returns their progress dicts. Called by every
+        :meth:`drain` after the fit pipeline resolves; callable
+        standalone for a dedicated long-job pump loop."""
+        out = []
+        for job in list(self.catalog_jobs.values()):
+            if job.state not in ("done", "failed"):
+                with telemetry.span("catalog.slice", job=job.job_id):
+                    job.advance(budget_s)
+            out.append(job.progress())
+        return out
+
+    def catalog_progress(self, job_id: str) -> dict | None:
+        job = self.catalog_jobs.get(job_id)
+        return None if job is None else job.progress()
+
+    def catalog_checkpoint(self, job_id: str) -> dict | None:
+        """The job's latest checkpoint (the router stashes it after
+        every slice so a host death resumes instead of restarting)."""
+        job = self.catalog_jobs.get(job_id)
+        if job is None:
+            return None
+        return job._last_checkpoint or job.checkpoint()
 
     # ------------------------------------------------------------------
     # durable sessions: the replication/adoption surface (ISSUE 13)
@@ -654,6 +722,9 @@ class ThroughputScheduler:
             "devices": self.n_devices,
             "sessions": len(self.sessions.entries),
             "replicas": len(self.replicas),
+            "catalog_jobs": sum(
+                1 for j in self.catalog_jobs.values()
+                if j.state not in ("done", "failed")),
             "last_drain_wall_s": (self.last_drain or {}).get("wall_s"),
             "program_misses": int(
                 counter_value("cache.fit_program.miss") or 0),
@@ -675,9 +746,15 @@ class ThroughputScheduler:
 
         A :class:`PredictRequest` routes to the READ lane instead: its
         own bounded queue, drained by :meth:`drain_reads` ahead of any
-        fit batch — reads never queue behind fit drains."""
+        fit batch — reads never queue behind fit drains. A
+        :class:`~pint_tpu.catalog.job.CatalogFitRequest` routes to the
+        LONG-JOB lane (:meth:`submit_catalog`)."""
+        from pint_tpu.catalog.job import CatalogFitRequest
+
         if isinstance(request, PredictRequest):
             return self._submit_read(request)
+        if isinstance(request, CatalogFitRequest):
+            return self.submit_catalog(request)
         degraded = self.degraded()
         cap = self.max_queue if not degraded else max(1, self.max_queue // 2)
         if len(self._queue) >= cap:
@@ -1271,7 +1348,7 @@ class ThroughputScheduler:
             entry, status="ok" if conv else "nonconverged", plan=plan,
             chi2=chi2, converged=conv, attempts=2, passthrough=True)
 
-    def drain(self) -> list[FitResult]:
+    def drain(self, *, advance_catalog: bool = True) -> list[FitResult]:
         """Fit every queued request; resolve handles; empty the queue.
 
         Batches flow through the double-buffered pipeline: host prep of
@@ -1280,6 +1357,14 @@ class ThroughputScheduler:
         order (batch execution order is a scheduling detail). Every
         request resolves to a structured status — a fault in one batch
         salvages its own members and never strands the rest.
+
+        ``advance_catalog=False`` (the fleet transports' drain path)
+        skips the end-of-drain catalog slice: the router advances long
+        jobs through its OWN ``advance_catalog`` op under the generous
+        slow-path deadline — embedding a slice (minutes of joint-fit
+        work at catalog scale) inside the fit-drain RPC would blow the
+        fit-sized wire deadline and falsely suspect a working host,
+        and the job would advance twice per router drain.
         """
         from pint_tpu.telemetry import recorder
 
@@ -1292,6 +1377,11 @@ class ThroughputScheduler:
         else:
             self._emit_read_record()
         if not self._queue:
+            # no fit batches this drain: the catalog jobs still get
+            # their slice (a drain loop with only long-job traffic
+            # must make progress)
+            if advance_catalog and self.catalog_jobs:
+                self.advance_catalog()
             return []
         queue, self._queue = self._queue, []
         self._drain_seq += 1
@@ -1733,6 +1823,23 @@ class ThroughputScheduler:
             }
             telemetry.inc("serve.session.drains")
 
+        # catalog slice (ISSUE 14): long jobs advance AFTER this
+        # drain's reads and fit batches resolved — bounded by the
+        # device-budget slice, so a drain's wall is small-fit work
+        # plus at most one slice, never the whole joint fit
+        catalog_block = None
+        if advance_catalog and self.catalog_jobs:
+            prog = self.advance_catalog()
+            catalog_block = {
+                "jobs": len(prog),
+                "running": sum(p["state"] == "running" for p in prog),
+                "done": sum(p["state"] == "done" for p in prog),
+                "failed": sum(p["state"] == "failed" for p in prog),
+                "iterations": sum(p["iterations"] for p in prog),
+                "checkpoints": sum(p["checkpoints"] for p in prog),
+                "resumes": sum(p["resumes"] for p in prog),
+            }
+
         statuses: dict[str, int] = {}
         for r in results:
             statuses[r.status] = statuses.get(r.status, 0) + 1
@@ -1778,6 +1885,7 @@ class ThroughputScheduler:
                     for d, s in sorted(self._dev_streak.items())},
             },
             **({"sessions": sessions_block} if sessions_block else {}),
+            **({"catalog": catalog_block} if catalog_block else {}),
             "batch_detail": [
                 {"kind": p.kind, "group": p.group,
                  "toa_bucket": p.toa_bucket, "real": len(p.indices),

@@ -321,6 +321,61 @@ def read_summary(records: list[dict]) -> dict:
     }
 
 
+def catalog_summary(records: list[dict]) -> dict:
+    """Catalog long-job rollup (ISSUE 14) from ``type="longjob"``
+    records: per-job iteration/accept counts, per-iteration wall
+    percentiles, checkpoint and resume totals, grid-point progress and
+    final chi2 — the progress ledger of the joint PTA fits a run
+    served. Records predating catalog workloads simply contribute
+    nothing — old artifacts degrade gracefully."""
+    jobs: dict[str, dict] = {}
+    events = 0
+    walls: list[float] = []
+    for r in records:
+        if r.get("type") != "longjob":
+            continue
+        events += 1
+        jid = str(r.get("job") or "?")
+        j = jobs.setdefault(jid, {
+            "job": jid, "events": 0, "iterations": 0, "accepts": 0,
+            "checkpoints": 0, "resumes": 0, "chi2": None,
+            "hosts": set(), "grid_points": None, "grid_done": 0,
+            "n_pulsars": None, "ntoas": None})
+        j["events"] += 1
+        j["iterations"] = max(j["iterations"],
+                              int(r.get("iter") or 0))
+        j["accepts"] = max(j["accepts"], int(r.get("accepts") or 0))
+        j["checkpoints"] = max(j["checkpoints"],
+                               int(r.get("checkpoints") or 0))
+        j["resumes"] = max(j["resumes"], int(r.get("resumes") or 0))
+        if r.get("chi2") is not None:
+            j["chi2"] = float(r["chi2"])
+        if r.get("host"):
+            j["hosts"].add(str(r["host"]))
+        if r.get("n_pulsars") is not None:
+            j["n_pulsars"] = int(r["n_pulsars"])
+        if r.get("ntoas") is not None:
+            j["ntoas"] = int(r["ntoas"])
+        if r.get("grid_points") is not None:
+            j["grid_points"] = int(r["grid_points"])
+        if r.get("event") == "grid_point":
+            j["grid_done"] += 1
+        if r.get("event") == "iteration" and r.get("wall_s") is not None:
+            walls.append(float(r["wall_s"]))
+    for j in jobs.values():
+        j["hosts"] = sorted(j["hosts"])
+    return {
+        "events": events, "jobs": list(jobs.values()),
+        "iterations_recorded": len(walls),
+        "total_iterations": sum(j["iterations"] for j in jobs.values()),
+        "checkpoints": sum(j["checkpoints"] for j in jobs.values()),
+        "resumes": sum(j["resumes"] for j in jobs.values()),
+        "p50_iter_wall_s": _pct(walls, 50),
+        "p95_iter_wall_s": _pct(walls, 95),
+        "max_iter_wall_s": (round(max(walls), 6) if walls else None),
+    }
+
+
 def fleet_summary(records: list[dict]) -> dict:
     """Fleet-tier rollup (ISSUE 12) from ``type="fleet"`` router drain
     records: per-host request/queue/failure state, route split (sticky
@@ -748,6 +803,33 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no read records)")
 
+    ct = summary.get("catalog") or {}
+    if ct.get("events"):
+        lines.append("\n== catalog workloads (long jobs) ==")
+        lines.append(
+            f"  {len(ct['jobs'])} job(s), {ct['total_iterations']} "
+            f"iteration(s), {ct['checkpoints']} checkpoint(s), "
+            f"{ct['resumes']} resume(s)")
+        if ct.get("p50_iter_wall_s") is not None:
+            lines.append(
+                f"  iteration wall over {ct['iterations_recorded']} "
+                f"iteration(s): p50 {ct['p50_iter_wall_s']}s, "
+                f"p95 {ct['p95_iter_wall_s']}s, "
+                f"max {ct['max_iter_wall_s']}s")
+        for j in ct["jobs"]:
+            size = (f" ({j['n_pulsars']} psr / {j['ntoas']} TOAs)"
+                    if j.get("n_pulsars") else "")
+            grid = (f", grid {j['grid_done']}/{j['grid_points']}"
+                    if j.get("grid_points") else "")
+            hosts = ("+".join(j["hosts"]) if j.get("hosts") else "-")
+            chi2 = (f", chi2 {j['chi2']:.6g}"
+                    if j.get("chi2") is not None else "")
+            lines.append(
+                f"    {j['job']}{size}: {j['iterations']} iter / "
+                f"{j['accepts']} accept(s), {j['checkpoints']} "
+                f"ckpt(s), {j['resumes']} resume(s) on [{hosts}]"
+                f"{grid}{chi2}")
+
     fl = summary.get("fleet") or {}
     if fl.get("drains"):
         lines.append("\n== fleet tier (multi-host routing) ==")
@@ -892,6 +974,7 @@ def build_summary(paths: list[str], bench_path: str | None,
         "passthrough": passthrough_rollup(records),
         "sessions": sessions_summary(records),
         "reads": read_summary(records),
+        "catalog": catalog_summary(records),
         "fleet": fleet_summary(records),
         "mesh": mesh_summary(records),
         "faults": fault_summaries(records),

@@ -1,27 +1,41 @@
-"""North-star scale proof (VERDICT round-2 task 3): run on CPU, commit JSON.
+"""North-star scale proof: run on CPU, commit JSON.
 
-Two configurations nothing in the repo had ever executed at full size:
+Since ISSUE 14 every PTA-shaped dataset here comes from the ONE seeded
+catalog generator (``pint_tpu.catalog.generate`` — the par/tim-
+equivalent in-memory catalog with manifest), replacing this script's
+original hand-assembled setup; and the 68-pulsar joint fit runs
+THROUGH THE SERVE LAYER as a checkpointing long job, not as a script
+loop. Configurations:
 
-1. ``gls600k`` — single-pulsar GLS at 6x10^5 TOAs (150k 4-TOA ECORR
-   epochs, 30 red-noise harmonics) through the hybrid path
-   (``HybridGLSFitter``: CPU DD phase/design -> solve on the configured
-   accelerator; both CPU here).  Proves the O(n) device-side-basis
-   design has no dense-basis memory cliff (the host dense T at this size
-   would be ~6e5 x 300k-epoch-cols ~ 20 GB) and records the
-   per-iteration wall clock the <30 s north-star budget scales from.
-2. ``pta68`` — 68-pulsar joint PTA GLS (~6x10^5 TOAs total) with
-   per-pulsar ECORR + PLRedNoise and an HD-correlated GW background
-   (``PTAGLSFitter``).  All 68 pulsars share one model structure, so the
-   per-pulsar Gram runs as 68 calls of ONE compiled program; the (Q,Q)
-   HD-coupled core is a single Cholesky.  Records the gram-loop and
-   core-solve wall clocks separately (VERDICT Weak #8 asked for the
-   68-pulsar gram-loop number).
+1. ``gls600k`` — single-pulsar GLS at 6x10^5 TOAs (clustered 4-TOA
+   ECORR epochs, 30 red-noise harmonics) through the hybrid path
+   (``HybridGLSFitter``); the per-iteration wall the <30 s north-star
+   budget scales from. Dataset = a 1-member catalog.
+2. ``gls600k_sharded8`` — the same member through ``ShardedGLSFitter``
+   on an 8-virtual-device mesh (chi2 parity vs dense, per-device
+   bytes; the SCALE_r06 honest-wall convention — virtual devices on
+   this host share its core(s), so the wall is overhead-inclusive).
+3. ``catalog68`` — the ISSUE-14 headline: the 68 psr / ~6e5 TOA
+   catalog (ECORR + red noise + injected HD-correlated GW) fitted as a
+   SERVED long job: ``ThroughputScheduler.submit(CatalogFitRequest)``,
+   advanced in bounded slices through ordinary drains with a
+   concurrent small-fit + read drain between slices (read p50
+   recorded), pulsar-major stacked mesh placement (per-device bytes),
+   per-iteration walls + chi2 from the ``type="longjob"`` progress
+   stream, chi2 parity vs the dense O(n^3) covariance oracle on a
+   4-pulsar subset, a mid-fit HOST-KILL trial (2-host loopback fleet:
+   the job resumes from its last checkpoint on the survivor — parity
+   + iteration accounting vs an unkilled control), and an 8-point
+   noise hypergrid over one catalog sharing ONE compiled gram program
+   (program-cache counter-pinned).
+4. ``batched_het`` — full-size heterogeneous batched WLS (unchanged
+   scale case behind the 57-TOA suite test).
 
 Each config runs in its own subprocess so ``ru_maxrss`` is a clean
-per-config peak.  Output: one JSON line per config; no-arg mode runs
-both and writes ``SCALE_r03.json``.
+per-config peak. Output: one JSON line per config; no-arg mode runs
+all and writes ``SCALE_r14.json``.
 
-Run: ``python scale_proof.py [gls600k|pta68]``
+Run: ``python scale_proof.py [gls600k|gls600k_sharded8|catalog68|batched_het]``
 """
 
 from __future__ import annotations
@@ -46,51 +60,6 @@ import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
 
-SINGLE_PAR = """
-PSRJ           J1748-2021E
-RAJ             17:48:52.75  1
-DECJ           -20:21:29.0  1
-F0             61.485476554  1
-F1             -1.181D-15  1
-PEPOCH        53750.000000
-POSEPOCH      53750.000000
-DM              223.9  1
-EPHEM          DE421
-UNITS          TDB
-TZRMJD  53801.38605120074849
-TZRFRQ  1949.609
-TZRSITE 1
-EFAC 1.1
-ECORR 1.2
-TNREDAMP -13.5
-TNREDGAM 3.5
-TNREDC 30
-"""
-
-# one structure for all 68 pulsars: identical frozen params (PEPOCH,
-# TZR*, noise hyperparameters) so PTAGLSFitter's structure-keyed cache
-# compiles ONE gram executable; sky position / F0 / DM are free and flow
-# through the traced inputs
-PTA_PAR_TMPL = """
-PSRJ           FAKE{i:02d}
-RAJ            {raj}  1
-DECJ           {decj}  1
-F0             {f0}  1
-F1             -1.2D-15  1
-PEPOCH        53750.000000
-DM             {dm}  1
-EPHEM          DE421
-UNITS          TDB
-TZRMJD  53801.0
-TZRFRQ  1400.0
-TZRSITE gbt
-EFAC -f fake 1.1
-ECORR -f fake 0.9
-TNREDAMP -13.6
-TNREDGAM 3.1
-TNREDC 30
-"""
-
 N_PSR = int(os.environ.get("PINT_TPU_SCALE_PSRS", "68"))
 N_PER_PSR = int(os.environ.get("PINT_TPU_SCALE_N_PER_PSR", "8824"))
 N_SINGLE = int(os.environ.get("PINT_TPU_SCALE_N", "600000"))
@@ -102,35 +71,16 @@ def _rss_gb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
-def _clustered_mjds(n: int, seed: int, lo=50000.0, hi=58000.0):
-    """4-TOA epochs within 0.5 s — the ECORR shape of the bench."""
-    rng = np.random.default_rng(seed)
-    n_epochs = max(1, (n + 3) // 4)
-    centers = np.sort(rng.uniform(lo, hi, size=n_epochs))
-    offsets = rng.uniform(0.0, 0.5 / 86400.0, size=(n_epochs, 4))
-    return (centers[:, None] + offsets).ravel()[:n]
+def _single_member(n: int):
+    """One 6e5-TOA ECORR+red pulsar from the catalog generator (the
+    gls600k dataset — a 1-member catalog, no GW injection)."""
+    from pint_tpu.catalog import CatalogSpec, generate_catalog
 
-
-def _simulate(par: str, n: int, seed: int, *, flag=None, niter=2):
-    import dataclasses
-
-    from pint_tpu.models import get_model
-    from pint_tpu.ops.dd import DD
-    from pint_tpu.simulation import make_fake_toas_from_arrays
-    from pint_tpu.toas import Flags
-
-    model = get_model(par)
-    rng = np.random.default_rng(seed)
-    mjds = _clustered_mjds(n, seed)
-    freqs = np.where(rng.random(n) < 0.5, 1400.0, 430.0)
-    toas = make_fake_toas_from_arrays(
-        DD(np.asarray(mjds), np.zeros(n)), model,
-        freq_mhz=freqs, error_us=1.0, obs="gbt",
-        add_noise=True, seed=seed, niter=niter)
-    if flag:
-        toas = dataclasses.replace(
-            toas, flags=Flags(dict(d, **flag) for d in toas.flags))
-    return model, toas
+    spec = CatalogSpec(n_pulsars=1, toas_per_pulsar=n, seed=0,
+                       mix=("ecorr_red",), red_nharm=30,
+                       gw_log10_amp=None)
+    m = generate_catalog(spec).members[0]
+    return m.model, m.toas
 
 
 def run_gls600k() -> dict:
@@ -138,7 +88,7 @@ def run_gls600k() -> dict:
 
     n = N_SINGLE
     t0 = time.perf_counter()
-    model, toas = _simulate(SINGLE_PAR, n, seed=0)
+    model, toas = _single_member(n)
     build_s = time.perf_counter() - t0
 
     f = HybridGLSFitter(toas, model)
@@ -171,40 +121,19 @@ def run_gls600k() -> dict:
     }
 
 
-def _pta_sky(i: int):
-    """Golden-spiral sky coverage -> (raj, decj) sexagesimal strings."""
-    golden = (1 + 5 ** 0.5) / 2
-    ra_h = (24.0 * ((i / golden) % 1.0))
-    dec_d = np.degrees(np.arcsin(2 * (i + 0.5) / N_PSR - 1.0))
-    h = int(ra_h)
-    m = int((ra_h - h) * 60)
-    s = ((ra_h - h) * 60 - m) * 60
-    sign = "-" if dec_d < 0 else ""
-    ad = abs(dec_d)
-    dd_ = int(ad)
-    dm = int((ad - dd_) * 60)
-    ds = ((ad - dd_) * 60 - dm) * 60
-    return (f"{h:02d}:{m:02d}:{s:07.4f}",
-            f"{sign}{dd_:02d}:{dm:02d}:{ds:07.4f}")
-
-
 def run_gls600k_sharded8() -> dict:
-    """6e5 TOAs through ``ShardedGLSFitter`` on an 8-virtual-device mesh.
-
-    The judge's missing scale proof (round-5 VERDICT Weak #3: the
-    sharded GLS fitter had never executed above toy n). Asserts chi2
-    parity with the dense/hybrid path at the zero-delta linearization
-    point (deterministic — no damping-depth ambiguity), records
-    per-device array bytes of the sharded operands, the 1-vs-8-device
-    iteration walls, and a full damped ``fit_toas`` through the fitter
-    API. ``main()`` arms ``--xla_force_host_platform_device_count=8``
-    for this config's subprocess.
+    """6e5 TOAs through ``ShardedGLSFitter`` on an 8-virtual-device
+    mesh: chi2 parity vs the dense/hybrid path at the zero-delta
+    linearization point, per-device bytes, 1-vs-8-device iteration
+    walls, and a full damped ``fit_toas``. ``main()`` arms
+    ``--xla_force_host_platform_device_count=8`` for this subprocess.
     """
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from pint_tpu.bucketing import bucket_size, pad_toas
-    from pint_tpu.fitting.gls_step import (NoiseStatics, build_noise_statics,
+    from pint_tpu.fitting.gls_step import (NoiseStatics,
+                                           build_noise_statics,
                                            jitted_gls_step,
                                            pad_noise_statics)
     from pint_tpu.fitting.hybrid import HybridGLSFitter
@@ -218,7 +147,7 @@ def run_gls600k_sharded8() -> dict:
                 "error": f"needs 8 virtual devices, have {n_dev} (set "
                          "XLA_FLAGS=--xla_force_host_platform_device_count=8)"}
     t0 = time.perf_counter()
-    model, toas = _simulate(SINGLE_PAR, n, seed=0)
+    model, toas = _single_member(n)
     build_s = time.perf_counter() - t0
 
     # dense/hybrid reference: noise-marginalized chi2 at zero deltas
@@ -230,8 +159,6 @@ def run_gls600k_sharded8() -> dict:
     del f_h, sol
 
     def mesh_run(n_devices: int) -> dict:
-        """One compiled sharded step on an n_devices mesh: compile wall,
-        best iteration wall, chi2 at zero deltas, per-device bytes."""
         mesh = make_mesh(n_devices, psr_axis=1)
         n_target = bucket_size(n, multiple=n_devices)
         noise, pl_specs = build_noise_statics(model, toas)
@@ -273,9 +200,6 @@ def run_gls600k_sharded8() -> dict:
     r1 = mesh_run(1)
     rel = abs(r8["chi2_at_zero"] - chi2_dense) / abs(chi2_dense)
 
-    # the fitter-API proof: a full damped fit through ShardedGLSFitter
-    # (reuses the compiled 8-device step — same structure, shape,
-    # sharding)
     f = ShardedGLSFitter(toas, model, mesh=make_mesh(8, psr_axis=1))
     t0 = time.perf_counter()
     chi2_fit = f.fit_toas(maxiter=3)
@@ -300,50 +224,236 @@ def run_gls600k_sharded8() -> dict:
     }
 
 
-def run_pta68() -> dict:
-    from pint_tpu.parallel.pta import PTAGLSFitter
+def _dense_subset_oracle(job) -> dict:
+    """chi2 parity vs the brute-force dense covariance on the job's
+    (small) catalog — the acceptance oracle of the served joint fit."""
+    import jax.numpy as jnp
 
-    t0 = time.perf_counter()
-    problems = []
-    for i in range(N_PSR):
-        raj, decj = _pta_sky(i)
-        par = PTA_PAR_TMPL.format(i=i, raj=raj, decj=decj,
-                                  f0=100.0 + 7.3 * i, dm=15.0 + 3.1 * i)
-        model, toas = _simulate(par, N_PER_PSR, seed=100 + i,
-                                flag={"f": "fake"})
-        problems.append((toas, model))
-    build_s = time.perf_counter() - t0
+    from pint_tpu.fitting.gls_step import fourier_design, powerlaw_phi
+    from pint_tpu.parallel.pta import _psr_pos_icrs, hd_matrix
+    from pint_tpu.residuals import Residuals
 
-    f = PTAGLSFitter(problems, gw_log10_amp=GW_AMP, gw_gamma=GW_GAM,
-                     gw_nharm=GW_NHARM)
-    t0 = time.perf_counter()
-    grams = f._grams()          # includes the one-time compile
-    jax.block_until_ready(grams[-1]["S"])
-    gram_compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    grams = f._grams()
-    jax.block_until_ready(grams[-1]["S"])
-    gram_loop_s = time.perf_counter() - t0
+    problems = job.catalog.joint_problems()
+    models = [m for _t, m in problems]
+    gw = job.fitter.gw
+    rs, Ns, Ts, phis, Fs = [], [], [], [], []
+    for (toas, _), model in zip(problems, models):
+        r = np.asarray(Residuals(toas, model,
+                                 subtract_mean=False).time_resids)
+        w = 1.0 / np.square(np.asarray(
+            model.scaled_toa_uncertainty(toas)))
+        rs.append(r - np.sum(r * w) / np.sum(w))
+        Ns.append(1.0 / w)
+        Ts.append(np.asarray(model.noise_model_designmatrix(toas)))
+        phis.append(np.asarray(model.noise_model_basis_weight(toas)))
+        t_s = jnp.asarray((toas.tdb.hi + toas.tdb.lo) * 86400.0)
+        F, _f, _df = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
+                                    tspan=gw.tspan_s)
+        Fs.append(np.asarray(F))
+    sizes = [len(r) for r in rs]
+    off = np.concatenate([[0], np.cumsum(sizes)])
+    C = np.zeros((off[-1], off[-1]))
+    for i in range(len(rs)):
+        s = slice(off[i], off[i + 1])
+        C[s, s] = np.diag(Ns[i]) + (Ts[i] * phis[i]) @ Ts[i].T
+    pos = np.stack([_psr_pos_icrs(m) for m in models])
+    Gam = hd_matrix(pos)
+    f = np.arange(1, gw.nharm + 1) / gw.tspan_s
+    phi_gw = np.repeat(np.asarray(powerlaw_phi(
+        jnp.asarray(f), gw.log10_amp, gw.gamma, 1.0 / gw.tspan_s)), 2)
+    for a in range(len(rs)):
+        for b in range(len(rs)):
+            C[off[a]:off[a + 1], off[b]:off[b + 1]] += (
+                Gam[a, b] * (Fs[a] * phi_gw) @ Fs[b].T)
+    rfull = np.concatenate(rs)
+    chi2_ref = float(rfull @ np.linalg.solve(C, rfull))
+    rel = abs(job.chi2 - chi2_ref) / abs(chi2_ref)
+    return {"n_pulsars": len(models), "ntoas": int(off[-1]),
+            "chi2_served": float(job.chi2), "chi2_dense": chi2_ref,
+            "chi2_rel_diff": rel, "parity_ok": bool(rel < 1e-6)}
 
-    # ONE fused joint step = gram pass + arrow elimination + GW-core
-    # solve + noise-only merit (the per-iteration unit the damped
-    # fit_toas loop repeats ~2x per accepted iteration)
-    deltas0 = f.zero_flat()
+
+def run_catalog68() -> dict:
+    """The served 68-pulsar joint fit (docstring item 3)."""
+    import copy as _copy
+
+    from pint_tpu import telemetry
+    from pint_tpu.catalog import (CatalogFitRequest, CatalogJob,
+                                  CatalogSpec)
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import (FitRequest, PredictRequest,
+                                ThroughputScheduler)
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    telemetry.configure(enabled=True)
+    n_dev = len(jax.devices())
+    spec = CatalogSpec(n_pulsars=N_PSR, toas_per_pulsar=N_PER_PSR,
+                       seed=0, mix=("ecorr_red",), red_nharm=30,
+                       gw_log10_amp=GW_AMP, gw_gamma=GW_GAM,
+                       gw_nharm=GW_NHARM)
+    req = CatalogFitRequest(spec=spec, gw_log10_amp=GW_AMP,
+                            gw_gamma=GW_GAM, gw_nharm=GW_NHARM,
+                            maxiter=2)
+    # one iteration per slice: each drain = one joint iteration plus
+    # whatever small-fit/read traffic queued meanwhile
+    os.environ["PINT_TPU_CATALOG_SLICE_S"] = "0.0"
+    s = ThroughputScheduler(max_queue=32)
     t0 = time.perf_counter()
-    _, info = f.step(deltas0)
-    fit_iter_s = time.perf_counter() - t0
-    chi2 = float(info["chi2_at_input"])
-    q_list = [int(g["S"].shape[0]) for g in grams]
+    h = s.submit(req)
+
+    # concurrent small-fit + read traffic served BETWEEN slices
+    par = ("PSRJ FAKE_CO\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    truth = get_model(par)
+    co_toas = make_fake_toas_uniform(53000, 56000, 200, truth, obs="@",
+                                     freq_mhz=1400.0, error_us=2.0,
+                                     add_noise=True, seed=42)
+    co_model = get_model(par)
+    co_handle = s.submit(FitRequest(co_toas, co_model, maxiter=8,
+                                    min_chi2_decrease=1e-5))
+    mjds = np.sort(np.random.default_rng(43).uniform(
+        54000.001, 54000.999, 256))
+    n_drains = 0
+    read_ok = 0
+    small_fit_status = None
+    warmed = False
+    while not h.done() and n_drains < 20:
+        s.drain()
+        n_drains += 1
+        if co_handle.done() and small_fit_status is None:
+            small_fit_status = co_handle.result().status
+        if not h.done():
+            if not warmed:
+                # one unmeasured warm-up against the NOW-FITTED model:
+                # the cold segment-cache build + compile is the read
+                # path's own one-time cost (BENCH_r14); this config
+                # measures warm reads CONCURRENT with the long job
+                s.predict(PredictRequest(mjds, model=co_model))
+                s.read_stats()  # flush the warm-up out of the window
+                warmed = True
+            r = s.predict(PredictRequest(mjds, model=co_model))
+            read_ok += r.status == "ok"
+    total_wall = time.perf_counter() - t0
+    read_rec = s.read_stats() or {}
+    res = h.result()
+    job = h.job
+    per_dev = job.fitter.per_device_bytes()
+    stacked = job.fitter._psr_stacked is not None
+
+    # --- subset oracle: served fit vs the dense covariance ----------
+    sub_spec = CatalogSpec(n_pulsars=4, toas_per_pulsar=256, seed=0,
+                           mix=("ecorr_red",), red_nharm=8,
+                           gw_log10_amp=GW_AMP, gw_gamma=GW_GAM,
+                           gw_nharm=6)
+    sub_req = CatalogFitRequest(spec=sub_spec, gw_log10_amp=GW_AMP,
+                                gw_gamma=GW_GAM, gw_nharm=6, maxiter=5)
+    sub_job = CatalogJob(sub_req, "subset-oracle")
+    while not sub_job.advance(1e9):
+        pass
+    oracle = _dense_subset_oracle(sub_job)
+
+    # --- mid-fit host-kill trial (2-host loopback fleet) ------------
+    from pint_tpu.fleet.router import FleetRouter
+    from pint_tpu.fleet.transport import LoopbackHost
+
+    kill_spec = CatalogSpec(n_pulsars=8, toas_per_pulsar=256, seed=1,
+                            mix=("ecorr_red",), red_nharm=8,
+                            gw_log10_amp=GW_AMP, gw_gamma=GW_GAM,
+                            gw_nharm=6)
+    kill_req = CatalogFitRequest(spec=kill_spec, gw_log10_amp=GW_AMP,
+                                 gw_gamma=GW_GAM, gw_nharm=6,
+                                 maxiter=8, min_chi2_decrease=0.0)
+    ctrl = CatalogJob(kill_req, "kill-ctrl")
+    while not ctrl.advance(1e9):
+        pass
+    hosts = [LoopbackHost("w0", max_queue=8, mesh_devices=1),
+             LoopbackHost("w1", max_queue=8, mesh_devices=1)]
+    router = FleetRouter(hosts)
+    kh = router.submit_catalog(kill_req)
+    router.drain()
+    router.drain()
+    pre_kill_iters = kh.progress()["iterations"]
+    owner = kh.host
+    next(t for t in hosts if t.host_id == owner).kill()
+    n = 0
+    while not kh.done() and n < 40:
+        router.drain()
+        n += 1
+    kp = kh.progress()
+    kill_trial = {
+        "owner_killed": owner, "finished_on": kp["host"],
+        "pre_kill_iterations": pre_kill_iters,
+        "iterations": kp["iterations"],
+        "control_iterations": ctrl.iterations,
+        "iterations_accounted": bool(kp["iterations"]
+                                     == ctrl.iterations),
+        "fleet_resumes": kp["fleet_resumes"],
+        "chi2": kp["chi2"], "chi2_control": ctrl.chi2,
+        "chi2_rel_vs_control": (abs(kp["chi2"] - ctrl.chi2)
+                                / max(abs(ctrl.chi2), 1e-12)),
+        "resumed_not_restarted": bool(
+            kp["fleet_resumes"] >= 1
+            and kp["iterations"] == ctrl.iterations),
+    }
+
+    # --- hypergrid: 8 points / one compiled program -----------------
+    grid = [(-14.0 + 0.2 * i, 3.9 + 0.15 * (i % 2)) for i in range(8)]
+    grid_req = CatalogFitRequest(spec=sub_spec, gw_log10_amp=GW_AMP,
+                                 gw_gamma=GW_GAM, gw_nharm=6,
+                                 maxiter=3, hypergrid=grid)
+    gjob = CatalogJob(grid_req, "grid")
+    # warm point 0 first, then pin zero compiles for points 1..7
+    while gjob.grid_idx == 0 and not gjob.advance(0.0):
+        pass
+    before = telemetry.counters_snapshot()
+    while not gjob.advance(1e9):
+        pass
+    delta = telemetry.counters_delta(before)
+    grid_misses = int(delta.get("cache.fit_program.miss", 0))
+    os.environ.pop("PINT_TPU_CATALOG_SLICE_S", None)
+
+    walls = [round(w, 3) for w in job.iter_walls]
     return {
-        "config": "pta68", "n_pulsars": N_PSR,
-        "ntoas_total": N_PSR * N_PER_PSR,
+        "config": "catalog68",
+        "manifest_id": job.catalog.manifest_id(),
+        "n_pulsars": spec.n_pulsars,
+        "ntoas_total": spec.n_pulsars * spec.toas_per_pulsar,
         "gw_nharm": GW_NHARM, "rednoise_harmonics_per_psr": 30,
-        "q_per_pulsar": q_list[0], "Q_total": int(sum(q_list)),
-        "build_s": round(build_s, 2),
-        "gram_compile_s": round(gram_compile_s, 2),
-        "gram_loop_68psr_s": round(gram_loop_s, 2),
-        "fit_iter_s": round(fit_iter_s, 2),
-        "chi2": float(chi2),
+        "served": True, "state": res["state"],
+        "iterations": res["iterations"],
+        "accepts": res["accepts"],
+        "checkpoints": res["checkpoints"],
+        "chi2": res["chi2"],
+        "iter_walls_s": walls,
+        "best_iter_wall_s": (min(walls) if walls else None),
+        "total_wall_s": round(total_wall, 2),
+        "drains": n_drains,
+        "psr_major_stacked": stacked,
+        "n_devices": n_dev,
+        "per_device_bytes": {str(k): int(v)
+                             for k, v in sorted(per_dev.items())},
+        "concurrent_small_fit_status": small_fit_status,
+        "concurrent_reads_ok": int(read_ok),
+        "read_p50_s": read_rec.get("p50_s"),
+        "read_p99_s": read_rec.get("p99_s"),
+        "wall_note": ("honest-wall convention (SCALE_r06): virtual "
+                      "devices share this host's core(s); placement/"
+                      "parity/progress proven here, physical isolation "
+                      "and the <30 s per-iteration target need real "
+                      "silicon"),
+        "subset_oracle": oracle,
+        "host_kill_trial": kill_trial,
+        "hypergrid": {
+            "points": len(grid),
+            "results": [dict(r, chi2=float(r["chi2"]))
+                        for r in gjob.grid_results],
+            "best_point": (list(gjob._grid_best["point"])
+                           if gjob._grid_best else None),
+            "program_misses_after_first_point": grid_misses,
+            "one_compiled_program": bool(grid_misses == 0),
+        },
         "peak_rss_gb": round(_rss_gb(), 2),
         "backend": jax.devices()[0].platform,
     }
@@ -352,55 +462,63 @@ def run_pta68() -> dict:
 def run_batched_het() -> dict:
     """Full-size heterogeneous batched WLS: three different model
     STRUCTURES (isolated / ELL1 binary / freq-band JUMP+EFAC) through
-    one vmapped union-model program. The suite keeps a 57-TOA version
-    (tests/test_parallel.py::test_batched_heterogeneous_matches_individual);
-    this is the scale case behind it (round-4 VERDICT task 3: one
-    full-size case per family lives here, not in the 8-minute suite).
+    one vmapped union-model program (the scale case behind
+    tests/test_parallel.py::test_batched_heterogeneous_matches_individual).
     """
+    import dataclasses as _dc
+
+    from pint_tpu.catalog.generate import clustered_mjds
+    from pint_tpu.models import get_model
+    from pint_tpu.ops.dd import DD
     from pint_tpu.parallel.batch import BatchedPulsarFitter
+    from pint_tpu.simulation import make_fake_toas_from_arrays
+    from pint_tpu.toas import Flags
 
     n = N_BATCH
-    wls_par = "\n".join(
-        ln for ln in SINGLE_PAR.splitlines()
-        if not ln.startswith(("EFAC", "ECORR", "TNRED")))
+    wls_par = ("PSRJ J1748-2021E\nRAJ 17:48:52.75  1\n"
+               "DECJ -20:21:29.0  1\nF0 {f0}  1\nF1 -1.181D-15  1\n"
+               "PEPOCH 53750.000000\nPOSEPOCH 53750.000000\n"
+               "DM 223.9  1\nEPHEM DE421\nUNITS TDB\n"
+               "TZRMJD 53801.38605120074849\nTZRFRQ 1949.609\n"
+               "TZRSITE 1\n")
     ell1 = ("BINARY ELL1\nPB 5.7410459\nA1 7.9455\nTASC 53750.0\n"
             "EPS1 2.1e-5 1\nEPS2 -1.5e-5 1\n")
     jump = "JUMP FREQ 300 500 1.0e-4 1\nEFAC FREQ 300 500 1.5\n"
     t0 = time.perf_counter()
     problems = []
     for i, extra in enumerate(("", ell1, jump)):
-        par = wls_par.replace("61.485476554", f"{61.485476554 + 0.9 * i:.9f}")
-        model, toas = _simulate(par + "\n" + extra, n, seed=200 + i)
+        par = wls_par.format(f0=f"{61.485476554 + 0.9 * i:.9f}") + extra
+        model = get_model(par)
+        rng = np.random.default_rng(200 + i)
+        mjds = clustered_mjds(n, rng, 50000.0, 58000.0)
+        freqs = np.where(rng.random(n) < 0.5, 1400.0, 430.0)
+        toas = make_fake_toas_from_arrays(
+            DD(np.asarray(mjds), np.zeros(n)), model,
+            freq_mhz=freqs, error_us=1.0, obs="gbt",
+            add_noise=True, seed=200 + i, niter=2)
         problems.append((toas, model))
     build_s = time.perf_counter() - t0
 
     f = BatchedPulsarFitter(problems)
     t0 = time.perf_counter()
-    # maxiter 10, not 3 (round-5 VERDICT Weak #6): with the ABSOLUTE
-    # decrease floor min_chi2_decrease=1e-3 and chi2 ~ 2e4, the
-    # JUMP+EFAC pulsar's extra fitted parameters keep the per-iteration
-    # decrease above the floor for >3 damped iterations, so maxiter=3
-    # sat on a knife edge (r05 recorded converged=false at the SAME
-    # chi2 the converged fit reaches). Headroom costs only warm-program
-    # executions. Regression pinned by
-    # tests/test_parallel.py::test_batched_heterogeneous_matches_individual.
-    chi2 = f.fit_toas(maxiter=10)
+    # maxiter 40: with the ABSOLUTE min_chi2_decrease=1e-3 floor at
+    # chi2 ~ 1.5e4, the JUMP+EFAC member's shallow tail (chi2 moving
+    # in the 7th significant digit per iteration) needs the headroom
+    # to cross it on this catalog-generator dataset (the SCALE_r06
+    # knife-edge note, one notch deeper); headroom costs only
+    # warm-program executions
+    chi2 = f.fit_toas(maxiter=40)
     fit_s = time.perf_counter() - t0
     return {
         "config": "batched_het", "n_pulsars": 3, "ntoas_per_psr": n,
         "structures": ["isolated", "ELL1", "JUMP+EFAC"],
         "n_union_params": len(f.free_params),
         "build_s": round(build_s, 2),
-        "maxiter": 10,
+        "maxiter": 40,
         "fit_s": round(fit_s, 2),
         "chi2": [float(c) for c in np.asarray(chi2)],
         "reduced_chi2": [round(float(c) / n, 3) for c in np.asarray(chi2)],
         "converged": [bool(b) for b in np.asarray(f.converged)],
-        "note": ("r05's converged=[..,false] member was maxiter=3 meeting "
-                 "the absolute min_chi2_decrease=1e-3 floor at chi2~2e4: "
-                 "the JUMP+EFAC structure needs a few more damped "
-                 "iterations to cross it; maxiter=10 converges at the "
-                 "same chi2"),
         "peak_rss_gb": round(_rss_gb(), 2),
         "backend": jax.devices()[0].platform,
     }
@@ -409,7 +527,7 @@ def run_batched_het() -> dict:
 def main() -> int:
     configs = {"gls600k": run_gls600k,
                "gls600k_sharded8": run_gls600k_sharded8,
-               "pta68": run_pta68,
+               "catalog68": run_catalog68,
                "batched_het": run_batched_het}
     if len(sys.argv) > 1:
         out = configs[sys.argv[1]]()
@@ -418,9 +536,10 @@ def main() -> int:
     results = []
     for cfg in configs:
         env = dict(os.environ)
-        if cfg == "gls600k_sharded8":
-            # only this config gets the virtual mesh: extra virtual
-            # devices change make_mesh defaults (and perf) elsewhere
+        if cfg in ("gls600k_sharded8", "catalog68"):
+            # the virtual mesh: sharded8 needs 8 devices; catalog68's
+            # scheduler hands its pool to the job, whose pulsar-major
+            # stacked mesh route engages on > 1 device
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                                 + " --xla_force_host_platform_device_count=8"
                                 ).strip()
@@ -437,7 +556,7 @@ def main() -> int:
            "host": f"{os.cpu_count()}-core CPU (sandbox)",
            "results": results}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "SCALE_r06.json")
+                        "SCALE_r14.json")
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(json.dumps(out))

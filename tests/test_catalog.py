@@ -1,0 +1,516 @@
+"""Catalog workloads (ISSUE 14): generator determinism, the served
+joint-fit long job (progress / checkpoint / resume), the hypergrid
+mode's program reuse, pulsar-major stacking, fleet failover, and the
+traced-DMEFAC wideband frontier."""
+
+import copy
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import telemetry
+from pint_tpu.catalog import (CatalogFitRequest, CatalogJob,
+                              CatalogSpec, generate_catalog)
+from pint_tpu.catalog.hypergrid import run_grid
+from pint_tpu.parallel import make_mesh
+from pint_tpu.parallel.pta import PTAGLSFitter
+from pint_tpu.residuals import Residuals
+from pint_tpu.serve import (FitRequest, PredictRequest,
+                            ThroughputScheduler)
+
+GW = dict(gw_log10_amp=-14.0, gw_gamma=4.33, gw_nharm=3)
+SPEC = CatalogSpec(n_pulsars=4, toas_per_pulsar=48, seed=11,
+                   red_nharm=3, gw_nharm=3)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.configure(enabled=True)
+    yield
+
+
+# ----------------------------------------------------------------------
+# generator
+# ----------------------------------------------------------------------
+
+def test_generator_determinism_bitwise_manifest():
+    a = generate_catalog(SPEC)
+    b = generate_catalog(SPEC)
+    assert (json.dumps(a.manifest(), sort_keys=True)
+            == json.dumps(b.manifest(), sort_keys=True))
+    assert a.manifest_id() == b.manifest_id()
+    c = generate_catalog(dataclasses.replace(SPEC, seed=12))
+    assert c.manifest_id() != a.manifest_id()
+    # the GW injection is part of the data identity
+    d = generate_catalog(dataclasses.replace(SPEC, gw_log10_amp=None))
+    assert d.manifest_id() != a.manifest_id()
+
+
+def test_generator_mix_and_wideband_members():
+    spec = CatalogSpec(n_pulsars=4, toas_per_pulsar=16, seed=5,
+                       mix=("ecorr_red", "wideband_dm"), red_nharm=3)
+    cat = generate_catalog(spec)
+    kinds = [m.kind for m in cat.members]
+    assert kinds == ["ecorr_red", "wideband_dm"] * 2
+    assert len(cat.joint_problems()) == 2      # narrowband only
+    wb = cat.wideband_members()
+    assert len(wb) == 2
+    for m in wb:
+        assert m.toas.is_wideband()
+        assert np.all(np.isfinite(np.asarray(m.toas.get_dm_errors())))
+    # per-member DMEFAC values vary (the mixed-value frontier fixture)
+    vals = [m.model["DMEFAC1"].value_f64 for m in wb]
+    assert vals[0] != vals[1]
+
+
+# ----------------------------------------------------------------------
+# the joint fit vs the dense oracle
+# ----------------------------------------------------------------------
+
+def _dense_chi2_at(problems, models, gw) -> float:
+    """Brute-force noise-marginalized chi2 r^T C^-1 r at the models'
+    current values (the test_pta dense-covariance oracle, with the
+    gram's scaled-weight mean-subtraction convention)."""
+    from pint_tpu.fitting.gls_step import fourier_design, powerlaw_phi
+    from pint_tpu.parallel.pta import _psr_pos_icrs, hd_matrix
+
+    rs, Ns, Ts, phis, Fs = [], [], [], [], []
+    for (toas, _), model in zip(problems, models):
+        r = np.asarray(Residuals(toas, model,
+                                 subtract_mean=False).time_resids)
+        w = 1.0 / np.square(np.asarray(
+            model.scaled_toa_uncertainty(toas)))
+        rs.append(r - np.sum(r * w) / np.sum(w))
+        Ns.append(1.0 / w)
+        Ts.append(np.asarray(model.noise_model_designmatrix(toas)))
+        phis.append(np.asarray(model.noise_model_basis_weight(toas)))
+        t_s = jnp.asarray((toas.tdb.hi + toas.tdb.lo) * 86400.0)
+        F, _f, _df = fourier_design(t_s, gw.nharm, t_ref=gw.t_ref_s,
+                                    tspan=gw.tspan_s)
+        Fs.append(np.asarray(F))
+    sizes = [len(r) for r in rs]
+    off = np.concatenate([[0], np.cumsum(sizes)])
+    C = np.zeros((off[-1], off[-1]))
+    for i in range(len(rs)):
+        s = slice(off[i], off[i + 1])
+        C[s, s] = np.diag(Ns[i]) + (Ts[i] * phis[i]) @ Ts[i].T
+    pos = np.stack([_psr_pos_icrs(m) for m in models])
+    Gam = hd_matrix(pos)
+    f = np.arange(1, gw.nharm + 1) / gw.tspan_s
+    phi_gw = np.repeat(np.asarray(powerlaw_phi(
+        jnp.asarray(f), gw.log10_amp, gw.gamma, 1.0 / gw.tspan_s)), 2)
+    for a in range(len(rs)):
+        for b in range(len(rs)):
+            C[off[a]:off[a + 1], off[b]:off[b + 1]] += (
+                Gam[a, b] * (Fs[a] * phi_gw) @ Fs[b].T)
+    rfull = np.concatenate(rs)
+    return float(rfull @ np.linalg.solve(C, rfull))
+
+
+def test_catalog_joint_fit_matches_dense_oracle():
+    cat = generate_catalog(SPEC)
+    req = CatalogFitRequest(spec=SPEC, maxiter=6, **GW)
+    job = CatalogJob(req, "oracle")
+    while not job.advance(1e9):
+        pass
+    assert job.state == "done" and not job.diverged
+    problems = job.catalog.joint_problems()
+    models = [m for _t, m in problems]
+    chi2_ref = _dense_chi2_at(problems, models, job.fitter.gw)
+    np.testing.assert_allclose(job.chi2, chi2_ref, rtol=1e-6)
+    # the fitted models carry uncertainties (write-back ran)
+    assert all(m["F0"].uncertainty is not None
+               and m["F0"].uncertainty > 0 for m in models)
+    del cat
+
+
+# ----------------------------------------------------------------------
+# progress / checkpoint / resume
+# ----------------------------------------------------------------------
+
+def test_progress_records_schema(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    try:
+        os.environ["PINT_TPU_CATALOG_SLICE_S"] = "0.0"
+        s = ThroughputScheduler(max_queue=4, mesh_devices=1)
+        h = s.submit(CatalogFitRequest(spec=SPEC, maxiter=4, **GW))
+        n = 0
+        while not h.done() and n < 40:
+            s.drain()
+            n += 1
+        assert h.done()
+        telemetry.write_rollup()
+    finally:
+        os.environ.pop("PINT_TPU_CATALOG_SLICE_S", None)
+        telemetry.configure(enabled=True, jsonl_path="")
+    recs = [json.loads(ln) for ln in open(path)]
+    long = [r for r in recs if r.get("type") == "longjob"]
+    assert long, "no longjob records emitted"
+    iters = [r for r in long if r.get("event") == "iteration"]
+    assert iters
+    for r in iters:
+        for key in ("job", "state", "iter", "accepts", "chi2",
+                    "checkpoints", "resumes", "lam", "accepted",
+                    "halvings", "wall_s", "n_pulsars", "ntoas"):
+            assert key in r, key
+        assert np.isfinite(r["chi2"])
+    # the pollable handle mirrors the same counters
+    p = h.progress()
+    assert p["state"] == "done"
+    assert p["iterations"] == max(r["iter"] for r in long)
+    assert p["checkpoints"] >= len(iters)
+    # scheduler drain record carried the catalog block at least once
+    assert h.job.state == "done"
+
+
+def test_checkpoint_resume_parity_vs_control():
+    req = CatalogFitRequest(spec=SPEC, maxiter=8,
+                            min_chi2_decrease=0.0, **GW)
+    ctrl = CatalogJob(req, "ctrl")
+    while not ctrl.advance(1e9):
+        pass
+    assert ctrl.iterations >= 3  # enough room to interrupt mid-fit
+
+    k = CatalogJob(req, "victim")
+    k.advance(0.0)   # bootstrap + 1 iteration
+    ck = k.checkpoint()
+    assert 0 < ck["iterations"] < ctrl.iterations
+    del k            # the "killed host"
+
+    r = CatalogJob.from_checkpoint(ck)
+    while not r.advance(1e9):
+        pass
+    assert r.state == "done"
+    assert r.resumes == 1 and r.resume_evals == 1
+    # iteration accounting: pre-kill work counted, never repeated
+    assert r.iterations == ctrl.iterations
+    assert r.chi2 == ctrl.chi2  # bitwise: same trajectory
+    # the resumed fitter wrote back the same solution
+    for (m_c, m_r) in zip([m for _t, m in
+                           ctrl.catalog.joint_problems()],
+                          [m for _t, m in r.catalog.joint_problems()]):
+        assert m_c["F0"].value_f64 == m_r["F0"].value_f64
+
+
+def test_scheduler_serves_reads_and_fits_during_catalog(tmp_path):
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    os.environ["PINT_TPU_CATALOG_SLICE_S"] = "0.0"
+    try:
+        s = ThroughputScheduler(max_queue=8, mesh_devices=1)
+        h = s.submit(CatalogFitRequest(spec=SPEC, maxiter=6,
+                                       min_chi2_decrease=0.0, **GW))
+        s.drain()   # one slice
+        assert not h.done()
+        par = ("PSRJ FAKE_CO\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+               "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+               "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+               "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+        truth = get_model(par)
+        toas = make_fake_toas_uniform(53000, 56000, 32, truth, obs="@",
+                                      freq_mhz=1400.0, error_us=2.0,
+                                      add_noise=True, seed=9)
+        m = get_model(par)
+        fh = s.submit(FitRequest(toas, m, maxiter=5,
+                                 min_chi2_decrease=1e-5))
+        res = s.drain()
+        assert res[0].status == "ok"          # small fit mid-catalog
+        assert (s.last_drain or {}).get("catalog", {}).get("jobs") == 1
+        # a read mid-catalog-fit: served, zero fit-loop launches
+        before = telemetry.counters_snapshot()
+        r = s.predict(PredictRequest(np.array([54000.1, 54000.2]),
+                                     model=m))
+        delta = telemetry.counters_delta(before)
+        assert r.status == "ok"
+        assert int(delta.get("fit.device_loop.launches", 0)) == 0
+        n = 0
+        while not h.done() and n < 40:
+            s.drain()
+            n += 1
+        assert h.done() and h.result()["state"] == "done"
+        assert s.report()["catalog_jobs"] == 0
+    finally:
+        os.environ.pop("PINT_TPU_CATALOG_SLICE_S", None)
+
+
+# ----------------------------------------------------------------------
+# hypergrid: program reuse + per-point parity
+# ----------------------------------------------------------------------
+
+def test_hypergrid_shares_one_program_with_per_point_parity():
+    points = [(-13.8, 3.0), (-13.4, 3.2), (-14.0, 3.6)]
+    cat = generate_catalog(SPEC)
+    f = PTAGLSFitter(cat.joint_problems(), **GW)
+    f._prepare()
+    # warm the program on point 0, then pin ZERO compiles for the rest
+    res0 = run_grid(f, points[:1], maxiter=4)
+    before = telemetry.counters_snapshot()
+    res_rest = run_grid(f, points[1:], maxiter=4)
+    delta = telemetry.counters_delta(before)
+    assert int(delta.get("cache.fit_program.miss", 0)) == 0
+    results = res0 + res_rest
+    # per-point parity vs a STANDALONE fit whose models carry the
+    # point's values as ordinary frozen hyperparameters
+    for (amp, gamma), got in zip(points, results):
+        cat_i = generate_catalog(SPEC)  # same data, fresh models
+        for _t, m in cat_i.joint_problems():
+            m["TNREDAMP"].value = (amp, 0.0)
+            m["TNREDGAM"].value = (gamma, 0.0)
+        f_i = PTAGLSFitter(cat_i.joint_problems(), **GW)
+        from pint_tpu.fitting.damped import downhill_iterate
+
+        _d, _info, chi2_i, _conv = downhill_iterate(
+            f_i.step, f_i.zero_flat(), maxiter=4)
+        np.testing.assert_allclose(got.chi2, chi2_i, rtol=1e-9)
+
+
+def test_catalog_job_hypergrid_mode_and_auto_grid():
+    req = CatalogFitRequest(spec=SPEC, maxiter=3,
+                            hypergrid=[(-13.8, 3.0), (-13.2, 3.4)],
+                            **GW)
+    job = CatalogJob(req, "grid")
+    while not job.advance(1e9):
+        pass
+    assert job.state == "done"
+    assert len(job.grid_results) == 2
+    assert all(np.isfinite(r["chi2"]) for r in job.grid_results)
+    best = min(job.grid_results, key=lambda r: r["chi2"])
+    assert job.summary()["best_point"] == list(best["point"])
+    # the sliced job driver and run_grid must agree POINT-FOR-POINT —
+    # in particular point 0 must be fitted AT grid point 0, not at the
+    # members' own hyper values (regression: the job driver skipped
+    # set_pl_params for the first point)
+    cat_ref = generate_catalog(SPEC)
+    f_ref = PTAGLSFitter(cat_ref.joint_problems(), **GW)
+    ref = run_grid(f_ref, [(-13.8, 3.0), (-13.2, 3.4)], maxiter=3)
+    for got, want in zip(job.grid_results, ref):
+        np.testing.assert_allclose(got["chi2"], want.chi2, rtol=1e-9)
+    # "auto": a free red-noise hyperparameter no longer means
+    # unservable — the grid derives from (then freezes) it
+    cat = generate_catalog(SPEC)
+    for _t, m in cat.joint_problems():
+        m["TNREDAMP"].frozen = False
+    req2 = CatalogFitRequest(catalog=cat, maxiter=2, hypergrid="auto",
+                             **GW)
+    job2 = CatalogJob(req2, "auto")
+    job2._ensure()
+    assert job2.grid_points and len(job2.grid_points) >= 8
+    for _t, m in cat.joint_problems():
+        assert m["TNREDAMP"].frozen  # retired, not fitted per-request
+
+
+# ----------------------------------------------------------------------
+# pulsar-major stacked mesh route
+# ----------------------------------------------------------------------
+
+def test_psr_major_stacked_route_matches_plain():
+    cat = generate_catalog(SPEC)
+    f_plain = PTAGLSFitter(cat.joint_problems(), **GW)
+    _nf, info_p = f_plain.step(f_plain.zero_flat())
+
+    cat2 = generate_catalog(SPEC)
+    mesh = make_mesh(4, psr_axis=2)
+    f_st = PTAGLSFitter(cat2.joint_problems(), **GW, mesh=mesh)
+    f_st._prepare()
+    assert f_st._psr_stacked is not None
+    _nf2, info_s = f_st.step(f_st.zero_flat())
+    np.testing.assert_allclose(
+        float(info_s["chi2_at_input"]),
+        float(info_p["chi2_at_input"]), rtol=1e-12)
+    # placement really is pulsar-major: >= 2 devices hold table bytes
+    by_dev = f_st.per_device_bytes()
+    assert sum(1 for v in by_dev.values() if v > 0) >= 2
+    c1 = f_plain.fit_toas(maxiter=3)
+    c2 = f_st.fit_toas(maxiter=3)
+    np.testing.assert_allclose(c2, c1, rtol=1e-10)
+
+
+def test_stacked_route_falls_back_on_heterogeneous_structures():
+    spec = dataclasses.replace(SPEC, mix=("ecorr_red", "red"))
+    cat = generate_catalog(spec)
+    mesh = make_mesh(4, psr_axis=2)
+    f = PTAGLSFitter(cat.joint_problems(), **GW, mesh=mesh)
+    f._prepare()
+    assert f._psr_stacked is None  # heterogeneous: per-pulsar route
+    _nf, info = f.step(f.zero_flat())
+    assert np.isfinite(float(info["chi2_at_input"]))
+
+
+# ----------------------------------------------------------------------
+# fleet: least-loaded routing + checkpoint failover
+# ----------------------------------------------------------------------
+
+def test_fleet_catalog_kill_resumes_from_checkpoint(monkeypatch):
+    from pint_tpu.fleet.router import FleetRouter
+    from pint_tpu.fleet.transport import LoopbackHost
+
+    monkeypatch.setenv("PINT_TPU_CATALOG_SLICE_S", "0.0")
+    req = CatalogFitRequest(spec=SPEC, maxiter=8,
+                            min_chi2_decrease=0.0, **GW)
+    ctrl = CatalogJob(req, "ctrl")
+    while not ctrl.advance(1e9):
+        pass
+
+    hosts = [LoopbackHost("w0", max_queue=8, mesh_devices=1),
+             LoopbackHost("w1", max_queue=8, mesh_devices=1)]
+    r = FleetRouter(hosts)
+    h = r.submit_catalog(req)
+    r.drain()
+    r.drain()
+    assert not h.done()
+    pre = h.progress()["iterations"]
+    assert 0 < pre < ctrl.iterations
+    owner = h.host
+    next(t for t in hosts if t.host_id == owner).kill()
+    n = 0
+    while not h.done() and n < 40:
+        r.drain()
+        n += 1
+    p = h.progress()
+    assert p["state"] == "done"
+    assert p["host"] != owner              # resumed on the survivor
+    assert p["fleet_resumes"] == 1
+    assert p["iterations"] == ctrl.iterations  # accounted, not re-run
+    assert p["chi2"] == ctrl.chi2              # bitwise parity
+    blk = (r.last_drain or {}).get("catalog")
+    assert blk and blk["jobs"] == 1
+
+
+def test_fleet_catalog_kill_before_first_slice(monkeypatch):
+    """Owner dies before any slice ran (no checkpoint): the job
+    re-submits fresh on a survivor and the ORIGINAL handle keeps
+    resolving (regression: the fresh submit's new host-local id used
+    to re-key the entry and orphan the handle)."""
+    from pint_tpu.fleet.router import FleetRouter
+    from pint_tpu.fleet.transport import LoopbackHost
+
+    monkeypatch.setenv("PINT_TPU_CATALOG_SLICE_S", "0.0")
+    req = CatalogFitRequest(spec=SPEC, maxiter=4, **GW)
+    hosts = [LoopbackHost("w0", max_queue=8, mesh_devices=1),
+             LoopbackHost("w1", max_queue=8, mesh_devices=1)]
+    r = FleetRouter(hosts)
+    h = r.submit_catalog(req)
+    owner = h.host
+    next(t for t in hosts if t.host_id == owner).kill()
+    n = 0
+    while not h.done() and n < 40:
+        r.drain()
+        n += 1
+    p = h.progress()
+    assert p["state"] == "done"
+    assert p["host"] != owner
+    assert np.isfinite(p["chi2"])
+
+
+# ----------------------------------------------------------------------
+# traced DMEFAC/DMEQUAD (satellite: the PR-10 residue)
+# ----------------------------------------------------------------------
+
+def _wb_pair():
+    spec = CatalogSpec(n_pulsars=2, toas_per_pulsar=24, seed=21,
+                       mix=("wideband_dm",), gw_log10_amp=None)
+    cat = generate_catalog(spec)
+    return cat.wideband_members()
+
+
+def test_mixed_dmefac_wideband_shares_one_batch_and_program():
+    ms = _wb_pair()
+    assert (ms[0].model["DMEFAC1"].value_f64
+            != ms[1].model["DMEFAC1"].value_f64)
+    s = ThroughputScheduler(max_queue=4, mesh_devices=1)
+    for m in ms:
+        s.submit(FitRequest(m.toas, copy.deepcopy(m.model), maxiter=4,
+                            min_chi2_decrease=1e-5))
+    plans = s.plan()
+    assert len(plans) == 1 and plans[0].kind == "batched"
+    assert len(plans[0].indices) == 2
+    res = s.drain()
+    assert all(x.status in ("ok", "nonconverged") for x in res)
+    chi2_traced = [x.chi2 for x in res]
+
+    # kill switch restores the pinned-constant split (two groups) and
+    # the SAME answers
+    os.environ["PINT_TPU_TRACE_DMEFAC"] = "0"
+    try:
+        s2 = ThroughputScheduler(max_queue=4, mesh_devices=1)
+        for m in ms:
+            s2.submit(FitRequest(m.toas, copy.deepcopy(m.model),
+                                 maxiter=4, min_chi2_decrease=1e-5))
+        plans2 = s2.plan()
+        assert len(plans2) == 2  # mixed values split compiled programs
+        res2 = s2.drain()
+        chi2_pinned = [x.chi2 for x in res2]
+    finally:
+        os.environ.pop("PINT_TPU_TRACE_DMEFAC", None)
+    np.testing.assert_allclose(chi2_traced, chi2_pinned, rtol=1e-9)
+
+
+def test_scaled_dm_sigma_np_mirrors_pinned_path():
+    from pint_tpu.bucketing import pad_toas
+    from pint_tpu.fitting.gls_step import scaled_dm_sigma_np
+    from pint_tpu.fitting.wideband import build_wb_data
+
+    m = _wb_pair()[0]
+    n_target = len(m.toas) + 5
+    mirror = scaled_dm_sigma_np(m.model, m.toas, n_target)
+    padded = pad_toas(m.toas, n_target)
+    errs = build_wb_data(m.toas, n_target)["errs"]
+    comp = [c for c in m.model.components
+            if hasattr(c, "scale_dm_sigma")]
+    assert len(comp) == 1
+    pinned = np.asarray(comp[0].scale_dm_sigma(jnp.asarray(errs),
+                                               padded))
+    np.testing.assert_allclose(mirror, pinned, rtol=1e-15)
+
+
+# ----------------------------------------------------------------------
+# report section
+# ----------------------------------------------------------------------
+
+def test_report_catalog_section_and_graceful_degradation(tmp_path):
+    from pint_tpu.telemetry.report import build_summary, render
+
+    # old artifacts (no longjob records) degrade gracefully
+    mini = os.path.join(os.path.dirname(__file__), "data",
+                        "telemetry_mini.jsonl")
+    summary = build_summary([mini], None, [], 25.0)
+    assert summary["catalog"]["events"] == 0
+    assert "catalog workloads" not in render(summary)
+
+    # synthetic longjob records roll up per job
+    path = str(tmp_path / "cat.jsonl")
+    recs = [
+        {"type": "longjob", "kind": "catalog_fit", "job": "cat-1",
+         "host": "w0", "state": "running", "event": "iteration",
+         "iter": i, "accepts": i, "chi2": 100.0 - i,
+         "checkpoints": i + 1, "resumes": 0, "lam": 1.0,
+         "accepted": True, "halvings": 0, "wall_s": 0.5,
+         "n_pulsars": 4, "ntoas": 192}
+        for i in range(1, 4)
+    ] + [{"type": "longjob", "kind": "catalog_fit", "job": "cat-1",
+          "host": "w1", "state": "running", "event": "iteration",
+          "iter": 4, "accepts": 4, "chi2": 95.0, "checkpoints": 5,
+          "resumes": 1, "lam": 1.0, "accepted": True, "halvings": 0,
+          "wall_s": 0.4, "n_pulsars": 4, "ntoas": 192}]
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    summary = build_summary([path], None, [], 25.0)
+    ct = summary["catalog"]
+    assert ct["events"] == 4
+    assert ct["total_iterations"] == 4
+    assert ct["resumes"] == 1
+    assert ct["p50_iter_wall_s"] is not None
+    [job] = ct["jobs"]
+    assert job["hosts"] == ["w0", "w1"]
+    text = render(summary)
+    assert "catalog workloads" in text
+    assert "cat-1" in text

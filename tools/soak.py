@@ -208,14 +208,16 @@ def _sim_flagged_toas(model, rng, n: int, flag_rng=None):
 def one_trial(seed: int, force_chaos: bool = False,
               force_sessions: bool = False,
               force_fleet: bool = False,
-              force_partition: bool = False) -> tuple[bool, str, dict]:
+              force_partition: bool = False,
+              force_catalog: bool = False) -> tuple[bool, str, dict]:
     """Returns (ok, failure_text, axes) — axes records which sampler
     dimensions and optional gates this trial exercised, so the committed
     SOAK JSON makes coverage auditable (round-4 VERDICT task 4).
     ``force_chaos`` (the ``--chaos`` flag) arms the fault-injection gate
     on every trial regardless of its probability draw; ``force_sessions``
-    (``--sessions``) likewise arms the sessionful-append gate, and
-    ``force_fleet`` (``--fleet``) the multi-host routing gate (every
+    (``--sessions``) likewise arms the sessionful-append gate,
+    ``force_fleet`` (``--fleet``) the multi-host routing gate, and
+    ``force_catalog`` (``--catalog``) the catalog long-job gate (every
     probability draw is still consumed, so forced and unforced runs of
     a seed exercise identical axis draws)."""
     rng = np.random.default_rng(seed)
@@ -1132,6 +1134,102 @@ def one_trial(seed: int, force_chaos: bool = False,
                              or {}).get("restores"),
             }
 
+        # catalog long-job gate (ISSUE 14): a randomized small catalog
+        # joint fit served through a 1/2/4-host fleet as a sliced,
+        # checkpointing long job, COEXISTING with small-fit and read
+        # traffic between slices. Half the multi-host trials kill the
+        # owning host mid-fit and assert the job RESUMES from its last
+        # checkpoint on a survivor (iteration count continues and the
+        # final chi2 matches an unkilled control) — never restarts.
+        # APPENDED gate, own substream.
+        if gates.random() < 0.08 or force_catalog:
+            axes["gates"].append("catalog")
+            from pint_tpu.catalog import (CatalogFitRequest, CatalogJob,
+                                          CatalogSpec)
+            from pint_tpu.fleet import build_fleet
+            from pint_tpu.serve import FitRequest, PredictRequest
+
+            crng = np.random.default_rng((seed, 14))
+            n_hosts = int(crng.choice([1, 2, 4]))
+            mix = [("ecorr_red",), ("ecorr_red", "red"),
+                   ("red",)][int(crng.integers(3))]
+            cspec = CatalogSpec(
+                n_pulsars=int(crng.choice([3, 4])),
+                toas_per_pulsar=int(crng.integers(24, 49)),
+                seed=int(crng.integers(2 ** 31)), mix=mix,
+                red_nharm=3, gw_nharm=3)
+            grid = ([(-13.9, 3.0), (-13.3, 3.4)]
+                    if crng.random() < 0.3 else None)
+            creq = CatalogFitRequest(
+                spec=cspec, gw_log10_amp=-14.0, gw_gamma=4.33,
+                gw_nharm=3, maxiter=5, min_chi2_decrease=0.0,
+                hypergrid=grid)
+            kill_cat = n_hosts > 1 and crng.random() < 0.5
+            os.environ["PINT_TPU_CATALOG_SLICE_S"] = "0.0"
+            try:
+                ctrl = CatalogJob(creq, "soak-ctrl")
+                while not ctrl.advance(1e9):
+                    pass
+                assert ctrl.state == "done" and not ctrl.diverged
+
+                crouter = build_fleet(n_hosts, max_queue=16)
+                ch = crouter.submit_catalog(creq)
+                crouter.drain()
+                crouter.drain()
+                victim_c = None
+                if kill_cat and not ch.done():
+                    victim_c = ch.host
+                    crouter.hosts[victim_c].kill()
+                # co-traffic between slices: a small fit and a read
+                # must keep flowing while the long job advances
+                m_co = get_model(par, allow_tcb=True)
+                for name, d in perturbed.items():
+                    if name in m_co.free_params:
+                        m_co[name].add_delta(d)
+                t_co = _sim_flagged_toas(m_co, crng,
+                                         int(crng.integers(40, 80)))
+                hco = crouter.submit(FitRequest(
+                    t_co, m_co, maxiter=30, min_chi2_decrease=1e-7,
+                    tag="cat_co"))
+                n_dr = 0
+                while not ch.done() and n_dr < 60:
+                    crouter.drain()
+                    n_dr += 1
+                assert ch.done(), "catalog job never finished"
+                assert hco.done() and hco.result().status in (
+                    "ok", "nonconverged"), "co-fit starved by catalog"
+                rd = crouter.predict(PredictRequest(
+                    np.array([54000.25, 54000.5]), model=m_co))
+                assert rd.status == "ok", "read failed mid-catalog"
+                pc = ch.progress()
+                assert pc["state"] == "done", pc.get("error")
+                assert abs(pc["chi2"] - ctrl.chi2) <= \
+                    1e-9 * max(1.0, abs(ctrl.chi2)), \
+                    f"catalog chi2 {pc['chi2']} != control {ctrl.chi2}"
+                if victim_c is not None:
+                    assert pc["host"] != victim_c, \
+                        "job finished on a killed host"
+                    assert pc["fleet_resumes"] >= 1, \
+                        "owner killed mid-fit but job never resumed"
+                    assert pc["iterations"] == ctrl.iterations, (
+                        "resume repeated or dropped work: "
+                        f"{pc['iterations']} vs control "
+                        f"{ctrl.iterations}")
+                axes["catalog"] = {
+                    "hosts": n_hosts, "spec": {
+                        "n_pulsars": cspec.n_pulsars,
+                        "toas_per_pulsar": cspec.toas_per_pulsar,
+                        "mix": list(cspec.mix)},
+                    "hypergrid": bool(grid),
+                    "killed_host": victim_c,
+                    "resumes": pc["resumes"],
+                    "iterations": pc["iterations"],
+                    "checkpoints": pc["checkpoints"],
+                    "chi2": pc["chi2"],
+                }
+            finally:
+                os.environ.pop("PINT_TPU_CATALOG_SLICE_S", None)
+
         # checkpoint contract: par round-trip preserves the phase model
         par2 = model.as_parfile()
         model2 = get_model(par2)
@@ -1185,6 +1283,13 @@ def main() -> int:
                          "of a kill, and the sessionful fence gate "
                          "(hang -> failover -> resume -> fenced late "
                          "commit) runs every trial")
+    ap.add_argument("--catalog", action="store_true",
+                    help="force the catalog long-job gate on every "
+                         "trial (ISSUE 14): a randomized catalog joint "
+                         "fit served in slices alongside small-fit/"
+                         "read traffic; half the multi-host trials "
+                         "kill the owning host mid-fit and assert "
+                         "checkpoint resume, not restart")
     args = ap.parse_args()
 
     import json
@@ -1207,6 +1312,7 @@ def main() -> int:
               "seed_base": args.seed, "trials_requested": args.trials,
               "chaos": args.chaos, "sessions": args.sessions,
               "fleet": args.fleet, "partition": args.partition,
+              "catalog": args.catalog,
               "n_pass": 0, "n_fail": 0, "fail_seeds": [], "trials": []}
 
     def save():
@@ -1251,7 +1357,8 @@ def main() -> int:
             ok, msg, axes = one_trial(seed, force_chaos=args.chaos,
                                       force_sessions=args.sessions,
                                       force_fleet=args.fleet,
-                                      force_partition=args.partition)
+                                      force_partition=args.partition,
+                                      force_catalog=args.catalog)
         wall = time.time() - t1
         deltas = telemetry.counters_delta(counters_before)
         repro_path = ""
