@@ -42,6 +42,7 @@ import numpy as np
 # importing pint_tpu honors an explicit JAX_PLATFORMS request despite
 # the axon sitecustomize's jax.config override (pint_tpu.setup_platform)
 import pint_tpu  # noqa: F401  (enables x64)
+from pint_tpu import config  # noqa: E402  (the PINT_TPU_* knob registry)
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
@@ -52,10 +53,23 @@ import jax.numpy as jnp  # noqa: E402
 # that column into noise across rounds.
 
 N_DEFAULT = 100_000
-INIT_TIMEOUT_S = int(os.environ.get("PINT_TPU_BENCH_INIT_TIMEOUT", "300"))
+
+
+def _env_reps(default: int) -> int:
+    """PINT_TPU_BENCH_REPS with a per-MODE default when unset (the
+    registry default is the headline mode's 5); unparseable values
+    degrade to the default like every env_int read does."""
+    raw = config.env_raw("PINT_TPU_BENCH_REPS")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+INIT_TIMEOUT_S = config.env_int("PINT_TPU_BENCH_INIT_TIMEOUT")
 # the tunnel can also hang mid-compile/mid-execute (observed), not just
 # at init: a whole-run alarm converts that into a diagnostic JSON too
-TOTAL_TIMEOUT_S = int(os.environ.get("PINT_TPU_BENCH_TOTAL_TIMEOUT", "1200"))
+TOTAL_TIMEOUT_S = config.env_int("PINT_TPU_BENCH_TOTAL_TIMEOUT")
 
 PAR = """
 PSRJ           J1748-2021E
@@ -102,8 +116,8 @@ def _telemetry_begin() -> None:
     from pint_tpu import telemetry
 
     telemetry.configure(
-        enabled=os.environ.get("PINT_TPU_TELEMETRY", "") != "0",
-        jsonl_path=os.environ.get("PINT_TPU_TELEMETRY_PATH")
+        enabled=config.env_raw("PINT_TPU_TELEMETRY") != "0",
+        jsonl_path=config.env_str("PINT_TPU_TELEMETRY_PATH")
         or "bench_telemetry.jsonl")
     _HOST_START = telemetry.host_sample()
 
@@ -464,7 +478,7 @@ def _bench_fit_loop(toas, noise, pl_specs, compiled_step,
     # The recorder state is read per launch, so flipping the env var
     # selects a differently-keyed (ring-free) compiled program; its one
     # compile is paid here, before any timed rep.
-    rec_prev = os.environ.get("PINT_TPU_FLIGHT_RECORDER")
+    rec_prev = config.env_raw("PINT_TPU_FLIGHT_RECORDER")
     rec_was_on = _recorder.active()
 
     def _set_rec(val):
@@ -1376,10 +1390,10 @@ def bench_throughput_mesh(n_fits: int, reps: int = 3) -> None:
                "host_cores": os.cpu_count(), "mode": "throughput_mesh",
                "fit_throughput_mesh": rec}
         out.update(_telemetry_fields())
-        detail_path = os.environ.get(
-            "PINT_TPU_MESH_DETAIL",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "MULTICHIP_r06.json"))
+        detail_path = (config.env_str("PINT_TPU_MESH_DETAIL")
+                       or os.path.join(
+                           os.path.dirname(os.path.abspath(__file__)),
+                           "MULTICHIP_r06.json"))
         try:
             with open(detail_path, "w") as fh:
                 json.dump(out, fh, indent=1)
@@ -1616,7 +1630,7 @@ def _bench_read_mixed(n: int = 100_000, reps: int = 3) -> dict:
     r0 = s.drain()[0]
     populate_s = time.perf_counter() - t0
     assert r0.status == "ok", r0.error
-    Q = int(os.environ.get("PINT_TPU_BENCH_READ_Q", "256"))
+    Q = config.env_int("PINT_TPU_BENCH_READ_Q")
 
     def q_batch():
         # one UTC-day cache window: every batch hits the same artifact
@@ -1952,8 +1966,8 @@ def _bench_fleet_durability(par_a: str, hyper: dict) -> tuple:
         crouter.heartbeat()      # and the rejoin is visible
         post = crouter.hosts[new_pin].session_summary(skey0)
         pdelta = _t.counters_delta(before_p)
-        budget = (float(os.environ["PINT_TPU_FLEET_OP_DEADLINE_S"])
-                  + float(os.environ["PINT_TPU_FLEET_HEARTBEAT_S"]))
+        budget = (config.env_float("PINT_TPU_FLEET_OP_DEADLINE_S")
+                  + config.env_float("PINT_TPU_FLEET_HEARTBEAT_S"))
         # the stall component: this drain vs the same pair's previous
         # (unpartitioned) append drain — the fit work cancels out
         stall_overhead = stall_wall - cwalls[-1]
@@ -2307,10 +2321,10 @@ def bench_fleet() -> None:
                "host_cores": os.cpu_count(), "mode": "fleet",
                "fleet_ab": rec}
         out.update(_telemetry_fields())
-        detail_path = os.environ.get(
-            "PINT_TPU_FLEET_DETAIL",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "FLEET_r02.json"))
+        detail_path = (config.env_str("PINT_TPU_FLEET_DETAIL")
+                       or os.path.join(
+                           os.path.dirname(os.path.abspath(__file__)),
+                           "FLEET_r02.json"))
         try:
             with open(detail_path, "w") as fh:
                 json.dump(out, fh, indent=1)
@@ -2551,10 +2565,10 @@ def _finish(record: dict) -> None:
     redirected stdout as one JSON document (tools/tpu_retry.sh) keep
     working.
     """
-    detail_path = os.environ.get(
-        "PINT_TPU_BENCH_DETAIL",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_DETAIL_r12.json"))
+    detail_path = (config.env_str("PINT_TPU_BENCH_DETAIL")
+                   or os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_DETAIL_r12.json"))
     try:
         with open(detail_path, "w") as fh:
             json.dump(record, fh, indent=1)
@@ -2577,14 +2591,14 @@ def main() -> None:
     import subprocess
     import sys
 
-    if os.environ.get("PINT_TPU_BENCH_CHILD"):
+    if config.env_on("PINT_TPU_BENCH_CHILD"):
         _main_guarded()
         return
 
     # one telemetry artifact per bench run: every child inherits the
     # path and appends (records carry pid); the parent owns — and
     # truncates — the default file so repeat runs don't accumulate
-    if not os.environ.get("PINT_TPU_TELEMETRY_PATH"):
+    if not config.env_str("PINT_TPU_TELEMETRY_PATH"):
         os.environ["PINT_TPU_TELEMETRY_PATH"] = "bench_telemetry.jsonl"
         try:
             os.unlink("bench_telemetry.jsonl")
@@ -2627,8 +2641,20 @@ def main() -> None:
             _emit({"metric": "smoke_fit_wall", "value": -1.0, "unit": "s",
                    "vs_baseline": 0.0, "smoke": True, "error": fail})
             sys.exit(1)
+        # static-invariant gate (ISSUE 15): jaxlint must run clean vs
+        # the committed baseline — a new host-sync / eager-jnp /
+        # donation / fingerprint-drift / knob finding fails CI here,
+        # at diff time, not at the next perf-artifact regression
+        lint = subprocess.run(
+            [sys.executable, "-m", "tools.analyze"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True)
+        res["jaxlint"] = {"ok": lint.returncode == 0,
+                          "findings": lint.stdout.strip().splitlines(),
+                          "stderr": (lint.stderr or "")[-400:]}
         print(json.dumps(res))
         ok = res.get("value", -1.0) > 0 and "host_polluted" in res
+        ok = ok and res["jaxlint"]["ok"]
         # serve smoke acceptance: parity proven, occupancy reported
         serve = res.get("serve") or {}
         ok = ok and serve.get("parity_ok") is True and "occupancy" in serve
@@ -2663,12 +2689,12 @@ def main() -> None:
         # mid-fit with zero fit-loop launches
         catalog = res.get("catalog") or {}
         ok = ok and catalog.get("ok") is True
-        if os.environ.get("PINT_TPU_TELEMETRY", "") != "0":
+        if config.env_raw("PINT_TPU_TELEMETRY") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
         sys.exit(0 if ok else 1)
 
-    mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
+    mode = config.env_str("PINT_TPU_BENCH_MODE")
     # match the success-metric family (pta emits pta_gls_iter_*)
     diag_metric = ("pta_gls_iter_wall" if mode == "pta"
                    else f"{mode}_fit_iter_wall")
@@ -2691,10 +2717,11 @@ def main() -> None:
                               f"no budget left ({remaining:.0f}s)"}
             return
         pta_env = dict(env_pin, PINT_TPU_BENCH_MODE="pta",
-                       PINT_TPU_BENCH_N=os.environ.get(
-                           "PINT_TPU_BENCH_PTA_N", "40000"),
-                       PINT_TPU_BENCH_PSRS=os.environ.get(
-                           "PINT_TPU_BENCH_PSRS", "8"))
+                       PINT_TPU_BENCH_N=str(config.env_int(
+                           "PINT_TPU_BENCH_PTA_N")),
+                       PINT_TPU_BENCH_PSRS=(
+                           config.env_raw("PINT_TPU_BENCH_PSRS")
+                           or "8"))
         pta_res, pta_fail = run_child(pta_env, remaining - 20.0)
         if pta_res is not None:
             # the tunnel can die between children: a PTA record whose
@@ -2711,29 +2738,29 @@ def main() -> None:
                           else {"error": pta_fail})
 
     mode_env: dict = {}
-    if os.environ.get("PINT_TPU_BENCH_MODE") == "throughput_mesh":
+    if config.env_raw("PINT_TPU_BENCH_MODE") == "throughput_mesh":
         # the virtual mesh A/B (ISSUE 7) is an XLA:CPU construct (the
         # SCALE_r06 convention): pin the child to CPU and arm the
         # host-platform device count BEFORE its jax initializes
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
-            n_dev = os.environ.get("PINT_TPU_BENCH_MESH_DEVICES", "8")
+            n_dev = str(config.env_int("PINT_TPU_BENCH_MESH_DEVICES"))
             mode_env["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
         mode_env.setdefault("JAX_PLATFORMS", "cpu")
-    if os.environ.get("PINT_TPU_BENCH_MODE") == "fleet":
+    if config.env_raw("PINT_TPU_BENCH_MODE") == "fleet":
         # the fleet A/B (ISSUE 12) spawns real CPU worker processes;
         # the router child itself is pinned to CPU too (the SCALE_r06
         # convention — this is a correctness/transport artifact)
         mode_env.setdefault("JAX_PLATFORMS", "cpu")
-    if os.environ.get("PINT_TPU_BENCH_MODE") == "read_mixed":
+    if config.env_raw("PINT_TPU_BENCH_MODE") == "read_mixed":
         # the read-contention A/B (ISSUE 11) needs >= 2 devices so the
         # read lane owns a device the contending fit does not: same
         # virtual-CPU convention as the mesh A/B
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
-            n_dev = os.environ.get("PINT_TPU_BENCH_READ_DEVICES", "2")
+            n_dev = str(config.env_int("PINT_TPU_BENCH_READ_DEVICES"))
             mode_env["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
@@ -3472,13 +3499,13 @@ def _run_smoke() -> None:
 
 def _main_guarded() -> None:
     _telemetry_begin()
-    if os.environ.get("PINT_TPU_BENCH_SMOKE"):
+    if config.env_on("PINT_TPU_BENCH_SMOKE"):
         _run_smoke()
         return
-    n = int(os.environ.get("PINT_TPU_BENCH_N", str(N_DEFAULT)))
+    n = config.env_int("PINT_TPU_BENCH_N")
     # best-of-k needs k >= 3 for a meaningful spread (VERDICT Weak #2)
-    reps = max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "5")))
-    mode = os.environ.get("PINT_TPU_BENCH_MODE", "gls")
+    reps = max(3, config.env_int("PINT_TPU_BENCH_REPS"))
+    mode = config.env_str("PINT_TPU_BENCH_MODE")
     if mode in ("pta", "wideband", "batch", "throughput",
                 "throughput_mesh", "throughput_mixed",
                 "throughput_incremental", "read_mixed", "fleet"):
@@ -3489,29 +3516,24 @@ def _main_guarded() -> None:
                    "unit": "s", "vs_baseline": 0.0,
                    "error": f"backend init failed: {e}"})
             return
-        n_psr = int(os.environ.get("PINT_TPU_BENCH_PSRS", "16"))
+        n_psr = config.env_int("PINT_TPU_BENCH_PSRS")
         if mode == "pta":
             bench_pta(n_psr, max(1, n // n_psr), reps)
         elif mode == "wideband":
             bench_wideband(n, reps)
         elif mode == "throughput":
-            bench_throughput(int(os.environ.get("PINT_TPU_BENCH_FITS",
-                                                "64")), reps)
+            bench_throughput(config.env_int("PINT_TPU_BENCH_FITS"), reps)
         elif mode == "throughput_mesh":
-            bench_throughput_mesh(
-                int(os.environ.get("PINT_TPU_BENCH_FITS", "64")), reps)
+            bench_throughput_mesh(config.env_int("PINT_TPU_BENCH_FITS"),
+                                  reps)
         elif mode == "throughput_mixed":
-            bench_throughput_mixed(
-                int(os.environ.get("PINT_TPU_BENCH_FITS", "64")),
-                max(3, int(os.environ.get("PINT_TPU_BENCH_REPS", "3"))))
+            bench_throughput_mixed(config.env_int("PINT_TPU_BENCH_FITS"),
+                                   max(3, _env_reps(3)))
         elif mode == "throughput_incremental":
-            bench_throughput_incremental(
-                n, max(5, int(os.environ.get("PINT_TPU_BENCH_REPS",
-                                             "8"))))
+            bench_throughput_incremental(n, max(5, _env_reps(8)))
         elif mode == "read_mixed":
-            bench_read_mixed(
-                int(os.environ.get("PINT_TPU_BENCH_READ_N", "100000")),
-                max(2, int(os.environ.get("PINT_TPU_BENCH_REPS", "3"))))
+            bench_read_mixed(config.env_int("PINT_TPU_BENCH_READ_N"),
+                             max(2, _env_reps(3)))
         elif mode == "fleet":
             bench_fleet()
         else:
@@ -3574,8 +3596,8 @@ def _main_guarded() -> None:
         # spelling is honored as an alias). View with tensorboard/xprof.
         from pint_tpu.telemetry import core as _tele_core
 
-        legacy_dir = os.environ.get("PINT_TPU_BENCH_PROFILE", "")
-        if legacy_dir and not os.environ.get("PINT_TPU_PROFILE_DIR"):
+        legacy_dir = config.env_str("PINT_TPU_BENCH_PROFILE") or ""
+        if legacy_dir and not config.env_str("PINT_TPU_PROFILE_DIR"):
             os.environ["PINT_TPU_PROFILE_DIR"] = legacy_dir
         if _tele_core.profile_dir():
             with telemetry.profile_span("bench.profiled_rep"):

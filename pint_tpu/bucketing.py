@@ -45,7 +45,8 @@ compilation everywhere (the parity tests run both ways).
 from __future__ import annotations
 
 import dataclasses
-import os
+
+from pint_tpu import config
 
 import jax
 import jax.numpy as jnp
@@ -62,12 +63,12 @@ BUCKET_FLOOR = 32
 
 def enabled() -> bool:
     """Fit-path bucketing gate (read per call so tests can flip it)."""
-    return os.environ.get("PINT_TPU_FIT_BUCKETING", "") != "0"
+    return config.env_on("PINT_TPU_FIT_BUCKETING")
 
 
 def bucket_ceiling() -> int:
     """Largest TOA count still bucketed on the fit path (see module doc)."""
-    return int(os.environ.get("PINT_TPU_BUCKET_MAX", "16384"))
+    return config.env_int("PINT_TPU_BUCKET_MAX")
 
 
 def _round_up(n: int, multiple: int) -> int:

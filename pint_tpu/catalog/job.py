@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
+from pint_tpu import config
 import time
 from typing import Any
 
@@ -54,7 +54,7 @@ def slice_budget_s() -> float:
     so tests can flip it): the scheduler stops opening new catalog
     iterations once a slice has consumed this much wall — small fits
     and reads interleave between slices."""
-    return float(os.environ.get("PINT_TPU_CATALOG_SLICE_S", "5.0"))
+    return config.env_float("PINT_TPU_CATALOG_SLICE_S")
 
 
 @dataclasses.dataclass

@@ -1,22 +1,340 @@
-"""Runtime configuration + bundled-data locator.
+"""Runtime configuration: the central ``PINT_TPU_*`` knob registry.
 
 Reference equivalents: ``pint.config`` (runtimefile locator for
 src/pint/data/runtime) and the reference's scattered environment
-switches (clock-file policies etc.). All knobs live in one dataclass
-read from the environment once, overridable programmatically:
+switches (clock-file policies etc.).
 
-* ``PINT_TPU_EPHEM_DIR``     — directory searched for ``deNNN.bsp`` kernels
-* ``PINT_TPU_STRICT_EPHEM``  — refuse the analytic-ephemeris fallback
-* ``PINT_TPU_CLOCK_DIR``     — directory of tempo/tempo2 clock files to
-  auto-register at first use
-* ``PINT_TPU_CACHE_DIR``     — TOA pickle-cache location (defaults beside
-  the tim file)
+Every environment knob the tree reads is DECLARED here — name, default,
+kind, one-line doc — and read through the typed helpers below
+(:func:`env_str` / :func:`env_int` / :func:`env_float` / :func:`env_on`
+/ :func:`env_raw`). The static-analysis pass (``python -m
+tools.analyze``, rule ``env-knob-registry``) enforces both directions:
+a direct ``os.environ`` read of a ``PINT_TPU_*`` name outside this
+module is a finding, and so is a helper read (or an ``os.environ``
+write) naming a knob that is not declared. ``python -m tools.analyze
+--knobs`` prints the full table; ``docs/KNOBS.md`` is generated from it
+(never hand-edited — tests pin the regeneration).
+
+Declarations are PURE LITERALS on purpose: the analyzer extracts the
+registry by parsing this file's AST (it must run without importing jax,
+which ``import pint_tpu`` pulls in), so ``declare(...)`` calls may not
+use computed names, defaults or docs.
+
+Knob kinds:
+
+* ``str``      — string value; empty/unset resolves to the default.
+* ``int``/``float`` — parsed number; empty/unset or unparseable
+  resolves to the default (a typo'd knob must not crash a service).
+* ``bool``     — :func:`env_on` semantics: unset/empty -> default,
+  the literal string ``"0"`` -> False, anything else -> True. This is
+  the tree's kill-switch convention (``PINT_TPU_X=0`` disables).
+* ``tristate`` — raw string compared at the call site (e.g.
+  ``PINT_TPU_TELEMETRY``: "0" hard-off, "1" on-at-import, unset
+  defers); read through :func:`env_raw`.
+
+``scope`` marks where a knob is read: ``lib`` (pint_tpu), ``bench``
+(bench.py / scale_proof.py / tpu_evidence.py), ``tools``
+(tools/soak.py), ``tests`` (tests/ only — outside the analyzer's scan,
+declared for the generated docs), ``reserved`` (named by ROADMAP /
+CHANGES for a future subsystem; declared so the kill-switch inventory
+check closes before the code lands).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    default: object
+    kind: str  # "str" | "int" | "float" | "bool" | "tristate"
+    doc: str
+    scope: str = "lib"
+
+
+#: name -> Knob; populated by the literal declare() calls below.
+KNOBS: dict[str, Knob] = {}
+
+
+def declare(name: str, default, kind: str, doc: str,
+            scope: str = "lib") -> None:
+    """Register one knob. Arguments must be literals (see module doc)."""
+    if name in KNOBS:
+        raise ValueError(f"duplicate knob declaration {name}")
+    if kind not in ("str", "int", "float", "bool", "tristate"):
+        raise ValueError(f"unknown knob kind {kind!r} for {name}")
+    KNOBS[name] = Knob(name, default, kind, doc, scope)
+
+
+def knob(name: str) -> Knob:
+    """The declaration of ``name``; KeyError names the registry rule so
+    an undeclared read fails loudly at runtime too, not only in CI."""
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not declared in the pint_tpu.config knob "
+            "registry (jaxlint rule env-knob-registry)") from None
+
+
+def env_raw(name: str) -> str | None:
+    """The raw environment value of a DECLARED knob (None when unset).
+
+    For ``tristate`` knobs whose call sites compare literal strings;
+    every other kind has a typed helper below.
+    """
+    knob(name)
+    return os.environ.get(name)
+
+
+def env_str(name: str) -> str | None:
+    """String knob: the env value, or the declared default when unset
+    or empty (the tree's ``os.environ.get(X) or None`` convention)."""
+    k = knob(name)
+    raw = os.environ.get(name)
+    if raw:
+        return raw
+    return k.default
+
+
+def env_int(name: str) -> int:
+    """Integer knob; unset/empty/unparseable -> declared default."""
+    k = knob(name)
+    raw = os.environ.get(name)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return int(k.default)
+
+
+def env_float(name: str) -> float:
+    """Float knob; unset/empty/unparseable -> declared default."""
+    k = knob(name)
+    raw = os.environ.get(name)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return float(k.default)
+
+
+def env_on(name: str) -> bool:
+    """Boolean knob, kill-switch convention: unset or empty -> the
+    declared default; the literal ``"0"`` -> False; any other value ->
+    True. (``PINT_TPU_FLEET=0`` disables, ``PINT_TPU_FLEET=`` defers.)
+    """
+    k = knob(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return bool(k.default)
+    return raw != "0"
+
+
+# --- library knobs (pint_tpu/) --------------------------------------
+declare("PINT_TPU_EPHEM_DIR", None, "str",
+        "Directory searched for deNNN.bsp solar-system ephemeris "
+        "kernels before the bundled/analytic fallbacks.")
+declare("PINT_TPU_STRICT_EPHEM", False, "bool",
+        "Refuse the analytic-ephemeris fallback: a missing .bsp kernel "
+        "raises instead of degrading precision silently.")
+declare("PINT_TPU_CLOCK_DIR", None, "str",
+        "Directory of tempo/tempo2 clock files auto-registered at "
+        "first use.")
+declare("PINT_TPU_CACHE_DIR", None, "str",
+        "TOA pickle-cache location (defaults beside the .tim file).")
+declare("PINT_TPU_DEVICE_LOOP", True, "bool",
+        "Kill switch for the fused one-launch/one-fetch device fit "
+        "loop; 0 restores the host-driven downhill loop (the parity "
+        "oracle).")
+declare("PINT_TPU_FIT_BUCKETING", True, "bool",
+        "Kill switch for pow-2 TOA-count bucketing (compiled-program "
+        "reuse across nearby sizes); 0 compiles per exact shape.")
+declare("PINT_TPU_BUCKET_MAX", 16384, "int",
+        "Bucketing ceiling: TOA counts above it pad to multiples "
+        "instead of the next power of two.")
+declare("PINT_TPU_HYBRID_PIPELINE", "", "tristate",
+        "Hybrid CPU->accelerator fitter stage-overlap: 1 forces the "
+        "pipelined driver on (how CPU-only parity tests exercise it), "
+        "0 forces it off, unset auto-enables on real accelerators.")
+declare("PINT_TPU_TRACE_EFAC", True, "bool",
+        "Kill switch for EFAC/EQUAD values riding the traced "
+        "NoiseStatics.sigma (mixed-EFAC traffic sharing one compiled "
+        "program); 0 restores the PR-8 pinned-constant routing.")
+declare("PINT_TPU_TRACE_DMEFAC", True, "bool",
+        "Kill switch for DMEFAC/DMEQUAD values riding the traced "
+        "NoiseStatics.dm_sigma (wideband analogue of "
+        "PINT_TPU_TRACE_EFAC); 0 restores the pinned-constant path.")
+declare("PINT_TPU_BATCH_NOISE", True, "bool",
+        "Kill switch for the batchable noise/wideband frontier; 0 "
+        "restores the PR-5 routing (every correlated-noise/wideband "
+        "request a per-request passthrough).")
+declare("PINT_TPU_F64", True, "bool",
+        "Reserved (ROADMAP item 5): force-f64 kill switch for the "
+        "mixed-precision fit kernels; every kernel is f64 today.",
+        scope="reserved")
+declare("PINT_TPU_SESSION_BYTES", 67108864, "int",
+        "Session-cache device-byte budget; admission beyond it evicts "
+        "LRU unpinned states, then raises SessionCacheFull.")
+declare("PINT_TPU_SESSION_MAX_APPENDS", 16, "int",
+        "Append-count drift gate: a session full-refits (through the "
+        "one populate code path) after this many rank-k updates.")
+declare("PINT_TPU_SESSION_DRIFT_SIGMA", 1.0, "float",
+        "Cumulative parameter-motion drift gate in posterior sigmas "
+        "before a session's incremental state forces a full refit.")
+declare("PINT_TPU_FAULTS", None, "str",
+        "Seed-driven fault-injection plan, e.g. "
+        "'nan_toas=0.2,seed=7' (tools/soak.py chaos gates); unset = "
+        "injector inert.")
+declare("PINT_TPU_READ_PATH", True, "bool",
+        "Kill switch for the on-device Chebyshev read path; 0 serves "
+        "predictions through host Polycos (the parity oracle).")
+declare("PINT_TPU_READ_SEGMENT_MIN", 60.0, "float",
+        "Chebyshev segment span in minutes for the read path's "
+        "generated windows.")
+declare("PINT_TPU_READ_WINDOW_SEGMENTS", 24, "int",
+        "Segments per generated read-path cache window.")
+declare("PINT_TPU_READ_NCOEFF", 12, "int",
+        "Chebyshev coefficients per read-path segment.")
+declare("PINT_TPU_READ_CACHE_BYTES", 33554432, "int",
+        "Read-path segment-cache byte budget (LRU beyond it).")
+declare("PINT_TPU_READ_MAX_WINDOWS", 16, "int",
+        "Cap on fresh cache windows one predict request may generate; "
+        "rows beyond it are served dense (counted, never truncated).")
+declare("PINT_TPU_FLEET", True, "bool",
+        "Kill switch for the fleet tier; 0 (or one host) degenerates "
+        "to the bitwise single-host scheduler path.")
+declare("PINT_TPU_FLEET_PROCESSES", 1, "int",
+        "Fleet process count; >1 arms jax.distributed.initialize in "
+        "workers.")
+declare("PINT_TPU_FLEET_PROCESS_ID", 0, "int",
+        "This worker's process index for jax.distributed.initialize.")
+declare("PINT_TPU_FLEET_COORD", "127.0.0.1:9733", "str",
+        "jax.distributed coordinator address for fleet workers.")
+declare("PINT_TPU_FLEET_JOURNAL_BYTES", 67108864, "int",
+        "Fleet append-journal byte budget; over it, committed appends "
+        "snapshot-truncate into the base table (replay cost only).")
+declare("PINT_TPU_FLEET_OP_DEADLINE_S", 60.0, "float",
+        "Default per-operation fleet transport wire deadline [s]; a "
+        "miss raises HostSuspect into the suspicion ladder.")
+declare("PINT_TPU_FLEET_HEARTBEAT_S", 5.0, "float",
+        "Fleet heartbeat ping deadline [s] (suspicion-ladder cadence).")
+declare("PINT_TPU_CATALOG_SLICE_S", 5.0, "float",
+        "Device-budget per catalog long-job slice [s] between which "
+        "reads and small fits drain; always >= 1 iteration.")
+declare("PINT_TPU_SCRIPT_INIT_TIMEOUT", 60, "int",
+        "CLI scripts' backend-init watchdog [s] (tunnel-hang guard).")
+declare("PINT_TPU_TELEMETRY", "", "tristate",
+        "Telemetry master gate: 0 hard kill switch (overrides entry "
+        "points), 1 on at import for plain library use, unset defers "
+        "to telemetry.configure().")
+declare("PINT_TPU_TELEMETRY_PATH", None, "str",
+        "Telemetry JSON-lines artifact path (appended to); unset "
+        "keeps records in-memory only (rollup still works).")
+declare("PINT_TPU_TELEMETRY_LOAD1", 1.5, "float",
+        "1-min load-average threshold above which a host sample is "
+        "flagged polluted.")
+declare("PINT_TPU_TELEMETRY_LOG", False, "bool",
+        "Mirror span begin/end to the pint_tpu.telemetry logger.")
+declare("PINT_TPU_TELEMETRY_MAX_MB", 16.0, "float",
+        "Telemetry artifact rotation threshold [MB].")
+declare("PINT_TPU_PROFILE_DIR", None, "str",
+        "XLA-profiler output directory; unset = profiling off.")
+declare("PINT_TPU_FLIGHT_RECORDER", True, "bool",
+        "Kill switch for the in-carry flight-recorder trace ring; 0 "
+        "removes the ring from the loop carry (different program).")
+declare("PINT_TPU_TRACE_LEN", 64, "int",
+        "Flight-recorder ring capacity in entries (floor 4).")
+
+# --- bench.py / scale_proof.py / tpu_evidence.py knobs ---------------
+declare("PINT_TPU_BENCH_MODE", "gls", "str",
+        "bench.py mode: gls | fit_throughput | throughput_mixed | "
+        "throughput_mesh | throughput_incremental | read_mixed | "
+        "fleet | pta | catalog.", scope="bench")
+declare("PINT_TPU_BENCH_N", 100000, "int",
+        "bench.py TOA count for the headline fit.", scope="bench")
+declare("PINT_TPU_BENCH_REPS", 5, "int",
+        "bench.py repetitions (mode-specific floors apply).",
+        scope="bench")
+declare("PINT_TPU_BENCH_FITS", 64, "int",
+        "Request count for the throughput bench modes.", scope="bench")
+declare("PINT_TPU_BENCH_PSRS", 16, "int",
+        "Pulsar count for the PTA bench mode.", scope="bench")
+declare("PINT_TPU_BENCH_PTA_N", 40000, "int",
+        "TOA count for the rider PTA record in default-mode runs.",
+        scope="bench")
+declare("PINT_TPU_BENCH_MESH_DEVICES", 8, "int",
+        "Virtual device count armed for the throughput_mesh mode.",
+        scope="bench")
+declare("PINT_TPU_BENCH_READ_N", 100000, "int",
+        "TOA count of the contending fit in the read_mixed mode.",
+        scope="bench")
+declare("PINT_TPU_BENCH_READ_Q", 256, "int",
+        "Queries per predict request in the read_mixed mode.",
+        scope="bench")
+declare("PINT_TPU_BENCH_READ_DEVICES", 2, "int",
+        "Virtual device count armed for the read_mixed mode.",
+        scope="bench")
+declare("PINT_TPU_BENCH_INIT_TIMEOUT", 300, "int",
+        "bench.py backend-init watchdog [s].", scope="bench")
+declare("PINT_TPU_BENCH_TOTAL_TIMEOUT", 1200, "int",
+        "bench.py whole-run watchdog [s], CPU fallback included.",
+        scope="bench")
+declare("PINT_TPU_BENCH_CHILD", False, "bool",
+        "Internal: set in bench.py children so the driver/child split "
+        "recurses exactly once.", scope="bench")
+declare("PINT_TPU_BENCH_SMOKE", False, "bool",
+        "Internal: set by bench --smoke children (tiny CI workload).",
+        scope="bench")
+declare("PINT_TPU_BENCH_DETAIL", None, "str",
+        "Path for the full bench record (stdout carries only the "
+        "short line).", scope="bench")
+declare("PINT_TPU_BENCH_PROFILE", None, "str",
+        "Legacy alias of PINT_TPU_PROFILE_DIR for bench runs.",
+        scope="bench")
+declare("PINT_TPU_MESH_DETAIL", None, "str",
+        "Path for the full throughput_mesh record.", scope="bench")
+declare("PINT_TPU_FLEET_DETAIL", None, "str",
+        "Path for the full fleet-mode record.", scope="bench")
+declare("PINT_TPU_SCALE_PSRS", 68, "int",
+        "scale_proof.py catalog pulsar count.", scope="bench")
+declare("PINT_TPU_SCALE_N_PER_PSR", 8824, "int",
+        "scale_proof.py TOAs per catalog pulsar.", scope="bench")
+declare("PINT_TPU_SCALE_N", 600000, "int",
+        "scale_proof.py single-fit TOA count (gls600k/sharded8).",
+        scope="bench")
+declare("PINT_TPU_SCALE_BATCH_N", 20000, "int",
+        "scale_proof.py per-member TOA count for batched_het.",
+        scope="bench")
+declare("PINT_TPU_EVIDENCE_OUT", "TPU_EVIDENCE_r05.json", "str",
+        "tpu_evidence.py output artifact path.", scope="bench")
+declare("PINT_TPU_EVIDENCE_N", 100000, "int",
+        "tpu_evidence.py hybrid-fit TOA count.", scope="bench")
+
+# --- tools/soak.py knobs ---------------------------------------------
+declare("PINT_TPU_SOAK_REPRO_DIR", ".", "str",
+        "Directory for per-trial soak repro artifacts on failure.",
+        scope="tools")
+
+# --- tests-only knobs (declared for the generated docs; tests/ is
+# outside the analyzer's scan scope) ---------------------------------
+declare("PINT_TPU_RUN_TPU_TESTS", False, "bool",
+        "Keep the accelerator platform visible to the test suite "
+        "(tier-1 pins JAX_PLATFORMS=cpu otherwise).", scope="tests")
+declare("PINT_TPU_JAX_CACHE", True, "bool",
+        "Persistent XLA compile cache for the test suite; 0 opts out "
+        "on hosts where the cache itself misbehaves.", scope="tests")
+declare("PINT_TPU_JAX_CACHE_DIR", None, "str",
+        "Override location of the test suite's XLA compile cache.",
+        scope="tests")
+declare("PINT_TPU_GOLDEN_DIR", None, "str",
+        "Directory of external golden datasets; unset skips those "
+        "tests with an explanation.", scope="tests")
 
 
 @dataclasses.dataclass
@@ -29,10 +347,10 @@ class Config:
     @classmethod
     def from_env(cls) -> "Config":
         return cls(
-            ephem_dir=os.environ.get("PINT_TPU_EPHEM_DIR") or None,
-            strict_ephem=bool(os.environ.get("PINT_TPU_STRICT_EPHEM")),
-            clock_dir=os.environ.get("PINT_TPU_CLOCK_DIR") or None,
-            cache_dir=os.environ.get("PINT_TPU_CACHE_DIR") or None,
+            ephem_dir=env_str("PINT_TPU_EPHEM_DIR"),
+            strict_ephem=env_on("PINT_TPU_STRICT_EPHEM"),
+            clock_dir=env_str("PINT_TPU_CLOCK_DIR"),
+            cache_dir=env_str("PINT_TPU_CACHE_DIR"),
         )
 
 

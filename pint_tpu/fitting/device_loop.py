@@ -51,7 +51,7 @@ everywhere (the reference oracle; parity tests run both).
 
 from __future__ import annotations
 
-import os
+from pint_tpu import config
 
 import jax
 import jax.numpy as jnp
@@ -72,7 +72,7 @@ _LOOP_CACHE = LRUCache(32, name="device_loop")
 
 def enabled() -> bool:
     """Device-loop gate (read per call so tests can flip the env var)."""
-    return os.environ.get("PINT_TPU_DEVICE_LOOP", "") != "0"
+    return config.env_on("PINT_TPU_DEVICE_LOOP")
 
 
 def _sel(pred, a, b):

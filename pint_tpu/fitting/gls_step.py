@@ -98,9 +98,9 @@ def trace_efac_enabled() -> bool:
     ``PINT_TPU_TRACE_EFAC=0`` pins white-noise values as trace
     constants again (the PR-8 behavior, in which mixed-EFAC traffic
     splits compiled programs and serve batches)."""
-    import os
+    from pint_tpu import config
 
-    return os.environ.get("PINT_TPU_TRACE_EFAC", "") != "0"
+    return config.env_on("PINT_TPU_TRACE_EFAC")
 
 
 def trace_dmefac_enabled() -> bool:
@@ -109,9 +109,9 @@ def trace_dmefac_enabled() -> bool:
     DM-error scaling values as trace constants again, in which
     mixed-DMEFAC wideband traffic splits compiled programs and serve
     batches."""
-    import os
+    from pint_tpu import config
 
-    return os.environ.get("PINT_TPU_TRACE_DMEFAC", "") != "0"
+    return config.env_on("PINT_TPU_TRACE_DMEFAC")
 
 
 def scaled_sigma_np(model, toas, n_target: int | None = None) -> np.ndarray:

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import os
 
+from pint_tpu import config
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -410,7 +412,7 @@ class HybridGLSFitter(Fitter):
             if telemetry.enabled():
                 # close the span at stage-1 completion (dispatch is
                 # async); disabled, keep the uninstrumented overlap
-                jax.block_until_ready(packed)
+                jax.block_until_ready(packed)  # jaxlint: disable=host-sync-in-hot-path -- telemetry-gated honest span close; the uninstrumented path above keeps the async overlap
         return packed
 
     def _iterate_dispatch(self, base, deltas):
@@ -599,7 +601,7 @@ class HybridGLSFitter(Fitter):
         forces it on (1 — how the CPU-only parity tests exercise the
         path) or off (0).
         """
-        env = os.environ.get("PINT_TPU_HYBRID_PIPELINE", "")
+        env = config.env_raw("PINT_TPU_HYBRID_PIPELINE") or ""
         if env == "0":
             return False
         if env == "1":

@@ -17,7 +17,7 @@ bitwise to the single-host path. See docs/ARCHITECTURE.md
 
 from __future__ import annotations
 
-import os
+from pint_tpu import config
 
 from pint_tpu.fleet.durability import SessionJournal  # noqa: F401
 from pint_tpu.fleet.router import (  # noqa: F401
@@ -41,8 +41,7 @@ def build_fleet(n_hosts: int | None = None, *,
     ``sched_kwargs`` pass through to every host's scheduler.
     """
     if n_hosts is None:
-        n_hosts = int(os.environ.get("PINT_TPU_FLEET_PROCESSES", "1")
-                      or "1")
+        n_hosts = config.env_int("PINT_TPU_FLEET_PROCESSES")
     if not fleet_enabled():
         n_hosts = 1
     n_hosts = max(1, int(n_hosts))

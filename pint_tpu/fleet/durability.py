@@ -46,31 +46,29 @@ replication): the appends since the last surviving copy.
 
 from __future__ import annotations
 
-import os
+from pint_tpu import config
 import pickle
 from typing import Any
 
 from pint_tpu import telemetry
 
-_DEF_BUDGET = 64 * 1024 * 1024
 
 
 def journal_budget() -> int:
     """Journal byte budget (read per call so tests can flip it)."""
-    return int(os.environ.get("PINT_TPU_FLEET_JOURNAL_BYTES",
-                              str(_DEF_BUDGET)))
+    return config.env_int("PINT_TPU_FLEET_JOURNAL_BYTES")
 
 
 def op_deadline_s() -> float:
     """Default per-operation transport deadline [s] — the sane default
     the ISSUE-13 liveness work replaces the flat 600 s timeout with.
     A request's own ``deadline_s`` extends it per call."""
-    return float(os.environ.get("PINT_TPU_FLEET_OP_DEADLINE_S", "60"))
+    return config.env_float("PINT_TPU_FLEET_OP_DEADLINE_S")
 
 
 def heartbeat_deadline_s() -> float:
     """Heartbeat ping deadline [s] (the suspicion-ladder cadence)."""
-    return float(os.environ.get("PINT_TPU_FLEET_HEARTBEAT_S", "5"))
+    return config.env_float("PINT_TPU_FLEET_HEARTBEAT_S")
 
 
 def _nbytes(obj) -> int:

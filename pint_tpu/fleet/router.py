@@ -65,7 +65,7 @@ pint_tpu.telemetry.report`` under "fleet tier".
 from __future__ import annotations
 
 import hashlib
-import os
+from pint_tpu import config
 import time
 from typing import Any
 
@@ -80,7 +80,7 @@ from pint_tpu.serve.scheduler import (FitResult, PredictRequest,
 def fleet_enabled() -> bool:
     """Kill switch (read per call so tests can flip it):
     ``PINT_TPU_FLEET=0`` forces the degenerate single-host path."""
-    return os.environ.get("PINT_TPU_FLEET", "") != "0"
+    return config.env_on("PINT_TPU_FLEET")
 
 
 def _score(host_id: str, key: str) -> str:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+from pint_tpu import config
 import subprocess
 import sys
 import time
@@ -45,11 +46,11 @@ def init_distributed() -> str:
     """
     from pint_tpu.fleet.router import fleet_enabled
 
-    n = int(os.environ.get("PINT_TPU_FLEET_PROCESSES", "1") or "1")
+    n = config.env_int("PINT_TPU_FLEET_PROCESSES")
     if n <= 1 or not fleet_enabled():
         return "off"
-    coord = os.environ.get("PINT_TPU_FLEET_COORD", "127.0.0.1:9733")
-    pid = int(os.environ.get("PINT_TPU_FLEET_PROCESS_ID", "0"))
+    coord = config.env_str("PINT_TPU_FLEET_COORD")
+    pid = config.env_int("PINT_TPU_FLEET_PROCESS_ID")
     try:
         import jax
 

@@ -42,13 +42,12 @@ from pint_tpu.predict.engine import (  # noqa: F401
     COEFF_PARITY_CYCLES, FREQ_PARITY_REL, PHASE_PARITY_CYCLES,
     ChebWindow, eval_window, generate_cheb_window, read_path_enabled)
 
-import os
-
+from pint_tpu import config
 
 def max_windows_per_request() -> int:
     """Cap on fresh cache windows one request may touch; query rows
     beyond it are served dense (counted, never silently truncated)."""
-    return int(os.environ.get("PINT_TPU_READ_MAX_WINDOWS", "16"))
+    return config.env_int("PINT_TPU_READ_MAX_WINDOWS")
 
 
 @dataclasses.dataclass

@@ -18,17 +18,16 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import os
+
+from pint_tpu import config
 
 from pint_tpu import telemetry
 
-_DEF_READ_BUDGET = 32 * 1024 * 1024
 
 
 def read_cache_budget() -> int:
     """Segment-cache byte budget (read per call for tests)."""
-    return int(os.environ.get("PINT_TPU_READ_CACHE_BYTES",
-                              str(_DEF_READ_BUDGET)))
+    return config.env_int("PINT_TPU_READ_CACHE_BYTES")
 
 
 @dataclasses.dataclass

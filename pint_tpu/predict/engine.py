@@ -47,7 +47,8 @@ before touching this module.
 from __future__ import annotations
 
 import dataclasses
-import os
+
+from pint_tpu import config
 
 import jax
 import jax.numpy as jnp
@@ -73,22 +74,22 @@ def read_path_enabled() -> bool:
     """Read-path kill switch (read per call so tests can flip it):
     ``PINT_TPU_READ_PATH=0`` serves every predict through the host
     ``Polycos`` reference path instead of the on-device engine."""
-    return os.environ.get("PINT_TPU_READ_PATH", "") != "0"
+    return config.env_on("PINT_TPU_READ_PATH")
 
 
 def segment_minutes() -> float:
     """Segment length of the read artifact [minutes]."""
-    return float(os.environ.get("PINT_TPU_READ_SEGMENT_MIN", "60"))
+    return config.env_float("PINT_TPU_READ_SEGMENT_MIN")
 
 
 def window_segments() -> int:
     """Segments per cache window (window span = this x segment)."""
-    return int(os.environ.get("PINT_TPU_READ_WINDOW_SEGMENTS", "24"))
+    return config.env_int("PINT_TPU_READ_WINDOW_SEGMENTS")
 
 
 def read_ncoeff() -> int:
     """Polynomial order of the read artifact (tempo NCOEFF)."""
-    return int(os.environ.get("PINT_TPU_READ_NCOEFF", "12"))
+    return config.env_int("PINT_TPU_READ_NCOEFF")
 
 
 def window_days() -> float:

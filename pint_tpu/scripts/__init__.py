@@ -69,6 +69,8 @@ def ensure_exact_f64() -> None:
 
     import jax
 
+    from pint_tpu import config
+
     log = logging.getLogger("pint_tpu.scripts")
 
     platforms = str(jax.config.jax_platforms or "")
@@ -86,7 +88,7 @@ def ensure_exact_f64() -> None:
     # C-level init (GIL held), so probe in a CHILD process with a
     # wall-clock timeout (the guard pattern bench.py uses), and only
     # initialize the backend here once the child proved it responsive.
-    timeout_s = int(os.environ.get("PINT_TPU_SCRIPT_INIT_TIMEOUT", "60"))
+    timeout_s = config.env_int("PINT_TPU_SCRIPT_INIT_TIMEOUT")
     code = ("import jax\n"
             "from pint_tpu.ops import dd\n"
             "b = jax.default_backend()\n"

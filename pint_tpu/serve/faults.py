@@ -37,7 +37,7 @@ unconditionally, pinned by the fault-idle A/B in BENCH_DETAIL_r10.
 from __future__ import annotations
 
 import dataclasses
-import os
+from pint_tpu import config
 import time
 import zlib
 
@@ -172,7 +172,7 @@ def active() -> FaultPlan | None:
     global _PLAN, _ENV_READ
     if _PLAN is None and not _ENV_READ:
         _ENV_READ = True
-        spec = os.environ.get("PINT_TPU_FAULTS")
+        spec = config.env_str("PINT_TPU_FAULTS")
         if spec:
             _PLAN = plan_from_spec(spec)
     return _PLAN

@@ -43,14 +43,13 @@ Additions on top of ``_fn_fingerprint``:
 
 from __future__ import annotations
 
-import os
-
+from pint_tpu import config
 
 def noise_batch_enabled() -> bool:
     """Batchable-frontier gate (read per call so tests can flip it):
     ``PINT_TPU_BATCH_NOISE=0`` restores the PR-5 routing in which every
     correlated-noise / wideband request is a per-request passthrough."""
-    return os.environ.get("PINT_TPU_BATCH_NOISE", "") != "0"
+    return config.env_on("PINT_TPU_BATCH_NOISE")
 
 
 def _structural_state(model) -> tuple:

@@ -56,7 +56,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import os
+from pint_tpu import config
 import time
 from typing import Any
 
@@ -70,22 +70,21 @@ from pint_tpu.serve import fingerprint as _fp
 #: append/motion gates (asserted by bench --smoke and BENCH_r13)
 DRIFT_CHI2_REL = 1e-3
 
-_DEF_BUDGET = 64 * 1024 * 1024
 
 
 def byte_budget() -> int:
     """Session-cache device-byte budget (read per call for tests)."""
-    return int(os.environ.get("PINT_TPU_SESSION_BYTES", str(_DEF_BUDGET)))
+    return config.env_int("PINT_TPU_SESSION_BYTES")
 
 
 def max_appends() -> int:
     """Append-count gate: full refit after this many rank-k updates."""
-    return int(os.environ.get("PINT_TPU_SESSION_MAX_APPENDS", "16"))
+    return config.env_int("PINT_TPU_SESSION_MAX_APPENDS")
 
 
 def drift_limit_sigma() -> float:
     """Cumulative parameter-motion gate [posterior sigmas]."""
-    return float(os.environ.get("PINT_TPU_SESSION_DRIFT_SIGMA", "1.0"))
+    return config.env_float("PINT_TPU_SESSION_DRIFT_SIGMA")
 
 
 class SessionCacheFull(RuntimeError):

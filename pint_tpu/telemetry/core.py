@@ -27,8 +27,9 @@ tests can monkeypatch freely):
 
 from __future__ import annotations
 
-import os
 import threading
+
+from pint_tpu import config
 
 DEFAULT_LOAD1_THRESHOLD = 1.5
 
@@ -42,7 +43,7 @@ _mirror_logs: bool = False
 
 
 def _env_kill_switch() -> bool:
-    return os.environ.get("PINT_TPU_TELEMETRY", "") == "0"
+    return config.env_raw("PINT_TPU_TELEMETRY") == "0"
 
 
 def enabled() -> bool:
@@ -69,7 +70,7 @@ def profile_dir() -> str | None:
     diagnostic mode flipped on for a single run, and the gate must work
     for plain library use without any entry point calling configure.
     """
-    return os.environ.get("PINT_TPU_PROFILE_DIR") or None
+    return config.env_str("PINT_TPU_PROFILE_DIR")
 
 
 def configure(*, enabled: bool | None = None, jsonl_path: str | None = None,
@@ -87,16 +88,16 @@ def configure(*, enabled: bool | None = None, jsonl_path: str | None = None,
         if jsonl_path is not None:
             _jsonl_path = jsonl_path or None
         elif _jsonl_path is None:
-            _jsonl_path = os.environ.get("PINT_TPU_TELEMETRY_PATH") or None
+            _jsonl_path = config.env_str("PINT_TPU_TELEMETRY_PATH")
         if load1_threshold is not None:
             _load1_threshold = float(load1_threshold)
         else:
-            env = os.environ.get("PINT_TPU_TELEMETRY_LOAD1")
-            if env:
-                _load1_threshold = float(env)
+            if config.env_raw("PINT_TPU_TELEMETRY_LOAD1"):
+                _load1_threshold = config.env_float(
+                    "PINT_TPU_TELEMETRY_LOAD1")
         if mirror_logs is not None:
             _mirror_logs = bool(mirror_logs)
-        elif os.environ.get("PINT_TPU_TELEMETRY_LOG"):
+        elif config.env_on("PINT_TPU_TELEMETRY_LOG"):
             _mirror_logs = True
         if enabled is not None:
             _enabled = bool(enabled) and not _env_kill_switch()
@@ -114,12 +115,10 @@ def reset() -> None:
     from pint_tpu.telemetry import counters, export, recorder, spans
 
     with _config_lock:
-        _enabled = os.environ.get("PINT_TPU_TELEMETRY", "") == "1"
-        _jsonl_path = os.environ.get("PINT_TPU_TELEMETRY_PATH") or None
-        env_thr = os.environ.get("PINT_TPU_TELEMETRY_LOAD1")
-        _load1_threshold = (float(env_thr) if env_thr
-                            else DEFAULT_LOAD1_THRESHOLD)
-        _mirror_logs = bool(os.environ.get("PINT_TPU_TELEMETRY_LOG"))
+        _enabled = config.env_raw("PINT_TPU_TELEMETRY") == "1"
+        _jsonl_path = config.env_str("PINT_TPU_TELEMETRY_PATH")
+        _load1_threshold = config.env_float("PINT_TPU_TELEMETRY_LOAD1")
+        _mirror_logs = config.env_on("PINT_TPU_TELEMETRY_LOG")
     counters._reset()
     spans._reset()
     export._reset()
@@ -128,11 +127,10 @@ def reset() -> None:
 
 # plain library use: PINT_TPU_TELEMETRY=1 turns everything on without
 # any entry point calling configure()
-if os.environ.get("PINT_TPU_TELEMETRY", "") == "1":
+if config.env_raw("PINT_TPU_TELEMETRY") == "1":
     _enabled = True
-    _jsonl_path = os.environ.get("PINT_TPU_TELEMETRY_PATH") or None
-    env_thr = os.environ.get("PINT_TPU_TELEMETRY_LOAD1")
-    if env_thr:
-        _load1_threshold = float(env_thr)
-    if os.environ.get("PINT_TPU_TELEMETRY_LOG"):
+    _jsonl_path = config.env_str("PINT_TPU_TELEMETRY_PATH")
+    if config.env_raw("PINT_TPU_TELEMETRY_LOAD1"):
+        _load1_threshold = config.env_float("PINT_TPU_TELEMETRY_LOAD1")
+    if config.env_on("PINT_TPU_TELEMETRY_LOG"):
         _mirror_logs = True

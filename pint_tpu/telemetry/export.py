@@ -23,6 +23,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+from pint_tpu import config
 import threading
 import time
 
@@ -39,7 +40,6 @@ SCHEMA_VERSION = 3
 
 _MAX_BUFFER = 50_000
 _FLUSH_EVERY = 500
-DEFAULT_MAX_MB = 16.0
 
 _lock = threading.Lock()
 _buffer: list[dict] = []
@@ -151,13 +151,9 @@ atexit.register(flush)
 
 
 def _max_artifact_bytes() -> int:
-    """Rotation threshold (``PINT_TPU_TELEMETRY_MAX_MB``, default 16)."""
-    try:
-        mb = float(os.environ.get("PINT_TPU_TELEMETRY_MAX_MB",
-                                  str(DEFAULT_MAX_MB)))
-    except ValueError:
-        mb = DEFAULT_MAX_MB
-    return int(mb * 1e6)
+    """Rotation threshold (``PINT_TPU_TELEMETRY_MAX_MB``; default and
+    unparseable-value fallback live in the pint_tpu.config registry)."""
+    return int(config.env_float("PINT_TPU_TELEMETRY_MAX_MB") * 1e6)
 
 
 def _rotate_locked(path: str) -> None:

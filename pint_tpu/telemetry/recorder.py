@@ -60,11 +60,11 @@ ad-hoc probe as the only source of FLOP counts.
 from __future__ import annotations
 
 import os
+from pint_tpu import config
 import time
 
 from pint_tpu.telemetry import core, counters, export
 
-DEFAULT_TRACE_LEN = 64
 
 # scalar-loop entry fields, in emission order
 FIELDS = ("chi2", "lam", "accepted", "halvings", "probe_evals")
@@ -79,7 +79,7 @@ _LAST_TRACE: dict | None = None
 
 def enabled() -> bool:
     """Recorder gate (read per call so tests can flip the env var)."""
-    return os.environ.get("PINT_TPU_FLIGHT_RECORDER", "") != "0"
+    return config.env_on("PINT_TPU_FLIGHT_RECORDER")
 
 
 def active() -> bool:
@@ -89,12 +89,7 @@ def active() -> bool:
 
 def trace_len() -> int:
     """Ring capacity in entries (``PINT_TPU_TRACE_LEN``, default 64)."""
-    try:
-        n = int(os.environ.get("PINT_TPU_TRACE_LEN",
-                               str(DEFAULT_TRACE_LEN)))
-    except ValueError:
-        n = DEFAULT_TRACE_LEN
-    return max(4, n)
+    return max(4, config.env_int("PINT_TPU_TRACE_LEN"))
 
 
 def last_trace() -> dict | None:

@@ -48,6 +48,7 @@ import sys
 import time
 
 import pint_tpu
+from pint_tpu import config
 
 # this proof is a CPU-scaling measurement (see bench.py for the
 # accelerator path); the library-level guard makes the pin stick
@@ -60,10 +61,10 @@ import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
 
-N_PSR = int(os.environ.get("PINT_TPU_SCALE_PSRS", "68"))
-N_PER_PSR = int(os.environ.get("PINT_TPU_SCALE_N_PER_PSR", "8824"))
-N_SINGLE = int(os.environ.get("PINT_TPU_SCALE_N", "600000"))
-N_BATCH = int(os.environ.get("PINT_TPU_SCALE_BATCH_N", "20000"))
+N_PSR = config.env_int("PINT_TPU_SCALE_PSRS")
+N_PER_PSR = config.env_int("PINT_TPU_SCALE_N_PER_PSR")
+N_SINGLE = config.env_int("PINT_TPU_SCALE_N")
+N_BATCH = config.env_int("PINT_TPU_SCALE_BATCH_N")
 GW_AMP, GW_GAM, GW_NHARM = -14.2, 4.33, 14
 
 

@@ -1,0 +1,551 @@
+"""jaxlint (tools/analyze) — the static-invariant gate (ISSUE 15).
+
+Three layers:
+
+* per-rule fixture snippets: a must-flag and a must-not-flag pair for
+  each of the five rules (including the donation rule's PR-10
+  "copy the append table" false-positive guard);
+* the suppression/baseline machinery: inline disables need reasons and
+  must suppress something, baseline entries round-trip and every
+  surviving entry must match a live finding (deleting one flips the
+  gate);
+* live-tree pins: the committed tree is clean vs the committed
+  baseline, the baseline's justifications are written, docs/KNOBS.md
+  is exactly the regenerated table, and the runtime registry agrees
+  with the AST-extracted one the analyzer uses.
+
+The analyzer itself is stdlib-only; these tests never need jax except
+for the runtime-registry pin (pint_tpu.config imports nothing heavy,
+but ``import pint_tpu`` does — that one test uses the package like any
+other tier-1 test).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analyze import (Config, Finding, diff_baseline,  # noqa: E402
+                           load_baseline, run, save_baseline)
+from tools.analyze.knobs import (knob_table, render_markdown,  # noqa: E402
+                                 render_text)
+
+
+def _tree(tmp_path, files: dict, **cfg_kw) -> Config:
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    kw = dict(paths=sorted(files), hot_path=[], fetch_sites=[],
+              host_prep=[], prep_boundary=[])
+    kw.update(cfg_kw)
+    return Config(root=tmp_path, **kw)
+
+
+def _rules_hit(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------ host-sync rule
+HOT_BAD = """\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def drain(ops):
+        x = jnp.dot(ops, ops)
+        v = float(x)
+        y = jax.device_get(x)
+        a = np.asarray(x)
+        for t in x:
+            pass
+        return v, y, a
+"""
+
+HOT_OK = """\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def prep(tbl):
+        x = jnp.dot(tbl, tbl)
+        x = np.zeros(3)          # reassigned to host data
+        return float(x)
+
+    class InFlightFit:
+        def fetch(self):
+            return jax.device_get(self._out)   # approved site
+"""
+
+
+def test_host_sync_must_flag(tmp_path):
+    cfg = _tree(tmp_path, {"hot.py": HOT_BAD}, hot_path=["hot.py"])
+    hits = _rules_hit(run(cfg), "host-sync-in-hot-path")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 4
+    assert "float()" in msgs and "device_get" in msgs
+    assert "numpy.asarray" in msgs and "iteration" in msgs
+
+
+def test_host_sync_must_not_flag(tmp_path):
+    cfg = _tree(tmp_path, {"hot.py": HOT_OK}, hot_path=["hot.py"],
+                fetch_sites=["hot.py:InFlightFit.fetch"])
+    assert _rules_hit(run(cfg), "host-sync-in-hot-path") == []
+
+
+def test_host_sync_scoped_to_hot_path(tmp_path):
+    # the same source outside the configured hot-path globs is silent
+    cfg = _tree(tmp_path, {"cold.py": HOT_BAD}, hot_path=["hot*.py"])
+    assert _rules_hit(run(cfg), "host-sync-in-hot-path") == []
+
+
+# ------------------------------------------------------ eager-jnp rule
+PREP = """\
+    import jax.numpy as jnp
+    import numpy as np
+
+    def submit(tbl):
+        return jnp.equal(tbl, 0)
+
+    def place(tbl):
+        return jnp.asarray(tbl)
+"""
+
+
+def test_eager_jnp_must_flag_and_boundary(tmp_path):
+    cfg = _tree(tmp_path, {"prep.py": PREP}, host_prep=["prep.py"],
+                prep_boundary=["prep.py:place"])
+    hits = _rules_hit(run(cfg), "eager-jnp-in-host-prep")
+    assert [f.symbol for f in hits] == ["submit"]
+    assert "jnp.equal" in hits[0].message
+
+
+def test_eager_jnp_not_in_other_files(tmp_path):
+    cfg = _tree(tmp_path, {"other.py": PREP}, host_prep=["prep.py"])
+    assert _rules_hit(run(cfg), "eager-jnp-in-host-prep") == []
+
+
+# ------------------------------------------------------- donation rule
+DON = """\
+    import jax
+    import jax.numpy as jnp
+
+    def bad_wrapper(step, state, tbl):
+        h = dispatch_damped(step, jnp.zeros(3), (tbl, state),
+                            donate_state=True)
+        return state.shape          # read after donation
+
+    def ok_copy_pattern(step, entry):
+        # the PR-10 fix: donate a private copy; the caller's own table
+        # stays alive behind entry.pending — reading it must NOT flag
+        tbl = jax.tree.map(jnp.array, entry.pending)
+        h = dispatch_damped(step, jnp.zeros(3), (tbl,),
+                            donate_state=True)
+        return entry.pending
+
+    def ok_no_gate(step, state, tbl):
+        h = dispatch_damped(step, jnp.zeros(3), (tbl, state))
+        return state                # donate_state absent -> no donation
+
+    def bad_jit(f, a, b):
+        g = jax.jit(f, donate_argnums=(1,))
+        out = g(a, b)
+        return b
+
+    def ok_rebound(f, a, b):
+        g = jax.jit(f, donate_argnums=(1,))
+        b = g(a, b)
+        return b                    # re-bound to the result
+"""
+
+
+def test_donation_rule(tmp_path):
+    cfg = _tree(tmp_path, {"don.py": DON})
+    hits = _rules_hit(run(cfg), "donation-safety")
+    assert sorted((f.symbol, f.message.split("'")[1]) for f in hits) == [
+        ("bad_jit", "b"), ("bad_wrapper", "state")]
+
+
+# ----------------------------------------------- fingerprint-drift rule
+def _drift_tree(tmp_path, marker_handled: bool):
+    handled = '"is_noise_basis"' if marker_handled else '"is_other"'
+    files = {
+        "models/noise.py": """\
+            class PLRedNoise:
+                is_noise_basis = True
+        """,
+        "serve/fp.py": f"""\
+            def _noise_value_params(model):
+                out = set()
+                for c in model.components:
+                    if getattr(c, {handled}, False):
+                        out.update(p.name for p in c.params)
+                return frozenset(out)
+
+            def batchable(model, toas=None):
+                for c in model.components:
+                    if c.free:
+                        return False, "free_noise_param"
+                return True, ""
+        """,
+        "parallel/union.py": f"""\
+            def build_union_model(models):
+                for m in models:
+                    for c in m.components:
+                        if getattr(c, {handled}, False):
+                            pass
+                return models[0]
+        """,
+        "docs.md": "tokens: free_noise_param\n",
+    }
+    return _tree(tmp_path, files,
+                 fingerprint_file="serve/fp.py",
+                 union_file="parallel/union.py",
+                 models_glob="models/*.py",
+                 docs_arch="docs.md")
+
+
+def test_fingerprint_drift_must_flag(tmp_path):
+    cfg = _drift_tree(tmp_path, marker_handled=False)
+    hits = _rules_hit(run(cfg), "fingerprint-drift")
+    assert len(hits) == 1
+    assert "is_noise_basis" in hits[0].message
+    assert hits[0].file == "models/noise.py"
+
+
+def test_fingerprint_drift_must_not_flag(tmp_path):
+    cfg = _drift_tree(tmp_path, marker_handled=True)
+    assert _rules_hit(run(cfg), "fingerprint-drift") == []
+
+
+def test_fingerprint_drift_undocumented_token(tmp_path):
+    cfg = _drift_tree(tmp_path, marker_handled=True)
+    (tmp_path / "docs.md").write_text("tokens: none documented\n")
+    hits = _rules_hit(run(cfg), "fingerprint-drift")
+    assert len(hits) == 1 and "free_noise_param" in hits[0].message
+
+
+def test_fingerprint_drift_reason_token_covers_marker(tmp_path):
+    # a marker with no fingerprint/union handling is fine when a
+    # batchable reason token names it — that IS the passthrough leg
+    cfg = _drift_tree(tmp_path, marker_handled=False)
+    fp = tmp_path / "serve/fp.py"
+    fp.write_text(fp.read_text().replace(
+        '"free_noise_param"', '"noise_basis_unsupported"'))
+    (tmp_path / "docs.md").write_text("tokens: noise_basis_unsupported\n")
+    assert _rules_hit(run(cfg), "fingerprint-drift") == []
+
+
+def test_fingerprint_drift_method_markers(tmp_path):
+    """Plain ``scale_sigma`` is the white-noise hook whose category
+    marker is the ``is_noise_scale`` class attr — it must not be a
+    category of its own; qualified hooks (``scale_dm_sigma``) are."""
+    cfg = _drift_tree(tmp_path, marker_handled=True)
+    (tmp_path / "models/noise.py").write_text(textwrap.dedent("""\
+        class ScaleDmError:
+            def scale_dm_sigma(self, sigma, toas):
+                return sigma
+
+            def scale_sigma(self, sigma, toas):
+                return sigma
+    """))
+    hits = _rules_hit(run(cfg), "fingerprint-drift")
+    assert len(hits) == 1
+    assert "scale_dm_sigma" in hits[0].message
+    assert all("'scale_sigma'" not in f.message for f in hits)
+
+
+# ----------------------------------------------------- env-knob rule
+REG = """\
+    KNOBS = {}
+
+    def declare(name, default, kind, doc, scope="lib"):
+        KNOBS[name] = (default, kind, doc, scope)
+
+    declare("PINT_TPU_ALPHA", 3, "int", "alpha knob.")
+    declare("PINT_TPU_BETA", True, "bool", "beta kill switch.")
+    declare("PINT_TPU_DEAD", 1, "int", "never read anywhere.")
+    declare("PINT_TPU_RESERVED", 1, "int", "future.", scope="reserved")
+"""
+
+ENV_USER = """\
+    import os
+
+    from cfg import env_int, env_on, env_str
+
+    def good():
+        return env_int("PINT_TPU_ALPHA"), env_on("PINT_TPU_BETA")
+
+    def direct():
+        return os.environ.get("PINT_TPU_ALPHA", "3")
+
+    def undeclared():
+        return env_int("PINT_TPU_NOT_DECLARED")
+
+    def mismatch():
+        return env_str("PINT_TPU_ALPHA")
+
+    def unreadable(suffix):
+        return env_int("PINT_TPU_" + suffix)
+"""
+
+
+def _env_tree(tmp_path, user=ENV_USER):
+    files = {"cfg.py": REG, "user.py": user, "KNOBS.md":
+             "PINT_TPU_ALPHA PINT_TPU_BETA PINT_TPU_DEAD "
+             "PINT_TPU_RESERVED PINT_TPU_NOT_DECLARED\n"}
+    return _tree(tmp_path, files, registry_file="cfg.py",
+                 docs_knobs="KNOBS.md")
+
+
+def test_env_knob_rule(tmp_path):
+    cfg = _env_tree(tmp_path)
+    msgs = [f.message for f in _rules_hit(run(cfg), "env-knob-registry")]
+    assert any("direct environ read of PINT_TPU_ALPHA" in m for m in msgs)
+    assert any("PINT_TPU_NOT_DECLARED" in m and "undeclared" in m
+               for m in msgs)
+    assert any("disagrees with declared kind 'int'" in m for m in msgs)
+    assert any("unreadable knob name" in m for m in msgs)
+    assert any("PINT_TPU_DEAD" in m and "dead knob" in m for m in msgs)
+    # reserved-scope knobs are exempt from the dead-knob check
+    assert not any("PINT_TPU_RESERVED" in m and "dead knob" in m
+                   for m in msgs)
+
+
+def test_env_knob_docs_missing(tmp_path):
+    cfg = _env_tree(tmp_path)
+    (tmp_path / "KNOBS.md").write_text("only PINT_TPU_ALPHA here\n")
+    msgs = [f.message for f in _rules_hit(run(cfg), "env-knob-registry")]
+    assert any("PINT_TPU_BETA" in m and "missing from" in m for m in msgs)
+
+
+def test_env_knob_clean_fixture(tmp_path):
+    clean = ("from cfg import env_int\n\n"
+             "def good():\n"
+             "    return env_int(\"PINT_TPU_ALPHA\")\n")
+    files = {"cfg.py": REG.replace(
+        '    declare("PINT_TPU_DEAD", 1, "int", "never read anywhere.")\n',
+        ""), "user.py": clean,
+        "KNOBS.md": "PINT_TPU_ALPHA PINT_TPU_BETA PINT_TPU_RESERVED\n"}
+    cfg = _tree(tmp_path, files, registry_file="cfg.py",
+                docs_knobs="KNOBS.md")
+    # PINT_TPU_BETA is declared-but-unread -> dead knob; ALPHA clean
+    msgs = [f.message for f in _rules_hit(run(cfg), "env-knob-registry")]
+    assert all("PINT_TPU_ALPHA" not in m for m in msgs)
+
+
+# ------------------------------------------- disables and the baseline
+def test_disable_needs_reason_and_use(tmp_path):
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        def drain(ops):
+            x = jnp.dot(ops, ops)
+            a = float(x)  # jaxlint: disable=host-sync-in-hot-path -- scalar verdict crosses the wire here
+            b = float(x)  # jaxlint: disable=host-sync-in-hot-path
+            return a, b
+
+        def clean(ops):  # jaxlint: disable=donation-safety -- suppresses nothing
+            return ops
+    """
+    cfg = _tree(tmp_path, {"hot.py": src}, hot_path=["hot.py"])
+    findings = run(cfg)
+    # line 6 suppressed with reason; line 7 suppressed but bare
+    assert _rules_hit(findings, "host-sync-in-hot-path") == []
+    bare = _rules_hit(findings, "bare-disable")
+    assert len(bare) == 1 and bare[0].line == 7
+    unused = _rules_hit(findings, "unused-disable")
+    assert len(unused) == 1 and unused[0].line == 10
+
+
+def test_baseline_round_trip_and_gate(tmp_path):
+    cfg = _tree(tmp_path, {"hot.py": HOT_BAD}, hot_path=["hot.py"])
+    findings = run(cfg)
+    assert len(findings) == 4
+    save_baseline(cfg, findings)
+    entries = load_baseline(cfg)
+    new, stale = diff_baseline(run(cfg), entries)
+    assert new == [] and stale == []
+    # deleting any single baseline entry makes the gate fail
+    for i in range(len(entries)):
+        new, stale = diff_baseline(run(cfg), entries[:i] + entries[i+1:])
+        assert len(new) == 1 and stale == []
+    # a stale entry (source fixed, entry kept) also fails the gate
+    (tmp_path / "hot.py").write_text("x = 1\n")
+    new, stale = diff_baseline(run(cfg), entries)
+    assert new == [] and len(stale) == 4
+
+
+def test_baseline_matching_is_multiset(tmp_path):
+    # one grandfathered instance must not absorb a SECOND identical one
+    cfg = _tree(tmp_path, {"hot.py": HOT_BAD}, hot_path=["hot.py"])
+    save_baseline(cfg, run(cfg))
+    entries = load_baseline(cfg)
+    src = (tmp_path / "hot.py").read_text()
+    (tmp_path / "hot.py").write_text(
+        src + "\n\ndef drain2(ops):\n"
+        "    x = jnp.dot(ops, ops)\n    return float(x)\n")
+    new, stale = diff_baseline(run(cfg), entries)
+    assert len(new) == 1 and new[0].symbol == "drain2"
+
+
+# ------------------------------------------------------ live-tree pins
+def _repo_cfg() -> Config:
+    return Config.load(REPO)
+
+
+def test_live_tree_clean_vs_committed_baseline():
+    cfg = _repo_cfg()
+    new, stale = diff_baseline(run(cfg), load_baseline(cfg))
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_committed_baseline_is_justified():
+    entries = load_baseline(_repo_cfg())
+    assert entries, "the committed baseline must exercise the gate"
+    for e in entries:
+        assert e.get("why") and "TODO" not in e["why"], e
+
+
+def test_knobs_md_is_generated_output():
+    cfg = _repo_cfg()
+    generated = render_markdown(knob_table(cfg))
+    committed = (REPO / "docs/KNOBS.md").read_text()
+    assert committed == generated, (
+        "docs/KNOBS.md is stale — regenerate with "
+        "`python -m tools.analyze --knobs --markdown > docs/KNOBS.md`")
+
+
+def test_knob_table_text_form():
+    table = knob_table(_repo_cfg())
+    names = [e["name"] for e in table]
+    assert "PINT_TPU_TRACE_EFAC" in names
+    assert "PINT_TPU_TRACE_DMEFAC" in names
+    assert "PINT_TPU_READ_PATH" in names
+    assert "PINT_TPU_F64" in names  # the reserved ROADMAP kill switch
+    text = render_text(table)
+    assert "PINT_TPU_FLEET_OP_DEADLINE_S" in text
+    # every lib knob is read somewhere; only tests/reserved may not be
+    for e in table:
+        if e["scope"] not in ("tests", "reserved"):
+            assert e["readers"], f"{e['name']} read nowhere"
+
+
+def test_registry_runtime_matches_ast():
+    """The registry the analyzer extracts by AST is the registry the
+    library runs with — declarations must stay literal."""
+    from pint_tpu import config as rt
+    from tools.analyze import Module
+    from tools.analyze.rules import extract_registry
+
+    cfg = _repo_cfg()
+    mod = Module(cfg.registry_file,
+                 (REPO / cfg.registry_file).read_text())
+    knobs, findings = extract_registry(cfg, {cfg.registry_file: mod})
+    assert findings == []
+    assert set(knobs) == set(rt.KNOBS)
+    for name, entry in knobs.items():
+        assert entry["default"] == rt.KNOBS[name].default, name
+        assert entry["kind"] == rt.KNOBS[name].kind, name
+        assert entry["doc"] == rt.KNOBS[name].doc, name
+
+
+def test_env_helper_semantics(monkeypatch):
+    from pint_tpu import config as rt
+
+    monkeypatch.delenv("PINT_TPU_TRACE_EFAC", raising=False)
+    assert rt.env_on("PINT_TPU_TRACE_EFAC") is True
+    monkeypatch.setenv("PINT_TPU_TRACE_EFAC", "0")
+    assert rt.env_on("PINT_TPU_TRACE_EFAC") is False
+    monkeypatch.setenv("PINT_TPU_TRACE_EFAC", "")
+    assert rt.env_on("PINT_TPU_TRACE_EFAC") is True  # empty -> default
+    monkeypatch.setenv("PINT_TPU_TRACE_LEN", "not-an-int")
+    assert rt.env_int("PINT_TPU_TRACE_LEN") == 64  # typo -> default
+    monkeypatch.setenv("PINT_TPU_SESSION_DRIFT_SIGMA", "2.5")
+    assert rt.env_float("PINT_TPU_SESSION_DRIFT_SIGMA") == 2.5
+    with pytest.raises(KeyError, match="env-knob-registry"):
+        rt.env_raw("PINT_TPU_NOT_A_KNOB")
+
+
+def test_rule_catalog_documented():
+    arch = (REPO / "docs/ARCHITECTURE.md").read_text()
+    from tools.analyze import RULES
+
+    for rule in RULES:
+        assert rule in arch, f"rule {rule} missing from ARCHITECTURE.md"
+
+
+def test_pyproject_parser_rejects_non_literal_values(tmp_path):
+    """A TOML-but-not-Python value must error loudly (exit 2 in the
+    CLI), never silently swallow the keys after it — a half-read
+    config would pass the gate while checking the wrong scope."""
+    (tmp_path / "hot.py").write_text("x = 1\n")
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""\
+        [tool.jaxlint]
+        strict = true
+        hot_path = ["hot.py"]
+    """))
+    with pytest.raises(ValueError, match="strict"):
+        Config.load(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--root", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 2
+    # multi-line lists still parse
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""\
+        [tool.jaxlint]
+        hot_path = [
+            "hot.py",
+        ]
+        paths = ["hot.py"]
+    """))
+    cfg = Config.load(tmp_path)
+    assert cfg.hot_path == ["hot.py"] and cfg.paths == ["hot.py"]
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    files = {"hot.py": HOT_BAD,
+             "pyproject.toml": """\
+                [tool.jaxlint]
+                paths = ["hot.py"]
+                hot_path = ["hot.py"]
+                fetch_sites = []
+                host_prep = []
+             """}
+    for rel, body in files.items():
+        (tmp_path / rel).write_text(textwrap.dedent(body))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--root", str(tmp_path),
+         "--json"], cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["count"] == 4
+    assert all(set(f) >= {"file", "line", "rule", "message"}
+               for f in data["findings"])
+    # grandfather everything -> clean exit
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--root", str(tmp_path),
+         "--write-baseline"], cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--root", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_live_cli_gate_is_green():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze"], cwd=REPO,
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

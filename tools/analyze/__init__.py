@@ -1,0 +1,435 @@
+"""jaxlint — repo-specific static analysis for the pint_tpu tree.
+
+An AST pass (stdlib ``ast`` only — it must run without importing jax,
+in CI and pre-commit, in milliseconds) whose rules each encode an
+invariant this repo has shipped, broken, and re-fixed by hand review:
+
+* ``host-sync-in-hot-path`` — ``float()``/``bool()``/``int()``/
+  ``.item()``/``np.asarray``/iteration on device arrays, and
+  ``jax.device_get``/``block_until_ready``, inside the hot-path modules
+  (the fused loops' one-launch/one-fetch contract; the approved fetch
+  sites are the ONLY places a fit's device->host sync may live).
+* ``eager-jnp-in-host-prep`` — ``jnp.*`` dispatches in the batch-prep /
+  submit paths, where the PR-5/PR-8 rule is numpy until the one
+  shard-time ``device_put`` (each eager jnp call on concrete table data
+  is a hidden per-member XLA dispatch).
+* ``donation-safety`` — a local passed as a donated operand
+  (``donate_state=`` wrappers, literal ``jax.jit(...,
+  donate_argnums=...)``) that is read again in the same function after
+  the dispatch: on accelerators the buffer is deleted (the PR-10
+  class), on XLA:CPU it silently reads stale math.
+* ``fingerprint-drift`` — the cross-module consistency of the noise
+  value-tracing frontier: every noise/scale component marker in the
+  model zoo must be handled by ``fingerprint._noise_value_params`` AND
+  ``build_union_model``'s normalization, or named by a ``batchable``
+  passthrough reason token (the three lists drifted silently in
+  PR-8/10/14 until a perf artifact regressed).
+* ``env-knob-registry`` — every ``PINT_TPU_*`` environment read resolves
+  through the ``pint_tpu.config`` registry (declared default + doc);
+  direct/undeclared/unreadable/undocumented knobs are findings.
+
+Suppression policy: ``# jaxlint: disable=<rule>[,<rule>] -- <reason>``
+on the flagged statement's lines. A disable without a reason is itself
+a finding (``bare-disable``), as is one that suppresses nothing
+(``unused-disable``) and a committed-baseline entry matching no live
+finding (``stale-baseline``) — suppressions must stay self-documenting
+and live, so deleting any one of them flips the CI gate.
+
+Driver: ``python -m tools.analyze`` (exit 0 = clean vs the committed
+baseline, 1 = new/stale findings, 2 = internal error); ``--json`` for
+tooling; ``--knobs [--markdown]`` prints the registry table;
+``--write-baseline`` regenerates the grandfather file. Configuration
+lives in ``[tool.jaxlint]`` in pyproject.toml.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import re
+from pathlib import Path
+
+RULES = (
+    "host-sync-in-hot-path",
+    "eager-jnp-in-host-prep",
+    "donation-safety",
+    "fingerprint-drift",
+    "env-knob-registry",
+    "bare-disable",
+    "unused-disable",
+    "stale-baseline",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    file: str      # repo-relative posix path
+    line: int
+    rule: str
+    symbol: str    # enclosing Class.function qualname ("" at module scope)
+    message: str   # line-free (baseline matching survives reflow)
+    end_line: int = 0
+
+    def key(self) -> tuple:
+        return (self.file, self.rule, self.symbol, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line} {self.rule} {self.message}"
+
+    def as_json(self) -> dict:
+        return {"file": self.file, "line": self.line, "rule": self.rule,
+                "symbol": self.symbol, "message": self.message}
+
+
+@dataclasses.dataclass
+class Config:
+    """Analyzer configuration (defaults = this repo's layout; every
+    field is overridable from ``[tool.jaxlint]`` so tests can point the
+    rules at fixture trees)."""
+
+    root: Path
+    paths: list = dataclasses.field(default_factory=lambda: [
+        "pint_tpu", "tools", "bench.py", "scale_proof.py",
+        "tpu_evidence.py"])
+    hot_path: list = dataclasses.field(default_factory=lambda: [
+        "pint_tpu/fitting/device_loop.py",
+        "pint_tpu/fitting/incremental.py",
+        "pint_tpu/serve/*.py", "pint_tpu/predict/*.py",
+        "pint_tpu/fleet/*.py"])
+    fetch_sites: list = dataclasses.field(default_factory=list)
+    host_prep: list = dataclasses.field(default_factory=lambda: [
+        "pint_tpu/parallel/batch.py", "pint_tpu/serve/scheduler.py",
+        "pint_tpu/serve/fingerprint.py"])
+    prep_boundary: list = dataclasses.field(default_factory=list)
+    donating_calls: list = dataclasses.field(default_factory=lambda: [
+        "dispatch_damped:2:donate_state", "_dispatch:3:donate_state"])
+    baseline: str = "tools/analyze/baseline.json"
+    registry_file: str = "pint_tpu/config.py"
+    fingerprint_file: str = "pint_tpu/serve/fingerprint.py"
+    union_file: str = "pint_tpu/parallel/batch.py"
+    models_glob: str = "pint_tpu/models/*.py"
+    docs_knobs: str = "docs/KNOBS.md"
+    docs_arch: str = "docs/ARCHITECTURE.md"
+
+    @classmethod
+    def load(cls, root: Path) -> "Config":
+        cfg = cls(root=root)
+        for key, value in _read_pyproject_table(root).items():
+            field = key.replace("-", "_")
+            if hasattr(cfg, field):
+                setattr(cfg, field, value)
+        return cfg
+
+
+def _read_pyproject_table(root: Path) -> dict:
+    """The ``[tool.jaxlint]`` table of pyproject.toml.
+
+    Python 3.10 ships no tomllib and the container bakes no toml
+    package, so this parses the subset the block is committed in: one
+    ``key = value`` per logical line, values restricted to strings and
+    (possibly multi-line) lists of strings — all of which are valid
+    Python literals, handed to ``ast.literal_eval``.
+    """
+    py = root / "pyproject.toml"
+    if not py.is_file():
+        return {}
+    lines = py.read_text().splitlines()
+    out: dict = {}
+    in_table = False
+    pending_key, pending = None, ""
+
+    def _unbalanced(s: str) -> bool:
+        return s.count("[") > s.count("]")
+
+    for line in lines:
+        stripped = line.strip()
+        if stripped.startswith("["):
+            if in_table and pending_key is not None:
+                raise ValueError(
+                    f"[tool.jaxlint] value for {pending_key!r} is not "
+                    "a string / list-of-strings literal")
+            in_table = stripped == "[tool.jaxlint]"
+            continue
+        if not in_table or not stripped or stripped.startswith("#"):
+            continue
+        if pending_key is None:
+            if "=" not in stripped:
+                continue
+            key, _, rhs = stripped.partition("=")
+            pending_key, pending = key.strip(), rhs.strip()
+        else:
+            pending += " " + stripped
+        if _unbalanced(pending):
+            continue  # multi-line list still open
+        try:
+            out[pending_key] = ast.literal_eval(pending)
+        except (ValueError, SyntaxError):
+            # a closed-but-unparseable value must not silently swallow
+            # every later key (reverting hot_path etc. to defaults
+            # would pass the gate while checking the wrong scope)
+            raise ValueError(
+                f"[tool.jaxlint] value for {pending_key!r} is not a "
+                f"string / list-of-strings literal: {pending!r}")
+        pending_key, pending = None, ""
+    if pending_key is not None:
+        raise ValueError(
+            f"[tool.jaxlint] value for {pending_key!r} is not a "
+            "string / list-of-strings literal (unclosed list?)")
+    return out
+
+
+def match_any(rel: str, patterns) -> bool:
+    """Does the repo-relative posix path match any configured pattern?
+    A pattern is an fnmatch glob, an exact path, or a directory prefix
+    (``pint_tpu/serve/`` or ``pint_tpu/serve``)."""
+    for pat in patterns:
+        if fnmatch.fnmatch(rel, pat) or rel == pat:
+            return True
+        if rel.startswith(pat.rstrip("/") + "/"):
+            return True
+    return False
+
+
+def site_match(rel: str, qualnames, sites) -> bool:
+    """Is this (file, enclosing-function-stack) an approved site?
+    Site entries are ``relpath`` (whole file) or ``relpath:Qual.name``
+    (that function and everything nested in it)."""
+    for site in sites:
+        path, _, qual = site.partition(":")
+        if not fnmatch.fnmatch(rel, path) and rel != path:
+            continue
+        if not qual or qual in qualnames:
+            return True
+    return False
+
+
+def gather_files(cfg: Config) -> list:
+    """Repo-relative posix paths of every Python file in scan scope."""
+    out = []
+    for entry in cfg.paths:
+        p = cfg.root / entry
+        if p.is_file():
+            out.append(entry)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                out.append(f.relative_to(cfg.root).as_posix())
+    return out
+
+
+# --------------------------------------------------------------- AST
+class Module:
+    """One parsed file + the shared lookups every rule needs."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._jl_parent = node
+        self.aliases = self._import_aliases()
+
+    def _import_aliases(self) -> dict:
+        """First-segment alias map: ``import jax.numpy as jnp`` ->
+        {"jnp": "jax.numpy"}; ``from pint_tpu import config`` ->
+        {"config": "pint_tpu.config"}."""
+        out: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        return out
+
+    def dotted(self, node) -> str | None:
+        """Canonical dotted name of a Name/Attribute chain with the
+        first segment resolved through the import aliases; None for
+        anything not a plain chain."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        parts[0] = self.aliases.get(parts[0], parts[0])
+        return ".".join(parts)
+
+    def enclosing(self, node) -> list:
+        """Innermost-first FunctionDef stack around ``node``."""
+        out = []
+        cur = getattr(node, "_jl_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = getattr(cur, "_jl_parent", None)
+        return out
+
+    def qualname(self, func) -> str:
+        """Dotted Class.outer.inner qualname of a FunctionDef."""
+        parts = [func.name]
+        cur = getattr(func, "_jl_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = getattr(cur, "_jl_parent", None)
+        return ".".join(reversed(parts))
+
+    def symbol_of(self, node) -> str:
+        funcs = self.enclosing(node)
+        return self.qualname(funcs[0]) if funcs else ""
+
+    def qualnames_of(self, node) -> set:
+        return {self.qualname(f) for f in self.enclosing(node)}
+
+    def functions(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def body_nodes(self, func):
+        """Every node lexically inside ``func`` but NOT inside a nested
+        function (each function's dataflow is analyzed in its own
+        scope)."""
+        for node in ast.walk(func):
+            if node is func:
+                continue
+            encl = self.enclosing(node)
+            if encl and encl[0] is func:
+                yield node
+
+
+# --------------------------------------------------- disable comments
+_DISABLE_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([a-z0-9,\-]+)(?:\s*--\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Disable:
+    line: int
+    rules: tuple
+    reason: str
+    used: bool = False
+
+
+def scan_disables(mod: Module) -> list:
+    out = []
+    for i, text in enumerate(mod.lines, start=1):
+        m = _DISABLE_RE.search(text)
+        if m:
+            out.append(Disable(
+                line=i,
+                rules=tuple(r.strip() for r in m.group(1).split(",")),
+                reason=(m.group(2) or "").strip()))
+    return out
+
+
+# ------------------------------------------------------------ driver
+def run(cfg: Config) -> list:
+    """All live findings (suppression comments already applied;
+    bare/unused-disable findings included). Baseline NOT applied —
+    see :func:`diff_baseline`."""
+    from tools.analyze import rules as _rules
+
+    files = gather_files(cfg)
+    findings: list = []
+    modules: dict = {}
+    for rel in files:
+        try:
+            mod = Module(rel, (cfg.root / rel).read_text())
+        except (SyntaxError, OSError) as exc:
+            findings.append(Finding(rel, 1, "env-knob-registry", "",
+                                    f"unparseable file: {exc}"))
+            continue
+        modules[rel] = mod
+
+    per_file_rules = (
+        _rules.rule_host_sync, _rules.rule_eager_jnp,
+        _rules.rule_donation, _rules.rule_env_knobs)
+    raw: list = []
+    for rel, mod in modules.items():
+        for rule_fn in per_file_rules:
+            raw.extend(rule_fn(mod, cfg))
+    raw.extend(_rules.rule_fingerprint_drift(cfg, modules))
+    raw.extend(_rules.rule_registry_integrity(cfg, modules))
+
+    # suppression pass: a disable on any physical line of the flagged
+    # statement covers it; track use so dead disables surface
+    disables = {rel: scan_disables(mod) for rel, mod in modules.items()}
+    for f in raw:
+        suppressed = False
+        for d in disables.get(f.file, ()):
+            span_end = max(f.end_line, f.line)
+            if f.line <= d.line <= span_end and f.rule in d.rules:
+                d.used = True
+                suppressed = True
+        if not suppressed:
+            findings.append(f)
+    for rel, ds in disables.items():
+        for d in ds:
+            if not d.reason:
+                findings.append(Finding(
+                    rel, d.line, "bare-disable", "",
+                    f"disable={','.join(d.rules)} carries no reason "
+                    "(append ' -- <why>'; suppressions must be "
+                    "self-documenting)"))
+            if not d.used:
+                findings.append(Finding(
+                    rel, d.line, "unused-disable", "",
+                    f"disable={','.join(d.rules)} suppresses nothing "
+                    "— delete it"))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------- baseline
+def load_baseline(cfg: Config) -> list:
+    p = cfg.root / cfg.baseline
+    if not p.is_file():
+        return []
+    data = json.loads(p.read_text())
+    return data.get("entries", [])
+
+
+def save_baseline(cfg: Config, findings: list) -> None:
+    entries = [dict(file=f.file, rule=f.rule, symbol=f.symbol,
+                    message=f.message,
+                    why="TODO: justify this grandfathered finding")
+               for f in findings]
+    p = cfg.root / cfg.baseline
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(
+        {"comment": "jaxlint grandfathered findings — every entry "
+                    "needs a 'why'; a stale entry fails the gate",
+         "entries": entries}, indent=1) + "\n")
+
+
+def diff_baseline(findings: list, entries: list) -> tuple:
+    """(new_findings, stale_entries): multiset matching on (file, rule,
+    symbol, message) — a baseline entry cancels exactly ONE live
+    finding, so a second instance of a grandfathered pattern is new."""
+    pool: dict = {}
+    for i, e in enumerate(entries):
+        key = (e.get("file"), e.get("rule"), e.get("symbol", ""),
+               e.get("message"))
+        pool.setdefault(key, []).append(i)
+    new = []
+    matched: set = set()
+    for f in findings:
+        bucket = pool.get(f.key())
+        if bucket:
+            matched.add(bucket.pop(0))
+        else:
+            new.append(f)
+    stale = [e for i, e in enumerate(entries) if i not in matched]
+    return new, stale

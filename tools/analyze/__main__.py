@@ -1,0 +1,92 @@
+"""CLI driver: ``python -m tools.analyze [options] [--root DIR]``.
+
+Exit codes: 0 clean vs the committed baseline, 1 new or stale
+findings, 2 internal error (unreadable config/registry). The findings
+stream is ``file:line rule-id message`` per line (``--json`` for the
+structured form) — the format CI logs and editors both grep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.analyze import (Config, diff_baseline, load_baseline, run,
+                           save_baseline)
+from tools.analyze.knobs import knob_table, render_markdown, render_text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="jaxlint: repo-specific static analysis")
+    ap.add_argument("--root", default=".",
+                    help="repo root (default: cwd)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as one JSON object")
+    ap.add_argument("--knobs", action="store_true",
+                    help="print the PINT_TPU_* knob table and exit")
+    ap.add_argument("--markdown", action="store_true",
+                    help="with --knobs: emit the docs/KNOBS.md form")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding (entries "
+                         "still need a hand-written 'why')")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report all findings, baseline ignored")
+    args = ap.parse_args(argv)
+
+    try:
+        cfg = Config.load(Path(args.root).resolve())
+    except Exception as exc:  # noqa: BLE001 — config errors are exit 2
+        print(f"jaxlint: unreadable config: {exc}", file=sys.stderr)
+        return 2
+
+    if args.knobs:
+        table = knob_table(cfg)
+        if args.markdown:
+            sys.stdout.write(render_markdown(table))
+        elif args.json:
+            print(json.dumps(table, indent=1))
+        else:
+            print(render_text(table))
+        return 0
+
+    try:
+        findings = run(cfg)
+    except Exception as exc:  # noqa: BLE001 — analyzer bug, not a finding
+        print(f"jaxlint: internal error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        save_baseline(cfg, findings)
+        print(f"jaxlint: wrote {len(findings)} entries to {cfg.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        new, stale = diff_baseline(findings, load_baseline(cfg))
+    from tools.analyze import Finding
+
+    for e in stale:
+        new.append(Finding(
+            e.get("file", cfg.baseline), 0, "stale-baseline", "",
+            f"baseline entry matches no live finding (rule "
+            f"{e.get('rule')}: {e.get('message')!r}) — delete it from "
+            f"{cfg.baseline}"))
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in new],
+            "count": len(new)}, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if new:
+            print(f"jaxlint: {len(new)} finding(s)", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

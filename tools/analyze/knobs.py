@@ -1,0 +1,104 @@
+"""Knob-table rendering for ``python -m tools.analyze --knobs``.
+
+The table is computed from the same two sources the registry rule
+checks: the literal ``declare(...)`` calls in ``pint_tpu/config.py``
+(name, default, kind, doc, scope) and the scan of every file in scope
+(which modules actually read/write each knob). ``docs/KNOBS.md`` is
+this module's ``--markdown`` output verbatim — generated, never
+hand-maintained (tests pin the regeneration).
+"""
+
+from __future__ import annotations
+
+from tools.analyze import Module
+
+
+def knob_table(cfg, modules=None) -> list:
+    """Sorted knob dicts: name/default/kind/doc/scope/readers."""
+    from tools.analyze import gather_files
+    from tools.analyze.rules import _env_call_sites, extract_registry
+
+    if modules is None:
+        modules = {}
+        for rel in gather_files(cfg):
+            try:
+                modules[rel] = Module(rel, (cfg.root / rel).read_text())
+            except (SyntaxError, OSError):
+                continue
+    knobs, _findings = extract_registry(cfg, modules)
+    readers: dict = {name: set() for name in knobs}
+    for rel, mod in modules.items():
+        for _node, _api, name_node, _w in _env_call_sites(mod):
+            if name_node is None or _w:
+                continue  # a write-only site is a setter, not a reader
+            try:
+                name = name_node.value
+            except AttributeError:
+                continue
+            if isinstance(name, str) and name in readers:
+                readers[name].add(rel)
+    out = []
+    for name in sorted(knobs):
+        e = knobs[name]
+        out.append({
+            "name": name,
+            "default": e["default"],
+            "kind": e["kind"],
+            "doc": e["doc"],
+            "scope": e["scope"],
+            "readers": sorted(readers.get(name, ())),
+        })
+    return out
+
+
+def _default_repr(v) -> str:
+    if v is None:
+        return "unset"
+    if v is True:
+        return "on"
+    if v is False:
+        return "off"
+    if v == "":
+        return "unset"
+    return str(v)
+
+
+def render_text(table: list) -> str:
+    lines = []
+    for e in table:
+        readers = ", ".join(e["readers"]) or "(not read in scan scope)"
+        lines.append(f"{e['name']}  [{e['kind']}, default "
+                     f"{_default_repr(e['default'])}, scope {e['scope']}]")
+        lines.append(f"    {e['doc']}")
+        lines.append(f"    read by: {readers}")
+    return "\n".join(lines)
+
+
+def render_markdown(table: list) -> str:
+    head = [
+        "# PINT_TPU_* environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit. Regenerate with",
+        "     `python -m tools.analyze --knobs --markdown > docs/KNOBS.md`.",
+        "     tests/test_analyze.py pins this file against the",
+        "     registry in pint_tpu/config.py. -->",
+        "",
+        "Every knob is declared in `pint_tpu/config.py` (the central",
+        "registry: default + kind + doc) and read through its typed",
+        "helpers; `python -m tools.analyze` (rule `env-knob-registry`)",
+        "fails CI on any direct/undeclared read. Kinds: `bool` follows",
+        "the kill-switch convention (`0` disables, unset/empty takes",
+        "the default, anything else enables); `tristate` values are",
+        "compared literally at the call site.",
+        "",
+        "| knob | kind | default | scope | read by | doc |",
+        "|---|---|---|---|---|---|",
+    ]
+    rows = []
+    for e in table:
+        readers = "<br>".join(e["readers"]) or "—"
+        doc = " ".join(str(e["doc"]).split()).replace("|", "\\|")
+        rows.append(f"| `{e['name']}` | {e['kind']} | "
+                    f"`{_default_repr(e['default'])}` | {e['scope']} | "
+                    f"{readers} | {doc} |")
+    return "\n".join(head + rows) + "\n"

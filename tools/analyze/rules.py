@@ -1,0 +1,624 @@
+"""jaxlint rule implementations (see package docstring for the bug
+class each rule encodes). Every rule yields :class:`tools.analyze
+.Finding` with a line-free message so baseline matching survives
+reflows."""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+
+from tools.analyze import Finding, match_any, site_match
+
+ENV_HELPERS = {
+    "env_raw": None, "env_str": "str", "env_int": "int",
+    "env_float": "float", "env_on": "bool",
+}
+
+_HOST_CASTS = {"float", "bool", "int"}
+_NP_MATERIALIZE = {"numpy.asarray", "numpy.array"}
+
+
+def _jax_assignments(mod, func):
+    """Ordered (line, name, is_jax) assignment events in ``func``'s own
+    scope — is_jax when the RHS is a ``jax.numpy.*`` (or
+    ``jax.device_put``) call, the provenance heuristic the host-sync
+    rule keys on."""
+    events = []
+    for node in mod.body_nodes(func):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        is_jax = False
+        if isinstance(value, ast.Call):
+            dn = mod.dotted(value.func) or ""
+            is_jax = (dn.startswith("jax.numpy.")
+                      or dn == "jax.device_put")
+        for t in targets:
+            if isinstance(t, ast.Name):
+                events.append((node.lineno, t.id, is_jax))
+    events.sort(key=lambda e: e[0])
+    return events
+
+
+def _is_jax_at(events, name: str, line: int) -> bool:
+    state = False
+    for ln, nm, is_jax in events:
+        if ln >= line:
+            break
+        if nm == name:
+            state = is_jax
+    return state
+
+
+def rule_host_sync(mod, cfg):
+    """host-sync-in-hot-path: device->host syncs outside the approved
+    fetch sites of the hot-path modules (the one-launch/one-fetch
+    contract of the fused loops — PR-4/PR-5 counters pin it at runtime,
+    this pins it at diff time)."""
+    if not match_any(mod.rel, cfg.hot_path):
+        return
+    per_func_events = {}
+    for node in ast.walk(mod.tree):
+        funcs = mod.enclosing(node)
+        quals = {mod.qualname(f) for f in funcs}
+        approved = site_match(mod.rel, quals, cfg.fetch_sites)
+        if isinstance(node, ast.Call):
+            dn = mod.dotted(node.func) or ""
+            terminal = (node.func.attr
+                        if isinstance(node.func, ast.Attribute) else dn)
+            if dn in ("jax.device_get", "jax.block_until_ready") or \
+                    terminal == "block_until_ready":
+                if not approved:
+                    yield Finding(
+                        mod.rel, node.lineno, "host-sync-in-hot-path",
+                        mod.symbol_of(node),
+                        f"{terminal or dn} outside an approved fetch "
+                        "site — the fused path's single device->host "
+                        "sync lives in the fetch/finish handles only",
+                        end_line=node.end_lineno or node.lineno)
+                continue
+            if not funcs:
+                continue
+            func = funcs[0]
+            if func not in per_func_events:
+                per_func_events[func] = _jax_assignments(mod, func)
+            events = per_func_events[func]
+
+            def _flag(arg_name, what, n=node):
+                return Finding(
+                    mod.rel, n.lineno, "host-sync-in-hot-path",
+                    mod.symbol_of(n),
+                    f"{what} on device array '{arg_name}' forces a "
+                    "blocking transfer in a hot path (fetch it once "
+                    "at the approved site instead)",
+                    end_line=n.end_lineno or n.lineno)
+
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_CASTS
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and not approved
+                    and _is_jax_at(events, node.args[0].id, node.lineno)):
+                yield _flag(node.args[0].id, f"{node.func.id}()")
+            elif (dn in _NP_MATERIALIZE and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and not approved
+                    and _is_jax_at(events, node.args[0].id, node.lineno)):
+                yield _flag(node.args[0].id, dn)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and isinstance(node.func.value, ast.Name)
+                    and not approved
+                    and _is_jax_at(events, node.func.value.id,
+                                   node.lineno)):
+                yield _flag(node.func.value.id, ".item()")
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            if not (isinstance(it, ast.Name) and funcs):
+                continue
+            func = funcs[0]
+            if func not in per_func_events:
+                per_func_events[func] = _jax_assignments(mod, func)
+            if (not approved and _is_jax_at(per_func_events[func], it.id,
+                                            it.lineno)):
+                yield Finding(
+                    mod.rel, it.lineno, "host-sync-in-hot-path",
+                    mod.symbol_of(it),
+                    f"iteration over device array '{it.id}' is one "
+                    "blocking transfer per element in a hot path",
+                    end_line=it.lineno)
+
+
+def rule_eager_jnp(mod, cfg):
+    """eager-jnp-in-host-prep: a ``jnp.*`` call on the host-prep /
+    submit paths is a hidden per-member XLA dispatch (the PR-5 toa_mask
+    and PR-8 stack_toas lessons) — those paths stay numpy until the one
+    shard-time ``device_put``, which happens only inside the configured
+    ``prep_boundary`` functions."""
+    if not match_any(mod.rel, cfg.host_prep):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = mod.dotted(node.func) or ""
+        if not dn.startswith("jax.numpy."):
+            continue
+        quals = mod.qualnames_of(node)
+        if site_match(mod.rel, quals, cfg.prep_boundary):
+            continue
+        yield Finding(
+            mod.rel, node.lineno, "eager-jnp-in-host-prep",
+            mod.symbol_of(node),
+            f"eager {dn.replace('jax.numpy', 'jnp')}() in a host-prep "
+            "path — numpy until the one shard-time device_put "
+            "(PR-5/PR-8 rule); device work belongs in a prep_boundary "
+            "function", end_line=node.end_lineno or node.lineno)
+
+
+def _donating_specs(cfg):
+    out = {}
+    for spec in cfg.donating_calls:
+        parts = spec.split(":")
+        name = parts[0]
+        pos = int(parts[1])
+        gate = parts[2] if len(parts) > 2 else None
+        out[name] = (pos, gate)
+    return out
+
+
+def _donated_names(expr) -> set:
+    """Bare local Names inside a donated operand expression. A Name
+    that is the receiver of an attribute chain (``entry.state``) is
+    skipped — the donated buffer lives behind the attribute and a later
+    read of the OBJECT is fine (the PR-10 'copy the append table'
+    pattern must not flag)."""
+    out = set()
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Name) or node.id == "self":
+            continue
+        parent = getattr(node, "_jl_parent", None)
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            continue
+        if isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+    return out
+
+
+def _truthy_gate(call, gate: str | None) -> bool:
+    """Does the call donate? With no gate kwarg configured, always.
+    With one, the kwarg must be present and not literally False/0/None
+    (a Name or expression is conservatively treated as possibly-True)."""
+    if gate is None:
+        return True
+    for kw in call.keywords:
+        if kw.arg == gate:
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True
+    return False
+
+
+def _jit_donated_positions(call, mod) -> tuple | None:
+    """Literal donated argnums of a ``jax.jit(f, donate_argnums=...)``
+    call, or None (absent / non-literal — dynamic argnums are skipped,
+    never guessed)."""
+    if (mod.dotted(call.func) or "") not in ("jax.jit", "jax.pjit",
+                                             "jax.experimental.pjit.pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return None
+            if isinstance(val, int):
+                return (val,)
+            if isinstance(val, (tuple, list)) and all(
+                    isinstance(v, int) for v in val):
+                return tuple(val)
+            return None
+    return None
+
+
+def rule_donation(mod, cfg):
+    """donation-safety: a local passed as a donated operand and read
+    again in the same function after the dispatch. On accelerators the
+    buffer is deleted at execution; on XLA:CPU donation no-ops and the
+    read silently sees stale math — the PR-10 same-drain-session class.
+    """
+    specs = _donating_specs(cfg)
+    for func in mod.functions():
+        # jit-wrapped locals with literal donate_argnums: name -> tuple
+        jit_donators: dict = {}
+        donations = []  # (line_end, donated name set, call node)
+        for node in mod.body_nodes(func):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                pos = _jit_donated_positions(node.value, mod)
+                if pos is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jit_donators[t.id] = pos
+            if not isinstance(node, ast.Call):
+                continue
+            terminal = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else None)
+            donated: set = set()
+            if terminal in specs:
+                pos, gate = specs[terminal]
+                if _truthy_gate(node, gate) and len(node.args) > pos:
+                    donated |= _donated_names(node.args[pos])
+                # the operand may also ride a keyword of the same name
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in jit_donators):
+                for p in jit_donators[node.func.id]:
+                    if p < len(node.args):
+                        donated |= _donated_names(node.args[p])
+            elif isinstance(node.func, ast.Call):
+                pos = _jit_donated_positions(node.func, mod)
+                if pos is not None:
+                    for p in pos:
+                        if p < len(node.args):
+                            donated |= _donated_names(node.args[p])
+            if donated:
+                donations.append(
+                    (node.end_lineno or node.lineno, donated, node))
+        if not donations:
+            continue
+        # later loads / kills, in line order
+        loads, kills = [], []
+        for node in mod.body_nodes(func):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    parent = getattr(node, "_jl_parent", None)
+                    loads.append((node.lineno, node.id, node, parent))
+                else:
+                    kills.append((node.lineno, node.id))
+        for after, names, call in donations:
+            for name in sorted(names):
+                for ln, nm, node, parent in loads:
+                    if nm != name or ln <= after:
+                        continue
+                    # a Store at the donating statement itself
+                    # (``state = g(a, state)``) re-binds the name to
+                    # the result — that and any later re-bind kills
+                    killed = any(k_nm == name and call.lineno <= k_ln <= ln
+                                 for k_ln, k_nm in kills)
+                    if killed:
+                        continue
+                    yield Finding(
+                        mod.rel, ln, "donation-safety",
+                        mod.symbol_of(node),
+                        f"'{name}' was donated to a dispatch above and "
+                        "read again — the buffer is deleted on "
+                        "accelerators (stale on XLA:CPU); copy before "
+                        "donating or reload from the handle",
+                        end_line=ln)
+                    break  # one finding per donated name
+
+
+# ------------------------------------------------- fingerprint drift
+_MARKER_ATTR = re.compile(r"^is_noise_[a-z0-9_]+$")
+# qualified scale hooks only (scale_dm_sigma, a future scale_chrom_
+# sigma): plain scale_sigma is the white-noise hook whose category
+# marker is the is_noise_scale class attr above
+_MARKER_METH = re.compile(r"^scale_[a-z0-9]+_sigma$")
+
+
+def _getattr_strings(nodes, mod) -> set:
+    """Second-arg string constants of getattr()/hasattr() calls."""
+    out = set()
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (isinstance(fn, ast.Name) and fn.id in ("getattr", "hasattr")
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            out.add(node.args[1].value)
+    return out
+
+
+def _find_function(mod, name: str):
+    for func in mod.functions():
+        if func.name == name:
+            return func
+    return None
+
+
+def rule_fingerprint_drift(cfg, modules):
+    """fingerprint-drift: every noise/scale component marker in the
+    model zoo is (a) handled by ``_noise_value_params`` (values join
+    the traced set) AND (b) handled by the union builder's
+    normalization, or (c) named by a ``batchable`` passthrough reason
+    token. A new marker missing any leg reproduces the PR-8/PR-14
+    drift: values silently pin into the program key and every mix
+    recompiles."""
+    fp_mod = modules.get(cfg.fingerprint_file)
+    un_mod = modules.get(cfg.union_file)
+    if fp_mod is None or un_mod is None:
+        return  # fixture trees may scope the rule out entirely
+    fp_fn = _find_function(fp_mod, "_noise_value_params")
+    fp_handled = (_getattr_strings(ast.walk(fp_fn), fp_mod)
+                  if fp_fn else set())
+    un_handled = _getattr_strings(ast.walk(un_mod.tree), un_mod)
+    reasons = set()
+    bt_fn = _find_function(fp_mod, "batchable")
+    if bt_fn:
+        for node in ast.walk(bt_fn):
+            if (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Tuple)
+                    and len(node.value.elts) == 2
+                    and isinstance(node.value.elts[0], ast.Constant)
+                    and node.value.elts[0].value is False
+                    and isinstance(node.value.elts[1], ast.Constant)
+                    and isinstance(node.value.elts[1].value, str)
+                    and node.value.elts[1].value):
+                reasons.add(node.value.elts[1].value)
+
+    # reason tokens are part of the serve contract — each is documented
+    arch = cfg.root / cfg.docs_arch
+    arch_text = arch.read_text() if arch.is_file() else ""
+    for tok in sorted(reasons):
+        if tok and tok not in arch_text:
+            yield Finding(
+                cfg.fingerprint_file,
+                bt_fn.lineno if bt_fn else 1, "fingerprint-drift",
+                "batchable",
+                f"passthrough reason token '{tok}' is not documented "
+                f"in {cfg.docs_arch} (the rule catalog / batchable "
+                "frontier section)")
+
+    for rel, mod in sorted(modules.items()):
+        if not fnmatch.fnmatch(rel, cfg.models_glob):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            markers = []
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and _MARKER_ATTR.match(stmt.targets[0].id)
+                        and isinstance(stmt.value, ast.Constant)
+                        and stmt.value.value is True):
+                    markers.append((stmt.targets[0].id, stmt.lineno))
+                elif (isinstance(stmt, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                        and _MARKER_METH.match(stmt.name)):
+                    markers.append((stmt.name, stmt.lineno))
+            for marker, line in markers:
+                stem = marker[3:] if marker.startswith("is_") else marker
+                if any(stem in tok or tok in stem for tok in reasons):
+                    continue  # routed passthrough — explicitly named
+                missing = []
+                if marker not in fp_handled:
+                    missing.append(
+                        "fingerprint._noise_value_params (traced set)")
+                if marker not in un_handled:
+                    missing.append("build_union_model normalization")
+                if missing:
+                    yield Finding(
+                        rel, line, "fingerprint-drift", node.name,
+                        f"noise marker '{marker}' on {node.name} is "
+                        f"not handled by {' or '.join(missing)} and no "
+                        "batchable passthrough reason names it — "
+                        "values would silently pin into the program "
+                        "key")
+
+
+# ------------------------------------------------- env-knob registry
+_KNOB_TOKEN = re.compile(r"PINT_TPU_[A-Z0-9_]+")
+
+
+def extract_registry(cfg, modules) -> tuple:
+    """(knobs, findings) parsed from the registry file's literal
+    ``declare(...)`` calls — by AST, never import (the analyzer must
+    run without jax)."""
+    findings = []
+    knobs: dict = {}
+    mod = modules.get(cfg.registry_file)
+    if mod is None:
+        try:
+            from tools.analyze import Module
+            mod = Module(cfg.registry_file,
+                         (cfg.root / cfg.registry_file).read_text())
+        except OSError:
+            return {}, [Finding(cfg.registry_file, 1,
+                                "env-knob-registry", "",
+                                "knob registry file missing")]
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "declare"):
+            continue
+        try:
+            args = [ast.literal_eval(a) for a in node.args]
+            kwargs = {kw.arg: ast.literal_eval(kw.value)
+                      for kw in node.keywords}
+        except (ValueError, SyntaxError):
+            findings.append(Finding(
+                cfg.registry_file, node.lineno, "env-knob-registry", "",
+                "declare() with non-literal arguments — the registry "
+                "must be statically readable"))
+            continue
+        name = args[0] if args else kwargs.get("name")
+        entry = {"name": name, "line": node.lineno}
+        for i, field in enumerate(("default", "kind", "doc"), start=1):
+            entry[field] = (args[i] if len(args) > i
+                            else kwargs.get(field))
+        entry["scope"] = (args[4] if len(args) > 4
+                          else kwargs.get("scope", "lib"))
+        if name in knobs:
+            findings.append(Finding(
+                cfg.registry_file, node.lineno, "env-knob-registry", "",
+                f"duplicate declaration of {name}"))
+        knobs[name] = entry
+    return knobs, findings
+
+
+def _env_call_sites(mod):
+    """(node, api, name_node, is_write) for every environment access:
+    api in {'environ', 'getenv', 'helper:<fn>'}."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            dn = mod.dotted(node.func) or ""
+            terminal = dn.rsplit(".", 1)[-1]
+            if dn in ("os.environ.get", "os.getenv"):
+                if node.args:
+                    yield node, "getenv", node.args[0], False
+            elif dn in ("os.environ.setdefault", "os.environ.pop"):
+                if node.args:
+                    yield node, "getenv", node.args[0], True
+            elif terminal in ENV_HELPERS:
+                if node.args:
+                    yield node, f"helper:{terminal}", node.args[0], False
+                else:
+                    yield node, f"helper:{terminal}", None, False
+        elif isinstance(node, ast.Subscript):
+            if (mod.dotted(node.value) or "") == "os.environ":
+                yield (node, "environ-subscript", node.slice,
+                       not isinstance(node.ctx, ast.Load))
+
+
+def _mentions_knob(expr) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "PINT_TPU" in sub.value:
+            return True
+    return False
+
+
+def rule_env_knobs(mod, cfg):
+    """env-knob-registry (per-file half): direct ``os.environ`` READS
+    of PINT_TPU knobs outside the registry module, and unreadable
+    (non-literal) knob names. Declared-ness is checked by
+    :func:`rule_registry_integrity` with the registry in hand."""
+    is_registry = mod.rel == cfg.registry_file
+    for node, api, name_node, is_write in _env_call_sites(mod):
+        if name_node is None:
+            yield Finding(
+                mod.rel, node.lineno, "env-knob-registry",
+                mod.symbol_of(node),
+                f"{api} read with no knob name argument")
+            continue
+        if isinstance(name_node, ast.Constant) and isinstance(
+                name_node.value, str):
+            name = name_node.value
+            if not name.startswith("PINT_TPU_"):
+                continue
+            if (api in ("getenv", "environ-subscript") and not is_write
+                    and not is_registry):
+                yield Finding(
+                    mod.rel, node.lineno, "env-knob-registry",
+                    mod.symbol_of(node),
+                    f"direct environ read of {name} — resolve it "
+                    "through pint_tpu.config (env_raw/env_str/env_int/"
+                    "env_float/env_on) so the default and doc live in "
+                    "the registry",
+                    end_line=node.end_lineno or node.lineno)
+        elif _mentions_knob(name_node) or api.startswith("helper:"):
+            yield Finding(
+                mod.rel, node.lineno, "env-knob-registry",
+                mod.symbol_of(node),
+                f"unreadable knob name in {api} access — knob names "
+                "must be string literals so the registry check can "
+                "verify them",
+                end_line=node.end_lineno or node.lineno)
+
+
+_HELPER_KIND_OK = {
+    "env_raw": None,            # any kind
+    "env_str": ("str",),
+    "env_int": ("int",),
+    "env_float": ("float", "int"),
+    "env_on": ("bool",),
+}
+
+
+def rule_registry_integrity(cfg, modules):
+    """env-knob-registry (whole-tree half): every knob token named in
+    scanned source is declared; helper reads agree with the declared
+    kind; every non-tests/reserved knob is actually read somewhere; and
+    every declared knob appears in the generated docs table."""
+    knobs, findings = extract_registry(cfg, modules)
+    if not knobs and findings:
+        # no registry in this tree (fixture roots): stay silent unless
+        # the scanned files actually reference knobs
+        any_ref = any(
+            _KNOB_TOKEN.search(line)
+            for mod in modules.values() for line in mod.lines)
+        if not any_ref:
+            return
+    yield from findings
+    referenced: set = set()
+    for rel, mod in sorted(modules.items()):
+        # (a) typed-helper reads must match the declared kind
+        for node, api, name_node, _w in _env_call_sites(mod):
+            if not (api.startswith("helper:")
+                    and isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)):
+                continue
+            name = name_node.value
+            helper = api.split(":", 1)[1]
+            if name not in knobs:
+                yield Finding(
+                    rel, node.lineno, "env-knob-registry",
+                    mod.symbol_of(node),
+                    f"{helper}({name!r}) reads an undeclared knob — "
+                    f"declare it in {cfg.registry_file}")
+                continue
+            ok = _HELPER_KIND_OK.get(helper)
+            kind = knobs[name]["kind"]
+            if ok is not None and kind not in ok:
+                yield Finding(
+                    rel, node.lineno, "env-knob-registry",
+                    mod.symbol_of(node),
+                    f"{helper}({name!r}) disagrees with declared kind "
+                    f"'{kind}'")
+        # (b) every PINT_TPU token in the source (docstrings and error
+        # messages included) must name a declared knob — the CHANGES-era
+        # kill-switch inventory check; tokens ending '_' are treated as
+        # wrapped across a line break and skipped
+        for i, line in enumerate(mod.lines, start=1):
+            for m in _KNOB_TOKEN.finditer(line):
+                tok = m.group(0)
+                if tok.endswith("_"):
+                    continue
+                if rel != cfg.registry_file:
+                    # the registry's own declare() lines don't count as
+                    # references, or no knob could ever be dead
+                    referenced.add(tok)
+                if tok not in knobs and rel != cfg.registry_file:
+                    yield Finding(
+                        rel, i, "env-knob-registry", "",
+                        f"{tok} is not declared in the knob registry "
+                        f"({cfg.registry_file})")
+    docs = cfg.root / cfg.docs_knobs
+    docs_text = docs.read_text() if docs.is_file() else ""
+    for name, entry in sorted(knobs.items()):
+        if (entry["scope"] not in ("tests", "reserved")
+                and name not in referenced):
+            yield Finding(
+                cfg.registry_file, entry["line"], "env-knob-registry",
+                "", f"declared knob {name} is read nowhere in the "
+                "scanned tree (dead knob — delete it or mark scope "
+                "tests/reserved)")
+        if name not in docs_text:
+            yield Finding(
+                cfg.registry_file, entry["line"], "env-knob-registry",
+                "", f"declared knob {name} missing from "
+                f"{cfg.docs_knobs} — regenerate it (python -m "
+                "tools.analyze --knobs --markdown)")

@@ -31,6 +31,7 @@ import traceback
 import numpy as np
 
 import pint_tpu  # noqa: F401
+from pint_tpu import config
 from pint_tpu.fitting.fitter import Fitter
 from pint_tpu.models import get_model
 from pint_tpu.residuals import Residuals
@@ -1303,8 +1304,8 @@ def main() -> int:
     # program-cache hit/miss) + a host sample ride each trial record, so
     # a slow or flaky trial is diagnosable from the committed SOAK JSON
     telemetry.configure(
-        enabled=os.environ.get("PINT_TPU_TELEMETRY", "") != "0",
-        jsonl_path=os.environ.get("PINT_TPU_TELEMETRY_PATH") or None)
+        enabled=config.env_raw("PINT_TPU_TELEMETRY") != "0",
+        jsonl_path=config.env_str("PINT_TPU_TELEMETRY_PATH"))
 
     record = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
               "git_sha": _git_sha(), "jax": jax.__version__,
@@ -1332,7 +1333,7 @@ def main() -> int:
         ('' when unwritable)."""
         from pint_tpu.telemetry import recorder
 
-        out_dir = os.environ.get("PINT_TPU_SOAK_REPRO_DIR", ".")
+        out_dir = config.env_str("PINT_TPU_SOAK_REPRO_DIR")
         path = os.path.join(out_dir, f"soak_repro_seed{seed}.json")
         rec = {"seed": seed, "ok": ok, "axes": axes,
                "counters": deltas, "trace": recorder.last_trace(),
