@@ -151,6 +151,23 @@ def _telemetry_fields() -> dict:
     return out
 
 
+def _compile_split() -> dict:
+    """Per-structure compile seconds from the supply-chain counters.
+
+    ``_resolve_program`` times every ``lower().compile()`` into
+    ``programs.compile_s.<kind>`` (device_loop / device_loop_gls /
+    device_loop_wideband / predict kinds), so a bench record can say
+    WHICH structure owned the compile bill instead of one aggregate
+    ``loop_compile_s``. Cumulative for the child process.
+    """
+    from pint_tpu import telemetry
+
+    pre = "programs.compile_s."
+    return {k[len(pre):]: round(v, 3)
+            for k, v in telemetry.counters_snapshot().items()
+            if k.startswith(pre)}
+
+
 def _init_backend() -> list:
     """jax.devices() with a hard timeout -> diagnostic instead of a hang."""
 
@@ -534,6 +551,7 @@ def _bench_fit_loop(toas, noise, pl_specs, compiled_step,
         # cross-check in BENCH_DETAIL: fit.device_loop.fetches)
         "fetch_counter_total": int(fetches),
         "loop_compile_s": round(loop_compile_s, 3),
+        "compile_split_s": _compile_split(),
         "maxiter": maxiter,
         "min_chi2_decrease": mdec,
         "reps": reps,
@@ -748,6 +766,7 @@ def _bench_fit_throughput(n_fits: int = 64, reps: int = 3) -> dict:
         # amortization honesty (satellite): the compile cost next to the
         # per-fit wall, charged over this run's n
         "loop_compile_s": round(loop_compile_s, 3),
+        "compile_split_s": _compile_split(),
         "sequential_cold_s": round(seq_cold, 3),
         "compile_amortized_over_n": {
             "n": n_fits,
@@ -1175,6 +1194,7 @@ def _bench_fit_throughput_mixed(n_fits: int = 64, reps: int = 3) -> dict:
         "program_cache_miss": misses,
         "program_cache_hit_rate": round(hits / max(1, hits + misses), 4),
         "loop_compile_s": round(loop_compile_s, 3),
+        "compile_split_s": _compile_split(),
         "sequential_cold_s": round(seq_cold, 3),
         "sequential_walls": [round(t, 4) for t in seq_walls],
         "scheduled_walls": [round(t, 4) for t in sched_walls],
@@ -2359,6 +2379,361 @@ def bench_fleet() -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def _bench_fleet_coldjoin() -> dict:
+    """The ISSUE-16 elastic-join A/B: a COLD worker process (empty
+    program store) joins an N=2 real-process fleet mid-stream.
+
+    Phases, all recorded:
+
+    1. **Warm the donors**: every structure is fit once on EACH donor
+       (direct transport submits — both stores must cover the whole
+       warm set so the single-donor pull suffices) plus one routed
+       round for the router's popularity/warm-set stats, and one
+       routed read per structure to compile the read programs.
+    2. **Live traffic**: a full fit round is submitted and left
+       PENDING, then the joiner (own empty ``PINT_TPU_PROGRAM_CACHE_
+       DIR``) is added — the handshake (select/pull/ship/adopt/
+       restash) runs with that traffic queued. Routed-read walls are
+       measured immediately before and immediately after the join;
+       the "unperturbed" gate compares reads whose structures did NOT
+       move to the joiner (a moved structure's first read pays its
+       own one-time warmup on the new host, reported separately).
+    3. **First sticky fit**: a structure whose NEW ring winner is the
+       joiner is submitted through the router; the joiner's ``report``
+       op must show ZERO new ``cache.fit_program.miss`` — its manifest
+       adopted the donors' warm keys, so the restart-accounting hit
+       fires on the very first dispatch (the supply-chain contract).
+    """
+    import tempfile
+
+    from pint_tpu import telemetry as _t
+    from pint_tpu.fleet import FleetRouter, TcpHost, rendezvous_rank
+    from pint_tpu.fleet.worker import spawn_local_workers
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, PredictRequest
+    from pint_tpu.serve import fingerprint as _fpm
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par_0 = ("PSRJ FAKE_COLDJOIN\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+             "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+             "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+             "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    pars = [par_0,
+            par_0 + "FD1 1.0e-5 1\n",
+            par_0 + "FD1 1.0e-5 1\nFD2 1.0e-9 1\n",
+            par_0.replace("DM 223.9", "DM 223.9 1"),
+            par_0 + "PHOFF 0.0 1\n",
+            par_0.replace("F1 -1.181e-15 1", "F1 -1.181e-15")]
+    hyper = dict(maxiter=8, min_chi2_decrease=1e-5)
+    structures = []
+    for i, par in enumerate(pars):
+        truth = get_model(par)
+        toas = make_fake_toas_uniform(
+            53000, 56000, 40, truth, obs="@",
+            freq_mhz=np.array([1400.0, 430.0]), error_us=2.0,
+            add_noise=True, seed=700 + i)
+        structures.append((par, toas))
+
+    def request(i, tag):
+        par, toas = structures[i]
+        m = get_model(par)
+        m["F0"].add_delta(2e-10)
+        return FitRequest(toas, m, tag=tag, **hyper)
+
+    root = tempfile.mkdtemp(prefix="pint_tpu_coldjoin_")
+    workers = spawn_local_workers(
+        2, env_per_worker=[
+            {"PINT_TPU_PROGRAM_CACHE_DIR": os.path.join(root, "w0")},
+            {"PINT_TPU_PROGRAM_CACHE_DIR": os.path.join(root, "w1")}])
+    hosts = {h: TcpHost(h, ("127.0.0.1", p)) for h, p, _ in workers}
+    joiner_proc = None
+    rec: dict = {"type": "fleet_coldjoin", "n_structures": len(pars)}
+    try:
+        router = FleetRouter(list(hosts.values()))
+        # -- phase 1: warm every structure on BOTH donors --------------
+        t0 = time.perf_counter()
+        for t in hosts.values():
+            for i in range(len(structures)):
+                t.submit(request(i, tag=f"warm-{t.host_id}-{i}"))
+        for t in hosts.values():
+            for r in t.drain(600.0):
+                if r.get("status") not in ("ok", "nonconverged"):
+                    rec["warm_error"] = r.get("status")
+        rec["donor_warm_wall_s"] = round(time.perf_counter() - t0, 3)
+        # a routed round: popularity + per-host warm sets + read warmup
+        for i in range(len(structures)):
+            router.submit(request(i, tag=f"pop-{i}"))
+        routed = [r.status for r in router.drain()]
+        rec["routed_round"] = routed
+        mjds = np.sort(np.random.default_rng(11).uniform(
+            54000.001, 54000.999, 16))
+
+        def read_round(label):
+            walls, bad = {}, 0
+            for i, (par, _toas) in enumerate(structures):
+                t1 = time.perf_counter()
+                r = router.predict(PredictRequest(mjds,
+                                                  model=get_model(par)))
+                walls[i] = round(time.perf_counter() - t1, 4)
+                bad += r.status != "ok"
+            return walls, bad
+
+        read_round("compile")           # per-structure read warmup
+        # -- phase 2: live traffic + the join --------------------------
+        for i in range(len(structures)):
+            router.submit(request(i, tag=f"live-{i}"))
+        walls_before, bad_before = read_round("before")
+        (jid, jport, jproc), = spawn_local_workers(
+            1, prefix="j", env_per_worker=[{
+                "PINT_TPU_PROGRAM_CACHE_DIR": os.path.join(root, "wj")}])
+        joiner_proc = jproc
+        jt = TcpHost(jid, ("127.0.0.1", jport))
+        before = _t.counters_snapshot()
+        t2 = time.perf_counter()
+        router.add_host(jt)
+        join_wall = time.perf_counter() - t2
+        jdelta = _t.counters_delta(before)
+        hosts[jid] = jt
+        walls_after, bad_after = read_round("after")
+        live = [r.status for r in router.drain()]
+        # -- phase 3: the joiner's first sticky fit --------------------
+        fp8s = {i: _fpm.short_id(_fpm.structure_fingerprint(
+            get_model(par), toas)) for i, (par, toas) in
+            enumerate(structures)}
+        ring = list(router.hosts)
+        moved = [i for i in fp8s
+                 if rendezvous_rank(fp8s[i], ring)[0] == jid]
+        rep0 = jt.report()
+        if moved:
+            h = router.submit(request(moved[0], tag="first-sticky"))
+            t3 = time.perf_counter()
+            res = router.drain()
+            first = {"structure": moved[0], "routed_host": h.host,
+                     "route": h.route,
+                     "status": res[0].status if res else "lost",
+                     "wall_s": round(time.perf_counter() - t3, 3),
+                     "via": "router"}
+        else:
+            # the ring moved nothing (possible at this structure
+            # count): submit the hottest structure straight at the
+            # joiner — the zero-miss adopt contract is host state, not
+            # a routing property
+            jt.submit(request(0, tag="first-direct"))
+            t3 = time.perf_counter()
+            out = jt.drain(600.0)
+            first = {"structure": 0, "routed_host": jid,
+                     "route": "direct",
+                     "status": out[0].get("status") if out else "lost",
+                     "wall_s": round(time.perf_counter() - t3, 3),
+                     "via": "transport"}
+        rep1 = jt.report()
+        first["joiner_program_miss_delta"] = (
+            int(rep1.get("program_misses", -1))
+            - int(rep0.get("program_misses", 0)))
+        # p99 over the structures that did NOT move to the joiner: the
+        # serving plane the join must not perturb. Moved structures'
+        # first post-join read pays a one-time warmup on its new host
+        # (reported, not gated — same class as any cold structure).
+        stay = [i for i in fp8s if i not in moved]
+        p99_before = max(walls_before[i] for i in stay) \
+            if stay else -1.0
+        p99_after = max(walls_after[i] for i in stay) if stay else -1.0
+        p99_ok = (bad_before == bad_after == 0 and stay
+                  and p99_after <= max(3.0 * p99_before, 0.25))
+        joiner_store = rep1.get("programs") or {}
+        rec.update({
+            "join_wall_s": round(join_wall, 3),
+            "join_ready": int(jdelta.get("fleet.join.ready", 0)),
+            "join_abandoned": int(jdelta.get("fleet.join.abandoned",
+                                             0)),
+            "moved_structures": moved,
+            "adopted_prior_keys": int(joiner_store.get("prior", 0)),
+            "joiner_store": joiner_store,
+            "first_sticky": first,
+            "live_round_statuses": live,
+            "read_p99_stay_before_s": p99_before,
+            "read_p99_stay_after_s": p99_after,
+            "read_walls_before_s": walls_before,
+            "read_walls_after_s": walls_after,
+            "moved_first_read_s": {i: walls_after[i] for i in moved},
+            "p99_ok": bool(p99_ok),
+        })
+        rec["ok"] = bool(
+            rec["join_ready"] == 1 and rec["join_abandoned"] == 0
+            and rec["adopted_prior_keys"] > 0
+            and first["status"] in ("ok", "nonconverged")
+            and first["joiner_program_miss_delta"] == 0
+            and all(s in ("ok", "nonconverged") for s in live)
+            and p99_ok)
+        rec["honest_wall_note"] = (
+            "3 worker processes share this host's cores: walls prove "
+            "the handshake is off the serving path and the zero-miss "
+            "adopt accounting, not spatial speedup (the MULTICHIP_r06 "
+            "convention)")
+        return rec
+    finally:
+        for t in hosts.values():
+            try:
+                t.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        for _h, _p2, p in workers:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        if joiner_proc is not None and joiner_proc.poll() is None:
+            try:
+                joiner_proc.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                joiner_proc.kill()
+
+
+def bench_fleet_coldjoin() -> None:
+    """Standalone cold-join A/B (``PINT_TPU_BENCH_MODE=coldjoin``;
+    ISSUE 16). ``value`` is the joiner's first-sticky-fit wall;
+    ``vs_baseline`` 1.0 on a fully-passing A/B. Detail to
+    PINT_TPU_FLEET_DETAIL (default ``FLEET_r03.json``)."""
+    from pint_tpu import telemetry
+
+    metric = "fleet_coldjoin_first_sticky_fit_wall"
+    try:
+        with telemetry.span("bench.fleet_coldjoin"):
+            rec = _bench_fleet_coldjoin()
+        out = {"metric": metric,
+               "value": rec["first_sticky"]["wall_s"],
+               "unit": "s", "vs_baseline": 1.0 if rec["ok"] else 0.0,
+               "backend": jax.default_backend(),
+               "host_cores": os.cpu_count(), "mode": "coldjoin",
+               "fleet_coldjoin": rec}
+        out.update(_telemetry_fields())
+        detail_path = (config.env_str("PINT_TPU_FLEET_DETAIL")
+                       or os.path.join(
+                           os.path.dirname(os.path.abspath(__file__)),
+                           "FLEET_r03.json"))
+        try:
+            with open(detail_path, "w") as fh:
+                json.dump(out, fh, indent=1)
+                fh.write("\n")
+        except OSError as e:
+            out["detail_error"] = str(e)
+        compact = {k: out[k] for k in ("metric", "value", "unit",
+                                       "vs_baseline", "backend",
+                                       "host_cores", "mode")}
+        compact["fleet_coldjoin"] = {
+            k: rec.get(k) for k in
+            ("ok", "join_wall_s", "join_ready", "moved_structures",
+             "adopted_prior_keys", "read_p99_stay_before_s",
+             "read_p99_stay_after_s", "p99_ok")}
+        compact["fleet_coldjoin"]["first_sticky"] = rec["first_sticky"]
+        compact["detail"] = os.path.basename(detail_path)
+        _emit(compact)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
+def _since_process_start() -> float:
+    """Wall seconds since THIS process was exec'd.
+
+    ``/proc``-based so the number covers interpreter + jax import —
+    the part of a restart a ``perf_counter`` anchored at module import
+    cannot see. Falls back to time-since-import off Linux.
+    """
+    try:
+        with open("/proc/self/stat") as fh:
+            stat = fh.read()
+        # comm (field 2) may contain spaces — split after its ')'
+        start_ticks = float(stat.rsplit(")", 1)[1].split()[19])
+        with open("/proc/uptime") as fh:
+            uptime = float(fh.read().split()[0])
+        return uptime - start_ticks / float(os.sysconf("SC_CLK_TCK"))
+    except (OSError, ValueError, IndexError):
+        return time.perf_counter()
+
+
+def bench_coldstart() -> None:
+    """Coldstart child (``PINT_TPU_BENCH_COLDSTART=1``; ISSUE 16).
+
+    Measures **process-start -> first fit served** — the restart cost
+    the program supply chain exists to kill. One dense GLS fit per
+    model structure (ECORR epochs + red noise: the compile-dominated
+    frontier program), first structure's completion stamped against
+    ``/proc`` process start so the number includes interpreter + jax
+    import + model build + trace + compile + execute. The parent
+    ``--cold-start`` branch runs this child twice against one fresh
+    ``PINT_TPU_PROGRAM_CACHE_DIR`` (cold writes the store, warm
+    restarts from it) plus once with the store off (today's baseline);
+    identical chi2 across all three runs is the bitwise-degeneracy
+    check of the acceptance criteria.
+    """
+    from pint_tpu import telemetry
+    from pint_tpu.fitting.device_loop import dense_gls_fit
+    from pint_tpu.models import get_model
+    from pint_tpu.programs import store_stats
+    # touch the store BEFORE any compile (the run_worker rule): the
+    # persistent XLA cache must be wired when the process's first
+    # program — the TOA simulation's phase inversion, not the fit —
+    # compiles, or the warm restart replays the whole build bill
+    from pint_tpu.programs.store import store as _store
+
+    _store()
+    jax_ready_s = _since_process_start()
+    n = config.env_int("PINT_TPU_BENCH_N")
+    # the headline default (100k) would make execute — which a warm
+    # restart pays too — the bill; coldstart wants the compile bill
+    n = 600 if n == N_DEFAULT else min(n, 5000)
+    variants = [("gls_ecorr_red", PAR),
+                ("fd", PAR + "FD1 1.0e-5 1\n"),
+                ("phoff", PAR + "PHOFF 0.0 1\n")]
+    if config.env_on("PINT_TPU_BENCH_SMOKE"):
+        variants = variants[:1]  # CI gate: one structure is enough to
+        # prove the warm restart serves with zero misses
+    try:
+        rng = np.random.default_rng(7)
+        walls, chi2s = [], []
+        first_fit = all_fits = 0.0
+        for i, (name, par) in enumerate(variants):
+            with telemetry.span("bench.coldstart_build"):
+                model = get_model(par)
+                toas = _sim_toas(model, n, rng, epochs4=True)
+            t0 = time.perf_counter()
+            with telemetry.span("bench.coldstart_fit"):
+                out = dense_gls_fit(toas, model, maxiter=5)
+            walls.append(round(time.perf_counter() - t0, 3))
+            chi2s.append(float(out[2]))
+            all_fits = _since_process_start()
+            if i == 0:
+                first_fit = all_fits
+        rec = {"metric": "coldstart_first_fit_wall",
+               "value": round(first_fit, 3), "unit": "s",
+               "vs_baseline": 0.0, "backend": jax.default_backend(),
+               "mode": "coldstart", "coldstart_child": {
+                   "store_dir_set": bool(config.env_str(
+                       "PINT_TPU_PROGRAM_CACHE_DIR")),
+                   "jax_ready_s": round(jax_ready_s, 3),
+                   "startup_to_first_fit_s": round(first_fit, 3),
+                   "startup_to_all_fits_s": round(all_fits, 3),
+                   "n_toas": n,
+                   "structures": [name for name, _ in variants],
+                   "fit_walls_s": walls,
+                   "chi2": [round(c, 6) for c in chi2s],
+                   "program_cache": {
+                       "hit": int(telemetry.counter_value(
+                           "cache.fit_program.hit", 0)),
+                       "miss": int(telemetry.counter_value(
+                           "cache.fit_program.miss", 0))},
+                   "compile_split_s": _compile_split(),
+                   "store": store_stats(),
+               }}
+        rec.update(_telemetry_fields())
+        _emit(rec)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": "coldstart_first_fit_wall", "value": -1.0,
+               "unit": "s", "vs_baseline": 0.0, "mode": "coldstart",
+               "error": f"{type(e).__name__}: {e}"})
+
+
 def bench_hybrid(n: int, reps: int, metric: str, budget_s: float,
                  backend: str, device: str, dd_ok_accel: bool) -> None:
     """GLS iteration with the CPU-DD -> accelerator-solve split.
@@ -2652,6 +3027,35 @@ def main() -> None:
         res["jaxlint"] = {"ok": lint.returncode == 0,
                           "findings": lint.stdout.strip().splitlines(),
                           "stderr": (lint.stderr or "")[-400:]}
+        # cold-restart smoke (ISSUE 16): two tiny coldstart children
+        # against one fresh program store; the warm RESTART must serve
+        # its first fit with cache.fit_program.miss == 0 (the supply
+        # chain's whole contract) and bit-identical chi2
+        import tempfile
+
+        cs_dir = tempfile.mkdtemp(prefix="pint_tpu_smoke_store_")
+        cs_env = dict(smoke_env, PINT_TPU_BENCH_COLDSTART="1",
+                      PINT_TPU_BENCH_N="150",
+                      PINT_TPU_PROGRAM_CACHE_DIR=cs_dir)
+        cs_cold, cs_f1 = run_child(cs_env, 240.0)
+        cs_warm, cs_f2 = run_child(cs_env, 240.0)
+        cs_cold = cs_cold or {"value": -1.0, "error": cs_f1}
+        cs_warm = cs_warm or {"value": -1.0, "error": cs_f2}
+        cs_miss = ((cs_warm.get("coldstart_child") or {})
+                   .get("program_cache") or {}).get("miss", -1)
+        cs_chi2 = [(r.get("coldstart_child") or {}).get("chi2")
+                   for r in (cs_cold, cs_warm)]
+        res["coldstart"] = {
+            "ok": bool(cs_cold.get("value", -1) > 0
+                       and cs_warm.get("value", -1) > 0
+                       and cs_miss == 0
+                       and cs_chi2[0] is not None
+                       and cs_chi2[0] == cs_chi2[1]),
+            "cold_s": cs_cold.get("value"),
+            "warm_s": cs_warm.get("value"),
+            "warm_program_cache_miss": cs_miss,
+            "error": cs_cold.get("error") or cs_warm.get("error"),
+        }
         print(json.dumps(res))
         ok = res.get("value", -1.0) > 0 and "host_polluted" in res
         ok = ok and res["jaxlint"]["ok"]
@@ -2689,10 +3093,97 @@ def main() -> None:
         # mid-fit with zero fit-loop launches
         catalog = res.get("catalog") or {}
         ok = ok and catalog.get("ok") is True
+        # cold-restart acceptance (ISSUE 16): warm restart against the
+        # populated store served its first fit with zero misses
+        ok = ok and (res.get("coldstart") or {}).get("ok") is True
         if config.env_raw("PINT_TPU_TELEMETRY") != "0":
             tele = res.get("telemetry") or {}
             ok = ok and bool(tele.get("spans")) and bool(tele.get("counters"))
         sys.exit(0 if ok else 1)
+
+    if "--cold-start" in sys.argv:
+        # the supply-chain restart A/B (ISSUE 16): three children on
+        # CPU — store OFF (today's baseline), store COLD (first run
+        # against a fresh PINT_TPU_PROGRAM_CACHE_DIR: pays the
+        # compiles, writes the store), store WARM (a process restart
+        # against the populated store) — each measuring process-start
+        # -> first served fit against /proc process start. The
+        # headline value is the warm restart wall; vs_baseline the
+        # cold/warm speedup. Identical chi2 across all three runs is
+        # the N=1 / store-off bitwise-degeneracy check.
+        import tempfile
+
+        store_dir = tempfile.mkdtemp(prefix="pint_tpu_coldstart_")
+        base_env = {"JAX_PLATFORMS": "cpu",
+                    "PINT_TPU_BENCH_COLDSTART": "1"}
+        budget = TOTAL_TIMEOUT_S / 4.0
+        runs: dict = {}
+        for label, extra in (
+                ("no_store", {}),
+                ("cold", {"PINT_TPU_PROGRAM_CACHE_DIR": store_dir}),
+                ("warm", {"PINT_TPU_PROGRAM_CACHE_DIR": store_dir})):
+            res, fail = run_child(dict(base_env, **extra), budget)
+            runs[label] = (res if res is not None
+                           else {"value": -1.0, "error": fail})
+        cold_s = runs["cold"].get("value", -1.0)
+        warm_s = runs["warm"].get("value", -1.0)
+        ok = cold_s > 0 and warm_s > 0
+        chi2s = {label: (r.get("coldstart_child") or {}).get("chi2")
+                 for label, r in runs.items()}
+        parity_ok = ok and len({json.dumps(c) for c in
+                                chi2s.values()}) == 1
+        warm_child = (runs["warm"].get("coldstart_child") or {})
+        warm_miss = (warm_child.get("program_cache")
+                     or {}).get("miss", -1)
+        record = {
+            "metric": "coldstart_warm_first_fit_wall",
+            "value": warm_s, "unit": "s",
+            "vs_baseline": (round(cold_s / warm_s, 2) if ok else 0.0),
+            "backend": runs["warm"].get("backend"),
+            "mode": "coldstart",
+            "coldstart": {
+                "ok": bool(ok and parity_ok and warm_miss == 0),
+                "no_store_s": runs["no_store"].get("value", -1.0),
+                "cold_s": cold_s, "warm_s": warm_s,
+                "speedup_cold_over_warm": (
+                    round(cold_s / warm_s, 2) if ok else 0.0),
+                "warm_program_cache_miss": warm_miss,
+                "parity_ok": parity_ok,
+                # the >=10x acceptance target assumes the compile bill
+                # dominates the restart the way BENCH_r12 measured on
+                # TPU (46.4 s loop_compile_s vs 0.29 s drain). On
+                # XLA:CPU the warm restart still pays the full trace +
+                # lowering (the persistent cache only skips backend
+                # codegen) and the AOT tier is gated off by the
+                # custom-call portability rule, so the structural
+                # ceiling here is the trace floor — the honest-verdict
+                # convention of BENCH_r14's read_p99.
+                "verdict": ("warm_restart_target_met" if ok
+                            and cold_s / warm_s >= 10.0 else
+                            "cpu_trace_floor_needs_silicon"),
+                "runs": runs,
+            }}
+        detail_path = (config.env_str("PINT_TPU_BENCH_DETAIL")
+                       or os.path.join(
+                           os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_DETAIL_r15.json"))
+        try:
+            with open(detail_path, "w") as fh:
+                json.dump(record, fh, indent=1)
+                fh.write("\n")
+        except OSError as e:
+            record["detail_error"] = str(e)
+        compact = {k: record[k] for k in ("metric", "value", "unit",
+                                          "vs_baseline", "backend",
+                                          "mode")}
+        compact["coldstart"] = {
+            k: record["coldstart"][k] for k in
+            ("ok", "no_store_s", "cold_s", "warm_s",
+             "speedup_cold_over_warm", "warm_program_cache_miss",
+             "parity_ok", "verdict")}
+        compact["detail"] = os.path.basename(detail_path)
+        _emit(compact)
+        sys.exit(0 if record["coldstart"]["ok"] else 1)
 
     mode = config.env_str("PINT_TPU_BENCH_MODE")
     # match the success-metric family (pta emits pta_gls_iter_*)
@@ -2749,10 +3240,10 @@ def main() -> None:
                 flags + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
         mode_env.setdefault("JAX_PLATFORMS", "cpu")
-    if config.env_raw("PINT_TPU_BENCH_MODE") == "fleet":
-        # the fleet A/B (ISSUE 12) spawns real CPU worker processes;
-        # the router child itself is pinned to CPU too (the SCALE_r06
-        # convention — this is a correctness/transport artifact)
+    if config.env_raw("PINT_TPU_BENCH_MODE") in ("fleet", "coldjoin"):
+        # the fleet A/Bs (ISSUE 12 / ISSUE 16) spawn real CPU worker
+        # processes; the router child itself is pinned to CPU too (the
+        # SCALE_r06 convention — correctness/transport artifacts)
         mode_env.setdefault("JAX_PLATFORMS", "cpu")
     if config.env_raw("PINT_TPU_BENCH_MODE") == "read_mixed":
         # the read-contention A/B (ISSUE 11) needs >= 2 devices so the
@@ -3499,6 +3990,18 @@ def _run_smoke() -> None:
 
 def _main_guarded() -> None:
     _telemetry_begin()
+    # COLDSTART before SMOKE: the --smoke parent's cold-restart gate
+    # spawns children carrying BOTH flags (smoke trims the workload)
+    if config.env_on("PINT_TPU_BENCH_COLDSTART"):
+        try:
+            _init_backend()
+        except Exception as e:  # noqa: BLE001
+            _emit({"metric": "coldstart_first_fit_wall", "value": -1.0,
+                   "unit": "s", "vs_baseline": 0.0,
+                   "error": f"backend init failed: {e}"})
+            return
+        bench_coldstart()
+        return
     if config.env_on("PINT_TPU_BENCH_SMOKE"):
         _run_smoke()
         return
@@ -3508,7 +4011,8 @@ def _main_guarded() -> None:
     mode = config.env_str("PINT_TPU_BENCH_MODE")
     if mode in ("pta", "wideband", "batch", "throughput",
                 "throughput_mesh", "throughput_mixed",
-                "throughput_incremental", "read_mixed", "fleet"):
+                "throughput_incremental", "read_mixed", "fleet",
+                "coldjoin"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
@@ -3536,6 +4040,8 @@ def _main_guarded() -> None:
                              max(2, _env_reps(3)))
         elif mode == "fleet":
             bench_fleet()
+        elif mode == "coldjoin":
+            bench_fleet_coldjoin()
         else:
             bench_batch(n_psr, max(1, n // n_psr), reps)
         return

@@ -330,6 +330,18 @@ def note_program(kind: str, fingerprint, shape, *, compiled=None) -> None:
         return
     key = (kind, fingerprint, shape)
     hit = key in _SEEN_PROGRAMS
+    if not hit:
+        # persistent program store (pint_tpu.programs): a triple a
+        # PRIOR process journaled is warm on disk — the artifact (XLA
+        # cache entry or adopted AOT executable) serves this dispatch
+        # without an XLA compile, so the restart counts a hit. No
+        # store configured -> False with zero side effects.
+        try:
+            from pint_tpu.programs import note_seen
+
+            hit = note_seen(kind, fingerprint, shape)
+        except Exception:
+            pass
     _SEEN_PROGRAMS.add(key)
     _tele_counters.inc(f"cache.fit_program.{'hit' if hit else 'miss'}")
     if compiled is not None:

@@ -250,6 +250,22 @@ declare("PINT_TPU_FLIGHT_RECORDER", True, "bool",
         "removes the ring from the loop carry (different program).")
 declare("PINT_TPU_TRACE_LEN", 64, "int",
         "Flight-recorder ring capacity in entries (floor 4).")
+declare("PINT_TPU_PROGRAM_CACHE_DIR", None, "str",
+        "Root of the per-host persistent program store (XLA compile "
+        "cache + AOT fit-program artifacts + manifest); unset = supply "
+        "chain off, bitwise today's in-process compile behavior.")
+declare("PINT_TPU_PROGRAM_AOT", True, "bool",
+        "Kill switch for the AOT executable serialize/adopt rung of "
+        "the program store; 0 keeps only the persistent XLA compile "
+        "cache (for hosts where executable reload misbehaves — see "
+        "docs/COMPILE_CACHE.md round-3 history).")
+declare("PINT_TPU_PROGRAM_SHIP", True, "bool",
+        "Fleet join prewarm gate: ship popularity-ranked warm programs "
+        "and replica summaries to a joining host before it takes "
+        "traffic; 0 restores the instant-routable join.")
+declare("PINT_TPU_PREWARM_TOP_K", 8, "int",
+        "Adopt-set size cap for the fleet join prewarm: the top-K "
+        "most-popular warm structures assigned to the joining host.")
 
 # --- bench.py / scale_proof.py / tpu_evidence.py knobs ---------------
 declare("PINT_TPU_BENCH_MODE", "gls", "str",
@@ -290,6 +306,10 @@ declare("PINT_TPU_BENCH_CHILD", False, "bool",
         "recurses exactly once.", scope="bench")
 declare("PINT_TPU_BENCH_SMOKE", False, "bool",
         "Internal: set by bench --smoke children (tiny CI workload).",
+        scope="bench")
+declare("PINT_TPU_BENCH_COLDSTART", False, "bool",
+        "Internal: set by bench --cold-start children (process-start -> "
+        "first-fit measurement against a shared program store).",
         scope="bench")
 declare("PINT_TPU_BENCH_DETAIL", None, "str",
         "Path for the full bench record (stdout carries only the "

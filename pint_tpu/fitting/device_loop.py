@@ -307,6 +307,55 @@ def _args_sig(args):
     return tuple(sig)
 
 
+def _store_load(entry, sig):
+    """Supply-chain rung: a persisted/adopted executable for this sig.
+
+    Consults the persistent program store (:mod:`pint_tpu.programs`)
+    for an AOT artifact saved by a prior process or shipped by the
+    fleet. None on any miss, skew, or failure — the caller's next rung
+    is a normal compile (which itself round-trips the persistent XLA
+    cache when the store is wired)."""
+    base = entry.get("pkey_base")
+    if not base:
+        return None
+    try:
+        from pint_tpu.programs import key as _pk
+        # NOTE: the package re-exports the store() FUNCTION, which
+        # shadows the submodule — import from the module path
+        from pint_tpu.programs.store import store as _store
+
+        st = _store()
+        if st is None:
+            return None
+        from pint_tpu.serve.fingerprint import canonical_repr
+
+        return st.load(_pk.artifact_key(base, sig),
+                       sig=canonical_repr(sig))
+    except Exception:  # noqa: BLE001 — persistence must never break a fit
+        return None
+
+
+def _store_save(entry, sig, compiled) -> None:
+    """Persist one freshly-compiled executable (best-effort)."""
+    base = entry.get("pkey_base")
+    if not base:
+        return
+    try:
+        from pint_tpu.programs import key as _pk
+        from pint_tpu.programs.store import store as _store
+
+        st = _store()
+        if st is None:
+            return
+        from pint_tpu.serve.fingerprint import canonical_repr
+
+        st.save(_pk.artifact_key(base, sig), compiled,
+                sig=canonical_repr(sig), kind=entry.get("kind", ""),
+                fp8=entry.get("fp8", ""), base=base)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _resolve_program(entry, deltas0, operands, hyper):
     """(program, freshly_compiled, sig): the AOT executable for this
     call signature, compiling (and caching) it on first sight.
@@ -314,7 +363,10 @@ def _resolve_program(entry, deltas0, operands, hyper):
     AOT (``jit(...).lower(...).compile()``) instead of plain jit
     dispatch so the compiled object is in hand for program accounting
     (``recorder.capture_program``); the compile itself happens exactly
-    when jit would have compiled anyway. Any failure in the AOT path —
+    when jit would have compiled anyway. With a persistent program
+    store configured, a disk/shipped artifact is tried FIRST (zero
+    recompile), and a fresh compile is serialized back (the supply
+    chain; see :mod:`pint_tpu.programs`). Any failure in the AOT path —
     building OR hashing the signature, lowering, compiling — falls back
     to the jitted callable (sig None when it cannot be cached):
     accounting must never break a fit."""
@@ -325,10 +377,24 @@ def _resolve_program(entry, deltas0, operands, hyper):
         return entry["jit"], None, None
     if prog is not None:
         return prog, None, sig
+    prog = _store_load(entry, sig)
+    if prog is not None:
+        entry["aot"][sig] = prog
+        return prog, None, sig
+    import time as _time
+
+    t0 = _time.perf_counter()
     try:
         prog = entry["jit"].lower(deltas0, operands, *hyper).compile()
     except Exception:  # noqa: BLE001
         prog = entry["jit"]
+    else:
+        # per-structure compile accounting (bench splits compile cost
+        # by kind instead of one aggregate loop_compile_s)
+        telemetry.inc(
+            "programs.compile_s." + (entry.get("kind") or "unknown"),
+            _time.perf_counter() - t0)
+        _store_save(entry, sig, prog)
     entry["aot"][sig] = prog
     return prog, (prog if prog is not entry["jit"] else None), sig
 
@@ -414,15 +480,29 @@ def _dispatch(builder, key, deltas0, operands, hyper, *, kind, fingerprint,
     # donation flag (donated programs have a different buffer contract)
     rec_on = recorder.active()
     donate = bool(donate_state) and _donate_operands()
-    cache_key = (key, rec_on, recorder.trace_len() if rec_on else 0,
-                 donate)
+    trace_len = recorder.trace_len() if rec_on else 0
+    cache_key = (key, rec_on, trace_len, donate)
     entry = _LOOP_CACHE.get_lru(cache_key)
     if entry is None:
+        # the entry's stable identity for the persistent store: the
+        # accounting triple + the dispatch-variant facts that select a
+        # distinct executable. None (unkeyable fingerprint) simply
+        # disables persistence for this entry.
+        try:
+            from pint_tpu.programs import key as _pk
+
+            pkey_base = _pk.program_key(
+                kind, fingerprint, tuple(shape),
+                extra=(rec_on, trace_len, donate))
+            fp8 = _pk.current_fp8() or ""
+        except Exception:  # noqa: BLE001 — identity is optional
+            pkey_base, fp8 = None, ""
         entry = _LOOP_CACHE.put_lru(
             cache_key,
             {"jit": jax.jit(builder(rec_on),
                             donate_argnums=(1,) if donate else ()),
-             "aot": {}})
+             "aot": {}, "pkey_base": pkey_base, "kind": kind,
+             "fp8": fp8})
     prog, fresh, sig = _resolve_program(entry, deltas0, operands, hyper)
     note_program(kind, fingerprint, tuple(shape), compiled=fresh)
     telemetry.inc("fit.device_loop.launches")
@@ -975,6 +1055,17 @@ def _maybe_trace_sigma(noise, model, toas, n_target):
         sigma=jnp.asarray(scaled_sigma_np(model, toas, n_target)))
 
 
+def fingerprint_id(model) -> str:
+    """Stable content id of the model structure for the dense paths'
+    program fingerprints — process-independent (unlike the salted
+    ``hash(model._fn_fingerprint())`` it replaced), so the persistent
+    program store and the fleet shipping protocol derive identical
+    keys in every worker (:mod:`pint_tpu.programs.key`)."""
+    from pint_tpu.programs.key import fingerprint_id as _fid
+
+    return _fid(model)
+
+
 def dense_wls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
                   max_step_halvings=8):
     """Fused dense WLS fit: bucketed table, one program, one fetch.
@@ -996,7 +1087,7 @@ def dense_wls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
         key=("dense_wls", id(step), id(probe)),
         maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
         max_step_halvings=max_step_halvings, kind="device_loop_wls",
-        fingerprint=(hash(model._fn_fingerprint()),),
+        fingerprint=(fingerprint_id(model),),
         shape=bucketing.toa_shape(toas_b))
 
 
@@ -1031,7 +1122,7 @@ def dense_wideband_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
         key=("dense_wb", id(step), id(probe)),
         maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
         max_step_halvings=max_step_halvings, kind="device_loop_wb",
-        fingerprint=(hash(model._fn_fingerprint()), tuple(pl_specs)),
+        fingerprint=(fingerprint_id(model), tuple(pl_specs)),
         shape=bucketing.toa_shape(toas_b))
 
 
@@ -1059,5 +1150,5 @@ def dense_gls_fit(toas, model, *, maxiter=20, min_chi2_decrease=1e-3,
         key=("dense_gls", id(step), id(probe)),
         maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
         max_step_halvings=max_step_halvings, kind="device_loop_gls",
-        fingerprint=(hash(model._fn_fingerprint()), pl_specs),
+        fingerprint=(fingerprint_id(model), pl_specs),
         shape=bucketing.toa_shape(toas_b))

@@ -98,6 +98,14 @@ def rendezvous_rank(key: str, host_ids) -> list[str]:
     return sorted(host_ids, key=lambda h: _score(h, key), reverse=True)
 
 
+#: Test seam for the elastic join handshake (ISSUE 16): when set, the
+#: router calls it as ``hook(stage, host_id)`` at each join stage
+#: ("selected", "pulled", "shipped", "ready") — the SIGKILL-mid-adopt
+#: test uses it to kill the joining worker at a precise stage. Never
+#: set in production.
+_JOIN_STAGE_HOOK = None
+
+
 class FleetHandle:
     """Future-like handle for a routed fit (the router's FitHandle)."""
 
@@ -228,11 +236,16 @@ class FleetRouter:
         self.degenerate = bool(degenerate or len(hosts) == 1
                                or not fleet_enabled())
         self._health: dict[str, dict] = {
-            hid: {"alive": True, "fail_streak": 0, "queue_depth": 0,
-                  "read_depth": 0, "degraded": False, "latency_s": None,
-                  "program_misses": 0, "misses": 0}
+            hid: {"alive": True, "ready": True, "fail_streak": 0,
+                  "queue_depth": 0, "read_depth": 0, "degraded": False,
+                  "latency_s": None, "program_misses": 0, "misses": 0}
             for hid in ids}
         self._warm: dict[str, set] = {hid: set() for hid in ids}
+        # per-fp8 request counts (ISSUE 16): the popularity stats that
+        # rank a joining host's prewarm adopt set — hottest structures
+        # ship first, bounded so a long-lived router cannot grow it
+        # unboundedly over one-shot structures
+        self._popularity: dict[str, int] = {}
         self._sticky: dict[tuple, str] = {}   # (sid, fp8) -> host id
         self._sid_last: dict[Any, tuple] = {}  # sid -> last sticky key
         self._inflight: dict[str, int] = {hid: 0 for hid in ids}
@@ -280,7 +293,12 @@ class FleetRouter:
     # health
     # ------------------------------------------------------------------
     def alive_hosts(self) -> list[str]:
-        return [h for h in self._order if self._health[h]["alive"]]
+        """Routable hosts: alive AND ready. A joining host is
+        registered but not ready until its adopt set is loaded
+        (ISSUE 16) — no traffic routes to it mid-handshake."""
+        return [h for h in self._order
+                if self._health[h]["alive"]
+                and self._health[h].get("ready", True)]
 
     def _degraded(self, hid: str) -> bool:
         h = self._health[hid]
@@ -317,26 +335,153 @@ class FleetRouter:
         return max([base] + dls) + base * max(0, len(pend) - 1) / 8.0
 
     def add_host(self, transport) -> None:
-        """Host JOIN: register a new transport. Rendezvous ranking is a
-        pure function of (key, host set), so only keys whose top score
-        the new host beats move to it (~1/(N+1), measured in
+        """Host JOIN: register a new transport and run the elastic
+        join handshake (ISSUE 16). Rendezvous ranking is a pure
+        function of (key, host set), so only keys whose top score the
+        new host beats move to it (~1/(N+1), measured in
         tests/test_fleet.py) — and existing session pins never move
-        (stickiness beats the ring)."""
+        (stickiness beats the ring).
+
+        The join is gated on READINESS: the host registers not-ready
+        (invisible to routing), the router selects its prewarm adopt
+        set from popularity stats, pulls the shipment from a warm
+        donor, ships it to the joiner (whose store eager-loads the
+        executables), re-stashes the session replicas the new ring
+        assigns it, and only then marks it routable. Every stage is
+        best-effort; a joiner that dies mid-adopt is abandoned (left
+        not-ready — a later heartbeat answer readmits it cold) and
+        in-flight traffic never notices. With shipping off
+        (``PINT_TPU_PROGRAM_SHIP=0``), no popularity yet, or the
+        degenerate fleet, the handshake is a no-op and the join is
+        exactly the pre-ISSUE-16 instant join."""
         hid = transport.host_id
         if hid in self.hosts:
             raise ValueError(f"duplicate host id {hid!r}")
         self.hosts[hid] = transport
         self._order.append(hid)
-        self._health[hid] = {"alive": True, "fail_streak": 0,
-                             "queue_depth": 0, "read_depth": 0,
-                             "degraded": False, "latency_s": None,
-                             "program_misses": 0, "misses": 0}
+        self._health[hid] = {"alive": True, "ready": False,
+                             "fail_streak": 0, "queue_depth": 0,
+                             "read_depth": 0, "degraded": False,
+                             "latency_s": None, "program_misses": 0,
+                             "misses": 0}
         self._warm[hid] = set()
         self._inflight[hid] = 0
         self._pending[hid] = []
+        telemetry.inc("fleet.host_join")
+        self._join_prewarm(hid, transport)
         self.degenerate = False if len(self._order) > 1 \
             and fleet_enabled() else self.degenerate
-        telemetry.inc("fleet.host_join")
+
+    def _join_prewarm(self, hid: str, transport) -> None:
+        """The supply-chain half of a join: select/pull/ship/adopt,
+        then flip readiness. See :meth:`add_host`."""
+        from pint_tpu.programs import ship as _ship
+
+        h = self._health[hid]
+        hook = _JOIN_STAGE_HOOK
+        try:
+            top_k = config.env_int("PINT_TPU_PREWARM_TOP_K")
+            if (self.degenerate or top_k <= 0 or not self._popularity
+                    or not config.env_on("PINT_TPU_PROGRAM_SHIP")):
+                h["ready"] = True
+                if hook:
+                    hook("ready", hid)
+                return
+            donors = [d for d in self._order
+                      if d != hid and self._health[d]["alive"]
+                      and self._health[d].get("ready", True)
+                      and not self._suspect(d)]
+            adopt = _ship.select_adopt_set(
+                self._popularity, [*donors, hid], hid, top_k,
+                rendezvous_rank)
+            if hook:
+                hook("selected", hid)
+            # one donor suffices: XLA cache entries + warm keys are
+            # host-global, and the blob tier dedups by key anyway.
+            # Prefer the donor holding the most of the adopt set warm.
+            shipment = None
+            for d in sorted(donors,
+                            key=lambda d: -len(self._warm[d]
+                                               & set(adopt))):
+                try:
+                    shipment = self.hosts[d].pull_programs(
+                        adopt, deadline_s=_dur.op_deadline_s())
+                except HostSuspect:
+                    self._note_timeout(d)
+                    continue
+                except (HostDown, OSError):
+                    self._note_down(d)
+                    continue
+                if shipment and any(shipment.get(k)
+                                    for k in ("blobs", "xla", "keys")):
+                    break
+                shipment = None
+            if hook:
+                hook("pulled", hid)
+            if shipment is not None:
+                # adopt may deserialize+compile-load: slow-path deadline
+                res = transport.ship_programs(
+                    shipment,
+                    deadline_s=max(_dur.op_deadline_s(), 300.0))
+                self._warm[hid].update(adopt)
+                telemetry.inc("fleet.join.adopted",
+                              int(res.get("adopted", 0)))
+                telemetry.add_record({
+                    "type": "fleet_join", "host": hid,
+                    "adopt_set": list(adopt), **(res or {})})
+            if hook:
+                hook("shipped", hid)
+            self._join_restash(hid)
+            h["ready"] = True
+            telemetry.inc("fleet.join.ready")
+            if hook:
+                hook("ready", hid)
+        except HostSuspect:
+            self._note_timeout(hid)
+            self._abandon_join(hid)
+        except (HostDown, OSError):
+            self._note_down(hid)
+            self._abandon_join(hid)
+
+    def _join_restash(self, hid: str) -> None:
+        """Re-stash session replicas the NEW ring assigns to ``hid``
+        (best-effort, bounded): the joiner becomes ring successor for
+        ~1/(N+1) of the journaled sessions, and replicating their
+        summaries now — before it takes traffic — means a later
+        failover onto it restores WARM instead of replaying the whole
+        journal."""
+        done = 0
+        for skey, lg in list(self._journal.logs.items()):
+            if done >= 16:
+                break
+            pin = self._sticky.get(skey)
+            if pin is None or pin == hid \
+                    or not self._health[pin]["alive"]:
+                continue
+            if self._ring_successor(skey, pin) != hid:
+                continue
+            try:
+                summary = self.hosts[pin].session_summary(skey)
+                if summary is None:
+                    continue
+                blob = _dur.build_replica(
+                    summary, epoch=self._epoch.get(skey, 0))
+                self.hosts[hid].stash_replica(skey, blob)
+                self._journal.note_replica(skey, hid,
+                                           summary["model_blob"])
+                done += 1
+                telemetry.inc("fleet.join.restashed")
+            except Exception:  # noqa: BLE001 — replica shipping is
+                continue       # always best-effort (ISSUE 13 contract)
+
+    def _abandon_join(self, hid: str) -> None:
+        """The joiner died/hung mid-handshake: leave it registered but
+        NOT ready — zero traffic ever routed to it, so nothing fails
+        over and nothing is lost. If it answers a later heartbeat it
+        is readmitted (cold: its adopt set never finished loading)."""
+        telemetry.inc("fleet.join.abandoned")
+        telemetry.add_record({"type": "fleet_join", "host": hid,
+                              "abandoned": True})
 
     def retire_host(self, host_id: str) -> None:
         """Host LEAVE (administrative): mark it dead so routing moves
@@ -426,6 +571,12 @@ class FleetRouter:
                 out[hid] = "rejoined"
             else:
                 out[hid] = "ok"
+            if not h.get("ready", True):
+                # an ABANDONED join answering again: readmit it cold
+                # (its adopt set never finished loading — it simply
+                # compiles on demand like a pre-ISSUE-16 joiner)
+                h["ready"] = True
+                telemetry.inc("fleet.join.readmitted")
         telemetry.set_gauge("fleet.hosts_alive", len(self.alive_hosts()))
         telemetry.set_gauge(
             "fleet.hosts_suspect",
@@ -799,6 +950,15 @@ class FleetRouter:
                     self._warm_hits += 1
                     telemetry.inc("fleet.route.warm_hit")
                 self._warm[hid].add(fp8)
+                # popularity stats feed the join prewarm adopt set
+                # (ISSUE 16); bounded by halving-prune, hot keys survive
+                self._popularity[fp8] = self._popularity.get(fp8, 0) + 1
+                if len(self._popularity) > 4096:
+                    keep = sorted(self._popularity,
+                                  key=self._popularity.get,
+                                  reverse=True)[:2048]
+                    self._popularity = {k: self._popularity[k]
+                                        for k in keep}
         telemetry.inc(f"fleet.route.{token}")
         self._route_counts[token] = self._route_counts.get(token, 0) + 1
         self._inflight[hid] += 1
@@ -1347,6 +1507,7 @@ class FleetRouter:
             "hosts": [
                 {"host": hid,
                  "alive": self._health[hid]["alive"],
+                 "ready": self._health[hid].get("ready", True),
                  "requests": per_host_n.get(hid, 0),
                  "queue_depth": self._health[hid]["queue_depth"],
                  "fail_streak": self._health[hid]["fail_streak"],

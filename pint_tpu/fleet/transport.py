@@ -248,6 +248,22 @@ class LoopbackHost:
         self._check("report")
         return self.scheduler.report()
 
+    # -- program supply chain (ISSUE 16) -------------------------------
+    def pull_programs(self, fp8s, deadline_s=None) -> dict:
+        """Export this host's shipment for the given fp8 set (AOT
+        blobs + XLA cache entries + warm keys); empty with no store."""
+        self._check("pull_programs", deadline_s)
+        from pint_tpu.programs.ship import export_for_ship
+
+        return export_for_ship(fp8s)
+
+    def ship_programs(self, shipment, deadline_s=None) -> dict:
+        """Install a shipment into this host's store (prewarm/adopt)."""
+        self._check("ship_programs", deadline_s)
+        from pint_tpu.programs.ship import adopt_shipment
+
+        return adopt_shipment(shipment)
+
     # -- durable sessions (ISSUE 13) -----------------------------------
     def session_summary(self, skey) -> dict | None:
         self._check("session_summary")
@@ -452,6 +468,15 @@ class TcpHost:
 
     def report(self) -> dict:
         return self._rpc("report")["report"]
+
+    # -- program supply chain (ISSUE 16) -------------------------------
+    def pull_programs(self, fp8s, deadline_s=None) -> dict:
+        return _unb64(self._rpc("pull_programs", payload=list(fp8s),
+                                deadline_s=deadline_s)["payload"])
+
+    def ship_programs(self, shipment, deadline_s=None) -> dict:
+        return _unb64(self._rpc("ship_programs", payload=shipment,
+                                deadline_s=deadline_s)["payload"])
 
     # -- durable sessions (ISSUE 13) -----------------------------------
     def session_summary(self, skey) -> dict | None:
@@ -668,6 +693,18 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             prog = scheduler.catalog_progress(_unb64(msg["payload"]))
             reply({"ok": True,
                    "payload": _b64(prog) if prog else None})
+        elif op == "pull_programs":
+            # program supply chain (ISSUE 16): a warm host exports its
+            # shipment for a joining worker's adopt set
+            from pint_tpu.programs.ship import export_for_ship
+
+            reply({"ok": True, "payload": _b64(
+                export_for_ship(_unb64(msg["payload"])))})
+        elif op == "ship_programs":
+            from pint_tpu.programs.ship import adopt_shipment
+
+            reply({"ok": True, "payload": _b64(
+                adopt_shipment(_unb64(msg["payload"])))})
         elif op == "report":
             rep = scheduler.report()
             if extra_report:

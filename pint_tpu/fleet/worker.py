@@ -81,6 +81,14 @@ def run_worker(port: int, host_id: str, *, max_queue: int = 256,
     """Worker main: distributed init, local scheduler, serve protocol."""
     from pint_tpu.fleet.transport import serve_worker
 
+    # touch the program store FIRST (ISSUE 16): with PINT_TPU_PROGRAM_
+    # CACHE_DIR set this wires the persistent XLA compile cache before
+    # the process's first compile, and primes the manifest so the
+    # worker's first fits count warm after a restart. No-op (None)
+    # with the knob unset.
+    from pint_tpu.programs.store import store as _store
+
+    _store()
     dist = init_distributed()
     sched = build_host_scheduler(host_id, max_queue=max_queue,
                                  window=window)
@@ -95,13 +103,20 @@ def run_worker(port: int, host_id: str, *, max_queue: int = 256,
                         extra_report=extra)
 
 
-def spawn_local_workers(n: int, *, env=None, ready_timeout_s: float = 120.0,
+def spawn_local_workers(n: int, *, env=None, env_per_worker=None,
+                        ready_timeout_s: float = 120.0,
                         distributed: bool = False,
                         coord_port: int = 9733, prefix: str = "w"):
     """Spawn N real worker processes on this machine; returns
     ``[(host_id, port, Popen)]`` once every worker's ready line has
     been read (ports are OS-assigned: ``--port 0``; host ids are
     ``<prefix>0..<prefix>N-1``).
+
+    ``env_per_worker`` (optional, length >= n) layers per-worker
+    overrides on top of ``env`` — the supply-chain A/B gives each
+    worker its own ``PINT_TPU_PROGRAM_CACHE_DIR`` this way (a program
+    store is per-host state; sharing one dir would fake the shipping
+    protocol's work).
 
     With ``distributed=True`` the workers are armed to attempt
     ``jax.distributed.initialize`` against a local coordinator
@@ -111,6 +126,8 @@ def spawn_local_workers(n: int, *, env=None, ready_timeout_s: float = 120.0,
     procs = []
     for i in range(n):
         wenv = dict(os.environ, **(env or {}))
+        if env_per_worker is not None:
+            wenv.update(env_per_worker[i] or {})
         wenv.setdefault("JAX_PLATFORMS", "cpu")
         if distributed:
             wenv["PINT_TPU_FLEET_PROCESSES"] = str(n)

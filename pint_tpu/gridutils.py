@@ -94,7 +94,9 @@ def _chisq_at_points(toas, model, param_names: tuple[str, ...],
     fn = model._cached_jit(("grid_chisq", tuple(param_names), solve_free),
                            build)
     tt = bucketing.bucket_toas(toas)
-    bucketing.note_program("grid_chisq", (id(fn),),
+    from pint_tpu.models.timing_model import program_fp8
+
+    bucketing.note_program("grid_chisq", (program_fp8(fn) or id(fn),),
                            (len(tt), int(np.shape(points)[0])))
     return np.asarray(fn(model.base_dd(), jnp.asarray(points), tt))
 

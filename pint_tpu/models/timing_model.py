@@ -44,6 +44,33 @@ from pint_tpu.utils.cache import LRUCache
 
 _JIT_PROGRAM_CACHE = LRUCache(128, name="jit_program")
 
+# Sidecar map id(callable) -> process-independent short id of the cache
+# key, filled once per LRU insertion. note_program callers used id(fn)
+# directly as the program fingerprint, which is stable within a process
+# (the LRU pins fn) but differs ACROSS processes — that defeated the
+# program-store warm accounting (pint_tpu.programs): a restarted host
+# could never recognise its own phase/designmatrix programs. Bounded by
+# the LRU: cleared when it outgrows the cache so evicted ids cannot
+# alias a recycled address.
+_PROGRAM_FP8: dict[int, str] = {}
+
+
+def _note_program_fp8(fn, fp) -> None:
+    try:
+        from pint_tpu.serve.fingerprint import short_id
+
+        if len(_PROGRAM_FP8) > 4 * 128:
+            _PROGRAM_FP8.clear()
+        _PROGRAM_FP8[id(fn)] = short_id(fp)
+    except Exception:
+        pass
+
+
+def program_fp8(fn):
+    """Process-independent fingerprint for a ``_cached_jit`` callable
+    (or None if it was never registered / the sidecar was flushed)."""
+    return _PROGRAM_FP8.get(id(fn))
+
 
 def _nan_safe(v):
     """Replace NaN floats in a nested fingerprint tuple with a sentinel.
@@ -446,6 +473,7 @@ class TimingModel:
             owner.__dict__.pop("_noise_basis_key", None)
             owner.__dict__.pop("_noise_basis_val", None)
             ent = _JIT_PROGRAM_CACHE.put_lru(fp, jax.jit(builder(owner)))
+            _note_program_fp8(ent, fp)
         return ent
 
     def phase(self, toas, abs_phase: bool = True) -> phase_mod.Phase:
@@ -464,8 +492,8 @@ class TimingModel:
             lambda owner: owner.phase_fn_toas(abs_phase=abs_phase))
         n = len(toas)
         padded = bucketing.bucket_toas(toas)
-        # id(fn) identifies (structure fingerprint, key): the LRU pins it
-        bucketing.note_program("phase", (id(fn),), (len(padded),))
+        bucketing.note_program(
+            "phase", (program_fp8(fn) or id(fn),), (len(padded),))
         ph = fn(self.base_dd(), {}, padded)
         if len(padded) == n:
             return ph
@@ -566,7 +594,8 @@ class TimingModel:
 
         n = len(toas)
         padded = bucketing.bucket_toas(toas)
-        bucketing.note_program("designmatrix", (id(fn),), (len(padded),))
+        bucketing.note_program(
+            "designmatrix", (program_fp8(fn) or id(fn),), (len(padded),))
         M = fn(self.base_dd(), padded)
         return (M if len(padded) == n else M[:n]), out_names
 

@@ -708,10 +708,13 @@ class BatchedPulsarFitter:
                 traced_tzr=anchored, masked=True,
                 params=self.free_params, vmapped=True)
         # the union is never mutated after construction (fit results
-        # write back to the MEMBER models), so its fingerprint hash is
-        # stable — dispatch_fit reuses it instead of re-hashing the
-        # whole component stack per launch
-        self._union_fp_hash = hash(self.union._fn_fingerprint())
+        # write back to the MEMBER models), so its fingerprint id is
+        # stable — dispatch_fit reuses it instead of re-digesting the
+        # whole component stack per launch. A content digest, not
+        # hash(): the persistent program store keys on it across
+        # processes (pint_tpu.programs).
+        from pint_tpu.fitting.device_loop import fingerprint_id
+        self._union_fp_hash = fingerprint_id(self.union)
 
     def _family_args(self) -> tuple:
         """Per-family operand tail between the TOA table and the mask:

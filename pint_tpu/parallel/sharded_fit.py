@@ -64,7 +64,7 @@ def sharded_fit(toas, model, *, mesh=None, maxiter: int = 2,
                 key=("sharded_wls", id(step), id(probe)),
                 maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
                 kind="device_loop_wls",
-                fingerprint=(hash(model._fn_fingerprint()),),
+                fingerprint=(device_loop.fingerprint_id(model),),
                 shape=toa_shape(toas_sh))
         return out[:4]
     step = jitted_wls_step(model)
@@ -157,7 +157,7 @@ def sharded_gls_fit(toas, model, *, mesh=None, maxiter: int = 2,
                 key=("sharded_gls", id(step), id(probe)),
                 maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
                 kind="device_loop_gls",
-                fingerprint=(hash(model._fn_fingerprint()), pl_specs),
+                fingerprint=(device_loop.fingerprint_id(model), pl_specs),
                 shape=toa_shape(toas_sh))
         return out[:4]
     step = jitted_gls_step(model, pl_specs=pl_specs)
@@ -231,7 +231,7 @@ class ShardedServeFitter:
                     min_chi2_decrease=min_chi2_decrease,
                     max_step_halvings=max_step_halvings,
                     kind="device_loop_wls",
-                    fingerprint=(hash(self.model._fn_fingerprint()),),
+                    fingerprint=(device_loop.fingerprint_id(self.model),),
                     shape=toa_shape(self.toas))
             return _InFlightShardedServeFit(self, handle)
         with self.mesh, telemetry.span("fit.sharded_serve.host_loop"):

@@ -227,7 +227,13 @@ def generate_cheb_window(model, mjd_start: float, *, n_seg: int,
         fn = model._cached_jit(
             ("predict_cheb", n_seg, n_nodes, ncoeff),
             lambda owner: _gen_builder(owner, n_seg, n_nodes, ncoeff))
-        bucketing.note_program("predict_cheb", (id(fn),),
+        # content-stable fingerprint (not id(fn) — process-salted):
+        # the persistent program store journals this triple, so a warm
+        # restart's generation program counts a cache hit (the XLA
+        # compile round-trips the store's disk cache)
+        from pint_tpu.fitting.device_loop import fingerprint_id
+
+        bucketing.note_program("predict_cheb", (fingerprint_id(model),),
                                (n_seg, n_nodes, ncoeff))
         out = fn(model.base_dd(), {}, toas, jnp.asarray(dt_min),
                  jnp.asarray(model.f0_f64),

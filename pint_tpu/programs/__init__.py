@@ -1,0 +1,49 @@
+"""The program supply chain: identity, persistence, distribution.
+
+A compiled fit program has historically been a per-process side
+effect: ``device_loop`` caches executables under ``id()``-keyed entries
+and every host join, crash recovery, or deploy recompiles everything it
+inherits (BENCH_r12: 0.29 s timed drain vs 46.4 s ``loop_compile_s``;
+FLEET_r02: 9.8 s cold round vs 0.049 s warm). This package makes a
+compiled program a first-class artifact instead:
+
+* :mod:`pint_tpu.programs.key` — a serialization-stable program key:
+  fingerprint short-id (content digest over a canonical repr, never
+  ``hash()``/``id()``) + bucket shape + jax/jaxlib/backend versions +
+  precision flags + the traced-set gates. Same model/bucket/flags in
+  two processes derive byte-identical keys.
+* :mod:`pint_tpu.programs.store` — the per-host persistent store under
+  ``PINT_TPU_PROGRAM_CACHE_DIR``: wires JAX's persistent compilation
+  cache (every jit/AOT compile round-trips to ``<root>/xla``), keeps
+  AOT-serialized fit-loop executables as shippable ``<root>/aot``
+  artifacts, and journals every program key in a manifest so a warm
+  restart counts restored programs as cache HITS.
+* :mod:`pint_tpu.programs.ship` — the fleet shipping + prewarm
+  protocol: blob validation and adopt-set selection for the router's
+  elastic join handshake (popularity-ranked warm-set keys travel over
+  the transport seam; a joining worker ADOPTS them before it is
+  routable).
+
+Degradation ladder (never a crash): adopted executable -> disk AOT
+artifact -> persistent XLA compile cache -> in-process
+``lower().compile()`` -> plain jit dispatch. Any miss, version skew, or
+corrupt artifact steps one rung down and counts a structured
+``programs.store.*`` telemetry counter. With the store knob unset
+(the default) every rung above in-process compile disappears and
+behavior is bitwise today's.
+"""
+
+from pint_tpu.programs.key import (environment_facts, fingerprint_id,
+                                   program_key)
+# NOTE: the store() accessor is deliberately NOT re-exported — a
+# package attribute named ``store`` would shadow the submodule and turn
+# ``from pint_tpu.programs import store`` into a function import (a bug
+# this package shipped with: every _ps.store() call silently
+# AttributeError'd into the except-and-degrade path). Import it as
+# ``from pint_tpu.programs.store import store``.
+from pint_tpu.programs.store import ProgramStore, note_seen, store_stats
+
+__all__ = [
+    "ProgramStore", "environment_facts", "fingerprint_id",
+    "note_seen", "program_key", "store_stats",
+]

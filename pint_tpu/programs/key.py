@@ -1,0 +1,166 @@
+"""Serialization-stable program keys (the supply chain's identity).
+
+A program key names one compiled artifact in a way two independent
+processes agree on. The in-process caches are allowed to key on
+``id()``/salted ``hash()`` (cheap, process-local); anything that
+touches disk or the wire must go through :func:`program_key`, which
+digests only content:
+
+* the structure fingerprint's short-id (a sha1 content digest over
+  :func:`pint_tpu.serve.fingerprint.canonical_repr` — set-order and
+  hash-seed independent);
+* the bucket shape (padded TOA/basis shapes — a program is compiled
+  for one bucket);
+* the environment facts (:func:`environment_facts`): jax/jaxlib
+  versions, backend, and every flag that changes the traced program
+  without changing the model — x64, the force-f64 kill switch, and the
+  traced-set gates (EFAC/DMEFAC tracing, noise batching). A flip of
+  any of these MUST change the key, or a stale artifact would be
+  adopted for a differently-traced program.
+
+The jaxlint ``program-key-drift`` rule pins ``_TRACED_SET_KNOBS``
+against the knobs the fingerprint traced set actually reads
+(``serve/fingerprint.py`` + the ``trace_*_enabled`` gates in
+``fitting/gls_step.py``) so the two can never silently diverge.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from pint_tpu import config
+from pint_tpu.serve import fingerprint as _fp
+
+#: Knobs that gate what the fit programs TRACE (vs. close over). Every
+#: knob read by the fingerprint traced set must appear here — enforced
+#: by the jaxlint ``program-key-drift`` rule — because a flip changes
+#: the compiled program while leaving the model fingerprint alone.
+_TRACED_SET_KNOBS = (
+    "PINT_TPU_BATCH_NOISE",
+    "PINT_TPU_TRACE_EFAC",
+    "PINT_TPU_TRACE_DMEFAC",
+)
+
+#: Precision flags folded into every key: ``PINT_TPU_F64`` (the
+#: reserved force-f64 kill switch) rides along with jax's own x64 state
+#: so a program compiled under one precision regime is never adopted
+#: under another.
+_PRECISION_KNOBS = ("PINT_TPU_F64",)
+
+
+def environment_facts() -> dict:
+    """Everything about the process that changes compiled programs.
+
+    Stable, JSON-safe, and cheap (no backend init beyond what the
+    caller already did). Part of every program key AND recorded inside
+    every on-disk artifact — a loader rejects artifacts whose recorded
+    facts differ from its own (version/flag skew -> degrade to
+    recompile, never a wrong-program execution).
+    """
+    import jax
+    import jaxlib
+
+    facts = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+    # literal reads, one per listed knob: the jaxlint program-key-drift
+    # rule statically pins this block against _TRACED_SET_KNOBS /
+    # _PRECISION_KNOBS (and those against the live gates), so a knob
+    # cannot be listed without being folded in here — and vice versa
+    facts["PINT_TPU_BATCH_NOISE"] = (
+        "1" if config.env_on("PINT_TPU_BATCH_NOISE") else "0")
+    facts["PINT_TPU_TRACE_EFAC"] = (
+        "1" if config.env_on("PINT_TPU_TRACE_EFAC") else "0")
+    facts["PINT_TPU_TRACE_DMEFAC"] = (
+        "1" if config.env_on("PINT_TPU_TRACE_DMEFAC") else "0")
+    raw = config.env_raw("PINT_TPU_F64")
+    facts["PINT_TPU_F64"] = "" if raw is None else str(raw)
+    return facts
+
+
+def fingerprint_id(model, toas=None) -> str:
+    """Stable 8-hex id of a model's structure for program fingerprints.
+
+    The drop-in replacement for the process-salted
+    ``hash(model._fn_fingerprint())`` the dense fit entry points used
+    to put in their ``note_program`` fingerprints: same model text in
+    two processes -> same id. With ``toas`` it digests the full serve
+    :func:`~pint_tpu.serve.fingerprint.structure_fingerprint` (family
+    and traced noise values included); without, the conservative bare
+    ``_fn_fingerprint()`` — the dense paths fit exactly the structure
+    they were handed, so the bare identity is the honest one."""
+    if toas is not None:
+        return _fp.short_id(_fp.structure_fingerprint(model, toas))
+    return _fp.short_id(model._fn_fingerprint())
+
+
+def artifact_key(base: str, sig) -> str | None:
+    """One executable's on-disk name: base key + dispatch signature.
+
+    A single ``(kind, fingerprint, shape)`` accounting triple can own
+    several executables (the per-``_args_sig`` AOT cache in
+    ``device_loop``), so the artifact name folds the canonicalized
+    signature into the base :func:`program_key`. ``None`` on any
+    repr failure — the caller skips persistence for that program.
+    """
+    if not base:
+        return None
+    try:
+        body = base + _fp.canonical_repr(sig)
+        return hashlib.sha256(body.encode()).hexdigest()[:32]
+    except Exception:
+        return None
+
+
+#: The serve-layer fingerprint short-id of the structure currently
+#: being dispatched (set by the scheduler around its launch sites) —
+#: artifact metadata the fleet shipping protocol filters on, matching
+#: the router's warm-set/popularity fp8s. Thread-free process, plain
+#: module state.
+_CURRENT_FP8: str | None = None
+
+
+class serve_fp8:
+    """Context manager tagging dispatches with the serve-layer fp8."""
+
+    def __init__(self, fp8: str | None):
+        self.fp8 = fp8
+
+    def __enter__(self):
+        global _CURRENT_FP8
+        self._saved = _CURRENT_FP8
+        _CURRENT_FP8 = self.fp8
+        return self
+
+    def __exit__(self, *exc):
+        global _CURRENT_FP8
+        _CURRENT_FP8 = self._saved
+        return False
+
+
+def current_fp8() -> str | None:
+    return _CURRENT_FP8
+
+
+def program_key(kind: str, fingerprint, shape, extra=()) -> str:
+    """The serialization-stable name of one compiled program.
+
+    ``(kind, fingerprint, shape)`` is the existing program-reuse
+    accounting triple (:func:`pint_tpu.bucketing.note_program`);
+    ``extra`` carries dispatch-variant facts (recorder state, donation)
+    that select a distinct executable for the same triple. All four are
+    canonicalized (:func:`~pint_tpu.serve.fingerprint.canonical_repr`)
+    and digested together with :func:`environment_facts` into a 32-hex
+    sha256 prefix. Never raises: an unreprable component degrades to
+    ``None`` (caller skips persistence for that program).
+    """
+    try:
+        body = _fp.canonical_repr(
+            (str(kind), fingerprint, shape, tuple(extra),
+             environment_facts()))
+        return hashlib.sha256(body.encode()).hexdigest()[:32]
+    except Exception:
+        return None

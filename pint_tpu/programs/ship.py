@@ -1,0 +1,90 @@
+"""Fleet program shipping: the prewarm/adopt half of the supply chain.
+
+Protocol (rides the PR-12 transport seam as two ops, symmetric on
+loopback and TCP):
+
+* ``pull_programs(fp8s)`` — a WARM host exports a *shipment*
+  (:func:`export_for_ship`), three portable tiers in one dict:
+
+  - ``blobs`` — AOT-serialized executables for the requested fp8 set
+    (only programs that passed :meth:`ProgramStore.portable`; on CPU
+    the factorizing fit programs never do, and this list is empty);
+  - ``xla`` — persistent XLA compile-cache entries ``(name, bytes)``,
+    portable on every backend (XLA relinks custom calls by name);
+  - ``keys`` — the host's warm base keys (manifest accounting), the
+    evidence that lets the joiner's first dispatch count a hit.
+
+* ``ship_programs(shipment)`` — the COLD host installs all three
+  tiers (:func:`adopt_shipment`): blobs are validated and
+  eager-deserialized (so "adopted" means runnable, not merely on
+  disk), cache entries land in its ``xla/`` dir, keys in its
+  manifest.
+
+The router drives both during its elastic join handshake
+(``FleetRouter.add_host``): it selects the adopt set from its own
+popularity stats (:func:`select_adopt_set`), pulls from the hosts
+whose warm sets cover it, ships to the joiner, and only then marks
+the joiner routable. Every step is best-effort — a host that cannot
+export (no store, no artifacts) simply contributes nothing, and a
+join whose shipping fails still completes with an empty adopt set
+(the joiner compiles on demand exactly as before this subsystem
+existed).
+"""
+
+from __future__ import annotations
+
+
+def select_adopt_set(popularity: dict, host_ids, new_host: str,
+                     top_k: int, rank) -> list:
+    """The fp8s a joining host should adopt, most popular first.
+
+    Primary choice: structures the NEW ring assigns to ``new_host``
+    (those are the keys rebalance moves onto it — exactly the ~1/(N+1)
+    slice that used to arrive cold). If the ring assigns it none (small
+    popularity sets, few keys), fall back to the globally hottest
+    structures: warm-aware routing steals toward warm hosts, so hot
+    programs are useful wherever they land. ``rank`` is the router's
+    rendezvous ranking function (injected — this module stays pure).
+    """
+    if top_k <= 0 or not popularity:
+        return []
+    ranked = sorted(popularity, key=lambda f: (-popularity[f], f))
+    mine = [f for f in ranked if rank(f, list(host_ids))[0] == new_host]
+    return (mine or ranked)[:int(top_k)]
+
+
+def export_for_ship(fp8s) -> dict:
+    """This host's shipment for the given fp8 set (see module doc)."""
+    from pint_tpu.programs.store import store as _store
+
+    st = _store()
+    if st is None:
+        return {"blobs": [], "xla": [], "keys": []}
+    return {"blobs": st.export(fp8s=fp8s) if fp8s else [],
+            "xla": st.export_xla(),
+            "keys": st.export_keys()}
+
+
+def adopt_shipment(shipment) -> dict:
+    """Install a shipment into this host's store; never raises.
+
+    Returns ``{"adopted", "failed", "xla", "keys"}`` — the joining
+    worker's readiness evidence (``adopted`` executables are
+    deserialized and runnable; ``xla``/``keys`` make its compiles
+    disk hits that count warm). With no store configured everything
+    "fails" softly and the join degrades to compile-on-demand.
+    """
+    from pint_tpu.programs.store import store as _store
+
+    st = _store()
+    shipment = shipment or {}
+    adopted = failed = 0
+    for blob in shipment.get("blobs") or []:
+        if st is not None and st.adopt(blob):
+            adopted += 1
+        else:
+            failed += 1
+    n_xla = st.adopt_xla(shipment.get("xla")) if st is not None else 0
+    n_keys = st.adopt_keys(shipment.get("keys")) if st is not None else 0
+    return {"adopted": adopted, "failed": failed,
+            "xla": n_xla, "keys": n_keys}

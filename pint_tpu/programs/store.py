@@ -1,0 +1,455 @@
+"""Per-host persistent program store (disk tier of the supply chain).
+
+Layout under ``PINT_TPU_PROGRAM_CACHE_DIR`` (the store root):
+
+* ``xla/`` — JAX's persistent compilation cache directory. Wired via
+  ``jax_compilation_cache_dir`` at store init, so EVERY compile in the
+  process (jit dispatch and AOT ``lower().compile()`` alike — the
+  damped fit loop, the GLS step, the predict-engine programs)
+  round-trips through disk; a warm restart pays trace time, not XLA
+  time.
+* ``aot/<key>.pgm`` — AOT-serialized fit-loop executables
+  (``jax.experimental.serialize_executable``), one pickle per
+  :func:`~pint_tpu.programs.key.program_key`: the SHIPPABLE artifact a
+  fleet join adopts with zero recompile. Atomic tmp+rename writes;
+  corrupt/alien files are skipped with a counter, never raised.
+* ``manifest.jsonl`` — append-only journal of every program key this
+  host has compiled or adopted. A new process loads it once; keys
+  present from a PRIOR process make :func:`note_seen` report *warm*,
+  which is how ``cache.fit_program.miss == 0`` holds across a restart
+  (the artifact — XLA cache entry or AOT file — is on disk, so the
+  "miss" never pays compile).
+
+Everything degrades (``programs.store.error.<stage>`` /
+``programs.store.skew`` counters, never an exception to a caller):
+with the knob unset :func:`store` returns ``None`` and every call site
+behaves bitwise as before this subsystem existed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+from pint_tpu import config, telemetry
+from pint_tpu.programs import key as _key
+
+_UNSET = object()
+_STORE = _UNSET
+
+
+def store():
+    """The process program store, or ``None`` (knob unset/bad root).
+
+    Resolved ONCE per process from ``PINT_TPU_PROGRAM_CACHE_DIR`` —
+    the XLA cache dir is global jax config, so flipping it mid-process
+    would silently redirect the whole process's compile traffic. Tests
+    that want an isolated store construct :class:`ProgramStore`
+    directly (``wire_xla=False``) instead of touching the knob.
+    """
+    global _STORE
+    if _STORE is _UNSET:
+        root = config.env_str("PINT_TPU_PROGRAM_CACHE_DIR")
+        if not root:
+            _STORE = None
+        else:
+            try:
+                _STORE = ProgramStore(root)
+            except Exception:
+                telemetry.inc("programs.store.error.init")
+                _STORE = None
+    return _STORE
+
+
+def _reset_for_tests() -> None:
+    global _STORE
+    _STORE = _UNSET
+
+
+def note_seen(kind, fingerprint, shape) -> bool:
+    """Manifest accounting for one first-seen program triple.
+
+    Called by :func:`pint_tpu.bucketing.note_program` the first time a
+    process sees ``(kind, fingerprint, shape)``. Returns True when a
+    PRIOR process already persisted this key (the program is warm on
+    disk — the restart counts a hit, not a miss); records the key in
+    the manifest either way so the NEXT restart is warm. No store ->
+    False, zero side effects.
+    """
+    st = store()
+    if st is None:
+        return False
+    base = _key.program_key(kind, fingerprint, shape)
+    if base is None:
+        return False
+    return st.note_base(base, kind=kind)
+
+
+def store_stats() -> dict | None:
+    """The store's health surface for reports/soak (None = no store)."""
+    st = store()
+    return st.stats() if st is not None else None
+
+
+class ProgramStore:
+    """One host's on-disk program store (see module docstring)."""
+
+    def __init__(self, root: str, *, wire_xla: bool = True):
+        self.root = os.path.abspath(root)
+        self.aot_dir = os.path.join(self.root, "aot")
+        self.xla_dir = os.path.join(self.root, "xla")
+        os.makedirs(self.aot_dir, exist_ok=True)
+        os.makedirs(self.xla_dir, exist_ok=True)
+        self._manifest_path = os.path.join(self.root, "manifest.jsonl")
+        self._env = _key.environment_facts()
+        #: keys journaled by PRIOR processes (warm-restart evidence)
+        self._prior: set[str] = set()
+        #: keys journaled by THIS process (dedups manifest appends)
+        self._known: set[str] = set()
+        #: deserialized executables ready to run, by program key
+        self._adopted: dict[str, object] = {}
+        self.counts = {"save": 0, "load": 0, "adopt": 0, "warm": 0,
+                       "skew": 0, "error": 0, "unportable": 0}
+        self._load_manifest()
+        if wire_xla:
+            self._wire_xla_cache()
+
+    # -- manifest ------------------------------------------------------
+    def _load_manifest(self) -> None:
+        try:
+            with open(self._manifest_path) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail of a crashed append
+                    k = rec.get("key")
+                    # a prior entry under DIFFERENT env facts is not
+                    # warm for this process (version skew) — but the
+                    # key already digests the facts, so mismatched
+                    # entries simply never collide with ours
+                    if k:
+                        self._prior.add(k)
+        except OSError:
+            pass
+
+    def _append_manifest(self, rec: dict) -> None:
+        try:
+            with open(self._manifest_path, "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
+        except (OSError, ValueError):
+            self._count_error("manifest")
+
+    def note_base(self, base: str, *, kind=None) -> bool:
+        warm = base in self._prior
+        if warm:
+            self.counts["warm"] += 1
+            telemetry.inc("programs.store.warm")
+        if base not in self._known:
+            self._known.add(base)
+            if base not in self._prior:
+                self._append_manifest({"key": base, "kind": kind})
+        return warm
+
+    # -- XLA persistent compile cache ----------------------------------
+    def _wire_xla_cache(self) -> None:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", self.xla_dir)
+            # persist everything: the supply chain wants the tiny
+            # programs too (a warm restart's miss==0 contract covers
+            # smoke-sized fits, not only headline compiles)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            # the cache latches DISABLED at the process's first compile
+            # if no dir was configured yet (observed on jax 0.4.37) —
+            # and something always compiles before the store's first
+            # touch (the backend EFT guard, a warmup op). Reset so the
+            # dir takes effect from here on.
+            from jax._src import compilation_cache as _cc
+
+            reset = getattr(_cc, "reset_cache", None)
+            if reset is not None:
+                reset()
+        except Exception:
+            self._count_error("xla_wire")
+
+    # -- AOT artifacts -------------------------------------------------
+    def _count_error(self, stage: str) -> None:
+        self.counts["error"] += 1
+        telemetry.inc(f"programs.store.error.{stage}")
+
+    def _aot_enabled(self) -> bool:
+        return config.env_on("PINT_TPU_PROGRAM_AOT")
+
+    def _path(self, pkey: str) -> str:
+        return os.path.join(self.aot_dir, f"{pkey}.pgm")
+
+    @staticmethod
+    def portable(compiled) -> bool:
+        """Whether a compiled executable survives cross-process
+        deserialize-and-RUN.
+
+        Executables whose optimized HLO contains custom calls do not:
+        the serialized artifact bakes in process-local state, and a
+        fresh process SEGFAULTS at dispatch (observed on jax 0.4.37
+        CPU for both legacy ``blas_strsm`` and name-registered
+        ``lapack_*_ffi`` targets — so no allowlist). On backends whose
+        linalg decomposes to pure HLO (TPU) the fit programs pass; on
+        CPU anything with a factorization stays local and the
+        persistent XLA cache rung carries the warm restart instead.
+        """
+        try:
+            return "custom_call_target" not in compiled.as_text()
+        except Exception:  # noqa: BLE001 — can't prove it: not portable
+            return False
+
+    def save(self, pkey: str, compiled, *, sig: str = "",
+             kind: str = "", fp8: str = "", base: str = "") -> bool:
+        """Serialize one freshly-compiled executable to disk.
+
+        Returns True iff the artifact landed; any failure (an
+        executable the backend cannot serialize, a full disk) counts
+        ``programs.store.error.save`` and leaves the in-process
+        behavior untouched.
+        """
+        if not pkey or not self._aot_enabled():
+            return False
+        if not self.portable(compiled):
+            # the compile still round-tripped the persistent XLA cache
+            # (wired at init), so the base key IS warm-restart evidence
+            # even though no shippable artifact exists
+            self.counts["unportable"] += 1
+            telemetry.inc("programs.store.unportable")
+            if base and base not in self._known:
+                self._known.add(base)
+                if base not in self._prior:
+                    self._append_manifest({"key": base, "kind": kind})
+            return False
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            blob = {"key": pkey, "kind": kind, "fp8": fp8, "sig": sig,
+                    "base": base, "env": self._env,
+                    "payload": pickle.dumps(
+                        (payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)}
+            tmp = self._path(pkey) + ".tmp"
+            with open(tmp, "wb") as fh:
+                pickle.dump(blob, fh, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._path(pkey))
+        except Exception:
+            self._count_error("save")
+            return False
+        self.counts["save"] += 1
+        telemetry.inc("programs.store.save")
+        self._append_manifest({"key": pkey, "kind": kind, "fp8": fp8,
+                               "aot": True})
+        self._known.add(pkey)
+        if base and base not in self._known:
+            # the artifact is warm-restart evidence for its accounting
+            # triple even if note_program never journaled it (e.g. a
+            # process that died between compile and the next dispatch)
+            self._known.add(base)
+            if base not in self._prior:
+                self._append_manifest({"key": base, "kind": kind})
+        return True
+
+    def load(self, pkey: str, *, sig: str = ""):
+        """An executable for ``pkey``, or None (miss/skew/corruption).
+
+        Adopted (already-deserialized) programs are returned directly;
+        otherwise the disk artifact is validated — recorded environment
+        facts must equal ours, the dispatch signature must match — and
+        deserialized. Every reject is a counter, never a raise: the
+        caller's next rung is the persistent XLA cache via a normal
+        compile.
+        """
+        if not pkey or not self._aot_enabled():
+            return None
+        prog = self._adopted.get(pkey)
+        if prog is not None:
+            return prog
+        try:
+            with open(self._path(pkey), "rb") as fh:
+                blob = pickle.load(fh)
+        except (OSError, pickle.PickleError, EOFError, ValueError):
+            return None  # plain miss (or torn write): not an error
+        try:
+            if blob.get("env") != self._env:
+                self.counts["skew"] += 1
+                telemetry.inc("programs.store.skew")
+                return None
+            if sig and blob.get("sig") and blob["sig"] != sig:
+                telemetry.inc("programs.store.sig_mismatch")
+                return None
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = pickle.loads(blob["payload"])
+            prog = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:
+            self._count_error("load")
+            return None
+        self._adopted[pkey] = prog
+        self.counts["load"] += 1
+        telemetry.inc("programs.store.load")
+        return prog
+
+    # -- fleet shipping ------------------------------------------------
+    def export(self, fp8s=None, keys=None) -> list[dict]:
+        """Raw artifact blobs for shipping (filtered by fp8 or key).
+
+        Blobs are the on-disk dicts verbatim (payload still pickled
+        bytes) — the adopting side revalidates everything, so export
+        never deserializes. Unreadable files are skipped.
+        """
+        out = []
+        fp8s = set(fp8s) if fp8s is not None else None
+        keys = set(keys) if keys is not None else None
+        try:
+            names = sorted(os.listdir(self.aot_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".pgm"):
+                continue
+            if keys is not None and name[:-4] not in keys:
+                continue
+            try:
+                with open(os.path.join(self.aot_dir, name), "rb") as fh:
+                    blob = pickle.load(fh)
+            except Exception:
+                continue
+            if fp8s is not None and blob.get("fp8") not in fp8s:
+                continue
+            out.append(blob)
+        return out
+
+    def export_xla(self, limit_bytes: int = 256 << 20) -> list:
+        """``(name, bytes)`` for the persistent XLA cache entries.
+
+        The portable shipping tier: XLA cache files relink custom
+        calls by name at load, so they are safe on every backend —
+        including the ones whose AOT executables are not (see
+        :meth:`portable`). Largest-first up to ``limit_bytes`` (the
+        big fit-loop modules are the ones worth a network hop);
+        ``-atime`` bookkeeping files are skipped.
+        """
+        out, spent = [], 0
+        try:
+            names = os.listdir(self.xla_dir)
+        except OSError:
+            return out
+        sized = []
+        for name in names:
+            if name.endswith("-atime") or os.sep in name:
+                continue
+            try:
+                sized.append(
+                    (os.path.getsize(os.path.join(self.xla_dir, name)),
+                     name))
+            except OSError:
+                continue
+        for size, name in sorted(sized, reverse=True):
+            if spent + size > limit_bytes and out:
+                break
+            try:
+                with open(os.path.join(self.xla_dir, name), "rb") as fh:
+                    out.append((name, fh.read()))
+                spent += size
+            except OSError:
+                continue
+        return out
+
+    def adopt_xla(self, files) -> int:
+        """Install shipped XLA cache entries (skip ones we have)."""
+        n = 0
+        for name, data in files or []:
+            name = os.path.basename(str(name))  # no path traversal
+            dst = os.path.join(self.xla_dir, name)
+            if os.path.exists(dst):
+                continue
+            try:
+                tmp = dst + ".tmp"
+                with open(tmp, "wb") as fh:
+                    fh.write(data)
+                os.replace(tmp, dst)
+                n += 1
+            except OSError:
+                self._count_error("adopt_xla")
+        return n
+
+    def export_keys(self, limit: int = 4096) -> list[str]:
+        """This host's warm base keys (manifest accounting), bounded."""
+        return sorted(self._prior | self._known)[:limit]
+
+    def adopt_keys(self, keys) -> int:
+        """Adopt shipped warm evidence: these triples' artifacts are in
+        the XLA cache entries shipped alongside, so the joiner's first
+        dispatch counts a hit (it pays trace, not XLA)."""
+        n = 0
+        for k in keys or []:
+            k = str(k)
+            if k and k not in self._prior:
+                self._prior.add(k)
+                if k not in self._known:
+                    self._known.add(k)
+                    self._append_manifest({"key": k, "adopted": True})
+                n += 1
+        return n
+
+    def adopt(self, blob: dict) -> bool:
+        """Install one shipped artifact: validate, persist, DESERIALIZE.
+
+        The eager deserialize is the point — a joining worker is only
+        marked ready once its adopt set is *loaded*, so its first
+        routed request runs a shipped executable with zero compile.
+        Version skew or a corrupt blob returns False (counted); the
+        join proceeds without that program.
+        """
+        try:
+            pkey = blob["key"]
+            if blob.get("env") != self._env:
+                self.counts["skew"] += 1
+                telemetry.inc("programs.store.skew")
+                return False
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = pickle.loads(blob["payload"])
+            prog = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:
+            self._count_error("adopt")
+            return False
+        self._adopted[pkey] = prog
+        try:
+            tmp = self._path(pkey) + ".tmp"
+            with open(tmp, "wb") as fh:
+                pickle.dump(blob, fh, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._path(pkey))
+            self._append_manifest({"key": pkey,
+                                   "kind": blob.get("kind"),
+                                   "fp8": blob.get("fp8"),
+                                   "aot": True, "adopted": True})
+        except Exception:
+            self._count_error("adopt_persist")  # loaded but not durable
+        self._known.add(pkey)
+        # the shipped program triple is warm by construction: the first
+        # dispatch through note_program (which checks the BASE
+        # accounting key) must count a hit, not a miss
+        self._prior.add(pkey)
+        base = blob.get("base")
+        if base:
+            self._prior.add(base)
+            self._append_manifest({"key": base,
+                                   "kind": blob.get("kind")})
+        self.counts["adopt"] += 1
+        telemetry.inc("programs.store.adopt")
+        return True
+
+    def stats(self) -> dict:
+        return dict(self.counts, root=self.root,
+                    prior=len(self._prior), known=len(self._known),
+                    adopted=len(self._adopted))

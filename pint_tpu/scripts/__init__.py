@@ -26,6 +26,25 @@ def script_init(log_level: str = "INFO") -> None:
 
     pint_logging.setup(log_level)
     ensure_exact_f64()
+    _touch_program_store()
+
+
+def _touch_program_store() -> None:
+    """Latch the persistent program store before the first compile.
+
+    The store-touch-before-first-compile rule (see
+    :mod:`pint_tpu.programs`): with PINT_TPU_PROGRAM_CACHE_DIR set, the
+    persistent XLA compile cache only helps if it is wired before the
+    process traces anything, so a console tool's repeat invocations pay
+    the compile once, not per run. No-op (store() is None) with the
+    knob unset; never raises — persistence must not break a CLI.
+    """
+    try:
+        from pint_tpu.programs.store import store as _store
+
+        _store()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _pin_platform() -> None:

@@ -223,9 +223,38 @@ def plan_key(fp: tuple, toa_bucket: int, hyper: tuple,
     return (fp, toa_bucket, hyper, int(devices), int(basis_bucket))
 
 
+def canonical_repr(obj) -> str:
+    """Process-independent textual form of a fingerprint-shaped value.
+
+    ``repr()`` alone is NOT stable across processes for sets and dicts:
+    string hash randomization (PYTHONHASHSEED) permutes their iteration
+    order, so two workers would digest the same fingerprint to
+    different program keys. Sets/frozensets are rendered sorted by
+    their elements' canonical forms, dicts sorted by key; everything
+    else falls through to ``repr`` (tuples of strings/numbers — the
+    shape ``_fn_fingerprint`` actually produces — are already stable).
+    The program supply chain (:mod:`pint_tpu.programs.key`) digests
+    this form, so it is part of the on-disk artifact contract: changing
+    it invalidates every persisted program key.
+    """
+    if isinstance(obj, (set, frozenset)):
+        return "{" + ",".join(sorted(canonical_repr(x) for x in obj)) + "}"
+    if isinstance(obj, dict):
+        return "{" + ",".join(
+            f"{canonical_repr(k)}:{canonical_repr(v)}"
+            for k, v in sorted(obj.items(),
+                               key=lambda kv: canonical_repr(kv[0]))) + "}"
+    if isinstance(obj, tuple):
+        return "(" + ",".join(canonical_repr(x) for x in obj) + ",)"
+    if isinstance(obj, list):
+        return "[" + ",".join(canonical_repr(x) for x in obj) + "]"
+    return repr(obj)
+
+
 def short_id(fp: tuple) -> str:
     """Stable 8-hex-digit label of a fingerprint for telemetry/records
-    (content digest, not ``hash()`` — that is salted per process)."""
+    (content digest over :func:`canonical_repr`, not ``hash()`` — that
+    is salted per process, and plain ``repr`` is set-order unstable)."""
     import hashlib
 
-    return hashlib.sha1(repr(fp).encode()).hexdigest()[:8]
+    return hashlib.sha1(canonical_repr(fp).encode()).hexdigest()[:8]

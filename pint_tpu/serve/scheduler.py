@@ -375,6 +375,17 @@ class BatchPlan:
         return tuple(range(self.slot, self.slot + self.devices))
 
 
+def _program_store_stats() -> dict | None:
+    """Persistent-program-store health for :meth:`report` (never
+    raises; None = no store configured — the bitwise-today default)."""
+    try:
+        from pint_tpu.programs import store_stats
+
+        return store_stats()
+    except Exception:  # noqa: BLE001 — health surface must not fail
+        return None
+
+
 class _FailedBatch:
     """Pipeline-stage failure marker: the batch's members get salvaged
     through per-request passthrough fits at the fetch stage."""
@@ -728,6 +739,10 @@ class ThroughputScheduler:
             "last_drain_wall_s": (self.last_drain or {}).get("wall_s"),
             "program_misses": int(
                 counter_value("cache.fit_program.miss") or 0),
+            # persistent program store health (None = no store): the
+            # router's join prewarm and tools/soak read adopt/save/skew
+            # totals from here
+            "programs": _program_store_stats(),
         }
 
     # ------------------------------------------------------------------
@@ -1487,6 +1502,17 @@ class ThroughputScheduler:
         def _dispatch(state):
             if isinstance(state, _FailedBatch):
                 return state
+            # tag every program compiled under this launch with the
+            # plan's fingerprint short-id: the persistent store's
+            # artifacts then carry the SAME fp8 the fleet router's
+            # warm-set/popularity stats use, which is what the join
+            # prewarm protocol filters shipments on (pint_tpu.programs)
+            from pint_tpu.programs.key import serve_fp8
+
+            with serve_fp8(state.plan.group):
+                return _dispatch_inner(state)
+
+        def _dispatch_inner(state):
             plan = state.plan
             while True:
                 try:
@@ -1603,8 +1629,11 @@ class ThroughputScheduler:
                     # the deferred async-dispatch error surfaces at this
                     # sync; one retry "attempt" = fresh dispatch + fetch
                     if state.handle is None:
-                        state.handle = state.fitter.dispatch_fit(
-                            **state.hyper)
+                        from pint_tpu.programs.key import serve_fp8
+
+                        with serve_fp8(plan.group):
+                            state.handle = state.fitter.dispatch_fit(
+                                **state.hyper)
                     chi2 = np.asarray(state.handle.finish(), dtype=float)
                     break
                 except Exception as e:  # noqa: BLE001

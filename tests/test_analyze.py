@@ -343,6 +343,100 @@ def test_env_knob_clean_fixture(tmp_path):
     assert all("PINT_TPU_ALPHA" not in m for m in msgs)
 
 
+# ------------------------------------------- program-key-drift rule
+PK_KEY_OK = """\
+    from pint_tpu import config
+
+    _TRACED_SET_KNOBS = ("PINT_TPU_TRACE_X",)
+    _PRECISION_KNOBS = ("PINT_TPU_FP",)
+
+    def environment_facts():
+        facts = {}
+        facts["x"] = config.env_on("PINT_TPU_TRACE_X")
+        facts["fp"] = config.env_raw("PINT_TPU_FP")
+        return facts
+"""
+
+PK_GATE_OK = """\
+    from pint_tpu import config
+
+    def trace_x_enabled():
+        return config.env_on("PINT_TPU_TRACE_X")
+
+    def ordinary_helper():
+        return config.env_on("PINT_TPU_UNRELATED")
+"""
+
+
+def _pk_tree(tmp_path, key=PK_KEY_OK, gate=PK_GATE_OK):
+    cfg = _tree(tmp_path, {"key.py": key, "gate.py": gate},
+                program_key_file="key.py",
+                traced_gate_files=["gate.py"])
+    return _rules_hit(run(cfg), "program-key-drift")
+
+
+def test_program_key_drift_clean_fixture(tmp_path):
+    """A gate read covered by the tuples, the tuples covered by
+    environment_facts(), and a knob read outside any ``*_enabled``
+    gate: zero findings."""
+    assert _pk_tree(tmp_path) == []
+
+
+def test_program_key_drift_flags_uncovered_gate_read(tmp_path):
+    gate = PK_GATE_OK + (
+        "\n    def trace_y_enabled():\n"
+        "        return config.env_on(\"PINT_TPU_TRACE_Y\")\n")
+    msgs = [f.message for f in _pk_tree(tmp_path, gate=gate)]
+    assert any("PINT_TPU_TRACE_Y" in m and "does not fold" in m
+               for m in msgs)
+
+
+def test_program_key_drift_flags_stale_tuple_entry(tmp_path):
+    key = PK_KEY_OK.replace(
+        '_TRACED_SET_KNOBS = ("PINT_TPU_TRACE_X",)',
+        '_TRACED_SET_KNOBS = ("PINT_TPU_TRACE_X", "PINT_TPU_GONE")')
+    key += "        # facts covers GONE so only the dead entry fires\n"
+    key = key.replace(
+        '        return facts',
+        '        facts["g"] = config.env_on("PINT_TPU_GONE")\n'
+        '        return facts')
+    msgs = [f.message for f in _pk_tree(tmp_path, key=key)]
+    assert any("PINT_TPU_GONE" in m and "dead entry" in m for m in msgs)
+
+
+def test_program_key_drift_flags_facts_not_reading_listed_knob(
+        tmp_path):
+    key = PK_KEY_OK.replace(
+        '        facts["fp"] = config.env_raw("PINT_TPU_FP")\n', "")
+    findings = _pk_tree(tmp_path, key=key)
+    assert any("PINT_TPU_FP" in f.message and "never reads" in f.message
+               and f.symbol == "environment_facts" for f in findings)
+
+
+def test_program_key_drift_flags_facts_reading_unlisted_knob(tmp_path):
+    key = PK_KEY_OK.replace(
+        '        return facts',
+        '        facts["s"] = config.env_on("PINT_TPU_SNEAKY")\n'
+        '        return facts')
+    msgs = [f.message for f in _pk_tree(tmp_path, key=key)]
+    assert any("PINT_TPU_SNEAKY" in m and "lists it" in m for m in msgs)
+
+
+def test_program_key_drift_requires_literal_tuples(tmp_path):
+    key = PK_KEY_OK.replace(
+        '_TRACED_SET_KNOBS = ("PINT_TPU_TRACE_X",)',
+        '_TRACED_SET_KNOBS = tuple(sorted(["PINT_TPU_TRACE_X"]))')
+    msgs = [f.message for f in _pk_tree(tmp_path, key=key)]
+    assert any("not a literal tuple" in m for m in msgs)
+
+
+def test_program_key_drift_silent_without_key_file(tmp_path):
+    cfg = _tree(tmp_path, {"gate.py": PK_GATE_OK},
+                program_key_file="key.py",
+                traced_gate_files=["gate.py"])
+    assert _rules_hit(run(cfg), "program-key-drift") == []
+
+
 # ------------------------------------------- disables and the baseline
 def test_disable_needs_reason_and_use(tmp_path):
     src = """\

@@ -354,6 +354,100 @@ def test_routed_reads_never_touch_fit_loops(toas_a, toas_b):
 
 
 # ----------------------------------------------------------------------
+# elastic join readiness (ISSUE 16): handshake gating + mid-adopt death
+# ----------------------------------------------------------------------
+
+def test_join_readiness_gates_routing(toas_a, monkeypatch):
+    """A joining host is registered but NOT routable until the
+    prewarm handshake completes: at every pre-ready stage it is
+    excluded from alive_hosts(), and only the terminal "ready" stage
+    admits it."""
+    from pint_tpu.fleet import router as router_mod
+
+    router = build_fleet(2, max_queue=8)
+    router.submit(_request(PAR, toas_a))     # populate popularity
+    assert router.drain()[0].status == "ok"
+    assert router._popularity                # the staged path engages
+    stages = []
+
+    def hook(stage, hid):
+        stages.append(stage)
+        if stage == "ready":
+            assert router._health[hid]["ready"]
+        else:
+            assert not router._health[hid]["ready"]
+            assert hid not in router.alive_hosts()
+
+    monkeypatch.setattr(router_mod, "_JOIN_STAGE_HOOK", hook)
+    before = telemetry.counters_snapshot()
+    router.add_host(LoopbackHost("hostX", max_queue=8))
+    delta = telemetry.counters_delta(before)
+    assert stages == ["selected", "pulled", "shipped", "ready"]
+    assert "hostX" in router.alive_hosts()
+    assert int(delta.get("fleet.join.ready", 0)) == 1
+    assert int(delta.get("fleet.join.abandoned", 0)) == 0
+    router.drain()
+
+
+@pytest.mark.slow
+def test_join_sigkill_mid_adopt_abandons_joiner(toas_a, tmp_path,
+                                                monkeypatch):
+    """SIGKILL the joining worker mid-handshake (after the donor pull,
+    before its adopt completes): the join is ABANDONED — the joiner is
+    never marked ready, zero traffic ever routes to it, and in-flight
+    serving on the survivors is unaffected."""
+    from pint_tpu.fleet import TcpHost
+    from pint_tpu.fleet import router as router_mod
+    from pint_tpu.fleet.worker import spawn_local_workers
+
+    donors = spawn_local_workers(
+        2, env_per_worker=[
+            {"PINT_TPU_PROGRAM_CACHE_DIR": str(tmp_path / f"store{i}")}
+            for i in range(2)])
+    hosts = [TcpHost(h, ("127.0.0.1", p)) for h, p, _ in donors]
+    joiner_procs = []
+    try:
+        router = FleetRouter(hosts)
+        for i in range(2):
+            router.submit(_request(PAR, toas_a, tag=i))
+        assert all(r.status == "ok" for r in router.drain())
+        (jid, jport, jproc), = spawn_local_workers(
+            1, prefix="j",
+            env_per_worker=[{"PINT_TPU_PROGRAM_CACHE_DIR":
+                             str(tmp_path / "storej")}])
+        joiner_procs.append(jproc)
+        killed = []
+
+        def hook(stage, hid):
+            if stage == "pulled" and hid == jid:
+                jproc.kill()                 # SIGKILL, not shutdown
+                jproc.wait(timeout=30)
+                killed.append(hid)
+
+        monkeypatch.setattr(router_mod, "_JOIN_STAGE_HOOK", hook)
+        before = telemetry.counters_snapshot()
+        router.add_host(TcpHost(jid, ("127.0.0.1", jport)))
+        delta = telemetry.counters_delta(before)
+        assert killed == [jid]
+        assert int(delta.get("fleet.join.abandoned", 0)) == 1
+        assert int(delta.get("fleet.join.ready", 0)) == 0
+        assert not router._health[jid]["ready"]
+        assert jid not in router.alive_hosts()
+        # live traffic routes around the corpse and still resolves
+        h = router.submit(_request(PAR, toas_a, tag="after"))
+        assert h.host != jid
+        assert router.drain()[0].status == "ok"
+    finally:
+        for h in hosts:
+            h.shutdown()
+        for _hid, _port, p in donors:
+            p.wait(timeout=30)
+        for p in joiner_procs:
+            if p.poll() is None:
+                p.kill()
+
+
+# ----------------------------------------------------------------------
 # TCP transport roundtrip (slow: spawns 2 real worker processes)
 # ----------------------------------------------------------------------
 

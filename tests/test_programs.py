@@ -1,0 +1,312 @@
+"""Program supply chain (ISSUE 16): key stability across processes,
+key sensitivity to traced-set/precision flags, and the persistent
+store's save/load/ship/adopt ladder with its degradation guarantees.
+
+The store unit tests construct :class:`ProgramStore` directly with
+``wire_xla=False`` so they never redirect the test process's global
+JAX compilation-cache dir (see the ``store()`` docstring)."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.programs import (ProgramStore, environment_facts,
+                               fingerprint_id, program_key)
+from pint_tpu.programs.key import artifact_key
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(autouse=True)
+def _aot_on(monkeypatch):
+    # the store's AOT tier is on by default; pin it so an ambient
+    # PINT_TPU_PROGRAM_AOT=0 in the environment can't skip these tests
+    monkeypatch.setenv("PINT_TPU_PROGRAM_AOT", "1")
+
+
+# ----------------------------------------------------------------------
+# key identity: cross-process stability, flag sensitivity
+# ----------------------------------------------------------------------
+
+_CHILD = """
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from pint_tpu.models import get_model
+from pint_tpu.programs import fingerprint_id, program_key
+PAR = '''%s'''
+m = get_model(PAR)
+fp = fingerprint_id(m)
+print(fp)
+print(program_key("device_loop_gls", (fp, ("ecorr", 2)), (64, 8),
+                  extra=(True, "donate")))
+print(program_key("batched_gls", (fp, None), (128,)))
+""" % PAR
+
+
+def _child_keys(hashseed: str) -> str:
+    env = dict(os.environ, PYTHONHASHSEED=hashseed,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(
+                   os.path.dirname(os.path.abspath(__file__))))
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_program_key_byte_identical_across_processes():
+    """The ISSUE 16 identity contract: same model/bucket/flags in two
+    independent processes (different hash seeds — the exact condition
+    that breaks ``hash()``-based fingerprints) derive byte-identical
+    fingerprint ids and program keys."""
+    a = _child_keys("1")
+    b = _child_keys("271828")
+    assert a == b
+    lines = a.strip().splitlines()
+    assert len(lines) == 3 and all(lines)
+
+
+def test_program_key_matches_in_process_derivation():
+    """The in-process derivation agrees with itself and is a 32-hex
+    digest (what lands in on-disk artifact names)."""
+    m_fp = fingerprint_id.__module__  # touch: module import sanity
+    assert m_fp == "pint_tpu.programs.key"
+    k1 = program_key("device_loop_gls", ("aabbccdd", ("pl", 30)),
+                     (64, 8), extra=(True,))
+    k2 = program_key("device_loop_gls", ("aabbccdd", ("pl", 30)),
+                     (64, 8), extra=(True,))
+    assert k1 == k2
+    assert len(k1) == 32 and int(k1, 16) >= 0
+
+
+def test_program_key_sensitive_to_triple_and_extra():
+    base = program_key("k", ("fp", 1), (64,), extra=())
+    assert program_key("k2", ("fp", 1), (64,), extra=()) != base
+    assert program_key("k", ("fp", 2), (64,), extra=()) != base
+    assert program_key("k", ("fp", 1), (128,), extra=()) != base
+    assert program_key("k", ("fp", 1), (64,), extra=(1,)) != base
+
+
+def test_program_key_changes_on_traced_set_and_precision_flags(
+        monkeypatch):
+    """Flipping any traced-set gate or the precision kill switch MUST
+    change every key — a stale artifact would otherwise be adopted for
+    a differently-traced program (the skew-reject's first line of
+    defense is never reaching the artifact at all)."""
+    args = ("device_loop_gls", ("fp", ("ecorr", 2)), (64, 8))
+    base = program_key(*args)
+    assert environment_facts()["PINT_TPU_TRACE_EFAC"] == "1"  # default
+    monkeypatch.setenv("PINT_TPU_TRACE_EFAC", "0")
+    flipped = program_key(*args)
+    assert flipped != base
+    monkeypatch.delenv("PINT_TPU_TRACE_EFAC")
+    assert program_key(*args) == base  # restored -> identical again
+    monkeypatch.setenv("PINT_TPU_BATCH_NOISE", "0")
+    assert program_key(*args) != base
+    monkeypatch.delenv("PINT_TPU_BATCH_NOISE")
+    monkeypatch.setenv("PINT_TPU_F64", "1")
+    assert program_key(*args) != base
+
+
+def test_program_key_never_raises():
+    class Unreprable:
+        def __repr__(self):
+            raise RuntimeError("no repr")
+
+    assert program_key("k", Unreprable(), (64,)) is None
+
+
+def test_artifact_key_folds_signature():
+    base = program_key("k", ("fp", 1), (64,))
+    a1 = artifact_key(base, ("sig", 1))
+    a2 = artifact_key(base, ("sig", 2))
+    assert a1 and a2 and a1 != a2 and len(a1) == 32
+    assert artifact_key("", ("sig", 1)) is None
+    assert artifact_key(base, ("sig", 1)) == a1
+
+
+# ----------------------------------------------------------------------
+# the persistent store: portability gate, round-trip, degradation
+# ----------------------------------------------------------------------
+
+def _compiled_add(n=8):
+    return jax.jit(lambda x: x * 2.0 + 1.0).lower(
+        jnp.zeros((n,), jnp.float32)).compile()
+
+
+def _compiled_cholesky(n=4):
+    a = jnp.eye(n, dtype=jnp.float32) * 4.0
+    return jax.jit(jnp.linalg.cholesky).lower(a).compile()
+
+
+def test_portable_gate_pure_hlo_yes_custom_call_no():
+    """On CPU a factorization lowers to a lapack custom call — its
+    serialized executable SEGFAULTS a fresh process at dispatch, so
+    the gate must refuse it; pure-HLO arithmetic passes."""
+    assert ProgramStore.portable(_compiled_add())
+    assert not ProgramStore.portable(_compiled_cholesky())
+    assert not ProgramStore.portable(object())  # can't prove -> no
+
+
+def test_store_save_load_roundtrip(tmp_path):
+    st = ProgramStore(str(tmp_path), wire_xla=False)
+    pkey = program_key("unit_add", ("fp", 0), (8,))
+    assert st.save(pkey, _compiled_add(), sig="s1", kind="unit_add",
+                   base="base0")
+    # a second store on the same root models a restarted process
+    st2 = ProgramStore(str(tmp_path), wire_xla=False)
+    prog = st2.load(pkey, sig="s1")
+    assert prog is not None
+    out = prog(jnp.ones((8,), jnp.float32))
+    assert jnp.allclose(out[0] if isinstance(out, (tuple, list))
+                        else out, 3.0)
+    assert st2.counts["load"] == 1
+    # signature mismatch: reject, no crash
+    st3 = ProgramStore(str(tmp_path), wire_xla=False)
+    assert st3.load(pkey, sig="OTHER") is None
+
+
+def test_store_unportable_save_still_journals_base_warm(tmp_path):
+    """An unportable executable saves nothing shippable, but the base
+    key is still warm-restart evidence (the XLA cache rung carries the
+    actual artifact): the NEXT process's note_base counts warm."""
+    st = ProgramStore(str(tmp_path), wire_xla=False)
+    pkey = program_key("unit_chol", ("fp", 0), (4, 4))
+    assert not st.save(pkey, _compiled_cholesky(), kind="unit_chol",
+                       base="baseC")
+    assert st.counts["unportable"] == 1
+    assert not os.path.exists(os.path.join(st.aot_dir, pkey + ".pgm"))
+    st2 = ProgramStore(str(tmp_path), wire_xla=False)
+    assert st2.note_base("baseC") is True
+    assert st2.counts["warm"] == 1
+    # a key no process ever journaled is cold
+    assert st2.note_base("never-seen") is False
+
+
+def test_store_env_skew_rejected(tmp_path):
+    st = ProgramStore(str(tmp_path), wire_xla=False)
+    pkey = program_key("unit_add", ("fp", 1), (8,))
+    assert st.save(pkey, _compiled_add(), kind="unit_add")
+    path = os.path.join(st.aot_dir, pkey + ".pgm")
+    with open(path, "rb") as fh:
+        blob = pickle.load(fh)
+    blob["env"] = dict(blob["env"], jaxlib="0.0.0-other")
+    with open(path, "wb") as fh:
+        pickle.dump(blob, fh)
+    st2 = ProgramStore(str(tmp_path), wire_xla=False)
+    assert st2.load(pkey) is None
+    assert st2.counts["skew"] == 1
+
+
+def test_store_corrupt_artifact_is_a_miss_not_a_crash(tmp_path):
+    st = ProgramStore(str(tmp_path), wire_xla=False)
+    pkey = program_key("unit_add", ("fp", 2), (8,))
+    assert st.save(pkey, _compiled_add())
+    path = os.path.join(st.aot_dir, pkey + ".pgm")
+    with open(path, "wb") as fh:
+        fh.write(b"\x00garbage not a pickle")
+    st2 = ProgramStore(str(tmp_path), wire_xla=False)
+    assert st2.load(pkey) is None          # degrade, never raise
+    # valid pickle, broken payload: counted as a load error
+    with open(path, "wb") as fh:
+        pickle.dump({"key": pkey, "env": environment_facts(),
+                     "payload": b"junk"}, fh)
+    st3 = ProgramStore(str(tmp_path), wire_xla=False)
+    assert st3.load(pkey) is None
+    assert st3.counts["error"] == 1
+
+
+def test_store_export_adopt_blob_roundtrip(tmp_path):
+    """The fleet blob tier: donor exports raw blobs, joiner adopts
+    (validate + persist + EAGER deserialize) and can run the program
+    with zero compiles; warm accounting covers the base key."""
+    donor = ProgramStore(str(tmp_path / "donor"), wire_xla=False)
+    pkey = program_key("unit_add", ("fp", 3), (8,))
+    assert donor.save(pkey, _compiled_add(), sig="s", kind="unit_add",
+                      fp8="aabbccdd", base="baseB")
+    blobs = donor.export(fp8s={"aabbccdd"})
+    assert len(blobs) == 1 and blobs[0]["key"] == pkey
+    assert donor.export(fp8s={"other"}) == []
+    assert len(donor.export(keys={pkey})) == 1
+
+    joiner = ProgramStore(str(tmp_path / "joiner"), wire_xla=False)
+    assert joiner.adopt(blobs[0]) is True
+    assert joiner.counts["adopt"] == 1
+    prog = joiner.load(pkey, sig="s")
+    assert prog is not None
+    # the base accounting key is warm on the joiner: first dispatch
+    # through note_program counts a HIT
+    assert joiner.note_base("baseB") is True
+    # skewed blob: refused, counted, join proceeds
+    bad = dict(blobs[0], env={"jax": "0.0.0"})
+    assert joiner.adopt(bad) is False
+    assert joiner.counts["skew"] == 1
+
+
+def test_store_xla_and_key_tiers_roundtrip(tmp_path):
+    donor = ProgramStore(str(tmp_path / "d"), wire_xla=False)
+    with open(os.path.join(donor.xla_dir, "entryA"), "wb") as fh:
+        fh.write(b"x" * 64)
+    with open(os.path.join(donor.xla_dir, "entryA-atime"), "wb") as fh:
+        fh.write(b"t")                     # bookkeeping: never shipped
+    files = donor.export_xla()
+    assert [n for n, _ in files] == ["entryA"]
+    donor.note_base("warmkey1")
+    donor.note_base("warmkey2")
+    keys = donor.export_keys()
+    assert set(keys) >= {"warmkey1", "warmkey2"}
+
+    joiner = ProgramStore(str(tmp_path / "j"), wire_xla=False)
+    assert joiner.adopt_xla(files) == 1
+    assert joiner.adopt_xla(files) == 0    # already present: skipped
+    assert os.path.exists(os.path.join(joiner.xla_dir, "entryA"))
+    # path traversal in a shipped name lands as a basename, never
+    # outside the store
+    assert joiner.adopt_xla([("../../evil", b"p")]) == 1
+    assert os.path.exists(os.path.join(joiner.xla_dir, "evil"))
+    assert joiner.adopt_keys(keys) == 2
+    assert joiner.note_base("warmkey1") is True  # shipped warmth counts
+
+
+def test_store_singleton_resolves_once_from_knob(tmp_path, monkeypatch):
+    """``store()`` resolves PINT_TPU_PROGRAM_CACHE_DIR exactly once per
+    process: no knob -> None, and a later flip never rewires a live
+    process (the XLA cache dir is global state)."""
+    from pint_tpu.programs import store as store_mod
+
+    monkeypatch.delenv("PINT_TPU_PROGRAM_CACHE_DIR", raising=False)
+    monkeypatch.setattr(store_mod, "_STORE", store_mod._UNSET)
+    assert store_mod.store() is None
+    assert store_mod.store_stats() is None
+    # knob now set, but the None already latched: still None
+    monkeypatch.setenv("PINT_TPU_PROGRAM_CACHE_DIR", str(tmp_path))
+    assert store_mod.store() is None
+    assert store_mod.note_seen("k", ("fp",), (8,)) is False  # no-op

@@ -24,6 +24,14 @@ invariant this repo has shipped, broken, and re-fixed by hand review:
   ``build_union_model``'s normalization, or named by a ``batchable``
   passthrough reason token (the three lists drifted silently in
   PR-8/10/14 until a perf artifact regressed).
+* ``program-key-drift`` — the cross-module consistency of program
+  identity (ISSUE 16): every knob a traced-set gate (the ``*_enabled``
+  functions of ``serve/fingerprint.py`` / ``fitting/gls_step.py``)
+  reads must be folded into the serialization-stable program key
+  (``programs/key.py _TRACED_SET_KNOBS``/``_PRECISION_KNOBS``), and
+  every listed knob must still have a live gate — a missing knob means
+  a persistent/shipped artifact compiled under one trace regime would
+  be adopted under another; a stale one silently widens every key.
 * ``env-knob-registry`` — every ``PINT_TPU_*`` environment read resolves
   through the ``pint_tpu.config`` registry (declared default + doc);
   direct/undeclared/unreadable/undocumented knobs are findings.
@@ -56,6 +64,7 @@ RULES = (
     "eager-jnp-in-host-prep",
     "donation-safety",
     "fingerprint-drift",
+    "program-key-drift",
     "env-knob-registry",
     "bare-disable",
     "unused-disable",
@@ -109,6 +118,9 @@ class Config:
     registry_file: str = "pint_tpu/config.py"
     fingerprint_file: str = "pint_tpu/serve/fingerprint.py"
     union_file: str = "pint_tpu/parallel/batch.py"
+    program_key_file: str = "pint_tpu/programs/key.py"
+    traced_gate_files: list = dataclasses.field(default_factory=lambda: [
+        "pint_tpu/serve/fingerprint.py", "pint_tpu/fitting/gls_step.py"])
     models_glob: str = "pint_tpu/models/*.py"
     docs_knobs: str = "docs/KNOBS.md"
     docs_arch: str = "docs/ARCHITECTURE.md"
@@ -361,6 +373,7 @@ def run(cfg: Config) -> list:
         for rule_fn in per_file_rules:
             raw.extend(rule_fn(mod, cfg))
     raw.extend(_rules.rule_fingerprint_drift(cfg, modules))
+    raw.extend(_rules.rule_program_key_drift(cfg, modules))
     raw.extend(_rules.rule_registry_integrity(cfg, modules))
 
     # suppression pass: a disable on any physical line of the flagged

@@ -419,6 +419,124 @@ def rule_fingerprint_drift(cfg, modules):
                         "key")
 
 
+# ------------------------------------------------ program-key drift
+def _literal_tuple_assign(mod, name: str):
+    """(tuple value, line) of a module-level ``NAME = (...)`` literal,
+    or (None, lineno/0) when absent or not statically readable."""
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            try:
+                val = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                return None, node.lineno
+            if isinstance(val, (tuple, list)) and all(
+                    isinstance(v, str) for v in val):
+                return tuple(val), node.lineno
+            return None, node.lineno
+    return None, 0
+
+
+def rule_program_key_drift(cfg, modules):
+    """program-key-drift: program identity must track the traced set
+    (ISSUE 16). Every knob a traced-set gate reads — the ``*_enabled``
+    functions of the fingerprint/gls_step frontier, whose flip changes
+    what the fit programs TRACE without changing the model fingerprint
+    — must be folded into the serialization-stable program key
+    (``programs/key.py _TRACED_SET_KNOBS`` / ``_PRECISION_KNOBS``), or
+    a persistent/shipped artifact compiled under one trace regime is
+    adopted under another. The reverse drift (a listed knob no gate
+    reads anymore) is flagged too: a dead entry silently widens every
+    key and masks the next real miss."""
+    key_mod = modules.get(cfg.program_key_file)
+    if key_mod is None:
+        return  # fixture trees may scope the supply chain out
+    listed: dict = {}
+    for name in ("_TRACED_SET_KNOBS", "_PRECISION_KNOBS"):
+        val, line = _literal_tuple_assign(key_mod, name)
+        if val is None and line:
+            yield Finding(
+                cfg.program_key_file, line, "program-key-drift", "",
+                f"{name} is not a literal tuple of knob names — the "
+                "drift check must be able to read it statically")
+        listed[name] = (val or (), line)
+    covered = set(listed["_TRACED_SET_KNOBS"][0]) | set(
+        listed["_PRECISION_KNOBS"][0])
+    gate_reads = []  # (rel, line, qualname, knob)
+    for rel in cfg.traced_gate_files:
+        mod = modules.get(rel)
+        if mod is None:
+            continue
+        for func in mod.functions():
+            if not func.name.endswith("_enabled"):
+                continue
+            qual = mod.qualname(func)
+            for node in mod.body_nodes(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = mod.dotted(node.func) or ""
+                terminal = dn.rsplit(".", 1)[-1]
+                if (terminal in ENV_HELPERS and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith("PINT_TPU_")):
+                    gate_reads.append(
+                        (rel, node.lineno, qual, node.args[0].value))
+    read_knobs = set()
+    for rel, line, qual, knob in gate_reads:
+        read_knobs.add(knob)
+        if knob not in covered:
+            yield Finding(
+                rel, line, "program-key-drift", qual,
+                f"traced-set gate reads {knob} but "
+                f"{cfg.program_key_file} does not fold it into the "
+                "program key (_TRACED_SET_KNOBS) — a flip would adopt "
+                "a stale artifact for a differently-traced program",
+                end_line=line)
+    if gate_reads:  # fixture trees with no gates skip the reverse leg
+        for knob in listed["_TRACED_SET_KNOBS"][0]:
+            if knob not in read_knobs:
+                yield Finding(
+                    cfg.program_key_file,
+                    listed["_TRACED_SET_KNOBS"][1],
+                    "program-key-drift", "",
+                    f"_TRACED_SET_KNOBS lists {knob} but no traced-set "
+                    "gate (*_enabled) reads it — a dead entry widens "
+                    "every program key")
+    # third leg: environment_facts() must READ (literally) exactly the
+    # listed knobs — listing without folding in, or folding in without
+    # listing, both silently desynchronize key identity from the tuple
+    # the other two legs check
+    facts_fn = _find_function(key_mod, "environment_facts")
+    if facts_fn is not None:
+        facts_reads = {}
+        for node in ast.walk(facts_fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = key_mod.dotted(node.func) or ""
+            terminal = dn.rsplit(".", 1)[-1]
+            if (terminal in ENV_HELPERS and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("PINT_TPU_")):
+                facts_reads.setdefault(node.args[0].value, node.lineno)
+        for knob in sorted(covered - set(facts_reads)):
+            yield Finding(
+                cfg.program_key_file, facts_fn.lineno,
+                "program-key-drift", "environment_facts",
+                f"{knob} is listed in the key-input tuples but "
+                "environment_facts() never reads it — the program key "
+                "would not change when it flips")
+        for knob in sorted(set(facts_reads) - covered):
+            yield Finding(
+                cfg.program_key_file, facts_reads[knob],
+                "program-key-drift", "environment_facts",
+                f"environment_facts() reads {knob} but neither "
+                "_TRACED_SET_KNOBS nor _PRECISION_KNOBS lists it — "
+                "undocumented key input the drift legs cannot check")
+
+
 # ------------------------------------------------- env-knob registry
 _KNOB_TOKEN = re.compile(r"PINT_TPU_[A-Z0-9_]+")
 

@@ -1416,6 +1416,15 @@ def main() -> int:
             "cross_trial_hit_rate": round(hits / max(1, hits + misses), 4),
             "per_cache": per_cache,
         }
+        # persistent-store health (ISSUE 16): None unless the soak ran
+        # with PINT_TPU_PROGRAM_CACHE_DIR — then save/load/adopt/skew
+        # totals say whether the on-disk supply chain carried the reuse
+        try:
+            from pint_tpu.programs import store_stats
+
+            record["program_reuse"]["persistent_store"] = store_stats()
+        except Exception:  # noqa: BLE001 — reporting only
+            pass
         save()
     print(f"soak: {args.trials - fails}/{args.trials} passed")
     return min(fails, 255)  # raw count would wrap mod 256 (256 -> "clean")
