@@ -103,6 +103,17 @@ def _emit(obj: dict) -> None:
 # polluted by the builder's own background load)
 _HOST_START: dict | None = None
 
+# telemetry artifact convention (ISSUE 19 hygiene): run outputs live
+# under the git-ignored telemetry/ directory, never loose at the repo
+# root; --telemetry-out / PINT_TPU_TELEMETRY_PATH override the default
+TELEMETRY_OUT_DEFAULT = "telemetry/bench_telemetry.jsonl"
+
+
+def _telemetry_path() -> str:
+    path = config.env_str("PINT_TPU_TELEMETRY_PATH") or TELEMETRY_OUT_DEFAULT
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    return path
+
 
 def _telemetry_begin() -> None:
     """Child-process telemetry init: on unless PINT_TPU_TELEMETRY=0.
@@ -117,8 +128,7 @@ def _telemetry_begin() -> None:
 
     telemetry.configure(
         enabled=config.env_raw("PINT_TPU_TELEMETRY") != "0",
-        jsonl_path=config.env_str("PINT_TPU_TELEMETRY_PATH")
-        or "bench_telemetry.jsonl")
+        jsonl_path=_telemetry_path())
     _HOST_START = telemetry.host_sample()
 
 
@@ -2633,6 +2643,297 @@ def bench_fleet_coldjoin() -> None:
                "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
 
 
+def _bench_fleet_trace() -> dict:
+    """The ISSUE-19 distributed-tracing A/B over REAL worker processes
+    (``PINT_TPU_BENCH_MODE=fleet_trace``; artifact FLEET_r04.json).
+
+    Phase 1 — **traced kill/failover stream**: two worker processes,
+    each writing its OWN telemetry JSONL; a sessionful stream
+    (populate, then an append) is routed; the pinned worker is
+    SIGKILLed holding the queued append; while the append is still
+    pending, ``python -m pint_tpu.telemetry.top --connect ... --once``
+    is captured over the live sockets (one live host, one error
+    entry). After failover, the THREE per-process artifacts (router +
+    both workers) are merged and must assemble into exactly ONE rooted
+    span tree carrying the full causal chain — submit -> accept ->
+    failover -> replay -> dispatch -> commit — across >= 3 pids, with
+    the dead worker's accept hop surviving its SIGKILL (the per-op
+    flush contract).
+
+    Phase 2 — **telemetry-off A/B**: the same 6-request warm stream
+    is routed through fresh worker pairs twice, once with telemetry on
+    (router JSONL + per-worker JSONL) and once under the
+    ``PINT_TPU_TELEMETRY=0`` kill switch on router AND workers. Both
+    sides warm on round 1 and measure round 2; the headline is the
+    off-side wall and the on/off overhead percent — the pin is that
+    tracing is a boolean check when off, not a tax."""
+    import signal as _signal
+    import subprocess as _sp
+    import sys as _sys
+    import tempfile
+
+    from pint_tpu import telemetry
+    from pint_tpu.fleet import FleetRouter, TcpHost
+    from pint_tpu.fleet.worker import spawn_local_workers
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest
+    from pint_tpu.telemetry import top as _top
+    from pint_tpu.telemetry import trace as _trace
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par_t = ("PSRJ FAKE_TRACE\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+             "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+             "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+             "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    hyper = dict(maxiter=8, min_chi2_decrease=1e-5)
+    truth = get_model(par_t)
+    pop = make_fake_toas_uniform(53000, 56000, 60, truth, obs="@",
+                                 freq_mhz=1400.0, error_us=2.0,
+                                 add_noise=True, seed=720)
+    app = make_fake_toas_uniform(56010, 56040, 6, truth, obs="@",
+                                 freq_mhz=1400.0, error_us=2.0,
+                                 add_noise=True, seed=721)
+
+    def fit_model():
+        m = get_model(par_t)
+        m["F0"].add_delta(2e-10)
+        return m
+
+    tmp = tempfile.mkdtemp(prefix="pint_tpu_fleet_trace_")
+    rec: dict = {}
+
+    # ---- phase 1: the traced kill/failover stream --------------------
+    router_jsonl = os.path.join(tmp, "router.jsonl")
+    wfiles = [os.path.join(tmp, f"w{i}.jsonl") for i in range(2)]
+    telemetry.configure(enabled=True, jsonl_path=router_jsonl)
+    workers = spawn_local_workers(
+        2, prefix="ft",
+        env_per_worker=[{"PINT_TPU_TELEMETRY": "1",
+                         "PINT_TPU_TELEMETRY_PATH": wfiles[i]}
+                        for i in range(2)])
+    hosts = [TcpHost(h, ("127.0.0.1", port)) for h, port, _ in workers]
+    procs = {h: p for h, _port, p in workers}
+    addrs = ",".join(f"127.0.0.1:{port}" for _h, port, _p in workers)
+    try:
+        router = FleetRouter(hosts)
+        t0 = time.perf_counter()
+        h0 = router.submit(FitRequest(pop, fit_model(),
+                                      session_id="r04", **hyper))
+        assert router.drain()[0].status == "ok"
+        pinned = h0.host
+        h1 = router.submit(FitRequest(app, None, session_id="r04",
+                                      **hyper))
+        procs[pinned].send_signal(_signal.SIGKILL)
+        procs[pinned].wait(timeout=30)
+        # the live plane, captured DURING the run: append pending,
+        # one worker freshly dead — over the real sockets
+        top_run = _sp.run(
+            [_sys.executable, "-m", "pint_tpu.telemetry.top",
+             "--connect", addrs, "--once", "--deadline-s", "60"],
+            capture_output=True, text=True, timeout=180)
+        top_snap = (json.loads(top_run.stdout)
+                    if top_run.returncode == 0 else None)
+        res = router.drain()
+        traced_wall = time.perf_counter() - t0
+        telemetry.flush()
+        tid = h1.result().trace_ctx.trace_id
+        tree = _trace.assemble(
+            _trace.load([router_jsonl, *wfiles])).get(tid)
+        names = _trace.hop_names(tree) if tree else []
+        need = ("submit", "accept", "failover", "replay", "dispatch",
+                "commit")
+        def find(node, name):
+            if node["rec"]["name"] == name:
+                return node
+            for c in node["children"]:
+                got = find(c, name)
+                if got is not None:
+                    return got
+            return None
+
+        accept_pid = None
+        if tree and tree["roots"]:
+            got = find(tree["roots"][0], "accept")
+            if got is not None:
+                accept_pid = got["rec"].get("pid")
+        chain_ok = bool(
+            tree is not None and len(tree["roots"]) == 1
+            and not tree["orphans"]
+            and all(n in names for n in need)
+            and res[0].status == "ok" and res[0].host != pinned
+            and len(tree["pids"]) >= 3
+            and set(tree["hosts"]) >= {pinned, res[0].host})
+        fleet_snap = router.fleet_metrics()
+        rec["trace_run"] = {
+            "ok": chain_ok,
+            "wall_s": round(traced_wall, 3),
+            "trace_id": tid,
+            "hop_chain": names,
+            "roots": len(tree["roots"]) if tree else 0,
+            "orphan_hops": len(tree["orphans"]) if tree else None,
+            "pids": len(tree["pids"]) if tree else 0,
+            "hosts": sorted(tree["hosts"]) if tree else [],
+            "killed_host": pinned,
+            "failover_host": res[0].host,
+            "accept_hop_from_killed_pid":
+                accept_pid == procs[pinned].pid,
+            "rendered_tree": (_trace.render(tree)[:40] if tree else []),
+        }
+        rec["top_once"] = {
+            "ok": top_snap is not None and _top.well_formed(top_snap),
+            "captured_mid_run": True,
+            "hosts_live": (top_snap or {}).get("hosts_live"),
+            "errors": sorted(((top_snap or {}).get("errors")
+                              or {}).keys()),
+            "snapshot": top_snap,
+        }
+        rec["router_fleet_metrics_well_formed"] = (
+            _top.well_formed(fleet_snap))
+        rec["router_failovers_total"] = (
+            (fleet_snap.get("router") or {}).get("failovers"))
+    finally:
+        for h in hosts:
+            try:
+                h.shutdown()
+            except Exception:  # noqa: BLE001 — one is SIGKILLed
+                pass
+        for _hid, _port, p in workers:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)
+
+    # ---- phase 2: the telemetry-off A/B ------------------------------
+    def routed_round2_wall(side: str) -> dict:
+        """Round-2 (all-warm) wall of a 6-request routed stream on a
+        fresh 2-worker fleet with telemetry per ``side``."""
+        if side == "on":
+            wenv = [{"PINT_TPU_TELEMETRY": "1",
+                     "PINT_TPU_TELEMETRY_PATH":
+                         os.path.join(tmp, f"ab_on_w{i}.jsonl")}
+                    for i in range(2)]
+            telemetry.configure(
+                enabled=True,
+                jsonl_path=os.path.join(tmp, "ab_on_router.jsonl"))
+        else:
+            wenv = [{"PINT_TPU_TELEMETRY": "0"} for _ in range(2)]
+            os.environ["PINT_TPU_TELEMETRY"] = "0"
+            telemetry.configure(enabled=True)  # kill switch must win
+        ws = spawn_local_workers(2, prefix=f"ab{side[0]}",
+                                 env_per_worker=wenv)
+        hs = [TcpHost(h, ("127.0.0.1", port)) for h, port, _ in ws]
+
+        def build():
+            reqs = []
+            for i in range(6):
+                par_i = par_t.replace("61.485476554",
+                                      f"{61.485476554 + 1e-3 * i:.9f}")
+                t_i = make_fake_toas_uniform(
+                    53000, 56000, 40, get_model(par_i), obs="@",
+                    freq_mhz=1400.0, error_us=2.0, add_noise=True,
+                    seed=730 + i)
+                m = get_model(par_i)
+                m["F0"].add_delta(2e-10)
+                reqs.append(FitRequest(t_i, m, tag=i, **hyper))
+            return reqs
+
+        try:
+            r = FleetRouter(hs)
+            for q in build():
+                r.submit(q)
+            warm = r.drain()
+            before = telemetry.counters_snapshot()
+            t0 = time.perf_counter()
+            for q in build():
+                r.submit(q)
+            res = r.drain()
+            wall = time.perf_counter() - t0
+            moved = telemetry.counters_delta(before)
+            return {"wall_round2_s": round(wall, 4),
+                    "all_ok": all(x.status == "ok"
+                                  for x in list(warm) + list(res)),
+                    "router_counters_moved": len(moved)}
+        finally:
+            for h in hs:
+                try:
+                    h.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            for _hid, _port, p in ws:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)
+
+    prev_env = config.env_raw("PINT_TPU_TELEMETRY")
+    on = routed_round2_wall("on")
+    try:
+        off = routed_round2_wall("off")
+    finally:
+        if prev_env is None:
+            os.environ.pop("PINT_TPU_TELEMETRY", None)
+        else:
+            os.environ["PINT_TPU_TELEMETRY"] = prev_env
+        telemetry.configure(
+            enabled=True, jsonl_path=os.path.join(tmp, "tail.jsonl"))
+    overhead_pct = 100.0 * (on["wall_round2_s"]
+                            / max(off["wall_round2_s"], 1e-9) - 1.0)
+    rec["ab"] = {"on": on, "off": off,
+                 "overhead_pct": round(overhead_pct, 2),
+                 # routed CPU fits are seconds-scale; the pin is "no
+                 # systematic tax", bounded loosely above run noise
+                 "overhead_ok": overhead_pct <= 25.0}
+    rec["ok"] = bool(rec["trace_run"]["ok"] and rec["top_once"]["ok"]
+                     and rec["router_fleet_metrics_well_formed"]
+                     and on["all_ok"] and off["all_ok"]
+                     and rec["ab"]["overhead_ok"])
+    return rec
+
+
+def bench_fleet_trace() -> None:
+    """Standalone tracing A/B (``PINT_TPU_BENCH_MODE=fleet_trace``;
+    ISSUE 19). ``value`` is the telemetry-off round-2 routed wall;
+    ``vs_baseline`` 1.0 on a fully-passing run. Detail to
+    PINT_TPU_FLEET_DETAIL (default ``FLEET_r04.json``)."""
+    from pint_tpu import telemetry
+
+    metric = "fleet_trace_off_round2_wall"
+    try:
+        with telemetry.span("bench.fleet_trace"):
+            rec = _bench_fleet_trace()
+        out = {"metric": metric,
+               "value": rec["ab"]["off"]["wall_round2_s"],
+               "unit": "s", "vs_baseline": 1.0 if rec["ok"] else 0.0,
+               "backend": jax.default_backend(),
+               "host_cores": os.cpu_count(), "mode": "fleet_trace",
+               "fleet_trace": rec}
+        out.update(_telemetry_fields())
+        detail_path = (config.env_str("PINT_TPU_FLEET_DETAIL")
+                       or os.path.join(
+                           os.path.dirname(os.path.abspath(__file__)),
+                           "FLEET_r04.json"))
+        try:
+            with open(detail_path, "w") as fh:
+                json.dump(out, fh, indent=1)
+                fh.write("\n")
+        except OSError as e:
+            out["detail_error"] = str(e)
+        compact = {k: out[k] for k in ("metric", "value", "unit",
+                                       "vs_baseline", "backend",
+                                       "host_cores", "mode")}
+        compact["fleet_trace"] = {
+            "ok": rec["ok"],
+            "trace_run_ok": rec["trace_run"]["ok"],
+            "hop_chain": rec["trace_run"]["hop_chain"][:10],
+            "pids": rec["trace_run"]["pids"],
+            "top_once_ok": rec["top_once"]["ok"],
+            "overhead_pct": rec["ab"]["overhead_pct"],
+        }
+        compact["detail"] = os.path.basename(detail_path)
+        _emit(compact)
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": metric, "value": -1.0, "unit": "s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"})
+
+
 def _since_process_start() -> float:
     """Wall seconds since THIS process was exec'd.
 
@@ -2972,11 +3273,21 @@ def main() -> None:
 
     # one telemetry artifact per bench run: every child inherits the
     # path and appends (records carry pid); the parent owns — and
-    # truncates — the default file so repeat runs don't accumulate
-    if not config.env_str("PINT_TPU_TELEMETRY_PATH"):
-        os.environ["PINT_TPU_TELEMETRY_PATH"] = "bench_telemetry.jsonl"
-        try:
-            os.unlink("bench_telemetry.jsonl")
+    # truncates — the file so repeat runs don't accumulate. Precedence:
+    # --telemetry-out > PINT_TPU_TELEMETRY_PATH > the telemetry/
+    # convention default (ISSUE 19 hygiene)
+    if "--telemetry-out" in sys.argv:
+        i = sys.argv.index("--telemetry-out")
+        if i + 1 >= len(sys.argv):
+            print("bench: --telemetry-out needs a path", file=sys.stderr)
+            sys.exit(2)
+        os.environ["PINT_TPU_TELEMETRY_PATH"] = sys.argv[i + 1]
+    path = os.environ.setdefault("PINT_TPU_TELEMETRY_PATH",
+                                 TELEMETRY_OUT_DEFAULT)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    for stale in (path, "bench_telemetry.jsonl", "bench_telemetry.jsonl.1"):
+        try:  # the pre-convention root-level artifacts must stop accreting
+            os.unlink(stale)
         except OSError:
             pass
 
@@ -3093,6 +3404,14 @@ def main() -> None:
         # mid-fit with zero fit-loop launches
         catalog = res.get("catalog") or {}
         ok = ok and catalog.get("ok") is True
+        # trace smoke acceptance (ISSUE 19): the kill/failover stream
+        # assembled as ONE rooted tree with the full hop chain, the
+        # live plane answered --once over a real socket, and the
+        # telemetry-off submit path moved zero counters ("skipped"
+        # only when the child runs under the telemetry kill switch)
+        tracegate = res.get("trace") or {}
+        ok = ok and (tracegate.get("ok") is True
+                     or bool(tracegate.get("skipped")))
         # cold-restart acceptance (ISSUE 16): warm restart against the
         # populated store served its first fit with zero misses
         ok = ok and (res.get("coldstart") or {}).get("ok") is True
@@ -3240,8 +3559,9 @@ def main() -> None:
                 flags + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
         mode_env.setdefault("JAX_PLATFORMS", "cpu")
-    if config.env_raw("PINT_TPU_BENCH_MODE") in ("fleet", "coldjoin"):
-        # the fleet A/Bs (ISSUE 12 / ISSUE 16) spawn real CPU worker
+    if config.env_raw("PINT_TPU_BENCH_MODE") in ("fleet", "coldjoin",
+                                                 "fleet_trace"):
+        # the fleet A/Bs (ISSUE 12 / 16 / 19) spawn real CPU worker
         # processes; the router child itself is pinned to CPU too (the
         # SCALE_r06 convention — correctness/transport artifacts)
         mode_env.setdefault("JAX_PLATFORMS", "cpu")
@@ -3916,6 +4236,156 @@ def _smoke_catalog() -> dict:
                 cat_delta.get("catalog.iterations", 0))}
 
 
+def _smoke_trace() -> dict:
+    """CI trace + live-plane gate (ISSUE 19). Three pins every pass:
+
+    (1) a sessionful append whose pinned loopback host dies mid-stream
+    reconstructs FROM THIS RUN'S OWN ARTIFACT as exactly one rooted
+    span tree — zero orphan hops, the full causal chain (submit ->
+    accept -> failover -> replay -> dispatch -> commit) present;
+    (2) the ``telemetry.top --connect ... --once`` CLI entry answers
+    over a REAL worker socket with a well-formed versioned snapshot
+    (worker served on a thread; the cross-interpreter subprocess
+    capture is the FLEET_r04 artifact);
+    (3) the disabled path stays free: under PINT_TPU_TELEMETRY=0 a
+    stream of fit submits increments zero counters and its p50 wall
+    sits within noise of the enabled submit (every added trace site is
+    one boolean check when off)."""
+    import contextlib
+    import io
+    import threading
+
+    from pint_tpu import telemetry
+    from pint_tpu.fleet import TcpHost, build_fleet
+    from pint_tpu.fleet.transport import serve_worker
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import FitRequest, ThroughputScheduler
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.telemetry import top as _top
+    from pint_tpu.telemetry import trace as _trace
+
+    if not telemetry.enabled():
+        return {"ok": True, "skipped": "telemetry disabled"}
+    par = ("PSRJ FAKE_TRACE\nF0 61.485476554 1\nF1 -1.181e-15 1\n"
+           "PEPOCH 53750\nRAJ 17:48:52.75\nDECJ -20:21:29.0\n"
+           "POSEPOCH 53750\nDM 223.9\nEPHEM DE421\nUNITS TDB\n"
+           "TZRMJD 53801.0\nTZRFRQ 1400.0\nTZRSITE @\n")
+    hyper = dict(maxiter=8, min_chi2_decrease=1e-5)
+    truth = get_model(par)
+    pop = make_fake_toas_uniform(53000, 56000, 40, truth, obs="@",
+                                 freq_mhz=1400.0, error_us=2.0,
+                                 add_noise=True, seed=190)
+    app = make_fake_toas_uniform(56010, 56030, 4, truth, obs="@",
+                                 freq_mhz=1400.0, error_us=2.0,
+                                 add_noise=True, seed=191)
+
+    # -- pin 1: the failover chain assembles into one rooted tree ------
+    router = build_fleet(2, max_queue=16, host_ids=["t0", "t1"])
+    m = get_model(par)
+    m["F0"].add_delta(2e-10)
+    h0 = router.submit(FitRequest(pop, m, session_id="tr", **hyper))
+    router.drain()
+    router.submit(FitRequest(app, None, session_id="tr", **hyper))
+    router.hosts[h0.host].kill()  # dies holding the queued append
+    res = router.drain()
+    telemetry.flush()
+    tid = (res[0].trace_ctx.trace_id
+           if res and res[0].trace_ctx is not None else None)
+    art = telemetry.jsonl_path()
+    tree = (_trace.assemble(_trace.load([art])).get(tid)
+            if art and tid else None)
+    names = _trace.hop_names(tree) if tree else []
+    need = ("submit", "accept", "failover", "replay", "dispatch",
+            "commit")
+    chain_ok = (tree is not None and len(tree["roots"]) == 1
+                and not tree["orphans"]
+                and all(n in names for n in need)
+                and res[0].status == "ok")
+    fleet_snap = router.fleet_metrics()
+
+    # -- pin 2: the one-shot live plane over a real socket -------------
+    # the worker runs IN-PROCESS on a thread — same listening socket,
+    # same metrics op, same CLI entry (top.main), without a second
+    # interpreter paying the jax import; the true cross-interpreter
+    # subprocess capture is the committed FLEET_r04 artifact
+    # (PINT_TPU_BENCH_MODE=fleet_trace)
+    class _ReadyPipe:
+        def __init__(self):
+            self.chunks: list = []
+            self.ev = threading.Event()
+
+        def write(self, s: str) -> None:
+            self.chunks.append(s)
+
+        def flush(self) -> None:
+            self.ev.set()
+
+    rp = _ReadyPipe()
+    s2 = ThroughputScheduler(max_queue=8)
+    th = threading.Thread(target=serve_worker, args=(s2, 0),
+                          kwargs={"ready_fh": rp}, daemon=True,
+                          name="smoke-trace-worker")
+    th.start()
+    snap = None
+    if rp.ev.wait(timeout=60):
+        wport = json.loads("".join(rp.chunks))["port"]
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = _top.main(["--connect", f"127.0.0.1:{wport}",
+                            "--once"])
+        if rc == 0:
+            snap = json.loads(buf.getvalue())
+        TcpHost("t-live", ("127.0.0.1", wport)).shutdown()
+        th.join(timeout=30)
+    top_ok = snap is not None and _top.well_formed(snap)
+
+    # -- pin 3: the disabled submit path costs nothing -----------------
+    def submit_p50() -> float:
+        s = ThroughputScheduler(max_queue=32)
+        walls = []
+        for i in range(9):
+            mm = get_model(par)
+            mm["F0"].add_delta(2e-10)
+            req = FitRequest(pop, mm, tag=i, **hyper)
+            t0 = time.perf_counter()
+            s.submit(req)
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls[1:]))  # drop the warmup submit
+
+    p50_on = submit_p50()
+    prev = config.env_raw("PINT_TPU_TELEMETRY")
+    os.environ["PINT_TPU_TELEMETRY"] = "0"  # the hard kill switch
+    telemetry.configure(enabled=True)       # ... which must win
+    try:
+        before = telemetry.counters_snapshot()
+        p50_off = submit_p50()
+        off_delta = telemetry.counters_delta(before)
+    finally:
+        if prev is None:
+            os.environ.pop("PINT_TPU_TELEMETRY", None)
+        else:
+            os.environ["PINT_TPU_TELEMETRY"] = prev
+        telemetry.configure(enabled=True)
+    # off must emit nothing and cost ~the same intake wall (the
+    # fingerprint hash dominates both sides; 2x is a loose noise bound)
+    off_ok = (not off_delta
+              and p50_off <= max(2.0 * p50_on, p50_on + 2e-3))
+
+    ok = chain_ok and top_ok and off_ok and _top.well_formed(fleet_snap)
+    return {"ok": ok, "chain_ok": chain_ok,
+            "hop_chain": names[:16], "trace_id": tid,
+            "orphan_hops": len(tree["orphans"]) if tree else None,
+            "hosts": tree["hosts"] if tree else None,
+            "fleet_metrics_well_formed": _top.well_formed(fleet_snap),
+            "top_once_well_formed": top_ok,
+            "submit_p50_on_s": round(p50_on, 6),
+            "submit_p50_off_s": round(p50_off, 6),
+            "submit_off_overhead_pct": round(
+                100.0 * (p50_off / p50_on - 1.0), 2),
+            "off_counter_delta_empty": not off_delta,
+            "disabled_path_ok": off_ok}
+
+
 def _run_smoke() -> None:
     """CI smoke: one tiny CPU fit proving the telemetry pipeline end-to-end.
 
@@ -3971,6 +4441,10 @@ def _run_smoke() -> None:
         # in slices with progress records, reads unblocked mid-fit
         with telemetry.span("bench.catalog_smoke"):
             catalog = _smoke_catalog()
+        # trace smoke (ISSUE 19): failover assembles as one rooted
+        # tree, top --once answers over a socket, off path stays free
+        with telemetry.span("bench.trace_smoke"):
+            tracegate = _smoke_trace()
         out = {"metric": "smoke_fit_wall",
                "value": round(time.perf_counter() - t_start, 3),
                "unit": "s", "vs_baseline": 0.0, "smoke": True,
@@ -3979,7 +4453,8 @@ def _run_smoke() -> None:
                "converged": bool(f.converged),
                "serve": serve, "chaos": chaos, "mesh": mesh,
                "frontier": frontier, "incremental": incremental,
-               "read": read, "fleet": fleet, "catalog": catalog}
+               "read": read, "fleet": fleet, "catalog": catalog,
+               "trace": tracegate}
         out.update(_telemetry_fields())
         _emit(out)
     except Exception as e:  # noqa: BLE001
@@ -4012,7 +4487,7 @@ def _main_guarded() -> None:
     if mode in ("pta", "wideband", "batch", "throughput",
                 "throughput_mesh", "throughput_mixed",
                 "throughput_incremental", "read_mixed", "fleet",
-                "coldjoin"):
+                "coldjoin", "fleet_trace"):
         try:
             _init_backend()
         except Exception as e:  # noqa: BLE001
@@ -4042,6 +4517,8 @@ def _main_guarded() -> None:
             bench_fleet()
         elif mode == "coldjoin":
             bench_fleet_coldjoin()
+        elif mode == "fleet_trace":
+            bench_fleet_trace()
         else:
             bench_batch(n_psr, max(1, n // n_psr), reps)
         return

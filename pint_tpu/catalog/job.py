@@ -85,6 +85,10 @@ class CatalogFitRequest:
     hypergrid: Any = None
     tag: Any = None
     deadline_s: float | None = None
+    #: distributed-trace context (ISSUE 19): stamped by the router /
+    #: scheduler at submit, carried through checkpoints in wire form
+    #: so a resumed job keeps annotating the SAME trace
+    trace_ctx: Any = None
 
     def __post_init__(self):
         if (self.spec is None) == (self.catalog is None):
@@ -144,6 +148,8 @@ class CatalogJob:
         self.state = "pending"
         self.error: str | None = None
         self.tag = request.tag
+        self.trace_ctx = getattr(request, "trace_ctx", None)
+        self._slo_observed = False
         # damped-loop state (the checkpointable core)
         self.deltas: dict | None = None
         self.chi2 = float("nan")
@@ -193,6 +199,13 @@ class CatalogJob:
         self._fit_start_iter = ckpt.get("fit_start_iter", 0)
         if ckpt.get("state") in ("done", "failed"):
             self.state = ckpt["state"]
+        # the checkpoint carries the trace in wire form: the resumed
+        # job re-heads the SAME trace with a replay hop, so a kill ->
+        # adopt chain stays one connected tree across hosts
+        ctx = telemetry.trace.unwire(ckpt.get("trace"))
+        self.trace_ctx = telemetry.trace.hop(
+            ctx, "replay", host=self.host_id or None,
+            kind="catalog_resume") or ctx
         telemetry.inc("catalog.resumes")
 
     def _ensure(self) -> None:
@@ -362,12 +375,25 @@ class CatalogJob:
             self.state = "failed"
             self.error = f"{type(e).__name__}: {e}"
             telemetry.inc("catalog.failed")
-            telemetry.add_record({
+            telemetry.add_record(telemetry.trace.stamp({
                 "type": "fault", "status": "catalog_failed",
-                "job": self.job_id, "error": self.error})
+                "job": self.job_id, "error": self.error},
+                self.trace_ctx))
         finally:
             self.wall_s += time.perf_counter() - t0
-        return self.state in ("done", "failed")
+        done = self.state in ("done", "failed")
+        if done and not self._slo_observed:
+            # terminal state reached exactly once per job (resumes
+            # restore _slo_observed=False only on non-terminal
+            # checkpoints): the longjob SLO observes total wall
+            self._slo_observed = True
+            telemetry.slo.observe("longjob", self.wall_s,
+                                  missed=self.state == "failed")
+            telemetry.trace.hop(self.trace_ctx, "commit",
+                                host=self.host_id or None,
+                                status=self.state,
+                                wall_s=round(self.wall_s, 3))
+        return done
 
     def _finish_fit(self) -> None:
         """One damped fit finished: commit (single-fit mode) or record
@@ -437,7 +463,7 @@ class CatalogJob:
                    "grid_points": len(self.grid_points)}
                   if self.grid_points is not None else {}),
                **fields}
-        telemetry.add_record(rec)
+        telemetry.add_record(telemetry.trace.stamp(rec, self.trace_ctx))
 
     def _save_checkpoint(self) -> None:
         self._last_checkpoint = self.checkpoint()
@@ -475,6 +501,7 @@ class CatalogJob:
             "grid_idx": self.grid_idx,
             "grid_best": self._grid_best,
             "fit_start_iter": self._fit_start_iter,
+            "trace": telemetry.trace.wire(self.trace_ctx),
         }
 
     @classmethod

@@ -243,6 +243,25 @@ declare("PINT_TPU_TELEMETRY_LOG", False, "bool",
         "Mirror span begin/end to the pint_tpu.telemetry logger.")
 declare("PINT_TPU_TELEMETRY_MAX_MB", 16.0, "float",
         "Telemetry artifact rotation threshold [MB].")
+declare("PINT_TPU_TRACE_SAMPLE", 1.0, "float",
+        "Distributed-trace root sampling rate in [0,1]; thinned "
+        "deterministically (error accumulator, no RNG). An unsampled "
+        "request is traceless for its whole life.")
+declare("PINT_TPU_FLEET_METRICS_DEADLINE_S", 5.0, "float",
+        "Wire deadline [s] for the fleet 'metrics' snapshot op (the "
+        "live plane must answer fast even when the host is busy).")
+declare("PINT_TPU_SLO_READ_S", 0.05, "float",
+        "Latency objective [s] for read-class (predict) requests; "
+        "served latency above it burns the read SLO counter.")
+declare("PINT_TPU_SLO_FIT_S", 30.0, "float",
+        "Latency objective [s] for sessionless fit requests "
+        "(submit-to-envelope wall).")
+declare("PINT_TPU_SLO_SESSION_S", 30.0, "float",
+        "Latency objective [s] for sessionful fit requests "
+        "(resolve/pin + fit wall).")
+declare("PINT_TPU_SLO_LONGJOB_S", 3600.0, "float",
+        "Latency objective [s] for catalog long jobs, submit to "
+        "terminal state.")
 declare("PINT_TPU_PROFILE_DIR", None, "str",
         "XLA-profiler output directory; unset = profiling off.")
 declare("PINT_TPU_FLIGHT_RECORDER", True, "bool",

@@ -253,6 +253,10 @@ class FleetRouter:
         self._seq = 0
         self._route_counts: dict[str, int] = {}
         self._failovers = 0
+        # lifetime totals for the live plane (the per-drain counters
+        # above zero out in _emit_record; fleet_metrics must not)
+        self._failovers_total = 0
+        self._fenced_rejects_total = 0
         self._warm_hits = 0   # requests landing on an already-warm host
         self._warm_total = 0  # ... out of all warm-trackable fits
         # durable sessions (ISSUE 13): the append journal, per-session
@@ -611,17 +615,19 @@ class FleetRouter:
             elif tok is not None:
                 telemetry.inc("fleet.transport.stale_replies")
 
-    def _fence_reject(self, hid: str, token, info: tuple) -> None:
+    def _fence_reject(self, hid: str, token, info: tuple,
+                      ctx=None) -> None:
         """Reject one stale-epoch commit/reply (never applied to the
         caller's model, the journal, or replication)."""
         skey, epoch = info
         self._fenced_rejects += 1
+        self._fenced_rejects_total += 1
         telemetry.inc("fleet.session.fenced_rejects")
-        telemetry.add_record({
+        telemetry.add_record(telemetry.trace.stamp({
             "type": "fleet_fence", "host": hid, "token": token,
             "session": repr(skey[0]) if skey else None,
             "stale_epoch": epoch,
-            "epoch": self._epoch.get(skey, 0) if skey else None})
+            "epoch": self._epoch.get(skey, 0) if skey else None}, ctx))
 
     def _fence_arm(self, hid: str, p: _Pending) -> None:
         """The router is about to re-run ``p`` elsewhere while ``hid``
@@ -769,7 +775,8 @@ class FleetRouter:
     # ------------------------------------------------------------------
     # durable-session restore (ISSUE 13)
     # ------------------------------------------------------------------
-    def _restore_session(self, skey: tuple, target_hid: str) -> str:
+    def _restore_session(self, skey: tuple, target_hid: str,
+                         ctx=None) -> str:
         """Rebuild a re-pinned session's committed state on
         ``target_hid`` before any retry dispatches.
 
@@ -837,14 +844,19 @@ class FleetRouter:
             # the retry still runs (PR-12 behavior) and the journal
             # keeps the history for the next attempt
             telemetry.inc("fleet.session.restore_failed")
-            telemetry.add_record({
+            telemetry.add_record(telemetry.trace.stamp({
                 "type": "fault", "status": "session_restore_failed",
                 "host": target_hid, "session": repr(skey[0]),
-                "error": f"{type(e).__name__}: {e}"})
+                "error": f"{type(e).__name__}: {e}"},
+                ctx if ctx is not None else telemetry.trace.current()))
             return "failed"
         self._sticky[skey] = target_hid
         self._restores[kind] = self._restores.get(kind, 0) + 1
         telemetry.inc(f"fleet.session.restore.{kind}")
+        telemetry.trace.hop(
+            ctx if ctx is not None else telemetry.trace.current(),
+            "replay", host=target_hid, kind=kind,
+            epoch=self._epoch.get(skey, 0))
         return kind
 
     # ------------------------------------------------------------------
@@ -858,6 +870,21 @@ class FleetRouter:
         when the whole fleet is full. A host dying at submit fails
         over transparently."""
         read = isinstance(request, PredictRequest)
+        # the trace is born HERE (ISSUE 19): the root context rides the
+        # request object through every transport op; the root hop
+        # itself is emitted in _track once the accepting host is known.
+        # The use() scope makes submit-time restore work (replay hops,
+        # spans) parent under this request's root.
+        if request.trace_ctx is None:
+            request.trace_ctx = telemetry.trace.root()
+        # hold the ROOT here: a loopback scheduler advances the shared
+        # request object's ctx to its accept hop, and the root hop must
+        # still be emitted with the original ids
+        rctx = request.trace_ctx
+        with telemetry.trace.use(rctx):
+            return self._submit_routed(request, read, rctx)
+
+    def _submit_routed(self, request, read: bool, rctx=None):
         fp8 = None
         if self.degenerate:
             hid = self._order[0]
@@ -917,12 +944,18 @@ class FleetRouter:
                 self._health[h]["queue_depth"] = e.depth
                 last_exc = e
                 continue
-            return self._track(h, tok, request, token, read, fp8)
+            return self._track(h, tok, request, token, read, fp8,
+                               rctx=rctx)
         assert last_exc is not None
         raise last_exc
 
-    def _track(self, hid, tok, request, token, read, fp8=None):
+    def _track(self, hid, tok, request, token, read, fp8=None,
+               rctx=None):
         self._seq += 1
+        telemetry.trace.emit_root(
+            rctx, "submit", host=hid, route=token,
+            lane="read" if read else "fit",
+            **({"fp8": fp8} if fp8 else {}))
         skey = None
         if read:
             handle = FleetPredictHandle(hid)
@@ -985,9 +1018,13 @@ class FleetRouter:
         memory holds the session's segment cache."""
         if self.degenerate:
             hid = self._order[0]
+            token = "degenerate"
         else:
             hid, token = self._route_read(request)
             telemetry.inc(f"fleet.read.route.{token}")
+        if request.trace_ctx is None:
+            request.trace_ctx = telemetry.trace.begin(
+                "submit", host=hid, route=token, lane="read")
         telemetry.inc("fleet.read.requests")
         try:
             wire = self.hosts[hid].predict(request)
@@ -1007,6 +1044,9 @@ class FleetRouter:
                           "cannot be served elsewhere", host=hid)
             telemetry.inc("fleet.read.route.failover")
             hid = self._route_read(request)[0]
+            request.trace_ctx = telemetry.trace.hop(
+                request.trace_ctx, "failover",
+                host=hid) or request.trace_ctx
             wire = self.hosts[hid].predict(request)
         return self._unwire_read(wire, request)
 
@@ -1020,7 +1060,8 @@ class FleetRouter:
             freq_hz=wire["freq_hz"], source=wire["source"],
             cache_hit=wire["cache_hit"], n_queries=wire["n_queries"],
             latency_s=wire["latency_s"], error=wire["error"],
-            host=wire.get("host"))
+            host=wire.get("host"),
+            trace_ctx=telemetry.trace.unwire(wire.get("trace_ctx")))
 
     def _unwire_fit(self, wire: dict, pend: _Pending) -> FitResult:
         if "result" in wire:           # loopback: the real object
@@ -1042,7 +1083,8 @@ class FleetRouter:
             error=wire["error"], attempts=wire["attempts"],
             trace=wire["trace"], retry_after_s=wire["retry_after_s"],
             injected=wire["injected"], session=wire["session"],
-            host=wire.get("host"))
+            host=wire.get("host"),
+            trace_ctx=telemetry.trace.unwire(wire.get("trace_ctx")))
 
     # ------------------------------------------------------------------
     # drain
@@ -1126,7 +1168,9 @@ class FleetRouter:
                 # request (partition failover mid-drain): the stale
                 # pin's commit must not become the record — reject it
                 # and re-run on the current pin
-                self._fence_reject(hid, p.token, (p.skey, p.epoch))
+                self._fence_reject(hid, p.token, (p.skey, p.epoch),
+                                   ctx=getattr(p.request,
+                                               "trace_ctx", None))
                 leftovers.append(p)
                 continue
             res = (self._unwire_read(w, p.request) if reads
@@ -1160,6 +1204,14 @@ class FleetRouter:
         else:
             return
         self._committed.add(p.skey)
+        # the durable-commit hop closes the trace's causal chain: its
+        # parent is the worker's dispatch hop (carried home on the
+        # result envelope), so the merged tree reads submit -> accept
+        # -> dispatch -> commit even across a failover re-pin
+        ctx = (res.trace_ctx if res.trace_ctx is not None
+               else getattr(req, "trace_ctx", None))
+        telemetry.trace.hop(ctx, "commit", host=res.host, route=route,
+                            epoch=p.epoch)
 
     def _replicate_committed(self) -> None:
         """Ship each just-committed session's summary to its ring
@@ -1215,7 +1267,15 @@ class FleetRouter:
         restored onto the new pin BEFORE the retry dispatches, so the
         re-run appends to the dead host's committed solution."""
         self._failovers += 1
+        self._failovers_total += 1
         telemetry.inc("fleet.failover.requests")
+        # the failover hop re-heads the request's trace chain: the
+        # restore replay, the survivor's accept, and the eventual
+        # commit all parent under it, so the merged tree shows the
+        # request crossing processes instead of fracturing into two
+        p.request.trace_ctx = telemetry.trace.hop(
+            p.request.trace_ctx, "failover", host=hid,
+            lane="read" if p.read else "fit") or p.request.trace_ctx
         # a sessionful request pinned to the dead host must re-pin —
         # with its state restored and the old pin fenced
         sid = getattr(p.request, "session_id", None)
@@ -1228,7 +1288,8 @@ class FleetRouter:
                 if self._sticky.get(skey) is None:
                     new = self._ring_successor(skey, hid)
                     if new is not None:
-                        self._restore_session(skey, new)
+                        self._restore_session(
+                            skey, new, ctx=p.request.trace_ctx)
         try:
             if p.read:
                 res = self.predict(p.request)
@@ -1303,6 +1364,9 @@ class FleetRouter:
         checkpoint is pulled back after every slice, so
         :meth:`_failover_catalog` can resume it on a survivor."""
         hid = self._catalog_target()
+        if getattr(request, "trace_ctx", None) is None:
+            request.trace_ctx = telemetry.trace.begin(
+                "submit", host=hid, lane="longjob")
         job_id = self.hosts[hid].submit_catalog(request)
         # the handle key is the FIRST host's job id, stable for the
         # job's life; "remote_id" tracks the current host-local id (a
@@ -1388,6 +1452,7 @@ class FleetRouter:
             e["resumes"] += 1
             self._catalog_resumes += 1
             self._failovers += 1
+            self._failovers_total += 1
         except (HostSuspect, HostDown, OSError):
             # the fallback died too: the next drain's sweep retries
             # against whatever is still alive
@@ -1548,6 +1613,10 @@ class FleetRouter:
             },
             "degenerate": self.degenerate,
             "wall_s": round(wall, 6),
+            "trace_ids": sorted({
+                r.trace_ctx.trace_id for r in results
+                if getattr(r, "trace_ctx", None) is not None
+                and r.trace_ctx.trace_id})[:64],
         }
         if self._catalog:
             cat_resumes, self._catalog_resumes = self._catalog_resumes, 0
@@ -1562,6 +1631,51 @@ class FleetRouter:
                     for hid in self._order},
             }
         telemetry.add_record(dict(self.last_drain))
+
+    def fleet_metrics(self, deadline_s: float | None = None) -> dict:
+        """The live introspection plane's fleet view: one ``metrics``
+        snapshot per host (a host that misses the snapshot deadline
+        becomes an ``error`` entry — the plane reports sickness, it
+        never hangs on it), folded by :func:`telemetry.top.aggregate`
+        and extended with the router's own state: routing/failover
+        health and the trace ids the ROUTER still holds pending (a
+        request a dead host took with it appears here even when no
+        live worker still knows about it)."""
+        from pint_tpu.telemetry import top as _top
+
+        if deadline_s is None:
+            deadline_s = config.env_float(
+                "PINT_TPU_FLEET_METRICS_DEADLINE_S")
+        per_host: dict[str, dict] = {}
+        for hid in self._order:
+            try:
+                per_host[hid] = self.hosts[hid].metrics(
+                    deadline_s=deadline_s)
+            except Exception as e:  # noqa: BLE001 — a dead host is data
+                per_host[hid] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        agg = _top.aggregate(per_host)
+        inflight = {
+            p.request.trace_ctx.trace_id
+            for pend in self._pending.values() for p in pend
+            if getattr(p.request, "trace_ctx", None) is not None
+            and p.request.trace_ctx.trace_id}
+        inflight.update(agg["inflight_traces"])
+        agg["inflight_traces"] = sorted(inflight)[:256]
+        agg["router"] = {
+            "hosts": {hid: {"alive": h["alive"],
+                            "fail_streak": h["fail_streak"],
+                            "misses": h["misses"],
+                            "degraded": self._degraded(hid)}
+                      for hid, h in self._health.items()},
+            "pending": sum(len(v) for v in self._pending.values()),
+            "sessions_pinned": len(self._sticky),
+            "catalog_jobs": sum(1 for e in self._catalog.values()
+                                if not e["done"]),
+            "failovers": self._failovers_total,
+            "fenced_rejects": self._fenced_rejects_total,
+        }
+        return agg
 
     def close(self) -> None:
         for h in self.hosts.values():

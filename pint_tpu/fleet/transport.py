@@ -52,6 +52,7 @@ import json
 import os
 import pickle
 import socket
+import threading
 import time
 
 from pint_tpu import telemetry
@@ -114,6 +115,7 @@ def wire_fit_result(token, res) -> dict:
             "batch": res.batch, "n_members": res.n_members,
             "occupancy": res.occupancy, "host": res.host,
             "injected": res.injected, "trace": res.trace,
+            "trace_ctx": telemetry.trace.wire(res.trace_ctx),
             "params": params}
 
 
@@ -123,7 +125,8 @@ def wire_read_result(res) -> dict:
             "phase_frac": res.phase_frac, "freq_hz": res.freq_hz,
             "source": res.source, "cache_hit": res.cache_hit,
             "n_queries": res.n_queries, "latency_s": res.latency_s,
-            "error": res.error, "host": res.host}
+            "error": res.error, "host": res.host,
+            "trace_ctx": telemetry.trace.wire(res.trace_ctx)}
 
 
 # ----------------------------------------------------------------------
@@ -247,6 +250,11 @@ class LoopbackHost:
     def report(self) -> dict:
         self._check("report")
         return self.scheduler.report()
+
+    def metrics(self, deadline_s=None) -> dict:
+        """The live-plane snapshot op (ISSUE 19)."""
+        self._check("metrics", deadline_s)
+        return self.scheduler.metrics_snapshot()
 
     # -- program supply chain (ISSUE 16) -------------------------------
     def pull_programs(self, fp8s, deadline_s=None) -> dict:
@@ -469,6 +477,10 @@ class TcpHost:
     def report(self) -> dict:
         return self._rpc("report")["report"]
 
+    def metrics(self, deadline_s=None) -> dict:
+        return _unb64(self._rpc("metrics",
+                                deadline_s=deadline_s)["payload"])
+
     # -- program supply chain (ISSUE 16) -------------------------------
     def pull_programs(self, fp8s, deadline_s=None) -> dict:
         return _unb64(self._rpc("pull_programs", payload=list(fp8s),
@@ -548,9 +560,15 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
                  ready_fh=None, extra_report=None) -> int:
     """Serve one scheduler over the JSONL protocol until ``shutdown``.
 
-    Single-threaded by design — the serve layer is thread-free, and the
-    fleet has exactly one router per worker. Sequential reconnects are
-    accepted (a router that restarts resumes against the same host
+    Op execution is SERIALIZED (one lock around every handler — the
+    serve layer itself stays thread-free), but connections are
+    concurrent (ISSUE 19): the router holds a persistent connection,
+    and the live introspection plane (``python -m
+    pint_tpu.telemetry.top``) must still be able to attach to a busy
+    worker and run its ``metrics`` op between the router's ops — a
+    single-connection accept loop would park it in the listen backlog
+    for as long as the router stays connected. Sequential reconnects
+    are accepted (a router that restarts resumes against the same host
     state). ``ready_fh`` (when given) receives one ``{"ready": ...}``
     JSON line after the socket is listening — the spawn handshake the
     bench/worker entry points wait on. ``extra_report`` is merged into
@@ -562,7 +580,7 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind((host, port))
-    srv.listen(1)
+    srv.listen(8)  # router + live-plane probes may connect together
     bound_port = srv.getsockname()[1]
     if ready_fh is not None:
         ready_fh.write(json.dumps(
@@ -607,6 +625,13 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             else:
                 pending.append((token, h))
             telemetry.inc("fleet.worker.requests")
+            # the accept hop must be DURABLE before the ack (ISSUE 19):
+            # the router may SIGKILL this process the instant it holds
+            # the token, and the cross-process trace merge still needs
+            # the dead worker's accept on disk — the generic post-op
+            # flush below runs after the reply and loses that race
+            if telemetry.enabled():
+                telemetry.flush()
             reply({"ok": True, "token": token})
         elif op == "drain":
             ack = msg.get("ack")
@@ -710,6 +735,11 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             if extra_report:
                 rep.update(extra_report)
             reply({"ok": True, "report": rep})
+        elif op == "metrics":
+            # the live plane (ISSUE 19): cheap, never touches device
+            # work — answerable even mid-backlog
+            reply({"ok": True,
+                   "payload": _b64(scheduler.metrics_snapshot())})
         elif op == "shutdown":
             reply({"ok": True})
             state["running"] = False
@@ -717,11 +747,14 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             reply({"ok": False, "error_type": "ValueError",
                    "error": f"unknown op {op!r}"})
 
-    while state["running"]:
-        try:
-            conn, _addr = srv.accept()
-        except OSError:
-            break
+    # ONE lock serializes every op across connections: the handlers
+    # mutate shared serve state (scheduler queues, pending/unacked,
+    # the token/seq counters), and the pre-ISSUE-19 contract was
+    # strictly sequential execution — concurrency lives only at the
+    # socket layer
+    op_lock = threading.Lock()
+
+    def serve_conn(conn) -> None:
         fh = conn.makefile("rwb")
 
         def reply(obj: dict) -> None:
@@ -741,24 +774,63 @@ def serve_worker(scheduler, port: int, *, host: str = "127.0.0.1",
             # reconnect instead of killing the worker — warm programs
             # and session state must survive a router crash
             try:
-                try:
-                    handle(json.loads(line), reply)
-                except ServeQueueFull as e:
-                    reply({"ok": False, "error_type": "ServeQueueFull",
-                           "attrs": {"depth": e.depth,
-                                     "max_queue": e.max_queue,
-                                     "retry_after_s": e.retry_after_s,
-                                     "degraded": e.degraded}})
-                except Exception as e:  # noqa: BLE001 — isolation
-                    # boundary: a bad request must never kill the worker
-                    reply({"ok": False, "error_type": type(e).__name__,
-                           "error": str(e)})
+                with op_lock:
+                    if not state["running"]:
+                        break
+                    try:
+                        handle(json.loads(line), reply)
+                    except ServeQueueFull as e:
+                        reply({"ok": False,
+                               "error_type": "ServeQueueFull",
+                               "attrs": {"depth": e.depth,
+                                         "max_queue": e.max_queue,
+                                         "retry_after_s": e.retry_after_s,
+                                         "degraded": e.degraded}})
+                    except Exception as e:  # noqa: BLE001 — isolation
+                        # boundary: a bad request must never kill the
+                        # worker
+                        reply({"ok": False,
+                               "error_type": type(e).__name__,
+                               "error": str(e)})
+                    # flush buffered telemetry after EVERY op (ISSUE
+                    # 19): a SIGKILLed worker's accept/dispatch hops
+                    # must already be on disk for the cross-process
+                    # trace merge — the worker RPC path is not hot, so
+                    # per-op flush is cheap relative to one socket
+                    # round-trip
+                    if telemetry.enabled():
+                        telemetry.flush()
             except OSError:
                 break  # pipe died mid-reply: await a reconnect
+        if not state["running"]:
+            # this connection carried the shutdown op (or observed
+            # it): wake the accept loop — close() alone does NOT
+            # unblock a thread parked in accept() on Linux, the
+            # listener must be shut down first
+            try:
+                srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                srv.close()
+            except OSError:
+                pass
         try:
             fh.close()
             conn.close()
         except OSError:
             pass
-    srv.close()
+
+    while state["running"]:
+        try:
+            conn, _addr = srv.accept()
+        except OSError:
+            break
+        t = threading.Thread(target=serve_conn, args=(conn,),
+                             daemon=True, name="fleet-worker-conn")
+        t.start()
+    try:
+        srv.close()
+    except OSError:
+        pass
     return state["served"]

@@ -103,6 +103,7 @@ engine", "failure domains" and "mesh".
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any
 
@@ -193,6 +194,7 @@ class FitRequest:
     tag: Any = None
     deadline_s: float | None = None
     session_id: Any = None
+    trace_ctx: Any = None         # distributed-trace chain head (or None)
 
 
 @dataclasses.dataclass
@@ -226,6 +228,7 @@ class FitResult:
     injected: str | None = None
     session: str | None = None  # session route token (ISSUE 10)
     host: str | None = None     # serving host id (ISSUE 12 fleet tier)
+    trace_ctx: Any = None       # dispatch-hop context (router commit parent)
 
     @property
     def fitted(self) -> bool:
@@ -282,6 +285,7 @@ class PredictRequest:
     freq_mhz: float = 1400.0      # observing frequency of the queries
     tag: Any = None
     deadline_s: float | None = None
+    trace_ctx: Any = None         # distributed-trace chain head (or None)
 
 
 #: read-result status taxonomy (a strict subset of :data:`STATUSES`)
@@ -311,6 +315,7 @@ class PredictResult:
     latency_s: float = 0.0
     error: str | None = None
     host: str | None = None     # serving host id (ISSUE 12 fleet tier)
+    trace_ctx: Any = None       # read-hop context (router commit parent)
 
 
 class PredictHandle:
@@ -745,6 +750,38 @@ class ThroughputScheduler:
             "programs": _program_store_stats(),
         }
 
+    def metrics_snapshot(self) -> dict:
+        """The live-plane snapshot (ISSUE 19): one versioned dict with
+        everything ``telemetry.top`` renders — :meth:`report`'s health
+        surface plus the full counter/gauge registries, the SLO ledger,
+        and the trace ids currently in flight on this host. Served by
+        the fleet ``metrics`` op; must stay cheap and side-effect-free
+        (no drain, no device work) so the plane answers while busy."""
+        from pint_tpu import telemetry as _t
+        from pint_tpu.telemetry.top import METRICS_SNAPSHOT_VERSION
+
+        inflight = sorted(
+            {req.trace_ctx.trace_id
+             for req, *_rest in self._queue
+             if req.trace_ctx is not None and req.trace_ctx.trace_id}
+            | {req.trace_ctx.trace_id
+               for req, _h, _t_sub in self._read_queue
+               if req.trace_ctx is not None
+               and req.trace_ctx.trace_id})[:64]
+        return {
+            "version": METRICS_SNAPSHOT_VERSION,
+            "t": time.time(),
+            "pid": os.getpid(),
+            "enabled": _t.enabled(),
+            **self.report(),
+            "counters": _t.counters_snapshot(),
+            "gauges": _t.gauges_snapshot(),
+            "session_cache": self.sessions.stats(),
+            "read_cache": self.reads.cache.stats(),
+            "slo": _t.slo.snapshot(),
+            "inflight_traces": inflight,
+        }
+
     # ------------------------------------------------------------------
     # intake
     # ------------------------------------------------------------------
@@ -789,6 +826,18 @@ class ThroughputScheduler:
                 request = dataclasses.replace(request, toas=toas,
                                               model=model)
                 telemetry.inc(f"serve.fault.injected.{injected}")
+        if request.trace_ctx is None:
+            # single-host use: the trace is born HERE (fleet requests
+            # arrive with the router's root already attached)
+            request.trace_ctx = telemetry.trace.begin(
+                "submit", host=self.host_id or None, lane="fit")
+        else:
+            # fleet intake: the accept hop pins THIS process into the
+            # request's trace at admission — flushed per worker op, it
+            # survives even a SIGKILL before the fit dispatches
+            request.trace_ctx = telemetry.trace.hop(
+                request.trace_ctx, "accept",
+                host=self.host_id or None) or request.trace_ctx
         if request.session_id is not None:
             # sessionful request (ISSUE 10): resolve the cache key once
             # on the enqueue path; admission backpressure for NEW
@@ -863,6 +912,13 @@ class ThroughputScheduler:
             raise ServeQueueFull(
                 depth=len(self._read_queue), max_queue=cap,
                 retry_after_s=0.05)
+        if request.trace_ctx is None:
+            request.trace_ctx = telemetry.trace.begin(
+                "submit", host=self.host_id or None, lane="read")
+        else:
+            request.trace_ctx = telemetry.trace.hop(
+                request.trace_ctx, "accept",
+                host=self.host_id or None) or request.trace_ctx
         handle = PredictHandle()
         self._read_queue.append((request, handle, time.perf_counter()))
         telemetry.inc("serve.requests")
@@ -897,13 +953,18 @@ class ThroughputScheduler:
         from pint_tpu.serve import fingerprint as _fpm
 
         telemetry.inc("serve.read.requests")
+        if request.trace_ctx is None:
+            # the synchronous fast lane never passed through submit
+            request.trace_ctx = telemetry.trace.begin(
+                "submit", host=self.host_id or None, lane="read")
         t0 = time.perf_counter()
         try:
             n = int(np.atleast_1d(np.asarray(request.mjds)).size)
         except Exception:  # noqa: BLE001 — ragged input: predict()
             n = 0          # below raises the structured error
         status, error, out = "ok", None, None
-        with telemetry.span("serve.read"):
+        with telemetry.trace.use(request.trace_ctx), \
+                telemetry.span("serve.read"):
             try:
                 if request.session_id is not None:
                     skey, entry = self.sessions.lookup_for_read(
@@ -951,19 +1012,26 @@ class ThroughputScheduler:
             cache_hit=bool(out is not None and out.cache_hit),
             n_queries=n, latency_s=round(latency, 9), error=error,
             host=self.host_id or None)
+        res.trace_ctx = telemetry.trace.hop(
+            request.trace_ctx, "read", host=self.host_id or None,
+            status=status, latency_s=round(latency, 6))
+        telemetry.slo.observe("read", latency, missed=status != "ok")
         self._read_stats.append({
             "latency_s": latency, "service_s": service_s,
             "queries": n, "status": status,
             "hit": res.cache_hit,
+            "trace_id": (None if request.trace_ctx is None
+                         else request.trace_ctx.trace_id),
             "source": res.source or "error",
             "misses": 0 if out is None else out.window_misses,
             "fallback_queries": (0 if out is None
                                  else out.fallback_queries)})
         if status == "failed":
-            telemetry.add_record({
+            telemetry.add_record(telemetry.trace.stamp({
                 "type": "fault", "status": "read_failed",
                 "tag": repr(request.tag), "error": error,
-                "queue_latency_s": round(latency, 6)})
+                "queue_latency_s": round(latency, 6)},
+                request.trace_ctx))
         return res
 
     def _emit_read_record(self) -> None:
@@ -1007,6 +1075,8 @@ class ThroughputScheduler:
             "predictions_per_s": (round(queries / busy, 1)
                                   if busy > 0 else None),
             "latencies_s": [round(v, 9) for v in lats[:64]],
+            "trace_ids": sorted({r["trace_id"] for r in window
+                                 if r.get("trace_id")})[:64],
             "cache": self.reads.cache.stats(),
         }
         telemetry.set_gauge("serve.read.p50_s", self.last_read["p50_s"])
@@ -1236,6 +1306,11 @@ class ThroughputScheduler:
             error = (f"deadline_s={req.deadline_s:g} exceeded "
                      f"(latency {t_done - t_sub:.3f}s); the completed "
                      "fit is attached")
+        # the dispatch hop: this host served the request — the result
+        # carries the hop back so the router's commit parents under it
+        hop_ctx = telemetry.trace.hop(
+            req.trace_ctx, "dispatch", host=self.host_id or None,
+            status=status, queue_latency_s=round(t_done - t_sub, 6))
         res = FitResult(
             tag=req.tag, request=req, chi2=float(chi2),
             converged=bool(converged),
@@ -1247,9 +1322,12 @@ class ThroughputScheduler:
             passthrough=passthrough, status=status, error=error,
             attempts=attempts, trace=trace, retry_after_s=retry_after_s,
             injected=meta.get("injected"), session=session,
-            host=self.host_id or None)
+            host=self.host_id or None, trace_ctx=hop_ctx)
         handle._result = res
         telemetry.inc(f"serve.status.{status}")
+        telemetry.slo.observe(
+            "session" if session is not None else "fit", t_done - t_sub,
+            missed=status not in ("ok", "nonconverged"))
         if status not in ("ok", "nonconverged"):
             rec = {"type": "fault", "status": status,
                    "tag": repr(req.tag), "group": res.group,
@@ -1258,7 +1336,8 @@ class ThroughputScheduler:
                    "queue_latency_s": res.queue_latency_s}
             if trace is not None:
                 rec["trace"] = trace
-            telemetry.add_record(rec)
+            telemetry.add_record(
+                telemetry.trace.stamp(rec, hop_ctx or req.trace_ctx))
         return res
 
     def _salvage(self, live, plan, failure: _FailedBatch):
@@ -1915,6 +1994,11 @@ class ThroughputScheduler:
             },
             **({"sessions": sessions_block} if sessions_block else {}),
             **({"catalog": catalog_block} if catalog_block else {}),
+            # distributed-trace cross-reference (capped): which request
+            # traces this drain served — report --trace joins on these
+            "trace_ids": sorted({
+                r.trace_ctx.trace_id for r in results
+                if r.trace_ctx is not None})[:64],
             "batch_detail": [
                 {"kind": p.kind, "group": p.group,
                  "toa_bucket": p.toa_bucket, "real": len(p.indices),

@@ -25,7 +25,15 @@ names and the compile-vs-execute measurement contract):
 * ``python -m pint_tpu.telemetry.report`` — the run-health report CLI
   over one or more JSON-lines artifacts (span tree, iteration
   timelines, cache hit rates, pollution windows, bench-regression
-  verdict).
+  verdict);
+* :mod:`pint_tpu.telemetry.trace` — distributed request tracing: the
+  trace_id/span_id/parent_id context born at submit, the hop emitter,
+  and the cross-process assembler behind ``report --trace <id>``;
+* :mod:`pint_tpu.telemetry.slo` — the SLO ledger (per-class latency
+  objectives from knobs, burn counters fed by the deadline machinery);
+* ``python -m pint_tpu.telemetry.top`` — the live fleet introspection
+  CLI over the ``metrics`` worker op (one-shot ``--once`` JSON, or a
+  refreshing table).
 
 Disabled (the default unless ``PINT_TPU_TELEMETRY=1`` or an entry point
 calls :func:`configure`), every hook is a boolean check and return —
@@ -52,11 +60,12 @@ from pint_tpu.telemetry.export import (add_record, flush, rollup, span_stats,
 from pint_tpu.telemetry.host import polluted as host_polluted
 from pint_tpu.telemetry.host import sample as host_sample
 from pint_tpu.telemetry.spans import jit_span, profile_span, span, traced
+from pint_tpu.telemetry import slo, trace
 
 __all__ = [
     "add_record", "configure", "counter_value", "counters_delta",
     "counters_snapshot", "enabled", "flush", "gauges_snapshot",
     "host_polluted", "host_sample", "inc", "jit_span", "jsonl_path",
-    "max_gauge", "profile_span", "reset", "rollup", "set_gauge", "span",
-    "span_stats", "traced", "write_rollup",
+    "max_gauge", "profile_span", "reset", "rollup", "set_gauge", "slo",
+    "span", "span_stats", "trace", "traced", "write_rollup",
 ]

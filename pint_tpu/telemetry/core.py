@@ -112,7 +112,7 @@ def reset() -> None:
     ``counters_delta`` snapshots instead, which don't disturb config.
     """
     global _enabled, _jsonl_path, _load1_threshold, _mirror_logs
-    from pint_tpu.telemetry import counters, export, recorder, spans
+    from pint_tpu.telemetry import counters, export, recorder, spans, trace
 
     with _config_lock:
         _enabled = config.env_raw("PINT_TPU_TELEMETRY") == "1"
@@ -123,6 +123,7 @@ def reset() -> None:
     spans._reset()
     export._reset()
     recorder._reset()
+    trace._reset()
 
 
 # plain library use: PINT_TPU_TELEMETRY=1 turns everything on without

@@ -35,8 +35,11 @@ from pint_tpu.telemetry import core, host
 # (one per serve-layer failure event; quarantines carry the member's
 # flight-recorder trace). Old consumers remain compatible: each bump
 # only ADDS line types, and readers that dispatch on "type" (the
-# documented contract) skip unknown ones.
-SCHEMA_VERSION = 3
+# documented contract) skip unknown ones. v4 (ISSUE 19): adds "hop"
+# records (distributed-trace causal steps, trace_id/span_id/parent_id)
+# and optional trace_id/trace_parent annotation fields on existing
+# line types.
+SCHEMA_VERSION = 4
 
 _MAX_BUFFER = 50_000
 _FLUSH_EVERY = 500

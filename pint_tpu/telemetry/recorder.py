@@ -124,7 +124,9 @@ def emit_trace(kind: str, entries: dict, *, loop: str,
     if not core._enabled:
         return rec
     counters.inc("trace.emitted")
-    export.add_record(dict(rec))
+    from pint_tpu.telemetry import trace as _trace
+
+    export.add_record(_trace.stamp(dict(rec), _trace.current()))
     if loop == "device":
         t = time.time()
         pid = os.getpid()
@@ -273,4 +275,6 @@ def capture_program(kind: str, compiled, *, shape=None) -> None:
     counters.inc("program.captures")
     for field, v in vals.items():
         counters.set_gauge(f"program.{kind}.{field}", v)
-    export.add_record(rec)
+    from pint_tpu.telemetry import trace as _trace
+
+    export.add_record(_trace.stamp(rec, _trace.current()))

@@ -30,6 +30,14 @@ written by bench.py / tools/soak.py / plain library use):
 * **failure domains** — ``type="fault"`` records (one per serve-layer
   failure event: status, retries, quarantine traces) plus the
   ``serve.fault.* / serve.retry.* / serve.quarantine.*`` counters;
+* **distributed traces** — ``type="hop"`` records (ISSUE 19) assembled
+  into per-request span trees via :mod:`pint_tpu.telemetry.trace`:
+  trace counts, orphan totals, the slowest end-to-end chains —
+  ``--trace ID`` renders one tree in full (merge per-host JSONL files
+  by passing them all);
+* **SLO ledger** — per-request-class latency objectives
+  (``slo.<class>.{total,burn}`` counters from the closing rollup):
+  totals, burns, burn rates against the configured targets;
 * **cache hit rates** — ``cache.<name>.{hit,miss,evict}`` counters from
   the closing rollup;
 * **host-pollution windows** — spans of wall time whose ``host``
@@ -54,6 +62,17 @@ import argparse
 import json
 import sys
 import time
+
+#: every JSONL record type this report understands. The
+#: ``record-schema-drift`` lint rule (tools/analyze) pins every
+#: ``type="..."`` emitter in pint_tpu/ to this tuple: a new record
+#: type must land together with its report section (or an explicit
+#: allowlist entry), so the flight recorder never silently grows
+#: records nothing can read. Keep it a PURE literal — the lint rule
+#: reads it from the AST.
+HANDLED_TYPES = ("span", "rollup", "trace", "program", "serve", "read",
+                 "fault", "host", "fleet", "fleet_fence", "longjob",
+                 "hop")
 
 
 def load_jsonl(path: str) -> tuple[list[dict], int]:
@@ -547,6 +566,56 @@ def fault_summaries(records: list[dict]) -> dict:
             "counters": serve_counters}
 
 
+def traces_summary(records: list[dict]) -> dict:
+    """Distributed-trace rollup (ISSUE 19): assemble the ``type="hop"``
+    records (plus their annotations) into span trees and summarize —
+    trace/hop/orphan counts and the slowest end-to-end chains. Records
+    predating tracing contribute nothing — old artifacts degrade
+    gracefully."""
+    from pint_tpu.telemetry import trace as _trace
+
+    trees = _trace.assemble(records)
+    slowest = sorted(trees.values(), key=lambda t: -t["wall_s"])[:8]
+    return {
+        "traces": len(trees),
+        "hops": sum(t["hops"] for t in trees.values()),
+        "annotations": sum(t["notes"] for t in trees.values()),
+        "orphan_hops": sum(len(t["orphans"]) for t in trees.values()),
+        "multi_host": sum(1 for t in trees.values()
+                          if len(t["hosts"]) > 1),
+        "slowest": [{"trace_id": t["trace_id"],
+                     "wall_s": t["wall_s"],
+                     "hops": _trace.hop_names(t),
+                     "hosts": t["hosts"]} for t in slowest],
+    }
+
+
+def slo_summary(records: list[dict]) -> dict:
+    """Per-class SLO ledger from the closing rollup's
+    ``slo.<class>.{total,burn}`` counters (ISSUE 19), with the targets
+    as configured in THIS process's environment (the artifact records
+    observations; targets are knobs)."""
+    from pint_tpu.telemetry import slo as _slo
+
+    counters: dict = {}
+    for r in records:
+        if r.get("type") == "rollup":
+            counters = r.get("counters") or counters
+    out: dict[str, dict] = {}
+    for key, v in counters.items():
+        parts = key.split(".")
+        if (len(parts) != 3 or parts[0] != "slo"
+                or parts[2] not in ("total", "burn")):
+            continue
+        led = out.setdefault(parts[1], {
+            "target_s": _slo.target_s(parts[1]), "total": 0, "burn": 0})
+        led[parts[2]] = int(v)
+    for led in out.values():
+        led["burn_rate"] = (round(led["burn"] / led["total"], 6)
+                            if led["total"] else 0.0)
+    return out
+
+
 def cache_rates(records: list[dict]) -> dict[str, dict]:
     """Hit rates per named cache, from the LAST rollup's counters."""
     counters: dict = {}
@@ -926,6 +995,31 @@ def render(summary: dict) -> str:
     else:
         lines.append("  (no fault records — clean run)")
 
+    tr = summary.get("dist_traces") or {}
+    if tr.get("traces"):
+        lines.append("\n== distributed traces ==")
+        lines.append(
+            f"  {tr['traces']} trace(s): {tr['hops']} hop(s), "
+            f"{tr['annotations']} annotation(s), "
+            f"{tr['orphan_hops']} orphan hop(s), "
+            f"{tr['multi_host']} spanning multiple hosts")
+        for t in tr["slowest"]:
+            lines.append(
+                f"    {t['trace_id']}  {t['wall_s']:.3f}s  "
+                f"{' -> '.join(t['hops'])}  "
+                f"[{'+'.join(t['hosts']) or '-'}]")
+        lines.append("  (render one in full: report --trace <id> "
+                     "<the same jsonl files>)")
+
+    sl = summary.get("slo") or {}
+    if sl:
+        lines.append("\n== SLO ledger ==")
+        for cls, led in sorted(sl.items()):
+            lines.append(
+                f"  {cls:<10} target {led['target_s']}s: "
+                f"{led['burn']}/{led['total']} burned "
+                f"(rate {led['burn_rate']:.4f})")
+
     lines.append("\n== cache hit rates ==")
     if summary["caches"]:
         for name, st in sorted(summary["caches"].items()):
@@ -978,6 +1072,8 @@ def build_summary(paths: list[str], bench_path: str | None,
         "fleet": fleet_summary(records),
         "mesh": mesh_summary(records),
         "faults": fault_summaries(records),
+        "dist_traces": traces_summary(records),
+        "slo": slo_summary(records),
         "caches": cache_rates(records),
         "pollution": pollution_windows(records),
     }
@@ -1011,6 +1107,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable summary instead of "
                          "the text report")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="render ONE assembled distributed trace from "
+                         "the given artifacts (pass every per-host "
+                         "file to merge a fleet run) and exit")
     args = ap.parse_args(argv)
 
     if not args.jsonl and not args.bench:
@@ -1018,6 +1118,22 @@ def main(argv: list[str] | None = None) -> int:
         print("report: need at least one JSONL artifact or --bench",
               file=sys.stderr)
         return 2
+    if args.trace:
+        from pint_tpu.telemetry import trace as _trace
+
+        try:
+            trees = _trace.assemble(_trace.load(args.jsonl))
+        except OSError as e:
+            print(f"report: unreadable input: {e}", file=sys.stderr)
+            return 2
+        tree = trees.get(args.trace)
+        if tree is None:
+            print(f"report: no trace {args.trace!r} in "
+                  f"{len(trees)} assembled trace(s): "
+                  f"{sorted(trees)[:16]}", file=sys.stderr)
+            return 2
+        print("\n".join(_trace.render(tree, notes=True)))
+        return 0
     try:
         summary = build_summary(args.jsonl, args.bench, args.history,
                                 args.max_regress_pct)

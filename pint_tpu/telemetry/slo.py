@@ -1,0 +1,67 @@
+"""SLO ledger: per-class latency objectives and burn counters.
+
+Four request classes — ``read`` (sync/async predict), ``fit``
+(sessionless fit envelope), ``session`` (sessionful fit envelope),
+``longjob`` (catalog fit, submit to terminal state) — each with a
+latency objective declared as a knob (``PINT_TPU_SLO_<CLASS>_S``).
+The serving paths call :func:`observe` exactly where they already
+measure latency for their records (the deadline machinery), so the
+ledger costs one counter pair per request and nothing when telemetry
+is off.
+
+``slo.<cls>.total`` counts observed requests; ``slo.<cls>.burn``
+counts the ones that missed the objective (latency above target, or
+an explicit miss like a deadline shed). ``snapshot()`` folds both
+into per-class burn rates for the metrics snapshot and the report.
+"""
+
+from __future__ import annotations
+
+from pint_tpu import config
+from pint_tpu.telemetry import core, counters
+
+#: request classes with a declared latency objective
+#: (``PINT_TPU_SLO_<CLASS>_S``).
+CLASSES = ("read", "fit", "session", "longjob")
+
+
+def target_s(cls: str) -> float:
+    """The declared latency objective [s] for a request class."""
+    # literal knob names so the env-knob-registry check can verify them
+    if cls == "read":
+        return config.env_float("PINT_TPU_SLO_READ_S")
+    if cls == "fit":
+        return config.env_float("PINT_TPU_SLO_FIT_S")
+    if cls == "session":
+        return config.env_float("PINT_TPU_SLO_SESSION_S")
+    if cls == "longjob":
+        return config.env_float("PINT_TPU_SLO_LONGJOB_S")
+    raise KeyError(cls)
+
+
+def observe(cls: str, latency_s: float, *, missed: bool = False) -> None:
+    """Ledger one served request of class ``cls``: always counts
+    toward ``slo.<cls>.total``; burns when the latency exceeded the
+    class objective or the caller already knows it missed (deadline
+    shed, failed request). No-op when telemetry is off."""
+    if not core._enabled:
+        return
+    counters.inc(f"slo.{cls}.total")
+    if missed or latency_s > target_s(cls):
+        counters.inc(f"slo.{cls}.burn")
+
+
+def snapshot() -> dict:
+    """Per-class ledger state: target, totals, burns, burn rate."""
+    snap = counters.counters_snapshot()
+    out = {}
+    for cls in CLASSES:
+        total = snap.get(f"slo.{cls}.total", 0)
+        burn = snap.get(f"slo.{cls}.burn", 0)
+        out[cls] = {
+            "target_s": target_s(cls),
+            "total": int(total),
+            "burn": int(burn),
+            "burn_rate": round(burn / total, 6) if total else 0.0,
+        }
+    return out

@@ -26,7 +26,7 @@ import os
 import threading
 import time
 
-from pint_tpu.telemetry import core, export
+from pint_tpu.telemetry import core, export, trace
 
 
 class _NullSpan:
@@ -59,7 +59,7 @@ class Span:
     """One open measurement region; use via ``with span(name): ...``."""
 
     __slots__ = ("name", "kind", "tags", "seq", "depth", "parent",
-                 "t_wall", "_t0", "dur_s")
+                 "t_wall", "_t0", "dur_s", "_trace")
 
     def __init__(self, name: str, kind: str | None, tags: dict):
         self.name = name
@@ -74,6 +74,7 @@ class Span:
             stack = _local.stack = []
         self.depth = len(stack)
         self.parent = stack[-1].name if stack else None
+        self._trace = trace.current()
         stack.append(self)
         if core.mirror_logs():
             _mirror("begin %s seq=%d depth=%d", self.name, self.seq,
@@ -94,6 +95,7 @@ class Span:
             rec.update(self.tags)
         if exc_type is not None:
             rec["error"] = exc_type.__name__
+        trace.stamp(rec, self._trace)
         export.add_span(rec)
         if core.mirror_logs():
             _mirror("end   %s seq=%d dur=%.6fs%s", self.name, self.seq,

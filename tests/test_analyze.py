@@ -643,3 +643,66 @@ def test_live_cli_gate_is_green():
         [sys.executable, "-m", "tools.analyze"], cwd=REPO,
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --------------------------------------- record-schema-drift rule
+RSD_REPORT_OK = """\
+    HANDLED_TYPES = ("span", "hop")
+"""
+
+RSD_EMIT_OK = """\
+    def emit(add):
+        add({"type": "span", "x": 1})
+        add({"type": "hop", "x": 2})
+        add({"type": "probe", "x": 3})
+"""
+
+
+def _rsd_tree(tmp_path, report=RSD_REPORT_OK, emit=RSD_EMIT_OK,
+              allow=("probe",)):
+    cfg = _tree(tmp_path, {"report.py": report, "emit.py": emit},
+                report_file="report.py",
+                record_emitter_paths=["emit.py"],
+                record_types_allowlist=list(allow))
+    return _rules_hit(run(cfg), "record-schema-drift")
+
+
+def test_record_schema_drift_clean_fixture(tmp_path):
+    """Handled types plus one allowlisted type: zero findings."""
+    assert _rsd_tree(tmp_path) == []
+
+
+def test_record_schema_drift_flags_unhandled_type(tmp_path):
+    emit = RSD_EMIT_OK + '        add({"type": "mystery", "x": 4})\n'
+    findings = _rsd_tree(tmp_path, emit=emit)
+    assert any("'mystery'" in f.message and f.symbol == "emit"
+               and f.file == "emit.py" for f in findings)
+    # the handled/allowlisted emitters stay quiet
+    assert all("'span'" not in f.message and "'probe'" not in f.message
+               for f in findings)
+
+
+def test_record_schema_drift_flags_stale_allowlist_entry(tmp_path):
+    msgs = [f.message
+            for f in _rsd_tree(tmp_path, allow=("probe", "ghost"))]
+    assert any("'ghost'" in m and "stale" in m for m in msgs)
+
+
+def test_record_schema_drift_requires_literal_tuple(tmp_path):
+    report = 'HANDLED_TYPES = tuple(sorted(["span", "hop"]))\n'
+    msgs = [f.message for f in _rsd_tree(tmp_path, report=report)]
+    assert any("not a literal tuple" in m for m in msgs)
+
+
+def test_record_schema_drift_silent_without_report_file(tmp_path):
+    cfg = _tree(tmp_path, {"emit.py": RSD_EMIT_OK},
+                report_file="report.py",
+                record_emitter_paths=["emit.py"])
+    assert _rules_hit(run(cfg), "record-schema-drift") == []
+
+
+def test_record_schema_drift_live_tree_handles_hop():
+    """The real report's HANDLED_TYPES names the trace hop record —
+    the drift gate reads exactly this tuple, so pin it at runtime."""
+    from pint_tpu.telemetry import report
+    assert "hop" in report.HANDLED_TYPES

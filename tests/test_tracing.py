@@ -1,0 +1,440 @@
+"""Distributed request tracing, the SLO ledger, and the live plane
+(ISSUE 19).
+
+Three layers:
+
+* unit behavior of :mod:`pint_tpu.telemetry.trace` (context creation,
+  the sampling accumulator, the telemetry-off contract, wire form,
+  assembly of merged per-process artifacts), the SLO ledger, and the
+  snapshot aggregation in :mod:`pint_tpu.telemetry.top`;
+* the loopback fleet end-to-end pin: a sessionful request whose pinned
+  host is killed mid-append reconstructs as ONE rooted span tree —
+  submit -> accept -> failover -> replay/accept -> dispatch -> commit
+  — with zero orphan hops, spanning both host ids;
+* the cross-PROCESS pin (slow): two real TCP workers each writing
+  their own JSONL artifact, one SIGKILLed mid-stream; merging the
+  three per-process files (two workers + this router process) still
+  yields exactly one rooted tree with the failover hop parented under
+  the original submit chain.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.fleet import FleetRouter, build_fleet
+from pint_tpu.models import get_model
+from pint_tpu.serve import FitRequest, PredictRequest
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.telemetry import slo, top, trace
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+HYPER = dict(maxiter=8, min_chi2_decrease=1e-5)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
+    monkeypatch.delenv("PINT_TPU_TELEMETRY_PATH", raising=False)
+    monkeypatch.delenv("PINT_TPU_TRACE_SAMPLE", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def truth():
+    return get_model(PAR)
+
+
+@pytest.fixture(scope="module")
+def toas(truth):
+    return make_fake_toas_uniform(53000, 56000, 60, truth, obs="gbt",
+                                  freq_mhz=1400.0, error_us=1.0,
+                                  add_noise=True, seed=601)
+
+
+@pytest.fixture(scope="module")
+def append_toas(truth):
+    return make_fake_toas_uniform(56010, 56030, 4, truth, obs="gbt",
+                                  freq_mhz=1400.0, error_us=1.0,
+                                  add_noise=True, seed=611)
+
+
+def _populate_model():
+    m = get_model(PAR)
+    m["F0"].add_delta(2e-10)
+    return m
+
+
+# ----------------------------------------------------------------------
+# context unit behavior
+# ----------------------------------------------------------------------
+
+def test_telemetry_off_contract():
+    """With the gate off every entry point is inert: None contexts,
+    no records, no ids — the disabled hot path stays one boolean."""
+    assert not telemetry.enabled()
+    assert trace.root() is None
+    assert trace.begin("submit", host="h") is None
+    assert trace.hop(None, "dispatch") is None
+    rec = {"type": "serve"}
+    assert trace.stamp(rec, None) is rec and "trace_id" not in rec
+    assert trace.wire(None) is None
+    with trace.use(None) as ctx:
+        assert ctx is None
+    assert trace.current() is None
+
+
+def test_unsampled_sentinel_propagates(monkeypatch, tmp_path):
+    """A sampled-out request carries the UNSAMPLED sentinel (not
+    None) so downstream tiers never re-roll; every emitter treats it
+    as inert."""
+    monkeypatch.setenv("PINT_TPU_TRACE_SAMPLE", "0")
+    path = str(tmp_path / "t.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    ctx = trace.root()
+    assert ctx is trace.UNSAMPLED and ctx is not None
+    # the propagation pattern: hop() returns None, `or ctx` keeps the
+    # sentinel flowing instead of reopening the sampling decision
+    assert (trace.hop(ctx, "dispatch") or ctx) is trace.UNSAMPLED
+    trace.emit_root(ctx, "submit")
+    rec = trace.stamp({"type": "serve"}, ctx)
+    assert "trace_id" not in rec
+    telemetry.flush()
+    assert not os.path.exists(path) or not [
+        l for l in open(path) if json.loads(l).get("type") == "hop"]
+
+
+def test_sampling_accumulator_is_deterministic(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_TRACE_SAMPLE", "0.5")
+    telemetry.configure(enabled=True)
+    trace._reset()
+    live = [trace.root() is not trace.UNSAMPLED for _ in range(10)]
+    assert sum(live) == 5  # exactly rate * n, no RNG
+
+
+def test_wire_roundtrip():
+    telemetry.configure(enabled=True)
+    ctx = trace.root()
+    pair = json.loads(json.dumps(trace.wire(ctx)))
+    assert trace.unwire(pair) == ctx
+    assert trace.unwire(ctx) is ctx
+    assert trace.unwire(None) is None
+    assert trace.wire(trace.UNSAMPLED) is None
+
+
+def test_hop_chain_assembles_and_renders(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    ctx = trace.begin("submit", host="h0", lane="fit")
+    d = trace.hop(ctx, "dispatch", host="h0")
+    telemetry.add_record(trace.stamp({"type": "serve", "t": time.time()}, d))
+    trace.hop(d, "commit", host="h0", epoch=1)
+    telemetry.flush()
+    trees = trace.assemble(trace.load([path]))
+    assert list(trees) == [ctx.trace_id]
+    tree = trees[ctx.trace_id]
+    assert len(tree["roots"]) == 1 and not tree["orphans"]
+    assert trace.hop_names(tree) == ["submit", "dispatch", "commit"]
+    assert tree["notes"] == 1 and not tree["loose_notes"]
+    text = "\n".join(trace.render(tree, notes=True))
+    assert "commit" in text and "~ serve" in text and "epoch=1" in text
+
+
+def test_assemble_orphans_duplicates_and_loose_notes():
+    recs = [
+        {"type": "hop", "name": "submit", "trace_id": "T",
+         "span_id": "a", "parent_id": None, "t": 1.0, "host": "h0"},
+        {"type": "hop", "name": "dispatch", "trace_id": "T",
+         "span_id": "b", "parent_id": "a", "t": 2.0, "host": "h1"},
+        # duplicate delivery of hop b: the first record wins
+        {"type": "hop", "name": "dup", "trace_id": "T",
+         "span_id": "b", "parent_id": "a", "t": 2.5},
+        # parent never appeared in the merge -> orphan
+        {"type": "hop", "name": "commit", "trace_id": "T",
+         "span_id": "c", "parent_id": "zz", "t": 3.0},
+        {"type": "serve", "trace_id": "T", "trace_parent": "b"},
+        {"type": "span", "trace_id": "T", "trace_parent": "gone"},
+        {"type": "rollup"},  # not trace-bearing: skipped, not a crash
+    ]
+    tree = trace.assemble(recs)["T"]
+    assert len(tree["roots"]) == 1
+    assert [r["name"] for r in tree["orphans"]] == ["commit"]
+    assert trace.hop_names(tree) == ["submit", "dispatch"]
+    assert len(tree["loose_notes"]) == 1
+    assert tree["hosts"] == ["h0", "h1"]
+    rendered = "\n".join(trace.render(tree))
+    assert "! orphan" in rendered
+
+
+# ----------------------------------------------------------------------
+# SLO ledger
+# ----------------------------------------------------------------------
+
+def test_slo_ledger_counts_and_burns(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_SLO_READ_S", "0.5")
+    telemetry.configure(enabled=True)
+    slo.observe("read", 0.1)
+    slo.observe("read", 0.9)                # over target -> burn
+    slo.observe("read", 0.1, missed=True)   # explicit miss -> burn
+    led = slo.snapshot()["read"]
+    assert led["target_s"] == 0.5
+    assert led["total"] == 3 and led["burn"] == 2
+    assert led["burn_rate"] == round(2 / 3, 6)
+    assert set(slo.snapshot()) == set(slo.CLASSES)
+
+
+def test_slo_observe_is_noop_when_off():
+    slo.observe("fit", 1e9, missed=True)
+    telemetry.configure(enabled=True)
+    assert slo.snapshot()["fit"]["total"] == 0
+
+
+# ----------------------------------------------------------------------
+# live-plane aggregation
+# ----------------------------------------------------------------------
+
+def test_top_aggregate_and_well_formed():
+    per_host = {
+        "w0": {"version": top.METRICS_SNAPSHOT_VERSION, "queue_depth": 2,
+               "read_depth": 1, "sessions": 3, "replicas": 1,
+               "counters": {"fit.iterations": 5},
+               "slo": {"read": {"target_s": 0.5, "total": 4, "burn": 1}},
+               "inflight_traces": ["t1", "t2"]},
+        "w1": {"version": top.METRICS_SNAPSHOT_VERSION, "queue_depth": 1,
+               "read_depth": 0, "sessions": 0, "replicas": 2,
+               "counters": {"fit.iterations": 7},
+               "slo": {"read": {"target_s": 0.5, "total": 2, "burn": 1}},
+               "inflight_traces": ["t2", "t3"]},
+        "w2": {"error": "HostDown: kaput"},
+    }
+    agg = top.aggregate(per_host)
+    assert top.well_formed(agg)
+    assert agg["hosts_live"] == 2 and agg["hosts_erroring"] == 1
+    assert agg["queue_depth"] == 3 and agg["sessions"] == 3
+    assert agg["counters"]["fit.iterations"] == 12
+    assert agg["slo"]["read"]["total"] == 6
+    assert agg["slo"]["read"]["burn_rate"] == round(2 / 6, 6)
+    assert agg["inflight_traces"] == ["t1", "t2", "t3"]
+    assert agg["errors"] == {"w2": "HostDown: kaput"}
+    assert not top.well_formed({"version": 999})
+    assert not top.well_formed(None)
+
+
+# ----------------------------------------------------------------------
+# single-host scheduler: trace born at submit, snapshot well-formed
+# ----------------------------------------------------------------------
+
+def test_scheduler_trace_chain_and_snapshot(tmp_path, toas):
+    from pint_tpu.serve import ThroughputScheduler
+
+    path = str(tmp_path / "solo.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    s = ThroughputScheduler(max_queue=8)
+    h = s.submit(FitRequest(toas, _populate_model(), **HYPER))
+    snap_busy = s.metrics_snapshot()  # taken with the fit in flight
+    s.drain()
+    assert h.result().status == "ok"
+    assert top.well_formed(snap_busy)
+    tid = h.result().trace_ctx.trace_id
+    assert tid in snap_busy["inflight_traces"]
+    telemetry.flush()
+    tree = trace.assemble(trace.load([path]))[tid]
+    assert len(tree["roots"]) == 1 and not tree["orphans"]
+    names = trace.hop_names(tree)
+    assert names[0] == "submit" and "dispatch" in names
+    assert slo.snapshot()["fit"]["total"] == 1
+
+
+# ----------------------------------------------------------------------
+# loopback fleet: SIGKILL failover reconstructs as ONE rooted tree
+# ----------------------------------------------------------------------
+
+def test_fleet_failover_reconstructs_one_tree(tmp_path, toas,
+                                              append_toas):
+    path = str(tmp_path / "fleet.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    router = build_fleet(2, max_queue=16)
+    h0 = router.submit(FitRequest(toas, _populate_model(),
+                                  session_id="s1", **HYPER))
+    assert router.drain()[0].status == "ok"
+    pinned = h0.host
+    h1 = router.submit(FitRequest(append_toas, None, session_id="s1",
+                                  **HYPER))
+    router.hosts[pinned].kill()  # dies holding the queued append
+    res = router.drain()
+    assert res[0].status == "ok" and res[0].host != pinned
+    telemetry.flush()
+    tid = h1.result().trace_ctx.trace_id
+    tree = trace.assemble(trace.load([path]))[tid]
+    # the acceptance pin: ONE rooted tree, no orphan hops, and the
+    # whole causal chain present across both hosts
+    assert len(tree["roots"]) == 1
+    assert tree["orphans"] == [] and tree["loose_notes"] == []
+    names = trace.hop_names(tree)
+    for name in ("submit", "accept", "failover", "replay", "dispatch",
+                 "commit"):
+        assert name in names, (name, names)
+    assert set(tree["hosts"]) == {pinned, res[0].host}
+    # fleet_metrics degrades the dead host to an error entry and
+    # reports router-side state
+    agg = router.fleet_metrics()
+    assert top.well_formed(agg)
+    assert agg["hosts_erroring"] == 1 and pinned in agg["errors"]
+    assert agg["router"]["failovers"] >= 1
+
+
+def test_read_trace_and_router_slo(tmp_path, toas):
+    """A routed read gets its own submit -> read chain and feeds the
+    read SLO class."""
+    import numpy as np
+
+    path = str(tmp_path / "read.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    router = build_fleet(2, max_queue=8)
+    router.submit(FitRequest(toas, _populate_model(), session_id="r1",
+                             **HYPER))
+    router.drain()
+    h = router.submit(PredictRequest(
+        session_id="r1", mjds=np.linspace(56000.0, 56010.0, 16),
+        obs="gbt", freq_mhz=1400.0))
+    router.drain()
+    res = h.result()
+    assert res.status == "ok" and res.trace_ctx is not None
+    telemetry.flush()
+    tree = trace.assemble(trace.load([path]))[res.trace_ctx.trace_id]
+    assert len(tree["roots"]) == 1 and not tree["orphans"]
+    names = trace.hop_names(tree)
+    assert names[0] == "submit" and "read" in names
+    assert slo.snapshot()["read"]["total"] >= 1
+
+
+# ----------------------------------------------------------------------
+# cross-process merge (slow: spawns 2 real TCP worker processes)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cross_process_trace_merge(tmp_path, toas, append_toas):
+    """The satellite pin: two real worker processes each write their
+    own JSONL; one is SIGKILLed holding a sessionful append; merging
+    the three per-process artifacts (router + both workers) still
+    assembles the request into exactly one rooted tree with the
+    failover hop parented under the original submit chain."""
+    from pint_tpu.fleet import TcpHost
+    from pint_tpu.fleet.worker import spawn_local_workers
+
+    router_jsonl = str(tmp_path / "router.jsonl")
+    wfiles = [str(tmp_path / f"w{i}.jsonl") for i in range(2)]
+    telemetry.configure(enabled=True, jsonl_path=router_jsonl)
+    workers = spawn_local_workers(
+        2, env_per_worker=[{"PINT_TPU_TELEMETRY": "1",
+                            "PINT_TPU_TELEMETRY_PATH": wfiles[i]}
+                           for i in range(2)])
+    hosts = [TcpHost(h, ("127.0.0.1", port)) for h, port, _ in workers]
+    procs = {h: p for h, _port, p in workers}
+    try:
+        router = FleetRouter(hosts)
+        h0 = router.submit(FitRequest(toas, _populate_model(),
+                                      session_id="x1", **HYPER))
+        assert router.drain()[0].status == "ok"
+        pinned = h0.host
+        h1 = router.submit(FitRequest(append_toas, None,
+                                      session_id="x1", **HYPER))
+        procs[pinned].send_signal(signal.SIGKILL)
+        procs[pinned].wait(timeout=30)
+        res = router.drain()
+        assert res[0].status == "ok" and res[0].host != pinned
+        telemetry.flush()
+        tid = h1.result().trace_ctx.trace_id
+        merged = trace.load([router_jsonl, *wfiles])
+        tree = trace.assemble(merged)[tid]
+        assert len(tree["roots"]) == 1, trace.render(tree)
+        assert tree["orphans"] == [], trace.render(tree)
+        names = trace.hop_names(tree)
+        for name in ("submit", "accept", "failover", "replay",
+                     "dispatch", "commit"):
+            assert name in names, (name, names)
+        # the chain genuinely spans both worker PROCESSES + the router
+        assert len(tree["pids"]) >= 3, tree["pids"]
+        assert set(tree["hosts"]) >= {pinned, res[0].host}
+        # the failover hop is parented INSIDE the original submit
+        # chain, not floating: walk down from the root
+        root = tree["roots"][0]
+        assert root["rec"]["name"] == "submit"
+
+        def find(node, name):
+            if node["rec"]["name"] == name:
+                return node
+            for c in node["children"]:
+                got = find(c, name)
+                if got is not None:
+                    return got
+            return None
+
+        assert find(root, "failover") is not None
+        # the dead worker's accept hop survived its SIGKILL (per-op
+        # flush in serve_worker) and came from the killed pid
+        accept = find(root, "accept")
+        assert accept is not None
+        assert accept["rec"]["pid"] == procs[pinned].pid
+        # the live plane answers over the real wire too
+        live = [h for h in hosts if h.host_id != pinned]
+        agg = top.aggregate({live[0].host_id: live[0].metrics()})
+        assert top.well_formed(agg)
+    finally:
+        for h in hosts:
+            try:
+                h.shutdown()
+            except Exception:  # noqa: BLE001 — one is SIGKILLed
+                pass
+        for _hid, _port, p in workers:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)
+
+
+# ----------------------------------------------------------------------
+# report CLI: --trace renders the tree
+# ----------------------------------------------------------------------
+
+def test_report_trace_flag(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    telemetry.configure(enabled=True, jsonl_path=path)
+    ctx = trace.begin("submit", host="h0")
+    trace.hop(trace.hop(ctx, "dispatch", host="h0"), "commit")
+    telemetry.flush()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.telemetry.report", path,
+         "--trace", ctx.trace_id],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-400:]
+    assert f"trace {ctx.trace_id}" in proc.stdout
+    assert "dispatch" in proc.stdout and "commit" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.telemetry.report", path,
+         "--trace", "doesnotexist"],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert ctx.trace_id in proc.stderr  # the known ids are listed

@@ -32,6 +32,12 @@ invariant this repo has shipped, broken, and re-fixed by hand review:
   every listed knob must still have a live gate — a missing knob means
   a persistent/shipped artifact compiled under one trace regime would
   be adopted under another; a stale one silently widens every key.
+* ``record-schema-drift`` — every ``{"type": "<t>"}`` telemetry record
+  literal emitted in the library names a type the report CLI handles
+  (``telemetry/report.py HANDLED_TYPES``) or one declared in the
+  ``record_types_allowlist`` (ISSUE 19): a record type nothing can
+  read is silent flight-recorder data loss; a stale allowlist entry is
+  flagged from the other side.
 * ``env-knob-registry`` — every ``PINT_TPU_*`` environment read resolves
   through the ``pint_tpu.config`` registry (declared default + doc);
   direct/undeclared/unreadable/undocumented knobs are findings.
@@ -65,6 +71,7 @@ RULES = (
     "donation-safety",
     "fingerprint-drift",
     "program-key-drift",
+    "record-schema-drift",
     "env-knob-registry",
     "bare-disable",
     "unused-disable",
@@ -121,6 +128,10 @@ class Config:
     program_key_file: str = "pint_tpu/programs/key.py"
     traced_gate_files: list = dataclasses.field(default_factory=lambda: [
         "pint_tpu/serve/fingerprint.py", "pint_tpu/fitting/gls_step.py"])
+    report_file: str = "pint_tpu/telemetry/report.py"
+    record_emitter_paths: list = dataclasses.field(
+        default_factory=lambda: ["pint_tpu"])
+    record_types_allowlist: list = dataclasses.field(default_factory=list)
     models_glob: str = "pint_tpu/models/*.py"
     docs_knobs: str = "docs/KNOBS.md"
     docs_arch: str = "docs/ARCHITECTURE.md"
@@ -374,6 +385,7 @@ def run(cfg: Config) -> list:
             raw.extend(rule_fn(mod, cfg))
     raw.extend(_rules.rule_fingerprint_drift(cfg, modules))
     raw.extend(_rules.rule_program_key_drift(cfg, modules))
+    raw.extend(_rules.rule_record_schema_drift(cfg, modules))
     raw.extend(_rules.rule_registry_integrity(cfg, modules))
 
     # suppression pass: a disable on any physical line of the flagged

@@ -537,6 +537,63 @@ def rule_program_key_drift(cfg, modules):
                 "undocumented key input the drift legs cannot check")
 
 
+# ---------------------------------------------- record-schema drift
+def rule_record_schema_drift(cfg, modules):
+    """record-schema-drift (ISSUE 19): every ``{"type": "<t>", ...}``
+    record literal emitted inside the library must name a type the
+    report CLI handles — the literal ``HANDLED_TYPES`` tuple in
+    ``telemetry/report.py`` — or one declared in the
+    ``record_types_allowlist`` (types that are deliberately
+    report-free, e.g. standalone probes). A record type nothing can
+    read is flight-recorder data loss that no test notices; an
+    allowlist entry nothing emits is a stale exemption that would mask
+    the next real drift."""
+    rep_mod = modules.get(cfg.report_file)
+    if rep_mod is None:
+        return  # fixture trees may scope the report out entirely
+    handled, line = _literal_tuple_assign(rep_mod, "HANDLED_TYPES")
+    if handled is None:
+        yield Finding(
+            cfg.report_file, line or 1, "record-schema-drift", "",
+            "HANDLED_TYPES is not a literal tuple of record type "
+            "names — the drift check must be able to read it "
+            "statically")
+        return
+    ok = set(handled) | set(cfg.record_types_allowlist)
+    emitted: dict = {}
+    for rel, mod in sorted(modules.items()):
+        if not match_any(rel, cfg.record_emitter_paths):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "type"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    emitted.setdefault(v.value, []).append(
+                        (rel, node.lineno, mod.symbol_of(node)))
+    for t, sites in sorted(emitted.items()):
+        if t in ok:
+            continue
+        for rel, ln, sym in sites:
+            yield Finding(
+                rel, ln, "record-schema-drift", sym,
+                f"record type '{t}' is emitted but {cfg.report_file} "
+                "HANDLED_TYPES does not name it and no "
+                "record_types_allowlist entry declares it — land the "
+                "report section (or the explicit exemption) with the "
+                "emitter")
+    if emitted:  # fixture trees with no emitters skip the reverse leg
+        for t in sorted(set(cfg.record_types_allowlist)):
+            if t not in emitted:
+                yield Finding(
+                    cfg.report_file, line, "record-schema-drift", "",
+                    f"record_types_allowlist declares '{t}' but "
+                    "nothing in the scanned tree emits it — delete "
+                    "the stale exemption")
+
+
 # ------------------------------------------------- env-knob registry
 _KNOB_TOKEN = re.compile(r"PINT_TPU_[A-Z0-9_]+")
 

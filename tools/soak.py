@@ -1291,6 +1291,14 @@ def main() -> int:
                          "read traffic; half the multi-host trials "
                          "kill the owning host mid-fit and assert "
                          "checkpoint resume, not restart")
+    ap.add_argument("--telemetry-out", nargs="?", default=None,
+                    const="telemetry/soak_telemetry.jsonl",
+                    help="write the telemetry JSON-lines artifact here "
+                         "(bare flag uses the telemetry/ convention "
+                         "default, ISSUE 19 hygiene: run artifacts "
+                         "never accrete loose at the repo root); "
+                         "omitted -> PINT_TPU_TELEMETRY_PATH or "
+                         "counters-only")
     args = ap.parse_args()
 
     import json
@@ -1303,9 +1311,13 @@ def main() -> int:
     # per-trial telemetry (ISSUE 1): counter deltas (damped-loop events,
     # program-cache hit/miss) + a host sample ride each trial record, so
     # a slow or flaky trial is diagnosable from the committed SOAK JSON
+    tele_path = (args.telemetry_out
+                 or config.env_str("PINT_TPU_TELEMETRY_PATH"))
+    if tele_path:
+        os.makedirs(os.path.dirname(tele_path) or ".", exist_ok=True)
     telemetry.configure(
         enabled=config.env_raw("PINT_TPU_TELEMETRY") != "0",
-        jsonl_path=config.env_str("PINT_TPU_TELEMETRY_PATH"))
+        jsonl_path=tele_path)
 
     record = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
               "git_sha": _git_sha(), "jax": jax.__version__,
