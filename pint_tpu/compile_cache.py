@@ -1,0 +1,73 @@
+"""Persistent XLA compile-cache wiring shared by the suite and bench.
+
+History (docs/COMPILE_CACHE.md): round 3 found this jaxlib's XLA:CPU
+AOT reload unsafe cross-host ("machine feature mismatch ... SIGILL"),
+so the cache stayed off for three rounds; the round-7 re-measurement
+ran the full suite cold AND fully-warm green (cold 10:05, warm 6:35 vs
+~14:40 uncached), so the suite default flipped to ON. The per-host tag
+below makes the round-3 failure impossible by construction: a cache
+entry is only ever reloaded on a machine with the same CPU model and
+feature flags as the writer.
+
+Callers: tests/conftest.py (the whole tier-1 suite) and bench.py's
+``--smoke`` child (the CI gate re-traces every serving/fleet program in
+a fresh process on every run — without the cache that is ~a minute of
+pure XLA recompilation inside the suite's single biggest test). The
+headline bench modes deliberately do NOT call this: their ``compile_s``
+column is a measured quantity and a silently-warm reload would turn it
+into noise across rounds.
+
+Opt out with ``PINT_TPU_JAX_CACHE=0`` on hosts where the reload itself
+misbehaves (the symptom is an XLA "machine feature mismatch" log line
+followed by SIGILL/segfault); ``PINT_TPU_JAX_CACHE_DIR`` overrides the
+location (default: ``<repo_root>/.jax_cache/<host-tag>``, gitignored).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+from . import config
+
+
+def host_cache_tag() -> str:
+    """Per-host cache subdir key: CPU model + feature flags.
+
+    The round-3 SIGILL mode was an executable deserialized on a machine
+    whose CPU features differ from the writer's (e.g. one checkout on
+    shared storage used from two hosts). Keying the default dir by
+    model+flags makes that cross-host reload impossible by
+    construction.
+    """
+    ident = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("model name", "flags")):
+                    ident += line
+                    if line.startswith("flags"):
+                        break
+    except OSError:
+        pass
+    return hashlib.md5(ident.encode()).hexdigest()[:12]
+
+
+def enable_persistent_cache(repo_root: str) -> bool:
+    """Point jax at the repo-local persistent compile cache.
+
+    Must run before the first compilation in the process (the config
+    keys are read at compile time, so import-time is the safe spot).
+    Returns False — and touches nothing — under PINT_TPU_JAX_CACHE=0.
+    """
+    if not config.env_on("PINT_TPU_JAX_CACHE"):
+        return False
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        config.env_str("PINT_TPU_JAX_CACHE_DIR")
+        or os.path.join(repo_root, ".jax_cache", host_cache_tag()))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return True

@@ -187,6 +187,17 @@ declare("PINT_TPU_SESSION_MAX_APPENDS", 16, "int",
 declare("PINT_TPU_SESSION_DRIFT_SIGMA", 1.0, "float",
         "Cumulative parameter-motion drift gate in posterior sigmas "
         "before a session's incremental state forces a full refit.")
+declare("PINT_TPU_SESSION_BATCH", True, "bool",
+        "Kill switch for vmapped multi-session append batching; 0 "
+        "restores one rank-k launch per session (the bitwise solo "
+        "path).")
+declare("PINT_TPU_SESSION_BATCH_MAX", 64, "int",
+        "Max member width of one batched session launch; a drain's "
+        "same-structure append group chunks beyond it.")
+declare("PINT_TPU_SESSION_GLS", True, "bool",
+        "Gate for the GLS Schur rank-k incremental session path; 0 "
+        "restores the stateless full-refit-per-append behavior for "
+        "correlated-noise sessions.")
 declare("PINT_TPU_FAULTS", None, "str",
         "Seed-driven fault-injection plan, e.g. "
         "'nan_toas=0.2,seed=7' (tools/soak.py chaos gates); unset = "
@@ -289,8 +300,8 @@ declare("PINT_TPU_PREWARM_TOP_K", 8, "int",
 # --- bench.py / scale_proof.py / tpu_evidence.py knobs ---------------
 declare("PINT_TPU_BENCH_MODE", "gls", "str",
         "bench.py mode: gls | fit_throughput | throughput_mixed | "
-        "throughput_mesh | throughput_incremental | read_mixed | "
-        "fleet | pta | catalog.", scope="bench")
+        "throughput_mesh | throughput_incremental | session_fleet | "
+        "read_mixed | fleet | pta | catalog.", scope="bench")
 declare("PINT_TPU_BENCH_N", 100000, "int",
         "bench.py TOA count for the headline fit.", scope="bench")
 declare("PINT_TPU_BENCH_REPS", 5, "int",
@@ -360,17 +371,19 @@ declare("PINT_TPU_SOAK_REPRO_DIR", ".", "str",
         "Directory for per-trial soak repro artifacts on failure.",
         scope="tools")
 
+declare("PINT_TPU_JAX_CACHE", True, "bool",
+        "Persistent XLA compile cache for the test suite and the bench "
+        "--smoke child (pint_tpu.compile_cache); 0 opts out on hosts "
+        "where the cache itself misbehaves.")
+declare("PINT_TPU_JAX_CACHE_DIR", None, "str",
+        "Override location of the persistent XLA compile cache "
+        "(default: <repo>/.jax_cache/<host-tag>).")
+
 # --- tests-only knobs (declared for the generated docs; tests/ is
 # outside the analyzer's scan scope) ---------------------------------
 declare("PINT_TPU_RUN_TPU_TESTS", False, "bool",
         "Keep the accelerator platform visible to the test suite "
         "(tier-1 pins JAX_PLATFORMS=cpu otherwise).", scope="tests")
-declare("PINT_TPU_JAX_CACHE", True, "bool",
-        "Persistent XLA compile cache for the test suite; 0 opts out "
-        "on hosts where the cache itself misbehaves.", scope="tests")
-declare("PINT_TPU_JAX_CACHE_DIR", None, "str",
-        "Override location of the test suite's XLA compile cache.",
-        scope="tests")
 declare("PINT_TPU_GOLDEN_DIR", None, "str",
         "Directory of external golden datasets; unset skips those "
         "tests with an explanation.", scope="tests")

@@ -1,0 +1,422 @@
+"""Incremental GLS refit: rank-k updates of the noise-marginalized Schur system.
+
+ISSUE 20 tentpole (b): correlated-noise sessions used to bypass the
+incremental path entirely — every append was a full warm refit
+(``serve.session.stateless``). This module extends the WLS rank-k
+machinery (:mod:`pint_tpu.fitting.incremental`) to the seg-GLS system
+built by :func:`pint_tpu.fitting.gls_step.gls_gram_seg`: at a converged
+GLS solution the old table is fully summarized by the Cholesky factor
+of the **noise-marginalized Schur complement** ``S`` over the extended
+coordinates ``[offset?] + free params + Fourier coefficients`` (ECORR
+epoch amplitudes eliminated, red-noise prior folded into the diagonal),
+and an append of ``k`` TOAs updates it as
+
+    S' = S + A_k^T W A_k - C_k^T d_k^-1 C_k
+
+— the new rows' whitened Gram minus the Schur elimination of the
+append's NEW ECORR epochs. The downdate term forbids the QR update
+form the WLS path uses, so the step refactorizes the (small, q_B x q_B)
+updated system with one fresh Cholesky per evaluation — still O(q_B^3)
+against the stateless path's O(n q_B^2 + n k_F) over the whole table.
+
+State vector ``u`` (q_B,) = [offset? (turns)] + free-param deltas +
+Fourier-coefficient displacements. Three GLS-specific facts ride the
+cached state beyond the WLS quartet:
+
+* ``a`` — the Fourier coefficients solved (conditioned on the written-
+  back timing solution) at snapshot time. They are never written into
+  the model, so the state must carry the expansion point explicitly;
+  the rank-k step updates them exactly (they are linear coordinates).
+* ``t_ref`` / ``tspan`` — the Fourier basis is FROZEN at the snapshot's
+  time span (:func:`pint_tpu.fitting.gls_step.fourier_design` with
+  explicit reference/span): the cached ``S`` was built against that
+  basis and appended rows must be evaluated in the same one. Appends
+  extending the span make the frozen basis (and its prior grid)
+  slightly stale — bounded by the session layer's append-count gate,
+  which re-freezes the basis at every full refit.
+
+Approximations (the session drift gates + tests/test_session.py GLS
+parity pin them): the timing-coordinate gradient at the snapshot point
+is dropped (the WLS incremental's documented "converged means ~zero
+gradient" assumption — the offset and Fourier coordinates are solved
+exactly at snapshot, so their gradient is zero by construction), and an
+append's ECORR epochs are assumed NEW (an appended observation never
+extends an old epoch's average — the observatory-pipeline reality the
+session layer serves).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import telemetry
+from pint_tpu.constants import SECS_PER_DAY
+from pint_tpu.fitting.incremental import (_state_names, make_incr_rows,
+                                          state_bytes)
+
+Array = jax.Array
+
+#: state-dict leaves cached per GLS session (superset of the WLS
+#: incremental's STATE_FIELDS; see module docstring)
+STATE_FIELDS = ("L", "norm", "mu", "chi2", "a", "t_ref", "tspan")
+
+
+def _k_fourier(pl_specs: tuple) -> int:
+    """Fourier-coefficient count of the stacked red-noise blocks."""
+    return 2 * sum(int(s.nharm) for s in pl_specs)
+
+
+def frozen_pl_bases(toas, pl_specs: tuple, pl_params, t_ref, tspan):
+    """:func:`pint_tpu.fitting.gls_step.pl_bases` against an EXPLICIT
+    (traced) reference epoch and span — the frozen-basis hook: appended
+    rows must be expanded in the snapshot's basis, not one re-derived
+    from their own (later) times."""
+    from pint_tpu.fitting.gls_step import fourier_design, powerlaw_phi
+
+    if not pl_specs:
+        return None, None
+    t_s = (toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+    blocks, phis = [], []
+    for i, spec in enumerate(pl_specs):
+        F, f, df = fourier_design(t_s, spec.nharm, t_ref=t_ref,
+                                  tspan=tspan)
+        if spec.scale != "none":
+            from pint_tpu.models.noise import DM_FREF_MHZ
+
+            ratio = (DM_FREF_MHZ / toas.freq_mhz)[:, None]
+            F = F * (jnp.square(ratio) if spec.alpha == 2.0
+                     else ratio ** spec.alpha)
+        blocks.append(F)
+        phis.append(jnp.repeat(
+            powerlaw_phi(f, pl_params[i, 0], pl_params[i, 1], df), 2))
+    return jnp.concatenate(blocks, axis=1), jnp.concatenate(phis)
+
+
+def make_gls_snapshot(model, params=None, pl_specs: tuple = ()):
+    """Build ``snapshot(base, toas, noise) -> state`` over the FULL table.
+
+    One :func:`gls_gram_seg` reduction at the model's current values
+    (deltas = 0, immediately after a converged GLS fit wrote back),
+    then: jittered Cholesky of the full Schur system ``S`` (red-noise
+    prior inside, ECORR epochs eliminated), and ONE conditional solve
+    of the non-timing coordinates (offset + Fourier block, timing
+    pinned at the written-back solution) whose result folds into the
+    absorbed mean and seeds the cached Fourier coefficients — making
+    the snapshot point an exact stationary point of those coordinates.
+    """
+    from pint_tpu.fitting.gls_step import gls_gram_seg
+
+    rows = make_incr_rows(model, params)
+    names, off = _state_names(model, params)
+    p = off + len(names)
+    k_f = _k_fourier(pl_specs)
+
+    def snapshot(base, toas, noise):
+        f0 = base["F0"].hi + base["F0"].lo
+        d = {k: jnp.zeros((), jnp.float64) for k in names}
+        M, resid_turns, w = rows(base, d, toas)
+        sigma = 1.0 / jnp.sqrt(w)
+        if off:
+            mu = jnp.sum(resid_turns * w) / jnp.sum(w)
+        else:
+            mu = jnp.zeros((), jnp.float64)
+        r = (resid_turns - mu) / f0
+        t_s = (toas.tdb.hi + toas.tdb.lo) * SECS_PER_DAY
+        # zero-weight padding rows replicate real TOAs (pad_toas), so
+        # the frozen span is the real table's span
+        t_ref = jnp.min(t_s)
+        tspan = jnp.maximum(jnp.max(t_s) - t_ref, SECS_PER_DAY)
+        F, phi_F = frozen_pl_bases(toas, pl_specs, noise.pl_params,
+                                   t_ref, tspan)
+        parts = gls_gram_seg(M, r, sigma, F, phi_F,
+                             noise.epoch_idx, noise.ecorr_phi)
+        S, rhs, norm = parts["S"], parts["rhs"], parts["norm"]
+        qb = S.shape[0]
+        S = S + jnp.eye(qb) * (jnp.finfo(jnp.float64).eps
+                               * jnp.trace(S))
+        L = jnp.linalg.cholesky(S)
+        chi2 = parts["quad0"]
+        if parts["d"].shape[0] > 0:
+            chi2 = chi2 - parts["c_e"] @ (parts["c_e"] / parts["d"])
+        # conditional solve of the offset + Fourier block (timing rows/
+        # cols excluded: those values were written back by the fit and
+        # are the expansion point by definition)
+        idx = ([0] if off else []) + list(range(p, qb))
+        a = jnp.zeros(k_f, jnp.float64)
+        if idx:
+            ix = np.asarray(idx)
+            Si = S[np.ix_(ix, ix)]
+            ri = rhs[ix]
+            cf = jax.scipy.linalg.cho_factor(Si, lower=True)
+            z = jax.scipy.linalg.cho_solve(cf, ri)
+            chi2 = chi2 - z @ ri
+            if off:
+                mu = mu + z[0] / norm[0]
+            if k_f:
+                a = z[1 if off else 0:] / norm[p:]
+        return {"L": L, "norm": norm, "mu": mu, "chi2": chi2, "a": a,
+                "t_ref": t_ref, "tspan": tspan}
+
+    return snapshot
+
+
+def make_gls_incr_step(model, params=None, pl_specs: tuple = ()):
+    """Build the fused GLS incremental full step ``full(u, operands)``.
+
+    ``operands = (base, toas_k, state, noise_k)`` — the cached state
+    plus the append bucket's own :class:`~pint_tpu.fitting.gls_step
+    .NoiseStatics` (its NEW ECORR epochs and padded-dummy rows). One
+    evaluation: append rows + frozen Fourier columns at the trial
+    point, Schur elimination of the new epochs, rank-k refactorization
+    of the marginalized system, Gauss-Newton re-solve. Same ``(new_u,
+    info)`` contract as the WLS incremental step; ``info`` carries the
+    full replacement state (adopt-selected to the kept point).
+    """
+    rows = make_incr_rows(model, params)
+    names, off = _state_names(model, params)
+    p = off + len(names)
+    k_f = _k_fourier(pl_specs)
+
+    def full(u, ops):
+        base, toas_k, state, noise = ops
+        f0 = base["F0"].hi + base["F0"].lo
+        d = {k: u[off + i] for i, k in enumerate(names)}
+        M, resid_turns, w = rows(base, d, toas_k)
+        rc = resid_turns - state["mu"]
+        if off:
+            rc = rc - u[0]
+        rho = rc / f0
+        if k_f:
+            F, _phi = frozen_pl_bases(toas_k, pl_specs, noise.pl_params,
+                                      state["t_ref"], state["tspan"])
+            rho = rho - F @ (state["a"] + u[p:])
+            Bt = jnp.concatenate([M, F], axis=1)
+        else:
+            Bt = M
+        norm = state["norm"]
+        A = Bt / norm
+        un = norm * u
+        Lu = state["L"].T @ un
+        G_new = A.T @ (A * w[:, None])
+        g = A.T @ (rho * w) - state["L"] @ Lu
+        chi2_new = jnp.sum(jnp.square(rho) * w)
+        ne = noise.ecorr_phi.shape[0]
+        if ne > 0:
+            def seg(x):
+                return jax.ops.segment_sum(x, noise.epoch_idx,
+                                           num_segments=ne + 1)[:ne]
+
+            d_e = seg(w) + 1.0 / noise.ecorr_phi
+            C = seg(A * w[:, None])
+            c_e = seg(rho * w)
+            G_new = G_new - C.T @ (C / d_e[:, None])
+            g = g - C.T @ (c_e / d_e)
+            chi2_new = chi2_new - c_e @ (c_e / d_e)
+        chi2_in = state["chi2"] + jnp.sum(jnp.square(Lu)) + chi2_new
+        H = state["L"] @ state["L"].T + G_new
+        H = H + jnp.eye(H.shape[0]) * (jnp.finfo(jnp.float64).eps
+                                       * jnp.trace(H))
+        Lh = jnp.linalg.cholesky(H)
+        vn = jax.scipy.linalg.cho_solve((Lh, True), g)
+        cov = jax.scipy.linalg.cho_solve((Lh, True),
+                                         jnp.eye(norm.shape[0]))
+        new_u = u + vn / norm
+        sig = jnp.sqrt(jnp.diagonal(cov)) / norm
+        errors = {k: sig[off + i] for i, k in enumerate(names)}
+        mu_new = state["mu"] + u[0] if off else state["mu"]
+        a_new = state["a"] + u[p:] if k_f else state["a"]
+        return new_u, {"chi2": chi2_in - vn @ g, "errors": errors,
+                       "chi2_at_input": chi2_in, "L": Lh,
+                       "mu": mu_new, "norm": norm, "a": a_new,
+                       "t_ref": state["t_ref"], "tspan": state["tspan"]}
+
+    return full
+
+
+def make_gls_incr_probe(model, params=None, pl_specs: tuple = ()):
+    """Residual-only judge: the step's ``chi2_at_input`` expression
+    (cached quadratic + new rows' NEW-epoch-marginalized chi2) with no
+    jacfwd and no factorization — the fused loop's halved-trial
+    evaluator."""
+    tzr = model.get_tzr_toas()
+    phase_fn = model.phase_fn_toas(tzr=tzr, abs_phase=True)
+    names, off = _state_names(model, params)
+    p = off + len(names)
+    k_f = _k_fourier(pl_specs)
+
+    def probe(u, ops):
+        base, toas_k, state, noise = ops
+        f0 = base["F0"].hi + base["F0"].lo
+        d = {k: u[off + i] for i, k in enumerate(names)}
+        ph = phase_fn(base, d, toas_k)
+        err = model.scaled_toa_uncertainty(toas_k)
+        w = 1.0 / jnp.square(err)
+        rc = (ph.frac.hi + ph.frac.lo) - state["mu"]
+        if off:
+            rc = rc - u[0]
+        rho = rc / f0
+        if k_f:
+            F, _phi = frozen_pl_bases(toas_k, pl_specs, noise.pl_params,
+                                      state["t_ref"], state["tspan"])
+            rho = rho - F @ (state["a"] + u[p:])
+        un = state["norm"] * u
+        quad = jnp.sum(jnp.square(state["L"].T @ un))
+        chi2_new = jnp.sum(jnp.square(rho) * w)
+        ne = noise.ecorr_phi.shape[0]
+        if ne > 0:
+            def seg(x):
+                return jax.ops.segment_sum(x, noise.epoch_idx,
+                                           num_segments=ne + 1)[:ne]
+
+            d_e = seg(w) + 1.0 / noise.ecorr_phi
+            c_e = seg(rho * w)
+            chi2_new = chi2_new - c_e @ (c_e / d_e)
+        return state["chi2"] + quad + chi2_new
+
+    return probe
+
+
+def jitted_gls_incr_step(model, params: tuple, pl_specs: tuple):
+    """Model-cache-shared :func:`make_gls_incr_step` (uncounted —
+    traced into the fused loop)."""
+    return model._cached_jit(
+        ("gls_incr_step", tuple(params), tuple(pl_specs)),
+        lambda owner: make_gls_incr_step(owner, params, pl_specs))
+
+
+def jitted_gls_incr_probe(model, params: tuple, pl_specs: tuple):
+    """Model-cache-shared :func:`make_gls_incr_probe`."""
+    return model._cached_jit(
+        ("gls_incr_probe", tuple(params), tuple(pl_specs)),
+        lambda owner: make_gls_incr_probe(owner, params, pl_specs))
+
+
+def jitted_gls_snapshot(model, params: tuple, pl_specs: tuple):
+    """Model-cache-shared, jitted :func:`make_gls_snapshot`."""
+    return model._cached_jit(
+        ("gls_incr_snapshot", tuple(params), tuple(pl_specs)),
+        lambda owner: jax.jit(make_gls_snapshot(owner, params, pl_specs)))
+
+
+def snapshot_state(model, toas) -> dict:
+    """Compute + fetch-free cached GLS state over the bucketed table.
+
+    The GLS analogue of :func:`pint_tpu.fitting.incremental
+    .snapshot_state`: one program launch, device-array state leaves,
+    host metadata (``names``/``off``/``q``/``pl_specs``) riding along.
+    """
+    from pint_tpu import bucketing
+    from pint_tpu.fitting.gls_step import (build_noise_statics,
+                                           pad_noise_statics)
+
+    names, off = _state_names(model)
+    noise, pl_specs = build_noise_statics(model, toas)
+    n_target = bucketing.bucket_size(len(toas))
+    noise = pad_noise_statics(noise, n_target)
+    toas_b = bucketing.bucket_toas(toas)
+    snap = jitted_gls_snapshot(model, tuple(names), pl_specs)
+    bucketing.note_program("gls_incr_snapshot",
+                           hash(model._fn_fingerprint()),
+                           bucketing.toa_shape(toas_b))
+    with telemetry.jit_span("incr.gls_snapshot"):
+        state = snap(model.base_dd(), toas_b, noise)
+    q = len(names) + off
+    return {"state": state, "names": names, "off": off, "q": q,
+            "pl_specs": pl_specs, "bytes": state_bytes(state)}
+
+
+class InFlightGlsIncrUpdate:
+    """A dispatched GLS incremental update; one fetch, state on-device.
+
+    The :class:`pint_tpu.fitting.incremental.InFlightIncrUpdate`
+    contract over the extended GLS state (:data:`STATE_FIELDS`)."""
+
+    __slots__ = ("_inner", "_new_state", "_result")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._new_state = None
+        self._result = None
+
+    def ready(self) -> bool:
+        return self._inner.ready()
+
+    def fetch(self):
+        """The update's single device->host sync; idempotent."""
+        if self._result is None:
+            out = self._inner._out
+            if out is not None:
+                info_dev = out[1]
+                self._new_state = {
+                    "L": info_dev["L"], "norm": info_dev["norm"],
+                    "mu": info_dev["mu"],
+                    "chi2": info_dev["chi2_at_input"],
+                    "a": info_dev["a"], "t_ref": info_dev["t_ref"],
+                    "tspan": info_dev["tspan"]}
+            self._result = self._inner.fetch()
+        return self._result
+
+    @property
+    def new_state(self) -> dict:
+        """Replacement cached state (device arrays); fetch() first."""
+        if self._result is None:
+            raise RuntimeError("fetch() the update before reading state")
+        return self._new_state
+
+
+def dispatch_gls_incremental(model, toas_append, state, *, names,
+                             maxiter=20, min_chi2_decrease=1e-3,
+                             max_step_halvings=8):
+    """Enqueue one fused GLS rank-k update; returns an
+    :class:`InFlightGlsIncrUpdate`.
+
+    The append bucket's noise statics are built fresh (its ECORR
+    epochs are NEW segments by assumption) and padded: rows to the
+    append bucket, the epoch axis to the basis bucket
+    (:func:`pint_tpu.bucketing.basis_bucket_size` — inert 1 s^2 dummy
+    priors with zero TOA support), so every append size and epoch
+    count of a structure shares one compiled program. Operand donation
+    follows the WLS incremental's rule exactly (the cached state is
+    replaced; accelerator backends only).
+    """
+    from pint_tpu import bucketing
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.fitting.gls_step import (build_noise_statics,
+                                           pad_noise_statics)
+
+    names = tuple(names)
+    _names, off = _state_names(model, names)
+    noise_k, pl_specs = build_noise_statics(model, toas_append)
+    has_ecorr = any(hasattr(c, "epoch_indices")
+                    for c in model.components)
+    k_target = bucketing.append_bucket_size(len(toas_append))
+    # an ECORR structure always pads the epoch axis (floor included even
+    # when this append happens to select zero epochs) so every append of
+    # the structure shares one compiled program shape
+    ne_target = (bucketing.basis_bucket_size(
+        max(int(noise_k.ecorr_phi.shape[0]), 1)) if has_ecorr else None)
+    noise_k = pad_noise_statics(noise_k, k_target, ne_target)
+    toas_k = bucketing.pad_toas(toas_append, k_target) \
+        if k_target != len(toas_append) else toas_append
+    if device_loop._donate_operands():
+        # same rule as dispatch_incremental: an exact-bucket append
+        # passes the caller's own table whose buffers the session
+        # keeps alive in entry.pending — donate a private copy
+        toas_k = jax.tree.map(jnp.array, toas_k)
+    step = jitted_gls_incr_step(model, names, pl_specs)
+    probe = jitted_gls_incr_probe(model, names, pl_specs)
+    qb = len(names) + off + _k_fourier(pl_specs)
+    u0 = jnp.zeros(qb, jnp.float64)
+    telemetry.inc("fit.incremental.gls_dispatched")
+    return InFlightGlsIncrUpdate(device_loop.dispatch_damped(
+        lambda u, ops: step(u, ops), u0,
+        (model.base_dd(), toas_k, state, noise_k),
+        probe=lambda u, ops: probe(u, ops),
+        key=("gls_incr", id(step), id(probe)),
+        maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings,
+        kind="device_loop_gls_incr",
+        fingerprint=(hash(model._fn_fingerprint()), names, pl_specs),
+        shape=(k_target, qb), donate_state=True))

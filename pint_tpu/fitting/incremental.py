@@ -328,6 +328,106 @@ class InFlightIncrUpdate:
         return self._new_state
 
 
+class InFlightIncrBatch:
+    """A dispatched MULTI-session incremental update: one vmapped launch.
+
+    The fleet-scale flavor of :class:`InFlightIncrUpdate` (ISSUE 20): N
+    same-structure sessions' append buckets ride a stacked member axis
+    through ONE free-running batched damped loop
+    (:func:`pint_tpu.fitting.device_loop.dispatch_damped_batched`), so N
+    sessions cost one launch and one fetch instead of N. Per-member
+    replacement states are captured as DEVICE-array slices of the
+    batched info carry before the host fetch — each session's cache
+    commit stays host-round-trip-free, exactly like the solo path.
+    Unlike the solo path the stacked operands are FRESH buffers
+    (``jnp.stack`` copies), so the member states are never donated and
+    stay valid if the launch fails.
+    """
+
+    __slots__ = ("_inner", "_n_real", "_new_states", "_result")
+
+    def __init__(self, inner, n_real: int):
+        self._inner = inner
+        self._n_real = n_real
+        self._new_states = None
+        self._result = None
+
+    def ready(self) -> bool:
+        return self._inner.ready()
+
+    def fetch(self):
+        """The batch's single device->host sync; idempotent."""
+        if self._result is None:
+            out = self._inner._inner._out
+            if out is not None:
+                info_dev = out[1]
+                self._new_states = [
+                    {"L": info_dev["L"][m], "norm": info_dev["norm"][m],
+                     "mu": info_dev["mu"][m],
+                     "chi2": info_dev["chi2_at_input"][m]}
+                    for m in range(self._n_real)]
+            self._result = self._inner.fetch()
+        return self._result
+
+    def new_state(self, m: int) -> dict:
+        """Member ``m``'s replacement cached state; fetch() first."""
+        if self._result is None:
+            raise RuntimeError("fetch() the batch before reading state")
+        return self._new_states[m]
+
+
+def dispatch_incremental_batch(members, *, maxiter=20,
+                               min_chi2_decrease=1e-3,
+                               max_step_halvings=8):
+    """Enqueue ONE vmapped rank-k launch over many sessions' appends.
+
+    ``members`` is ``[(model, toas_append, state), ...]`` — every member
+    must share one structure fingerprint (which pins the frozen and
+    unfittable parameter values, TZR anchor included — see
+    ``TimingModel._fn_fingerprint``), one free-parameter set and one
+    append bucket; equal fingerprints are exactly what makes the plain
+    ``jax.vmap`` of the scalar step/probe closures correct: every
+    member evaluates the same compiled phase program, per-member values
+    riding the stacked traced ``base``. The member axis pads to the
+    pow-2 width (:func:`pint_tpu.bucketing.member_bucket_size`,
+    replicating member 0 — inert: dummy results are never read) so
+    nearby batch sizes share one compiled program. Returns an
+    :class:`InFlightIncrBatch`.
+    """
+    from pint_tpu import bucketing
+    from pint_tpu.fitting import device_loop
+    from pint_tpu.parallel.batch import stack_toas
+
+    lead = members[0][0]
+    names, off = _state_names(lead)
+    names = tuple(names)
+    step = jitted_incr_step(lead, names)
+    probe = jitted_incr_probe(lead, names)
+    k_target = bucketing.append_bucket_size(
+        max(len(t) for _m, t, _s in members))
+    n_real = len(members)
+    b_target = bucketing.member_bucket_size(n_real)
+    rows = list(members) + [members[0]] * (b_target - n_real)
+    base = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                        *[m.base_dd() for m, _t, _s in rows])
+    toas_k = stack_toas([t for _m, t, _s in rows], k_target)
+    state = jax.tree.map(lambda *xs: jnp.stack(xs),
+                         *[s for _m, _t, s in rows])
+    u0 = jnp.zeros((b_target, len(names) + off), jnp.float64)
+    telemetry.inc("fit.incremental.batch_dispatched")
+    telemetry.inc("fit.incremental.batch_members", n_real)
+    return InFlightIncrBatch(device_loop.dispatch_damped_batched(
+        jax.vmap(lambda u, ops: step(u, ops), in_axes=(0, 0)), u0,
+        (base, toas_k, state),
+        probe=jax.vmap(lambda u, ops: probe(u, ops), in_axes=(0, 0)),
+        key=("incr_batch", id(step), id(probe)),
+        maxiter=maxiter, min_chi2_decrease=min_chi2_decrease,
+        max_step_halvings=max_step_halvings,
+        kind="device_loop_incr_batch",
+        fingerprint=(hash(lead._fn_fingerprint()), names, b_target),
+        shape=(b_target, k_target, len(names) + off)), n_real)
+
+
 def dispatch_incremental(model, toas_append, state, *, names, maxiter=20,
                          min_chi2_decrease=1e-3, max_step_halvings=8):
     """Enqueue one fused incremental update; returns the

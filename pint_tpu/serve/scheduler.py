@@ -109,7 +109,7 @@ from typing import Any
 
 import numpy as np
 
-from pint_tpu import bucketing, telemetry
+from pint_tpu import bucketing, config, telemetry
 from pint_tpu.serve import fingerprint as _fp
 from pint_tpu.serve import faults as _faults
 from pint_tpu.serve.pipeline import run_pipeline
@@ -355,6 +355,10 @@ class BatchPlan:
     #                           host-routed singletons like passthrough,
     #                           but the incremental route dispatches one
     #                           fused async program)
+    #                           | "session_batch" (ISSUE 20: many same-
+    #                           structure session appends riding ONE
+    #                           vmapped rank-k launch; indices are the
+    #                           member requests in queue order)
     group: str                # fingerprint short id
     indices: list[int]        # queue positions of the member requests
     toa_bucket: int
@@ -1125,19 +1129,42 @@ class ThroughputScheduler:
         groups: dict[tuple, list[int]] = {}
         order: list[tuple] = []
         plans: list[BatchPlan] = []
+        # session-append grouping (ISSUE 20): same-structure appends
+        # from MANY sessions share one vmapped rank-k launch. The group
+        # key is (fingerprint short-id, pow-2 APPEND bucket, fit
+        # hyperparameters) — exactly what makes one compiled batched
+        # program correct for every member. Only the FIRST append per
+        # session key may join a group: a second same-key append in one
+        # drain must observe the first's committed state, so it stays a
+        # solo singleton behind the drain's sess_prev serialization.
+        sess_solo: list[tuple[int, BatchPlan]] = []
+        sess_groups: dict[tuple, list[int]] = {}
+        sess_keys_batched: set = set()
+        sb_on = config.env_on("PINT_TPU_SESSION_BATCH")
         for i, (req, _h, _t, fp, m) in enumerate(self._queue):
             if m.get("session") is not None:
-                # sessionful singleton (ISSUE 10): never batched — the
-                # incremental route holds per-session state and the
-                # full-refit route runs over the ACCUMULATED table, not
-                # the request's append payload. Emitted first so the
-                # async incremental dispatch overlaps later batch prep;
-                # blast radius is one request by construction, so the
-                # degradation ladder needs no special-casing.
-                plans.append(BatchPlan(
-                    "session", _fp.short_id(fp), [i],
-                    bucketing.bucket_size(len(req.toas)), 1, devices=0,
-                    reason=m["session"]["mode"]))
+                # sessionful plans (ISSUE 10): never mixed into fit
+                # batches — the incremental route holds per-session
+                # state and the full-refit route runs over the
+                # ACCUMULATED table, not the request's append payload.
+                # Emitted first so the async incremental dispatch
+                # overlaps later batch prep; blast radius stays
+                # per-request (member faults resolve individually), so
+                # the degradation ladder needs no special-casing.
+                sm = m["session"]
+                if (sb_on and sm["mode"] == "append"
+                        and sm["key"] not in sess_keys_batched):
+                    sess_keys_batched.add(sm["key"])
+                    gkey = (_fp.short_id(fp),
+                            bucketing.append_bucket_size(len(req.toas)),
+                            (req.maxiter, req.min_chi2_decrease,
+                             req.max_step_halvings))
+                    sess_groups.setdefault(gkey, []).append(i)
+                else:
+                    sess_solo.append((i, BatchPlan(
+                        "session", _fp.short_id(fp), [i],
+                        bucketing.bucket_size(len(req.toas)), 1,
+                        devices=0, reason=sm["mode"])))
                 continue
             key = _fp.plan_key(fp, bucketing.bucket_size(len(req.toas)),
                                (req.maxiter, req.min_chi2_decrease,
@@ -1147,6 +1174,25 @@ class ThroughputScheduler:
                 groups[key] = []
                 order.append(key)
             groups[key].append(i)
+        # emit session plans (grouped chunks + solos) in queue order of
+        # their first member, ahead of every fit batch — same overlap
+        # rationale as the ISSUE-10 singletons. A group chunks at the
+        # max member width and a 1-member chunk degenerates to the solo
+        # plan (the batched machinery never sees width-1 work).
+        sb_max = max(1, config.env_int("PINT_TPU_SESSION_BATCH_MAX"))
+        for (fp8, kb, _hyp), idxs in sess_groups.items():
+            for c in range(0, len(idxs), sb_max):
+                chunk = idxs[c:c + sb_max]
+                if len(chunk) < 2:
+                    sess_solo.extend((i, BatchPlan(
+                        "session", fp8, [i],
+                        bucketing.bucket_size(len(self._queue[i][0].toas)),
+                        1, devices=0, reason="append")) for i in chunk)
+                else:
+                    sess_solo.append((chunk[0], BatchPlan(
+                        "session_batch", fp8, chunk, kb, len(chunk),
+                        devices=0, reason="append")))
+        plans.extend(p for _i, p in sorted(sess_solo, key=lambda t: t[0]))
         load = [0] * self.n_devices  # member-slots placed this pass
         width_cap = largest_pow2_leq(self.n_devices)
 
@@ -1354,7 +1400,7 @@ class ThroughputScheduler:
             "group": plan.group, "kind": plan.kind,
             "members": len(plan.indices), "attempts": failure.attempts,
             "error": f"{type(failure.error).__name__}: {failure.error}"})
-        if plan.kind == "session":
+        if plan.kind in ("session", "session_batch"):
             # a session stage failure must NOT salvage via a standalone
             # fit of the request payload: an append's toas are only the
             # new rows, and the session's committed HOST solution is
@@ -1550,6 +1596,20 @@ class ThroughputScheduler:
                     job.prep()  # gates read here, once per request
                     state.fitter = job
                     return state
+                if plan.kind == "session_batch":
+                    from pint_tpu.serve.session import (SessionBatch,
+                                                        SessionJob)
+
+                    jobs = []
+                    for i in plan.indices:
+                        sm = live[i][4]["session"]
+                        jobs.append(SessionJob(
+                            self.sessions, sm["key"], sm["fp"],
+                            live[i][0], sm["mode"]))
+                    batch = SessionBatch(jobs)
+                    batch.prep()
+                    state.fitter = batch
+                    return state
                 if plan.kind == "passthrough":
                     return state  # Fitter.auto built at dispatch time
                 if plan.kind == "sharded":
@@ -1620,6 +1680,23 @@ class ThroughputScheduler:
                         state.fitter.dispatch()
                         sess_prev[state.fitter.key] = state.fitter
                         return state
+                    if plan.kind == "session_batch":
+                        # per-member serialization against earlier
+                        # same-key jobs in this drain (the grouped plan
+                        # holds at most one job per key, but a create
+                        # or a duplicate-append solo plan may have
+                        # dispatched before this one)
+                        for job in state.fitter.jobs:
+                            prev = sess_prev.get(job.key)
+                            if prev is not None and prev is not job:
+                                try:
+                                    prev.finish()
+                                except Exception:  # noqa: BLE001
+                                    pass  # surfaced at prev's own fetch
+                        state.fitter.dispatch()
+                        for job in state.fitter.jobs:
+                            sess_prev[job.key] = job
+                        return state
                     if plan.kind == "passthrough":
                         # host-driven fitters cannot be suspended
                         # mid-loop: the fit runs here, already resolved
@@ -1687,6 +1764,58 @@ class ThroughputScheduler:
                     plan=plan, chi2=res["chi2"],
                     converged=res["converged"], t_done=job.t_done,
                     attempts=job.attempts, session=res["route"])]
+            if plan.kind == "session_batch":
+                # per-member resolution: one member's fetch failure
+                # resolves THAT member ``failed`` (device state
+                # invalidated, committed host solution intact — the
+                # ISSUE-10 salvage contract) while the rest commit on
+                # their own merits
+                out = []
+                any_fail = False
+                for m_i, i in enumerate(plan.indices):
+                    entry = live[i]
+                    job = state.fitter.jobs[m_i]
+                    try:
+                        res = job.finish()
+                    except Exception as e:  # noqa: BLE001 — isolation
+                        any_fail = True
+                        telemetry.inc("serve.fault.request")
+                        sm = entry[4].get("session")
+                        if sm is not None:
+                            self.sessions.invalidate(sm["key"])
+                        out.append(self._envelope(
+                            entry, status="failed", plan=plan,
+                            error=f"session batch member raised "
+                                  f"{type(e).__name__}: {e}",
+                            attempts=state.attempts))
+                        continue
+                    sess_jobs.append(job)
+                    if res["diverged"]:
+                        telemetry.inc("serve.fault.diverged")
+                        out.append(self._envelope(
+                            entry, status="diverged", plan=plan,
+                            chi2=res["chi2"], t_done=job.t_done,
+                            attempts=job.attempts,
+                            session=res["route"],
+                            error="session fit diverged (incremental "
+                                  "fallback included)"
+                                  if job.attempts > 1
+                                  else "session fit diverged"))
+                    else:
+                        out.append(self._envelope(
+                            entry,
+                            status="ok" if res["converged"]
+                            else "nonconverged",
+                            plan=plan, chi2=res["chi2"],
+                            converged=res["converged"],
+                            t_done=job.t_done, attempts=job.attempts,
+                            session=res["route"]))
+                if any_fail:
+                    fail_batches += 1
+                    failed_plans.add(plan._seq)
+                else:
+                    clean_plans.add(plan._seq)
+                return out
             if plan.kind == "passthrough":
                 clean_plans.add(plan._seq)
                 entry = live[plan.indices[0]]
@@ -1764,7 +1893,7 @@ class ThroughputScheduler:
                 return True
             if state.plan.kind == "passthrough":
                 return True  # resolved synchronously at dispatch
-            if state.plan.kind == "session":
+            if state.plan.kind in ("session", "session_batch"):
                 return state.fitter.ready()
             try:
                 return bool(state.handle is not None
@@ -1917,10 +2046,24 @@ class ThroughputScheduler:
             incr_walls = sorted(
                 j.wall_s for j in sess_jobs
                 if j.route == "incremental" and j.wall_s is not None)
+            # launch accounting (ISSUE 20): N batched members riding M
+            # vmapped launches + S solo rank-k launches -> the drain's
+            # incremental work cost M + S device launches, and
+            # launches-per-update is the headline batching win
+            solo = sum(j.launch == "solo" for j in sess_jobs)
+            batched_members = sum(j.launch == "batched"
+                                  for j in sess_jobs)
+            batched = len({id(j._batch) for j in sess_jobs
+                           if j.launch == "batched"})
             sessions_block = {
                 "requests": len(sess_jobs),
                 "routes": routes,
                 "drift_trips": trips,
+                "launches": {"solo": solo, "batched": batched,
+                             "batched_members": batched_members,
+                             "per_update": round(
+                                 (solo + batched)
+                                 / max(1, solo + batched_members), 4)},
                 "update_latencies_s": [round(w, 6)
                                        for w in incr_walls[:64]],
                 "p50_update_s": (round(float(np.percentile(

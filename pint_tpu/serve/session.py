@@ -87,6 +87,26 @@ def drift_limit_sigma() -> float:
     return config.env_float("PINT_TPU_SESSION_DRIFT_SIGMA")
 
 
+def _session_family(model, toas) -> str | None:
+    """Incremental family a (model, toas) structure snapshots under.
+
+    ``"wls"`` -> the rank-k QR update; ``"gls"`` -> the Schur rank-k
+    update (ISSUE 20, gated by ``PINT_TPU_SESSION_GLS``); ``None`` ->
+    stateless (full refit per append): non-batchable structures,
+    anchorless models, wideband (joint TOA+DM rows fit neither update's
+    row convention), and gated-off GLS.
+    """
+    ok, _ = _fp.batchable(model, toas)
+    if not ok or model.get_tzr_toas() is None:
+        return None
+    fam = _fp.family(model, toas)
+    if fam == "wls":
+        return "wls"
+    if fam == "gls" and config.env_on("PINT_TPU_SESSION_GLS"):
+        return "gls"
+    return None
+
+
 class SessionCacheFull(RuntimeError):
     """Session-state admission failed: every evictable entry is pinned
     by queued requests and the budget has no room. The ``ServeQueueFull``
@@ -126,6 +146,10 @@ class SessionEntry:
     state: dict | None = None  # on-device incremental state, or None
     names: list | None = None  # state-vector param order
     off: int = 0               # offset-coordinate count
+    #: incremental family of the committed state: "wls" (rank-k QR
+    #: update) or "gls" (Schur rank-k update, ISSUE 20); None while
+    #: stateless
+    family: str | None = None
     state_bytes: int = 0
     chi2: float = float("nan")
     n_toas: int = 0
@@ -369,22 +393,23 @@ class SessionCache:
         e.appends = 0
         e.drift = 0.0
         e.chi2 = float(chi2)
-        eligible = False
         try:
-            ok, _ = _fp.batchable(model, toas)
-            eligible = (ok and _fp.family(model, toas) == "wls"
-                        and model.get_tzr_toas() is not None)
+            family = _session_family(model, toas)
         except Exception:  # noqa: BLE001 — snapshot is an optimization
-            eligible = False
-        if eligible:
-            from pint_tpu.fitting import incremental as _incr
+            family = None
+        if family is not None:
+            if family == "gls":
+                from pint_tpu.fitting import gls_incremental as _mod
+            else:
+                from pint_tpu.fitting import incremental as _mod
 
-            snap = _incr.snapshot_state(model, toas)
+            snap = _mod.snapshot_state(model, toas)
             e.names, e.off = snap["names"], snap["off"]
+            e.family = family
             self.commit_state(key, snap["state"], snap["bytes"])
         else:
             self.commit_state(key, None, 0)
-            e.names, e.off = None, 0
+            e.names, e.off, e.family = None, 0, None
         self.notify_commit(key)
         telemetry.inc("serve.session.adopted")
         return e
@@ -434,6 +459,12 @@ class SessionJob:
         self._t0 = None
         self.t_done = None
         self.wall_s = None
+        #: set by :class:`SessionBatch` when this job rides a vmapped
+        #: multi-session launch: the batch handle + this job's member
+        #: index on the stacked axis
+        self._batch = None
+        self._member = None
+        self.launch = None        # "solo" | "batched" | None (full path)
 
     # -- helpers -------------------------------------------------------
     def _hyper(self) -> dict:
@@ -443,13 +474,17 @@ class SessionJob:
                     max_step_halvings=r.max_step_halvings)
 
     @staticmethod
-    def _snapshot_eligible(model, toas) -> bool:
-        """Is this fit inside the fused incremental step's domain?
-        TZR-anchored batchable WLS — exactly what
-        :mod:`pint_tpu.fitting.incremental` can express."""
-        ok, _ = _fp.batchable(model, toas)
-        return (ok and _fp.family(model, toas) == "wls"
-                and model.get_tzr_toas() is not None)
+    def _snapshot_family(model, toas) -> str | None:
+        """Incremental family of this fit, or None (stateless).
+
+        TZR-anchored batchable WLS takes the rank-k QR update
+        (:mod:`pint_tpu.fitting.incremental`); TZR-anchored batchable
+        GLS takes the Schur rank-k update (:mod:`pint_tpu.fitting
+        .gls_incremental`, gated by ``PINT_TPU_SESSION_GLS``). Wideband
+        stays stateless: its joint TOA+DM rows do not fit either
+        update's row convention.
+        """
+        return _session_family(model, toas)
 
     def prep(self) -> None:
         """Stage-entry stamp. Routing happens at DISPATCH time
@@ -485,11 +520,20 @@ class SessionJob:
             self.route_now()
         if self.route == "incremental":
             entry = self.cache.entries[self.key]
+            self.launch = "solo"
+            telemetry.inc("serve.session.launch.solo")
             with telemetry.span("serve.session.dispatch",
                                 route=self.route):
-                self._handle = _incr.dispatch_incremental(
-                    entry.model, self.request.toas, entry.state,
-                    names=entry.names, **self._hyper())
+                if entry.family == "gls":
+                    from pint_tpu.fitting import gls_incremental as _gls
+
+                    self._handle = _gls.dispatch_gls_incremental(
+                        entry.model, self.request.toas, entry.state,
+                        names=entry.names, **self._hyper())
+                else:
+                    self._handle = _incr.dispatch_incremental(
+                        entry.model, self.request.toas, entry.state,
+                        names=entry.names, **self._hyper())
             return
         # populate / full refit: host-driven, resolved synchronously
         # (like the scheduler's passthrough plans); completion stamped
@@ -501,6 +545,8 @@ class SessionJob:
         if self._result is not None:
             return True
         try:
+            if self._batch is not None:
+                return self._batch.ready()
             return self._handle is not None and self._handle.ready()
         except Exception:  # noqa: BLE001 — readiness is advisory
             return True
@@ -526,12 +572,13 @@ class SessionJob:
                                     self.request.toas])
             self.attempts = max(self.attempts, 1)
         hyper = self._hyper()
-        eligible = self._snapshot_eligible(model, toas_full)
-        if eligible:
+        family = self._snapshot_family(model, toas_full)
+        if family is not None:
             from pint_tpu.fitting import device_loop
 
-            d, info, chi2, conv, _cnt = device_loop.dense_wls_fit(
-                toas_full, model, **hyper)
+            dense = (device_loop.dense_gls_fit if family == "gls"
+                     else device_loop.dense_wls_fit)
+            d, info, chi2, conv, _cnt = dense(toas_full, model, **hyper)
             div = bool(np.asarray(info.get("diverged", False)))
             if not div:
                 errors = info["errors"]
@@ -567,13 +614,19 @@ class SessionJob:
         entry.appends = 0
         entry.drift = 0.0
         entry.chi2 = float(chi2)
-        if not eligible:
+        if family is None:
             self.cache.commit_state(self.key, None, 0)
-            entry.names, entry.off = None, 0
+            entry.names, entry.off, entry.family = None, 0, None
             telemetry.inc("serve.session.stateless")
         else:
-            snap = _incr.snapshot_state(model, toas_full)
+            if family == "gls":
+                from pint_tpu.fitting import gls_incremental as _gls
+
+                snap = _gls.snapshot_state(model, toas_full)
+            else:
+                snap = _incr.snapshot_state(model, toas_full)
             entry.names, entry.off = snap["names"], snap["off"]
+            entry.family = family
             self.cache.commit_state(self.key, snap["state"],
                                     snap["bytes"])
         # the committed values changed: readers must see THIS solution
@@ -592,8 +645,23 @@ class SessionJob:
             self.wall_s = (self.t_done or time.perf_counter()) - self._t0
             return self._result
         entry = self.cache.entries[self.key]
-        u, info, chi2, conv, _cnt = self._handle.fetch()
-        div = bool(np.asarray(info.get("diverged", False)))
+        if self._batch is not None:
+            # one member of a vmapped multi-session launch (ISSUE 20):
+            # the batch's single fetch is shared; this job commits its
+            # own member slice through the identical code path below
+            m = self._member
+            u, info, chi2, conv, _cnt = self._batch.fetch()
+
+            def pick(x):
+                return np.asarray(x)[m]
+
+            new_state = self._batch.handle.new_state(m)
+        else:
+            u, info, chi2, conv, _cnt = self._handle.fetch()
+            pick = np.asarray
+            new_state = self._handle.new_state
+        div = bool(pick(info.get("diverged", False))) \
+            if "diverged" in info else False
         if div:
             # a poisoned append (or a stale-state pathology): never
             # commit — fall back to the cold path, which repopulates
@@ -605,17 +673,20 @@ class SessionJob:
             self.wall_s = self.t_done - self._t0
             return self._result
         telemetry.inc("serve.session.incremental")
-        u = np.asarray(u)
+        u = np.asarray(pick(u))
         off, names = entry.off, entry.names
         sig = np.zeros(len(names))
         for i, k in enumerate(names):
-            e = float(np.asarray(info["errors"][k]))
+            e = float(np.asarray(pick(info["errors"][k])))
             sig[i] = e
             entry.model[k].add_delta(float(u[off + i]))
             entry.model[k].uncertainty = e
         # cumulative drift: the largest parameter move of this update in
-        # its own posterior sigma (zero-sigma params cannot gate)
-        moves = np.abs(u[off:])
+        # its own posterior sigma (zero-sigma params cannot gate). Slice
+        # the TIMING coordinates only — a GLS state vector carries the
+        # Fourier-coefficient displacements after them, and those are
+        # exact linear updates that cannot stale the cached quadratic
+        moves = np.abs(u[off:off + len(names)])
         with np.errstate(divide="ignore", invalid="ignore"):
             rel = np.where(sig > 0, moves / np.where(sig > 0, sig, 1.0),
                            0.0)
@@ -625,10 +696,9 @@ class SessionJob:
         entry.n_toas += len(self.request.toas)
         entry.appends += 1
         entry.drift += float(np.max(rel)) if rel.size else 0.0
-        entry.chi2 = float(np.asarray(chi2))
+        entry.chi2 = float(pick(chi2))
         committed = self.cache.commit_state(
-            self.key, self._handle.new_state,
-            _incr_state_bytes(self._handle.new_state))
+            self.key, new_state, _incr_state_bytes(new_state))
         if not committed:
             telemetry.inc("serve.session.state_dropped")
         # incremental commit moved the parameter values too (ISSUE 11)
@@ -636,10 +706,86 @@ class SessionJob:
         self.cache.touch(self.key)
         self.t_done = time.perf_counter()
         self.wall_s = self.t_done - self._t0
-        self._result = {"chi2": float(np.asarray(chi2)),
-                        "converged": bool(conv), "diverged": False,
+        self._result = {"chi2": float(pick(chi2)),
+                        "converged": bool(pick(conv)), "diverged": False,
                         "route": "incremental"}
         return self._result
+
+
+class SessionBatch:
+    """N same-structure session jobs drained as ONE vmapped launch.
+
+    The scheduler's ``"session_batch"`` plan state (ISSUE 20): the
+    grouped jobs' routes are decided at dispatch time (same rule as a
+    solo job — a refit earlier in the drain may have changed any
+    member's gates), members still on the incremental WLS route ride
+    one :func:`pint_tpu.fitting.incremental.dispatch_incremental_batch`
+    launch, and everyone else — populates, gate-tripped refits, GLS
+    sessions (whose Schur update stays solo: its state shapes depend on
+    the noise structure) — peels out to its ordinary solo path inside
+    the same plan. ``finish`` stays per member (each
+    :class:`SessionJob` commits its own slice of the shared fetch), so
+    durability journaling, read invalidation and trace hop fan-out
+    compose per member with no batch-aware code anywhere downstream.
+    """
+
+    def __init__(self, jobs: list):
+        self.jobs = list(jobs)
+        self.members: list = []   # jobs riding the vmapped launch
+        self.handle = None
+        self._fetched = None
+
+    def prep(self) -> None:
+        for j in self.jobs:
+            j.prep()
+
+    def dispatch(self) -> None:
+        from pint_tpu.fitting import incremental as _incr
+
+        riders = []
+        for j in self.jobs:
+            if j.route is None:
+                j.route_now()
+            entry = j.cache.entries.get(j.key)
+            if (j.route == "incremental" and entry is not None
+                    and entry.family == "wls"):
+                riders.append(j)
+            else:
+                j.dispatch()  # peel out: populate / refit / GLS solo
+        if len(riders) < 2:
+            for j in riders:
+                j.dispatch()
+            return
+        lead = riders[0]
+        telemetry.inc("serve.session.launch.batched")
+        telemetry.inc("serve.session.launch.batched_members",
+                      len(riders))
+        with telemetry.span("serve.session.dispatch",
+                            route="incremental_batch"):
+            self.handle = _incr.dispatch_incremental_batch(
+                [(j.cache.entries[j.key].model, j.request.toas,
+                  j.cache.entries[j.key].state) for j in riders],
+                **lead._hyper())
+        self.members = riders
+        for m, j in enumerate(riders):
+            j._batch = self
+            j._member = m
+            j.launch = "batched"
+
+    def ready(self) -> bool:
+        try:
+            if self.handle is not None and not self.handle.ready():
+                return False
+        except Exception:  # noqa: BLE001 — readiness is advisory
+            return True
+        return all(j.ready() for j in self.jobs if j._batch is not self)
+
+    def fetch(self):
+        """The batch's single device->host sync; idempotent (every
+        member's :meth:`SessionJob.finish` goes through here)."""
+        if self._fetched is None:
+            self._fetched = self.handle.fetch()
+        return self._fetched
 
 
 def _incr_state_bytes(state: dict) -> int:

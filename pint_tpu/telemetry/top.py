@@ -61,6 +61,30 @@ def aggregate(per_host: dict[str, dict]) -> dict:
     for led in slo.values():
         led["burn_rate"] = (round(led["burn"] / led["total"], 6)
                             if led["total"] else 0.0)
+    # session-path health (ISSUE 20 satellite): the stateless rate and
+    # the batched-vs-solo launch split were only raw counters before —
+    # a GLS fleet silently full-refitting every append, or batching
+    # silently degrading to per-session launches, was invisible in the
+    # rollup. First-class, computed from the summed counters so the
+    # router's fleet_metrics() and the CLI agree by construction.
+    solo = counters.get("serve.session.launch.solo", 0)
+    batched = counters.get("serve.session.launch.batched", 0)
+    members = counters.get("serve.session.launch.batched_members", 0)
+    updates = (counters.get("serve.session.populate", 0)
+               + counters.get("serve.session.full_refit", 0)
+               + counters.get("serve.session.incremental", 0))
+    session_health = {
+        "stateless": counters.get("serve.session.stateless", 0),
+        "stateless_rate": (round(
+            counters.get("serve.session.stateless", 0) / updates, 6)
+            if updates else 0.0),
+        "launches_solo": solo,
+        "launches_batched": batched,
+        "batched_members": members,
+        "launches_per_update": (round(
+            (solo + batched) / (solo + members), 4)
+            if solo + members else None),
+    }
     return {
         "version": METRICS_SNAPSHOT_VERSION,
         "t": time.time(),
@@ -72,6 +96,7 @@ def aggregate(per_host: dict[str, dict]) -> dict:
         "replicas": sum(s.get("replicas", 0) for s in live.values()),
         "catalog_jobs": sum(s.get("catalog_jobs", 0)
                             for s in live.values()),
+        "session_health": session_health,
         "counters": counters,
         "slo": slo,
         "inflight_traces": sorted(inflight)[:256],

@@ -30,42 +30,14 @@ import jax  # noqa: E402
 # Persistent XLA compilation cache: ON by default for the suite
 # (round-7 measurement, docs/COMPILE_CACHE.md: cold 10:05, warm 6:35 vs
 # ~14:40 uncached on this host — the warm suite finally meets the 8:00
-# target). History: round 3 found this jaxlib's XLA:CPU AOT reload
-# unsafe here ("machine feature mismatch ... SIGILL", then a segfault
-# with two identical pipeline jits in one process), so the cache was
-# closed for three rounds; the round-7 re-measurement ran the full
-# suite cold AND fully-warm (every executable deserialized) green, so
-# the default flips. Opt OUT with PINT_TPU_JAX_CACHE=0 on hosts where
-# the reload misbehaves (the symptom is an XLA "machine feature
-# mismatch" log line followed by SIGILL/segfault); PINT_TPU_JAX_CACHE_DIR
-# overrides the location (default: <repo>/.jax_cache, gitignored).
-if os.environ.get("PINT_TPU_JAX_CACHE", "1") != "0":
-    def _host_cache_tag() -> str:
-        """Per-host cache subdir: the round-3 SIGILL mode was an
-        executable deserialized on a machine whose CPU features differ
-        from the writer's (e.g. one checkout on shared storage used
-        from two hosts). Keying the default dir by CPU model+flags
-        makes that cross-host reload impossible by construction."""
-        import hashlib
-        import platform
+# target). History, per-host tag rationale, and the opt-out knobs
+# (PINT_TPU_JAX_CACHE=0 / PINT_TPU_JAX_CACHE_DIR) live with the shared
+# implementation in pint_tpu.compile_cache — bench.py's --smoke child
+# uses the same cache so the CI-gate test doesn't recompile the world
+# in a fresh process every tier-1 run.
+from pint_tpu.compile_cache import enable_persistent_cache  # noqa: E402
 
-        ident = platform.machine()
-        try:
-            with open("/proc/cpuinfo") as fh:
-                for line in fh:
-                    if line.startswith(("model name", "flags")):
-                        ident += line
-                        if line.startswith("flags"):
-                            break
-        except OSError:
-            pass
-        return hashlib.md5(ident.encode()).hexdigest()[:12]
-
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("PINT_TPU_JAX_CACHE_DIR")
-                      or os.path.join(os.path.dirname(__file__), "..",
-                                      ".jax_cache", _host_cache_tag()))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+enable_persistent_cache(os.path.join(os.path.dirname(__file__), ".."))
 
 # under PINT_TPU_RUN_TPU_TESTS=1 the accelerator platform owns the
 # config and "cpu" may not be a registered backend at all — the opt-in

@@ -238,6 +238,67 @@ def test_cold_replay_without_replica_converges(toas, appends,
                    - (pc[k][0] + pc[k][1])) / sig < 1e-6
 
 
+def test_batched_drain_kill_restores_every_member(toas, appends):
+    """ISSUE 20 (vmapped multi-session commits): N sessions queue their
+    appends into ONE drain — the member axis — and the host pinned for
+    most members is SIGKILLed before that drain runs. Every member must
+    restore on its successor (warm adopt or cold replay, never a miss)
+    and land at parity with an uninterrupted control fleet, member by
+    member."""
+    N = 4
+
+    def run(kill=False):
+        router = build_fleet(2, max_queue=32)
+        for i in range(N):
+            router.submit(FitRequest(toas, _populate(),
+                                     session_id=f"m{i}", **HYPER))
+        assert all(r.status == "ok" for r in router.drain())
+        pins = {i: router._sticky[router._sid_last[f"m{i}"]]
+                for i in range(N)}
+        for i in range(N):
+            router.submit(FitRequest(appends[i % len(appends)], None,
+                                     session_id=f"m{i}", **HYPER))
+        victim = None
+        if kill:
+            hosts = list(pins.values())
+            victim = max(set(hosts), key=hosts.count)
+            router.hosts[victim].kill()
+        res = router.drain()
+        assert all(r.status == "ok" for r in res), \
+            [(r.status, r.error) for r in res]
+        return router, pins, victim
+
+    before = telemetry.counters_snapshot()
+    r_kill, pins, victim = run(kill=True)
+    delta = telemetry.counters_delta(before)
+    # pigeonhole: 4 sessions on 2 hosts -> the busiest host held >= 2
+    # members, so the kill interrupted a genuinely multi-member drain
+    n_victim = sum(1 for h in pins.values() if h == victim)
+    assert n_victim >= 2
+    assert (int(delta.get("fleet.session.restore.warm", 0))
+            + int(delta.get("fleet.session.restore.cold", 0))) >= n_victim
+    assert int(delta.get("fleet.session.restore_miss", 0)) == 0
+
+    before = telemetry.counters_snapshot()
+    r_ctrl, _, _ = run()
+    delta_c = telemetry.counters_delta(before)
+    # the control's append drain actually rode the member axis
+    assert int(delta_c.get("serve.session.launch.batched_members",
+                           0)) >= 2
+
+    for i in range(N):
+        _, ek = _entry_of(r_kill, f"m{i}")
+        _, ec = _entry_of(r_ctrl, f"m{i}")
+        pk, chi2k, nk = _solution(ek)
+        pc, chi2c, nc = _solution(ec)
+        assert nk == nc, i
+        assert abs(chi2k - chi2c) / abs(chi2c) < 1e-6, i
+        for k in pc:
+            sig = max(pc[k][2], 1e-300)
+            assert abs((pk[k][0] + pk[k][1])
+                       - (pc[k][0] + pc[k][1])) / sig < 1e-6, (i, k)
+
+
 # ----------------------------------------------------------------------
 # partitions: fencing (satellite 3) + the suspicion ladder (satellite 1)
 # ----------------------------------------------------------------------

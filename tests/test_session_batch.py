@@ -1,0 +1,376 @@
+"""Fleet-scale session batching (ISSUE 20): same-structure appends
+from many sessions drain as ONE vmapped rank-k launch, correlated-noise
+(GLS) sessions take the incremental Schur rank-k path instead of full
+refits, and every kill switch restores the solo paths.
+
+The WLS PAR matches tests/test_session.py and the noise PARs match
+tests/test_noise_gls.py so compiled programs are shared across files
+where shapes coincide (bucketing + process-global caches).
+"""
+
+import copy
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.fitting import device_loop
+from pint_tpu.models import get_model
+from pint_tpu.serve import FitRequest, ThroughputScheduler
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.telemetry import top
+from pint_tpu.toas import Flags, merge_TOAs
+
+PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+BASE_PAR = """
+PSRJ           J1748-2021E
+RAJ             17:48:52.75  1
+DECJ           -20:21:29.0  1
+F0             61.485476554  1
+F1             -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9
+EPHEM          DE421
+UNITS          TDB
+TZRMJD  53801.38605120074849
+TZRFRQ  1949.609
+TZRSITE 1
+"""
+
+NOISE_LINES = "EFAC -f fake 1.5\nEQUAD -f fake 0.8\n"
+ECORR_LINES = "ECORR -f fake 1.2\n"
+RED_LINES = "TNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 12\n"
+
+HYPER = dict(maxiter=10, min_chi2_decrease=1e-3, max_step_halvings=8)
+
+N = 4
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+
+
+def _toas(n, seed, lo=53000, hi=56000, par=PAR):
+    truth = get_model(par)
+    return make_fake_toas_uniform(lo, hi, n, truth, obs="gbt",
+                                  freq_mhz=np.array([1400.0, 430.0]),
+                                  error_us=1.0, add_noise=True, seed=seed)
+
+
+def _model(pert=2e-10, par=PAR):
+    m = get_model(par)
+    m["F0"].add_delta(pert)
+    return m
+
+
+def _flag(toas):
+    return dataclasses.replace(
+        toas, flags=Flags(dict(d, f="fake") for d in toas.flags))
+
+
+def _entry(s, sid):
+    return s.sessions.entries[s.sessions._by_sid[sid]]
+
+
+@pytest.fixture(scope="module")
+def fleet_problem():
+    """N independent sessions: same fingerprint + shapes, different
+    data — the batchable case."""
+    return {
+        "toas": [_toas(60, seed=700 + i) for i in range(N)],
+        "app": [_toas(5, seed=720 + i, lo=56010, hi=56040)
+                for i in range(N)],
+    }
+
+
+def _run_fleet(problem, *, n=N):
+    """Populate n sessions, then queue every session's append WITHOUT
+    draining — the caller owns the (batched) append drain."""
+    s = ThroughputScheduler(max_queue=4 * n)
+    for i in range(n):
+        s.submit(FitRequest(problem["toas"][i], _model(),
+                            session_id=f"s{i}", **HYPER))
+    res = s.drain()
+    assert [r.status for r in res] == ["ok"] * n
+    for i in range(n):
+        s.submit(FitRequest(problem["app"][i], None, session_id=f"s{i}",
+                            **HYPER))
+    return s
+
+
+# ----------------------------------------------------------------------
+# the tentpole: N sessions, ONE launch
+# ----------------------------------------------------------------------
+
+def test_batched_drain_is_one_launch(fleet_problem):
+    """N sessions' same-shape appends drain as one vmapped launch and
+    one fetch — counter-pinned, with the drain-record rollup."""
+    s = _run_fleet(fleet_problem)
+    before = telemetry.counters_snapshot()
+    res = s.drain()
+    delta = telemetry.counters_delta(before)
+    assert [r.status for r in res] == ["ok"] * N
+    assert all(r.session == "incremental" for r in res)
+    assert delta.get("fit.device_loop.launches", 0) == 1
+    assert delta.get("fit.device_loop.fetches", 0) == 1
+    assert delta.get("serve.session.launch.batched", 0) == 1
+    assert delta.get("serve.session.launch.batched_members", 0) == N
+    assert delta.get("serve.session.launch.solo", 0) == 0
+    blk = s.last_drain["sessions"]
+    assert blk["routes"] == {"incremental": N}
+    assert blk["launches"] == {"solo": 0, "batched": 1,
+                               "batched_members": N,
+                               "per_update": round(1 / N, 4)}
+    assert [d["kind"] for d in s.last_drain["batch_detail"]] \
+        == ["session_batch"]
+
+
+def test_batched_matches_solo(fleet_problem, monkeypatch):
+    """Every member of a batched drain commits the solution the solo
+    path commits: params, chi2, and the device state itself."""
+    def run(batch):
+        if batch:
+            monkeypatch.delenv("PINT_TPU_SESSION_BATCH", raising=False)
+        else:
+            monkeypatch.setenv("PINT_TPU_SESSION_BATCH", "0")
+        s = _run_fleet(fleet_problem)
+        res = s.drain()
+        assert [r.status for r in res] == ["ok"] * N
+        out = {}
+        for i in range(N):
+            e = _entry(s, f"s{i}")
+            out[i] = ({k: (e.model[k].value_f64, e.model[k].uncertainty)
+                       for k in e.model.free_params},
+                      e.chi2,
+                      {f: np.asarray(e.state[f])
+                       for f in ("L", "norm", "mu", "chi2")})
+        return out
+
+    a, b = run(True), run(False)
+    for i in range(N):
+        pa, chi2a, sa = a[i]
+        pb, chi2b, sb = b[i]
+        assert abs(chi2a - chi2b) <= 1e-9 * abs(chi2b), i
+        for k in pb:
+            sig = max(pb[k][1] or 0.0, 1e-300)
+            assert abs(pa[k][0] - pb[k][0]) / sig < 1e-7, (i, k)
+        for f in sb:
+            np.testing.assert_allclose(sa[f], sb[f], rtol=1e-7,
+                                       atol=1e-12, err_msg=f"{i}/{f}")
+
+
+def test_kill_switch_restores_solo_plans(fleet_problem, monkeypatch):
+    """PINT_TPU_SESSION_BATCH=0: every append plans as its own
+    ``session`` kind and launches solo — the pre-batching path."""
+    monkeypatch.setenv("PINT_TPU_SESSION_BATCH", "0")
+    s = _run_fleet(fleet_problem)
+    before = telemetry.counters_snapshot()
+    res = s.drain()
+    delta = telemetry.counters_delta(before)
+    assert [r.session for r in res] == ["incremental"] * N
+    assert delta.get("fit.device_loop.launches", 0) == N
+    assert delta.get("serve.session.launch.solo", 0) == N
+    assert delta.get("serve.session.launch.batched", 0) == 0
+    assert {d["kind"] for d in s.last_drain["batch_detail"]} \
+        == {"session"}
+    blk = s.last_drain["sessions"]["launches"]
+    assert blk["solo"] == N and blk["batched"] == 0
+    assert blk["per_update"] == 1.0
+
+
+def test_batch_max_width_chunks(fleet_problem, monkeypatch):
+    """The width cap chunks a too-wide group into several batched
+    launches instead of one oversized member axis."""
+    monkeypatch.setenv("PINT_TPU_SESSION_BATCH_MAX", "2")
+    s = _run_fleet(fleet_problem)
+    res = s.drain()
+    assert [r.status for r in res] == ["ok"] * N
+    blk = s.last_drain["sessions"]["launches"]
+    assert blk == {"solo": 0, "batched": 2, "batched_members": N,
+                   "per_update": 0.5}
+
+
+def test_mixed_append_shapes_group_separately(fleet_problem):
+    """Different append buckets never share a member axis: two 8-bucket
+    appends batch, the 16-bucket one launches solo."""
+    s = ThroughputScheduler(max_queue=16)
+    for i in range(3):
+        s.submit(FitRequest(fleet_problem["toas"][i], _model(),
+                            session_id=f"m{i}", **HYPER))
+    assert all(r.status == "ok" for r in s.drain())
+    s.submit(FitRequest(fleet_problem["app"][0], None, session_id="m0",
+                        **HYPER))
+    s.submit(FitRequest(fleet_problem["app"][1], None, session_id="m1",
+                        **HYPER))
+    s.submit(FitRequest(_toas(12, seed=760, lo=56010, hi=56040), None,
+                        session_id="m2", **HYPER))
+    res = s.drain()
+    assert all(r.status == "ok" for r in res)
+    assert all(r.session == "incremental" for r in res)
+    blk = s.last_drain["sessions"]["launches"]
+    assert blk["batched"] == 1 and blk["batched_members"] == 2
+    assert blk["solo"] == 1
+
+
+def test_gated_members_peel_to_solo(fleet_problem, monkeypatch):
+    """Members whose dispatch-time route is NOT incremental (here: the
+    append gate trips) peel out of the batch and take their usual solo
+    path; nothing batches, everything still lands ok."""
+    s = ThroughputScheduler(max_queue=16)
+    for i in range(2):
+        s.submit(FitRequest(fleet_problem["toas"][i], _model(),
+                            session_id=f"p{i}", **HYPER))
+    assert all(r.status == "ok" for r in s.drain())
+    monkeypatch.setenv("PINT_TPU_SESSION_MAX_APPENDS", "0")
+    for i in range(2):
+        s.submit(FitRequest(fleet_problem["app"][i], None,
+                            session_id=f"p{i}", **HYPER))
+    before = telemetry.counters_snapshot()
+    res = s.drain()
+    delta = telemetry.counters_delta(before)
+    assert [r.status for r in res] == ["ok"] * 2
+    assert [r.session for r in res] == ["full_refit"] * 2
+    assert delta.get("serve.session.launch.batched", 0) == 0
+    assert delta.get("serve.session.refit.append_gate", 0) == 2
+
+
+# ----------------------------------------------------------------------
+# GLS sessions: the incremental Schur rank-k path (satellite 4)
+# ----------------------------------------------------------------------
+
+GLS_STRUCTURES = {
+    "white": NOISE_LINES,
+    "ecorr": NOISE_LINES + ECORR_LINES,
+    "red": NOISE_LINES + ECORR_LINES + RED_LINES,
+}
+
+
+@pytest.fixture(scope="module")
+def gls_problem():
+    """One base+append TOA pair shared by every GLS test: the noise
+    structure under test lives in the MODEL par, so the TOA data
+    (simulated from the noiseless BASE_PAR truth) can be identical
+    across structures — each test still runs its own session."""
+    return {"toas": _flag(_toas(60, seed=800, par=BASE_PAR)),
+            "app": _flag(_toas(5, seed=801, lo=56010, hi=56040,
+                               par=BASE_PAR))}
+
+
+@pytest.mark.parametrize("structure", sorted(GLS_STRUCTURES))
+def test_gls_incremental_matches_warm_refit(structure, gls_problem):
+    """A correlated-noise append takes the rank-k Schur update — one
+    launch, zero stateless refits — and lands where a warm full refit
+    over the merged table lands (parameter-uncertainty-relative),
+    across white/ecorr/red noise structures. EFAC/EQUAD-only models
+    are family "wls" by design (white noise rides the scaled
+    uncertainties; no noise basis to marginalize)."""
+    par = BASE_PAR + GLS_STRUCTURES[structure]
+    family = "wls" if structure == "white" else "gls"
+    toas, app = gls_problem["toas"], gls_problem["app"]
+    s = ThroughputScheduler(max_queue=8)
+    s.submit(FitRequest(toas, _model(par=par), session_id="g", **HYPER))
+    r0 = s.drain()[0]
+    assert r0.status == "ok" and r0.session == "populate"
+    e = _entry(s, "g")
+    assert e.family == family and e.state is not None
+    warm = copy.deepcopy(e.model)
+
+    before = telemetry.counters_snapshot()
+    s.submit(FitRequest(app, None, session_id="g", **HYPER))
+    r = s.drain()[0]
+    delta = telemetry.counters_delta(before)
+    assert r.status == "ok" and r.session == "incremental"
+    assert delta.get("serve.session.stateless", 0) == 0
+    assert delta.get("fit.incremental.gls_dispatched", 0) \
+        == (1 if family == "gls" else 0)
+    assert delta.get("fit.device_loop.launches", 0) == 1
+
+    # warm full-refit oracle over the merged table
+    m_full = copy.deepcopy(warm)
+    merged = merge_TOAs([toas, app])
+    dense = (device_loop.dense_gls_fit if family == "gls"
+             else device_loop.dense_wls_fit)
+    d, info_f, chi2_full, conv_f, _ = dense(merged, m_full, **HYPER)
+    assert conv_f
+    for k in warm.free_params:
+        v_full = warm[k].value_f64 + float(np.asarray(d[k]))
+        sig = float(np.asarray(info_f["errors"][k]))
+        assert abs(e.model[k].value_f64 - v_full) <= 0.1 * sig, \
+            (structure, k)
+    rel = abs(float(r.chi2) - float(chi2_full)) / abs(float(chi2_full))
+    assert rel < 0.05, (structure, rel)
+
+
+def test_gls_kill_switch_goes_stateless(gls_problem, monkeypatch):
+    """PINT_TPU_SESSION_GLS=0: correlated-noise sessions hold no device
+    state and every append full-refits (the pre-PR behavior)."""
+    monkeypatch.setenv("PINT_TPU_SESSION_GLS", "0")
+    par = BASE_PAR + NOISE_LINES + ECORR_LINES
+    toas, app = gls_problem["toas"], gls_problem["app"]
+    s = ThroughputScheduler(max_queue=8)
+    before = telemetry.counters_snapshot()
+    s.submit(FitRequest(toas, _model(par=par), session_id="k", **HYPER))
+    assert s.drain()[0].status == "ok"
+    e = _entry(s, "k")
+    assert e.family is None and e.state is None
+    s.submit(FitRequest(app, None, session_id="k", **HYPER))
+    r = s.drain()[0]
+    delta = telemetry.counters_delta(before)
+    assert r.status == "ok" and r.session == "full_refit"
+    assert delta.get("serve.session.stateless", 0) >= 2
+
+
+# ----------------------------------------------------------------------
+# fleet rollup (satellite: telemetry.top / fleet_metrics)
+# ----------------------------------------------------------------------
+
+def test_session_health_rollup():
+    """top.aggregate folds the launch/stateless counters into the
+    first-class session_health block."""
+    agg = top.aggregate({
+        "h0": {"counters": {"serve.session.launch.solo": 2,
+                            "serve.session.launch.batched": 1,
+                            "serve.session.launch.batched_members": 4,
+                            "serve.session.populate": 4,
+                            "serve.session.incremental": 6,
+                            "serve.session.stateless": 1},
+               "slo": {}, "queue_depth": 0},
+        "h1": {"counters": {"serve.session.launch.solo": 1},
+               "slo": {}, "queue_depth": 0},
+    })
+    sh = agg["session_health"]
+    assert sh["launches_solo"] == 3
+    assert sh["launches_batched"] == 1
+    assert sh["batched_members"] == 4
+    assert sh["launches_per_update"] == round(4 / 7, 4)
+    assert sh["stateless"] == 1
+    assert sh["stateless_rate"] == round(1 / 10, 6)
+
+
+def test_session_health_empty_fleet():
+    agg = top.aggregate({"h0": {"counters": {}, "slo": {},
+                                "queue_depth": 0}})
+    sh = agg["session_health"]
+    assert sh["launches_per_update"] is None
+    assert sh["stateless_rate"] == 0.0
